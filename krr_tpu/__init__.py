@@ -1,0 +1,20 @@
+"""krr_tpu — a TPU-native Kubernetes Resource Recommender.
+
+Same capabilities and plugin surface as the reference robusta-krr (see
+SURVEY.md), with the per-pod Python percentile loop replaced by batched
+JAX/Pallas kernels over the whole fleet.
+"""
+
+__version__ = "0.1.0"
+
+
+def run() -> None:
+    """CLI entry point. Defining a strategy/formatter subclass before calling
+    this registers it as a new sub-command / format option (same plugin
+    contract as the reference, `/root/reference/examples/custom_strategy.py`)."""
+    from krr_tpu.main import run as _run
+
+    _run()
+
+
+__all__ = ["run", "__version__"]

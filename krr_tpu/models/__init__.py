@@ -1,0 +1,27 @@
+from krr_tpu.models.allocations import (
+    NONE_ALLOCATIONS,
+    RecommendationValue,
+    ResourceAllocations,
+    ResourceType,
+    parse_resource_value,
+)
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import Recommendation, ResourceRecommendation, ResourceScan, Result, Severity
+from krr_tpu.models.series import FleetBatch, PackedSeries, RaggedHistory
+
+__all__ = [
+    "NONE_ALLOCATIONS",
+    "RecommendationValue",
+    "ResourceAllocations",
+    "ResourceType",
+    "parse_resource_value",
+    "K8sObjectData",
+    "Recommendation",
+    "ResourceRecommendation",
+    "ResourceScan",
+    "Result",
+    "Severity",
+    "FleetBatch",
+    "PackedSeries",
+    "RaggedHistory",
+]

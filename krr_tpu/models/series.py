@@ -1,0 +1,95 @@
+"""The fleet batch: usage history for every scannable object, in both the
+reference-compatible ragged form and the packed TPU form.
+
+This is the structure the Runner hands to strategies. Plugin strategies written
+against the reference contract (`BaseStrategy.run(history_data, object_data)`)
+consume the ragged view; TPU-native strategies consume the packed arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Mapping
+
+import numpy as np
+
+from krr_tpu.models.allocations import ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.ops.packing import pack_ragged
+
+#: Reference-shaped history for one object: pod name → samples.
+RaggedHistory = dict[str, np.ndarray]
+
+
+@dataclass
+class PackedSeries:
+    """Left-justified packed samples: ``values[i, :counts[i]]`` are real."""
+
+    values: np.ndarray  # [N, T] float64
+    counts: np.ndarray  # [N] int32
+
+    @property
+    def num_rows(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[1]
+
+
+@dataclass
+class FleetBatch:
+    """Everything a strategy needs to right-size the whole fleet in one call."""
+
+    objects: list[K8sObjectData]
+    ragged: dict[ResourceType, list[RaggedHistory]]
+    _packed: dict[ResourceType, PackedSeries] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.objects)
+
+    def packed(self, resource: ResourceType) -> PackedSeries:
+        """Packed [N, T] view for one resource (cached)."""
+        if resource not in self._packed:
+            values, counts = pack_ragged(self.ragged[resource])
+            self._packed[resource] = PackedSeries(values=values, counts=counts)
+        return self._packed[resource]
+
+    def history_for(self, index: int) -> dict[ResourceType, dict[str, list[Decimal]]]:
+        """Reference-shaped ``HistoryData`` for one object (Decimal samples) —
+        the compatibility path for per-object plugin strategies."""
+        return {
+            resource: {pod: [Decimal(repr(float(v))) for v in samples] for pod, samples in per_object[index].items()}
+            for resource, per_object in self.ragged.items()
+        }
+
+    @classmethod
+    def build(
+        cls,
+        objects: list[K8sObjectData],
+        histories: Mapping[ResourceType, list[RaggedHistory]],
+    ) -> "FleetBatch":
+        assert all(len(objects) == len(v) for v in histories.values())
+        return cls(objects=objects, ragged=dict(histories))
+
+    @classmethod
+    def from_history(
+        cls,
+        history_data: Mapping[ResourceType, Mapping[str, "list[Decimal] | np.ndarray"]],
+        object_data: K8sObjectData,
+    ) -> "FleetBatch":
+        """Wrap one object's reference-shaped ``HistoryData`` into a singleton
+        batch — the per-object → batched compatibility shim."""
+        return cls.build(
+            [object_data],
+            {
+                resource: [
+                    {
+                        pod: np.asarray([float(v) for v in samples], dtype=np.float64)
+                        for pod, samples in history_data.get(resource, {}).items()
+                    }
+                ]
+                for resource in ResourceType
+            },
+        )

@@ -1,0 +1,4 @@
+from krr_tpu.ops import digest, packing, quantile
+from krr_tpu.ops.packing import pack_ragged
+
+__all__ = ["digest", "packing", "quantile", "pack_ragged"]

@@ -1,0 +1,163 @@
+"""Mergeable quantile digest over log-spaced buckets (DDSketch-style).
+
+This is the sketch behind the ``tdigest`` strategy — the piece that makes the
+7-day @ 5 s time axis tractable (SURVEY.md §5 "long-context"): the raw
+``[containers × timesteps]`` matrix at fleet scale doesn't fit in HBM, so the
+time axis is processed in chunks, each chunk reduced to a fixed-size digest,
+and digests merged. Merging is a pure addition of bucket counts, which makes
+
+* chunked/streaming builds (``lax`` over time blocks),
+* device-parallel builds (``psum`` over a mesh axis), and
+* checkpoint/resume + incremental multi-source re-merge (add old + new counts)
+
+all the *same* associative operation. This is the TPU-idiomatic replacement
+for a centroid-based t-digest: centroid merging is sort-heavy and
+data-dependent (dynamic shapes), while log-bucket counts are static-shape,
+vectorizable, and give a *guaranteed relative value error* of
+``(sqrt(gamma) - 1)`` per quantile — 0.5 % at the default ``gamma = 1.01``,
+comfortably inside the ±1 % parity gate (BASELINE.md).
+
+Bucket layout: bucket 0 is the underflow bucket (values ≤ ``min_value``,
+including idle-CPU zeros, estimated as 0); bucket ``j ≥ 1`` covers
+``[min_value * gamma^(j-1), min_value * gamma^j)`` and is estimated by its
+geometric midpoint. The digest also tracks the exact per-row max (memory
+recommendations need it exactly) and total count.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class DigestSpec:
+    """Static configuration of the digest (shapes what XLA compiles)."""
+
+    gamma: float = 1.01
+    min_value: float = 1e-7
+    num_buckets: int = 2560
+
+    @property
+    def log_gamma(self) -> float:
+        return math.log(self.gamma)
+
+    @property
+    def max_value(self) -> float:
+        """Largest value representable without clipping into the top bucket."""
+        return self.min_value * self.gamma ** (self.num_buckets - 2)
+
+    @property
+    def relative_error(self) -> float:
+        return math.sqrt(self.gamma) - 1.0
+
+
+class Digest(NamedTuple):
+    """Per-row digest state — a pytree, shardable and psum-able."""
+
+    counts: jax.Array  # [N, B] float32 bucket counts (exact integers)
+    total: jax.Array  # [N] float32 total sample count
+    peak: jax.Array  # [N] float32 exact max (-inf when empty)
+
+
+def empty(spec: DigestSpec, num_rows: int) -> Digest:
+    return Digest(
+        counts=jnp.zeros((num_rows, spec.num_buckets), dtype=jnp.float32),
+        total=jnp.zeros((num_rows,), dtype=jnp.float32),
+        peak=jnp.full((num_rows,), -jnp.inf, dtype=jnp.float32),
+    )
+
+
+def bucketize(spec: DigestSpec, values: jax.Array) -> jax.Array:
+    """Map values to bucket indices (int32). Values ≤ min_value → bucket 0."""
+    safe = jnp.maximum(values, spec.min_value)
+    raw = jnp.floor(jnp.log(safe / spec.min_value) / spec.log_gamma).astype(jnp.int32)
+    idx = 1 + jnp.clip(raw, 0, spec.num_buckets - 2)
+    return jnp.where(values <= spec.min_value, 0, idx)
+
+
+def _histogram(spec: DigestSpec, idx: jax.Array, valid: jax.Array) -> jax.Array:
+    """Per-row bucket counts from bucket indices, via sort + rank difference.
+
+    Sort-based counting keeps everything dense (no scatter): invalid entries
+    get a sentinel index that sorts past every real bucket, then the count of
+    bucket ``b`` is the rank difference of ``b``'s first/last occurrence,
+    recovered with a batched searchsorted.
+    """
+    b = spec.num_buckets
+    sentinel = jnp.int32(b)
+    sorted_idx = jnp.sort(jnp.where(valid, idx, sentinel), axis=1)
+    queries = jnp.arange(b, dtype=jnp.int32)
+    cum = jax.vmap(lambda row: jnp.searchsorted(row, queries, side="right", method="sort"))(sorted_idx)
+    return jnp.diff(cum, axis=1, prepend=0).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def add_chunk(spec: DigestSpec, digest: Digest, values: jax.Array, valid: jax.Array) -> Digest:
+    """Fold one ``[N, Tc]`` time chunk (with validity mask) into the digest."""
+    idx = bucketize(spec, values)
+    counts = digest.counts + _histogram(spec, idx, valid)
+    total = digest.total + jnp.sum(valid, axis=1).astype(jnp.float32)
+    peak = jnp.maximum(digest.peak, jnp.max(jnp.where(valid, values, -jnp.inf), axis=1))
+    return Digest(counts=counts, total=total, peak=peak)
+
+
+def merge(a: Digest, b: Digest) -> Digest:
+    """Associative, commutative merge — also the cross-device collective body."""
+    return Digest(counts=a.counts + b.counts, total=a.total + b.total, peak=jnp.maximum(a.peak, b.peak))
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def percentile(spec: DigestSpec, digest: Digest, q: jax.Array | float) -> jax.Array:
+    """Per-row q-th percentile estimate with reference rank semantics
+    (``rank = floor((n - 1) * q / 100)``). NaN for empty rows."""
+    rank = jnp.floor((digest.total - 1.0) * jnp.float32(q) / 100.0)
+    rank = jnp.maximum(rank, 0.0)
+    cum = jnp.cumsum(digest.counts, axis=1)
+    k = jnp.argmax(cum > rank[:, None], axis=1).astype(jnp.float32)
+    estimate = jnp.where(
+        k == 0,
+        0.0,
+        spec.min_value * jnp.exp((k - 0.5) * spec.log_gamma),
+    )
+    # The digest never needs to report beyond the exactly-tracked max.
+    estimate = jnp.minimum(estimate, digest.peak)
+    return jnp.where(digest.total > 0, estimate, jnp.nan)
+
+
+def peak(digest: Digest) -> jax.Array:
+    """Exact per-row max; NaN for empty rows."""
+    return jnp.where(digest.total > 0, digest.peak, jnp.nan)
+
+
+def build_from_packed(
+    spec: DigestSpec, values: jax.Array, counts: jax.Array, chunk_size: int = 4096
+) -> Digest:
+    """Build a digest from a packed ``[N, T]`` array by scanning time chunks.
+
+    The chunked build is bit-identical to a one-shot build (merge is exact
+    integer addition), so tests pin ``chunked == one-shot`` — and the same
+    code path serves true streaming, where chunks arrive from the fetch
+    pipeline over time.
+    """
+    n, t = values.shape
+    pad = (-t) % chunk_size
+    if pad:
+        values = jnp.pad(values, ((0, 0), (0, pad)))
+    num_chunks = values.shape[1] // chunk_size
+    chunks = jnp.moveaxis(values.reshape(n, num_chunks, chunk_size), 1, 0)
+    offsets = jnp.arange(num_chunks, dtype=jnp.int32) * chunk_size
+
+    def step(digest: Digest, inp: tuple[jax.Array, jax.Array]) -> tuple[Digest, None]:
+        chunk, offset = inp
+        local = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + offset
+        valid = local < counts[:, None]
+        return add_chunk(spec, digest, chunk, valid), None
+
+    digest, _ = jax.lax.scan(step, empty(spec, n), (chunks, offsets))
+    return digest

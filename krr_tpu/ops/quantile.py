@@ -1,0 +1,59 @@
+"""Exact batched reductions over packed usage history.
+
+These replace the reference's per-object Python loops
+(`/root/reference/robusta_krr/strategies/simple.py:24-36`) with one fused XLA
+program over the whole fleet: sort/argmax over ``[N, T]`` with mask handling,
+compiled once and reused for any fleet of the same padded shape.
+
+Percentile semantics follow the reference's *documented* intent — the value at
+sorted index ``floor((n - 1) * q / 100)`` — not its literal unsorted-indexing
+quirk (`simple.py:32-36`; divergence noted in SURVEY.md §7). Empty rows
+(count == 0) return NaN, which the host edge converts to ``"?"``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _row_mask(counts: jax.Array, capacity: int) -> jax.Array:
+    """[N, T] validity mask from per-row counts (left-justified packing)."""
+    return jnp.arange(capacity, dtype=jnp.int32)[None, :] < counts[:, None]
+
+
+@jax.jit
+def masked_percentile(values: jax.Array, counts: jax.Array, q: jax.Array | float) -> jax.Array:
+    """Per-row percentile of the first ``counts[i]`` entries of ``values[i]``.
+
+    Returns the element at sorted index ``floor((count - 1) * q / 100)`` —
+    an actual sample, like the reference — or NaN for empty rows.
+    """
+    n, t = values.shape
+    mask = _row_mask(counts, t)
+    # Padding sorts to the top and is never selected (index < count <= first pad).
+    padded = jnp.where(mask, values, jnp.inf)
+    ordered = jnp.sort(padded, axis=1)
+    idx = jnp.floor((counts.astype(jnp.float32) - 1.0) * jnp.float32(q) / 100.0).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, t - 1)
+    picked = jnp.take_along_axis(ordered, idx[:, None], axis=1)[:, 0]
+    return jnp.where(counts > 0, picked, jnp.nan)
+
+
+@jax.jit
+def masked_max(values: jax.Array, counts: jax.Array) -> jax.Array:
+    """Per-row max of the valid prefix; NaN for empty rows."""
+    n, t = values.shape
+    mask = _row_mask(counts, t)
+    peak = jnp.max(jnp.where(mask, values, -jnp.inf), axis=1)
+    return jnp.where(counts > 0, peak, jnp.nan)
+
+
+@jax.jit
+def masked_sum_count(values: jax.Array, counts: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row (sum, count) over the valid prefix — building block for means
+    and for observability counters."""
+    mask = _row_mask(counts, values.shape[1])
+    return jnp.sum(jnp.where(mask, values, 0.0), axis=1), counts.astype(jnp.float32)

@@ -1,0 +1,25 @@
+from krr_tpu.strategies.base import (
+    BatchedStrategy,
+    AnyStrategy,
+    BaseStrategy,
+    HistoryData,
+    ResourceRecommendation,
+    RunResult,
+    StrategySettings,
+)
+from krr_tpu.strategies.simple import SimpleStrategy, SimpleStrategySettings
+from krr_tpu.strategies.tdigest import TDigestStrategy, TDigestStrategySettings
+
+__all__ = [
+    "AnyStrategy",
+    "BaseStrategy",
+    "BatchedStrategy",
+    "HistoryData",
+    "ResourceRecommendation",
+    "RunResult",
+    "StrategySettings",
+    "SimpleStrategy",
+    "SimpleStrategySettings",
+    "TDigestStrategy",
+    "TDigestStrategySettings",
+]

@@ -1,0 +1,170 @@
+"""Strategy plugin boundary: settings, base class, and registry.
+
+This preserves the reference's load-bearing design idea (SURVEY.md §1): a user
+script that merely *defines* a ``BaseStrategy`` subclass and then calls
+``krr_tpu.run()`` gets a new CLI sub-command for free, with the strategy's
+pydantic settings fields surfaced as ``--flags``. Differences from the
+reference implementation (`/root/reference/robusta_krr/core/abstract/strategies.py`):
+
+* registration happens eagerly via ``__init_subclass__`` into an explicit
+  registry (instead of walking ``__subclasses__()`` at call time);
+* the CLI reflects settings fields programmatically (no ``exec`` templates);
+* strategies get a **batched** entry point, ``run_batch(FleetBatch)``, which is
+  where the TPU path lives. Plugins written against the reference's per-object
+  ``run(history_data, object_data)`` contract still work: the default
+  ``run_batch`` falls back to calling ``run`` per object.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime
+from dataclasses import dataclass
+from decimal import Decimal
+from typing import Generic, Optional, TypeVar, get_args, get_origin
+
+import pydantic as pd
+
+from krr_tpu.models.allocations import ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.series import FleetBatch
+
+
+@dataclass
+class ResourceRecommendation:
+    """Raw (pre-rounding) recommendation for one resource of one object."""
+
+    request: Optional[Decimal]
+    limit: Optional[Decimal]
+
+
+#: Reference-shaped history: resource → pod → samples.
+HistoryData = dict[ResourceType, dict[str, list[Decimal]]]
+RunResult = dict[ResourceType, ResourceRecommendation]
+
+
+class StrategySettings(pd.BaseModel):
+    """Base settings every strategy inherits; fields become CLI flags.
+
+    Defaults match the reference: two weeks of history at a 15-minute step
+    (`/root/reference/robusta_krr/core/abstract/strategies.py:20-23`).
+    """
+
+    history_duration: float = pd.Field(24 * 7 * 2, ge=1, description="The duration of the history data to use (in hours).")
+    timeframe_duration: float = pd.Field(15, ge=1, description="The step for the history data (in minutes).")
+
+    @property
+    def history_timedelta(self) -> datetime.timedelta:
+        return datetime.timedelta(hours=self.history_duration)
+
+    @property
+    def timeframe_timedelta(self) -> datetime.timedelta:
+        return datetime.timedelta(minutes=self.timeframe_duration)
+
+
+_S = TypeVar("_S", bound=StrategySettings)
+
+_STRATEGY_REGISTRY: dict[str, type["BaseStrategy"]] = {}
+
+
+def _strip_postfix(name: str, postfix: str) -> str:
+    return name[: -len(postfix)] if name.lower().endswith(postfix.lower()) else name
+
+
+class BaseStrategy(abc.ABC, Generic[_S]):
+    """Base class for recommendation strategies.
+
+    Class attributes:
+        __display_name__: CLI name; defaults to the class name with the
+            ``Strategy`` postfix stripped, lowercased (``SimpleStrategy`` →
+            ``simple``). Override explicitly to customize.
+    """
+
+    __display_name__: str
+
+    settings: _S
+
+    def __init_subclass__(cls, **kwargs: object) -> None:
+        super().__init_subclass__(**kwargs)
+        # Register only concrete strategies (ones that implement `run`);
+        # intermediate abstract bases stay out of the CLI, either by not
+        # defining `run` or by opting out with `__register__ = False`.
+        if cls.run is not BaseStrategy.run and cls.__dict__.get("__register__", True):
+            name = cls.__dict__.get("__display_name__") or _strip_postfix(cls.__name__, "Strategy")
+            cls.__display_name__ = name
+            _STRATEGY_REGISTRY[name.lower()] = cls
+
+    def __init__(self, settings: _S):
+        self.settings = settings
+
+    def __str__(self) -> str:
+        return self.__display_name__.title()
+
+    # ------------------------------------------------------------------ API
+    @abc.abstractmethod
+    def run(self, history_data: HistoryData, object_data: K8sObjectData) -> RunResult:
+        """Per-object recommendation (reference-compatible plugin contract)."""
+
+    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
+        """Fleet-wide recommendation. TPU-native strategies override this with
+        a batched kernel; the default loops ``run`` per object (compat path
+        for plugins written the reference way)."""
+        return [self.run(batch.history_for(i), obj) for i, obj in enumerate(batch.objects)]
+
+    # ----------------------------------------------------------- reflection
+    @classmethod
+    def find(cls, name: str) -> type["BaseStrategy"]:
+        strategies = cls.get_all()
+        if name.lower() in strategies:
+            return strategies[name.lower()]
+        raise ValueError(f"Unknown strategy name: {name}. Available strategies: {', '.join(strategies)}")
+
+    @classmethod
+    def get_all(cls) -> dict[str, type["BaseStrategy"]]:
+        # Importing the built-in package registers the default strategies.
+        import krr_tpu.strategies as _  # noqa: F401
+
+        return dict(_STRATEGY_REGISTRY)
+
+    @classmethod
+    def get_settings_type(cls) -> type[StrategySettings]:
+        """Recover the settings model from the generic parameter
+        (``class MyStrategy(BaseStrategy[MySettings])``)."""
+        for klass in cls.__mro__:
+            for base in getattr(klass, "__orig_bases__", ()):
+                origin = get_origin(base)
+                if isinstance(origin, type) and issubclass(origin, BaseStrategy):
+                    for arg in get_args(base):
+                        if isinstance(arg, type) and issubclass(arg, StrategySettings):
+                            return arg
+        return StrategySettings
+
+
+class BatchedStrategy(BaseStrategy[_S]):
+    """Base for TPU-native strategies whose primary entry point is the batched
+    kernel: subclasses implement ``run_batch`` and inherit a ``run`` that wraps
+    one object into a singleton batch."""
+
+    __register__ = False  # intermediate base — not a CLI strategy itself
+
+    def run(self, history_data: HistoryData, object_data: K8sObjectData) -> RunResult:
+        return self.run_batch(FleetBatch.from_history(history_data, object_data))[0]
+
+    @abc.abstractmethod
+    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
+        ...
+
+
+AnyStrategy = BaseStrategy[StrategySettings]
+
+__all__ = [
+    "AnyStrategy",
+    "BaseStrategy",
+    "BatchedStrategy",
+    "StrategySettings",
+    "HistoryData",
+    "RunResult",
+    "ResourceRecommendation",
+    "K8sObjectData",
+    "ResourceType",
+]

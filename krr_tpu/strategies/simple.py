@@ -1,0 +1,102 @@
+"""The ``simple`` strategy: p99-CPU request, max+buffer memory request/limit.
+
+Behavior-compatible with `/root/reference/robusta_krr/strategies/simple.py`
+with one documented correction: the reference indexes the *unsorted* flattened
+sample list at the percentile position (`simple.py:32-36`), while its README
+documents a true 99th percentile — we compute the true (sorted) percentile,
+matching the documented intent (SURVEY.md §7 "quirks").
+
+TPU path: instead of flattening per-object Python lists, the whole fleet's
+packed ``[N, T]`` array is reduced in one jitted program (sort + gather for
+CPU, masked max for memory). The memory buffer multiplication and all rounding
+stay on the host in exact Decimal arithmetic, so parity with the reference is
+decided by integer ceilings, not float rounding.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pydantic as pd
+
+from krr_tpu.core.rounding import as_decimal
+from krr_tpu.models.allocations import ResourceType
+from krr_tpu.models.series import FleetBatch
+from krr_tpu.ops.quantile import masked_max, masked_percentile
+from krr_tpu.strategies.base import BatchedStrategy, ResourceRecommendation, RunResult, StrategySettings
+
+#: Memory samples are byte counts that overflow float32's 24-bit mantissa;
+#: scaling to (decimal) megabytes before device transfer keeps every value the
+#: rounding layer can distinguish exactly representable (SURVEY.md §7 "Hard parts").
+MEMORY_SCALE = 1_000_000.0
+
+
+def finalize_fleet(
+    cpu_values: np.ndarray,
+    memory_mb_values: np.ndarray,
+    memory_buffer_percentage: Decimal,
+    cpu_limit: Optional[np.ndarray] = None,
+) -> list[RunResult]:
+    """Host Decimal edge shared by the batched strategies: convert device
+    reductions into per-object raw recommendations.
+
+    * CPU: request = the selected percentile sample; **no limit** (reference
+      `simple.py:47`).
+    * Memory: request = limit = max × (1 + buffer/100), multiplied in Decimal
+      (reference `simple.py:24-29`).
+    """
+    buffer_factor = 1 + memory_buffer_percentage / 100
+    results: list[RunResult] = []
+    for i in range(len(cpu_values)):
+        cpu_request = as_decimal(cpu_values[i])
+        mem_mb = as_decimal(memory_mb_values[i])
+        mem_value = mem_mb * 1_000_000 * buffer_factor if not mem_mb.is_nan() else Decimal("nan")
+        results.append(
+            {
+                ResourceType.CPU: ResourceRecommendation(
+                    request=cpu_request,
+                    limit=as_decimal(cpu_limit[i]) if cpu_limit is not None else None,
+                ),
+                ResourceType.Memory: ResourceRecommendation(request=mem_value, limit=mem_value),
+            }
+        )
+    return results
+
+
+def fleet_device_arrays(batch: FleetBatch, resource: ResourceType, scale: float = 1.0):
+    """Packed host arrays → (float32 device values, int32 device counts)."""
+    packed = batch.packed(resource)
+    values = jnp.asarray(packed.values / scale if scale != 1.0 else packed.values, dtype=jnp.float32)
+    counts = jnp.asarray(packed.counts, dtype=jnp.int32)
+    return values, counts
+
+
+class SimpleStrategySettings(StrategySettings):
+    cpu_percentile: Decimal = pd.Field(
+        Decimal(99), gt=0, le=100, description="The percentile to use for the CPU recommendation."
+    )
+    memory_buffer_percentage: Decimal = pd.Field(
+        Decimal(5), gt=0, description="The percentage of added buffer to the peak memory usage for memory recommendation."
+    )
+
+
+class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
+    """Exact batched reductions — the correctness oracle for the sketch path."""
+
+    __display_name__ = "simple"
+
+    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
+        if not batch.objects:
+            return []
+        cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+        mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+
+        cpu_p = masked_percentile(cpu_values, cpu_counts, float(self.settings.cpu_percentile))
+        mem_max = masked_max(mem_values, mem_counts)
+
+        return finalize_fleet(
+            np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage
+        )

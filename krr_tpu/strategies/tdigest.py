@@ -1,0 +1,63 @@
+"""The ``tdigest`` strategy: sketch-based quantiles for fleet-scale history.
+
+Same recommendation semantics as ``simple`` (p-percentile CPU request, max ×
+buffer memory), but the CPU percentile comes from a mergeable log-bucket
+digest (`krr_tpu.ops.digest`) built by streaming the time axis in chunks —
+this is the path that scales to 7 d @ 5 s × 100 k containers, where the raw
+matrix doesn't fit in HBM. Memory needs only the exact per-row max, which is a
+cheap masked running reduction — no digest required — so memory
+recommendations are *identical* to ``simple``; CPU carries the digest's
+guaranteed relative error (0.5 % at the default gamma), inside the ±1 % gate.
+
+The digest state is mergeable (counts add), which is also what powers
+multi-device psum merges (`krr_tpu.parallel`), incremental multi-source
+re-merge, and checkpoint/resume (BASELINE.md configs 3-5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pydantic as pd
+
+from krr_tpu.models.allocations import ResourceType
+from krr_tpu.models.series import FleetBatch
+from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops.digest import DigestSpec
+from krr_tpu.ops.quantile import masked_max
+from krr_tpu.strategies.base import BatchedStrategy, RunResult
+from krr_tpu.strategies.simple import (
+    MEMORY_SCALE,
+    SimpleStrategySettings,
+    finalize_fleet,
+    fleet_device_arrays,
+)
+
+
+class TDigestStrategySettings(SimpleStrategySettings):
+    digest_gamma: float = pd.Field(
+        1.01, gt=1, description="Log-bucket growth factor; relative quantile error is sqrt(gamma) - 1."
+    )
+    digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
+    chunk_size: int = pd.Field(4096, ge=128, description="Time-axis chunk size for the streaming digest build.")
+
+    def cpu_spec(self) -> DigestSpec:
+        # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
+        return DigestSpec(gamma=self.digest_gamma, min_value=1e-7, num_buckets=self.digest_buckets)
+
+
+class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
+    __display_name__ = "tdigest"
+
+    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
+        if not batch.objects:
+            return []
+        spec = self.settings.cpu_spec()
+
+        cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+        mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+
+        cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size)
+        cpu_p = digest_ops.percentile(spec, cpu_digest, float(self.settings.cpu_percentile))
+        mem_max = masked_max(mem_values, mem_counts)
+
+        return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)

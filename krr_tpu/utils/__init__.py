@@ -1,0 +1,5 @@
+from krr_tpu.utils import resource_units
+from krr_tpu.utils.logging import KrrLogger, NULL_LOGGER
+from krr_tpu.utils.ttl_cache import TTLCache
+
+__all__ = ["resource_units", "KrrLogger", "NULL_LOGGER", "TTLCache"]

@@ -1,0 +1,76 @@
+"""Console / logging surface.
+
+Mirrors the UX contract of the reference's ``Configurable`` mixin
+(`/root/reference/robusta_krr/utils/configurable.py:10-96`):
+
+* colored ``[INFO]/[WARNING]/[ERROR]/[DEBUG]`` prefixes via rich;
+* ``--quiet`` suppresses echo, ``--verbose`` enables debug (debug messages are
+  stamped with the caller's ``file:line``);
+* logs go to stderr iff ``--logtostderr``, while the scan *result* is always
+  printed to stdout on a fresh console — this separation is what makes
+  ``krr simple -f json > out.json`` work.
+
+Unlike the reference we don't force every component to inherit a mixin; a
+single :class:`KrrLogger` is constructed from the config and passed (or the
+module default used).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Literal
+
+from rich.console import Console
+
+_LEVEL_COLOR = {"INFO": "green", "WARNING": "yellow", "ERROR": "red", "DEBUG": "green"}
+
+
+class KrrLogger:
+    def __init__(self, quiet: bool = False, verbose: bool = False, log_to_stderr: bool = False) -> None:
+        self.quiet = quiet
+        self.verbose = verbose
+        self.console = Console(stderr=log_to_stderr)
+
+    # -- result channel ------------------------------------------------------
+    def print_result(self, content: Any) -> None:
+        """The scan result always goes to stdout, regardless of --logtostderr."""
+        Console().print(content)
+
+    # -- log channel ---------------------------------------------------------
+    @property
+    def debug_active(self) -> bool:
+        return self.verbose and not self.quiet
+
+    def echo(
+        self, message: str = "", *, no_prefix: bool = False, type: Literal["INFO", "WARNING", "ERROR"] = "INFO"
+    ) -> None:
+        if self.quiet:
+            return
+        color = _LEVEL_COLOR[type]
+        prefix = "" if no_prefix else f"[bold {color}][{type}][/bold {color}] "
+        self.console.print(f"{prefix}{message}")
+
+    def info(self, message: str = "") -> None:
+        self.echo(message, type="INFO")
+
+    def warning(self, message: str = "") -> None:
+        self.echo(message, type="WARNING")
+
+    def error(self, message: str = "") -> None:
+        self.echo(message, type="ERROR")
+
+    def debug(self, message: str = "") -> None:
+        if not self.debug_active:
+            return
+        frame = inspect.stack()[1]
+        self.console.print(
+            f"[bold green][DEBUG][/bold green] {message}\t\t({frame.filename}:{frame.lineno})"
+        )
+
+    def debug_exception(self) -> None:
+        if self.debug_active:
+            self.console.print_exception()
+
+
+#: Default logger for components constructed without an explicit one.
+NULL_LOGGER = KrrLogger(quiet=True)

@@ -1,0 +1,4 @@
+def get_version() -> str:
+    import krr_tpu
+
+    return krr_tpu.__version__

@@ -1,0 +1,48 @@
+"""Pure-Python Decimal oracle re-implementing the reference pipeline's math.
+
+This is the parity gate: the batched TPU path must match these functions
+(which mirror `/root/reference/robusta_krr/strategies/simple.py:24-36` with
+the documented sorted percentile, plus the rounding of
+`/root/reference/robusta_krr/core/runner.py:49-77`) to ±1 %.
+"""
+
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+from typing import Optional
+
+
+def oracle_cpu_percentile(per_pod: dict[str, list[Decimal]], percentile: Decimal = Decimal(99)) -> Decimal:
+    """True percentile of the flattened samples: sorted value at index
+    floor((n-1) * p / 100). (The reference omits the sort — a documented bug.)"""
+    flat = [v for values in per_pod.values() for v in values]
+    if not flat:
+        return Decimal("nan")
+    flat.sort()
+    return flat[int((len(flat) - 1) * percentile / 100)]
+
+
+def oracle_memory_max(per_pod: dict[str, list[Decimal]], buffer_pct: Decimal = Decimal(5)) -> Decimal:
+    flat = [v for values in per_pod.values() for v in values]
+    if not flat:
+        return Decimal("nan")
+    return max(flat) * (1 + buffer_pct / 100)
+
+
+def oracle_round_cpu(value: Optional[Decimal], cpu_min_value: int = 5) -> Optional[Decimal]:
+    if value is None:
+        return None
+    if value.is_nan():
+        return Decimal("nan")
+    rounded = Decimal(math.ceil(value * 1000)) / 1000
+    return max(rounded, Decimal(cpu_min_value) / 1000)
+
+
+def oracle_round_memory(value: Optional[Decimal], memory_min_value: int = 10) -> Optional[Decimal]:
+    if value is None:
+        return None
+    if value.is_nan():
+        return Decimal("nan")
+    rounded = Decimal(math.ceil(value / 1_000_000)) * 1_000_000
+    return max(rounded, Decimal(memory_min_value) * 1_000_000)

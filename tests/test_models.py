@@ -1,0 +1,125 @@
+import json
+from decimal import Decimal
+
+from krr_tpu.models import (
+    K8sObjectData,
+    ResourceAllocations,
+    ResourceScan,
+    ResourceType,
+    Result,
+    Severity,
+)
+
+
+def make_object(requests=None, limits=None, **kwargs) -> K8sObjectData:
+    return K8sObjectData(
+        cluster=kwargs.get("cluster", "test"),
+        namespace=kwargs.get("namespace", "default"),
+        name=kwargs.get("name", "app"),
+        kind=kwargs.get("kind", "Deployment"),
+        container=kwargs.get("container", "main"),
+        pods=kwargs.get("pods", ["app-1", "app-2"]),
+        allocations=ResourceAllocations(
+            requests=requests or {ResourceType.CPU: None, ResourceType.Memory: None},
+            limits=limits or {ResourceType.CPU: None, ResourceType.Memory: None},
+        ),
+    )
+
+
+class TestAllocations:
+    def test_parses_quantity_strings(self):
+        alloc = ResourceAllocations(
+            requests={ResourceType.CPU: "100m", ResourceType.Memory: "128Mi"},
+            limits={ResourceType.CPU: "1", ResourceType.Memory: "1Gi"},
+        )
+        assert alloc.requests[ResourceType.CPU] == Decimal("0.1")
+        assert alloc.requests[ResourceType.Memory] == Decimal(134217728)
+        assert alloc.limits[ResourceType.CPU] == Decimal(1)
+
+    def test_nan_becomes_question_mark(self):
+        alloc = ResourceAllocations(
+            requests={ResourceType.CPU: Decimal("nan"), ResourceType.Memory: None},
+            limits={ResourceType.CPU: None, ResourceType.Memory: None},
+        )
+        assert alloc.requests[ResourceType.CPU] == "?"
+
+    def test_from_container_spec(self):
+        container = {
+            "name": "main",
+            "resources": {"requests": {"cpu": "250m", "memory": "64Mi"}, "limits": {"memory": "128Mi"}},
+        }
+        alloc = ResourceAllocations.from_container_spec(container)
+        assert alloc.requests[ResourceType.CPU] == Decimal("0.25")
+        assert alloc.limits[ResourceType.CPU] is None
+        assert alloc.limits[ResourceType.Memory] == Decimal(134217728)
+
+    def test_from_container_spec_no_resources(self):
+        alloc = ResourceAllocations.from_container_spec({"name": "main"})
+        assert alloc.requests[ResourceType.CPU] is None
+
+
+class TestSeverity:
+    def test_unknown_on_question_mark(self):
+        assert Severity.calculate("?", Decimal(1)) == Severity.UNKNOWN
+        assert Severity.calculate(Decimal(1), "?") == Severity.UNKNOWN
+
+    def test_none_cases(self):
+        assert Severity.calculate(None, None) == Severity.OK
+        assert Severity.calculate(None, Decimal(1)) == Severity.WARNING
+        assert Severity.calculate(Decimal(1), None) == Severity.WARNING
+
+    def test_thresholds(self):
+        # diff = (current - recommended) / recommended
+        rec = Decimal(100)
+        assert Severity.calculate(Decimal(201), rec) == Severity.CRITICAL  # diff > 1.0
+        assert Severity.calculate(Decimal(49), rec) == Severity.CRITICAL  # diff < -0.5
+        assert Severity.calculate(Decimal(151), rec) == Severity.WARNING  # diff > 0.5
+        assert Severity.calculate(Decimal(74), rec) == Severity.WARNING  # diff < -0.25
+        assert Severity.calculate(Decimal(100), rec) == Severity.GOOD
+        assert Severity.calculate(Decimal(150), rec) == Severity.GOOD  # exactly 0.5 is good
+        assert Severity.calculate(Decimal(75), rec) == Severity.GOOD  # exactly -0.25 is good
+        assert Severity.calculate(Decimal(200), rec) == Severity.WARNING  # exactly 1.0 is still warning
+        assert Severity.calculate(Decimal(50), rec) == Severity.WARNING  # exactly -0.5 is still warning
+
+
+class TestResourceScan:
+    def test_worst_cell_wins(self):
+        obj = make_object(requests={ResourceType.CPU: Decimal(3), ResourceType.Memory: Decimal(1000)})
+        recommendation = ResourceAllocations(
+            requests={ResourceType.CPU: Decimal(1), ResourceType.Memory: Decimal(1000)},
+            limits={ResourceType.CPU: None, ResourceType.Memory: Decimal(1000)},
+        )
+        scan = ResourceScan.calculate(obj, recommendation)
+        # cpu request diff = 2.0 -> CRITICAL dominates
+        assert scan.severity == Severity.CRITICAL
+
+    def test_all_unknown(self):
+        obj = make_object()
+        recommendation = ResourceAllocations(
+            requests={ResourceType.CPU: "?", ResourceType.Memory: "?"},
+            limits={ResourceType.CPU: "?", ResourceType.Memory: "?"},
+        )
+        scan = ResourceScan.calculate(obj, recommendation)
+        assert scan.severity == Severity.UNKNOWN
+
+
+class TestResult:
+    def _result(self) -> Result:
+        obj = make_object(requests={ResourceType.CPU: Decimal("0.1"), ResourceType.Memory: Decimal(100_000_000)})
+        recommendation = ResourceAllocations(
+            requests={ResourceType.CPU: Decimal("0.1"), ResourceType.Memory: Decimal(100_000_000)},
+            limits={ResourceType.CPU: None, ResourceType.Memory: Decimal(100_000_000)},
+        )
+        return Result(scans=[ResourceScan.calculate(obj, recommendation)])
+
+    def test_json_serializes_decimals_as_numbers(self):
+        result = self._result()
+        payload = json.loads(result.model_dump_json())
+        cell = payload["scans"][0]["recommended"]["requests"]["cpu"]
+        assert cell["value"] == 0.1
+
+    def test_perfect_fleet_scores_100(self):
+        assert self._result().score == 100
+
+    def test_empty_result_scores_0(self):
+        assert Result(scans=[]).score == 0

@@ -1,0 +1,136 @@
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops.digest import DigestSpec
+from krr_tpu.ops.packing import pack_ragged
+from krr_tpu.ops.quantile import masked_max, masked_percentile
+
+from .oracle import oracle_cpu_percentile, oracle_memory_max
+
+
+def ragged_fleet(rng: np.random.Generator, n: int = 17, max_pods: int = 4, max_len: int = 200):
+    """Random ragged per-object per-pod series, including empty objects."""
+    fleet = []
+    for i in range(n):
+        pods = {}
+        for p in range(rng.integers(0, max_pods + 1)):
+            length = int(rng.integers(0, max_len))
+            pods[f"pod-{i}-{p}"] = rng.gamma(2.0, 0.05, size=length)
+        fleet.append(pods)
+    return fleet
+
+
+class TestPacking:
+    def test_pack_shapes_and_contents(self, rng):
+        fleet = ragged_fleet(rng)
+        values, counts = pack_ragged(fleet)
+        assert values.shape[0] == len(fleet)
+        assert values.shape[1] % 128 == 0
+        for i, pods in enumerate(fleet):
+            flat = np.concatenate([np.asarray(v) for v in pods.values()]) if pods else np.empty(0)
+            assert counts[i] == flat.size
+            np.testing.assert_array_equal(values[i, : flat.size], flat)
+            np.testing.assert_array_equal(values[i, flat.size :], 0)
+
+    def test_pack_empty_fleet(self):
+        values, counts = pack_ragged([])
+        assert values.shape[0] == 0 and counts.shape[0] == 0
+
+
+class TestMaskedReductions:
+    def test_percentile_matches_decimal_oracle(self, rng):
+        fleet = ragged_fleet(rng)
+        values, counts = pack_ragged(fleet)
+        result = np.asarray(masked_percentile(values.astype(np.float32), counts, 99.0))
+        for i, pods in enumerate(fleet):
+            oracle = oracle_cpu_percentile({k: [Decimal(repr(float(x))) for x in v] for k, v in pods.items()})
+            if oracle.is_nan():
+                assert np.isnan(result[i])
+            else:
+                assert result[i] == pytest.approx(float(oracle), rel=1e-6)
+
+    @pytest.mark.parametrize("q", [0.0, 50.0, 90.0, 99.0, 100.0])
+    def test_percentile_all_qs(self, rng, q):
+        values = rng.normal(10, 3, size=(5, 256))
+        counts = np.array([256, 100, 1, 2, 0], dtype=np.int32)
+        result = np.asarray(masked_percentile(values.astype(np.float32), counts, q))
+        for i in range(4):
+            flat = sorted(values[i, : counts[i]])
+            expected = flat[int((len(flat) - 1) * q / 100)]
+            assert result[i] == pytest.approx(expected, rel=1e-6)
+        assert np.isnan(result[4])
+
+    def test_max_matches_oracle(self, rng):
+        fleet = ragged_fleet(rng)
+        values, counts = pack_ragged(fleet)
+        # Memory-like magnitudes, scaled to MB as the strategy does.
+        mb = values * 1000
+        result = np.asarray(masked_max(mb.astype(np.float32), counts))
+        for i, pods in enumerate(fleet):
+            if counts[i] == 0:
+                assert np.isnan(result[i])
+            else:
+                expected = max(float(np.max(np.asarray(v))) for v in pods.values() if np.asarray(v).size) * 1000
+                assert result[i] == pytest.approx(expected, rel=1e-6)
+
+
+class TestDigest:
+    SPEC = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+
+    def test_quantile_relative_error_bound(self, rng):
+        values = rng.gamma(2.0, 0.05, size=(8, 2048)).astype(np.float32)
+        counts = np.full(8, 2048, dtype=np.int32)
+        d = digest_ops.build_from_packed(self.SPEC, values, counts, chunk_size=512)
+        for q in [50.0, 90.0, 99.0]:
+            est = np.asarray(digest_ops.percentile(self.SPEC, d, q))
+            exact = np.asarray(masked_percentile(values, counts, q))
+            np.testing.assert_allclose(est, exact, rtol=self.SPEC.relative_error * 1.05)
+
+    def test_chunked_equals_oneshot(self, rng):
+        values = rng.gamma(2.0, 0.05, size=(4, 1024)).astype(np.float32)
+        counts = np.array([1024, 1000, 513, 0], dtype=np.int32)
+        d_one = digest_ops.build_from_packed(self.SPEC, values, counts, chunk_size=1024)
+        d_chunked = digest_ops.build_from_packed(self.SPEC, values, counts, chunk_size=128)
+        np.testing.assert_array_equal(np.asarray(d_one.counts), np.asarray(d_chunked.counts))
+        np.testing.assert_array_equal(np.asarray(d_one.total), np.asarray(d_chunked.total))
+        np.testing.assert_array_equal(np.asarray(d_one.peak), np.asarray(d_chunked.peak))
+
+    def test_merge_is_concatenation(self, rng):
+        a = rng.gamma(2.0, 0.05, size=(3, 256)).astype(np.float32)
+        b = rng.gamma(2.0, 0.05, size=(3, 512)).astype(np.float32)
+        ca = np.full(3, 256, dtype=np.int32)
+        cb = np.array([512, 100, 0], dtype=np.int32)
+        d_merged = digest_ops.merge(
+            digest_ops.build_from_packed(self.SPEC, a, ca),
+            digest_ops.build_from_packed(self.SPEC, b, cb),
+        )
+        both = np.concatenate([a, b], axis=1)
+        mask_a = np.arange(256)[None, :] < ca[:, None]
+        mask_b = np.arange(512)[None, :] < cb[:, None]
+        # Repack so the valid samples are left-justified.
+        packed, counts = pack_ragged([[row_a[m_a], row_b[m_b]] for row_a, m_a, row_b, m_b in zip(a, mask_a, b, mask_b)])
+        d_concat = digest_ops.build_from_packed(self.SPEC, packed.astype(np.float32), counts)
+        np.testing.assert_array_equal(np.asarray(d_merged.counts), np.asarray(d_concat.counts))
+        np.testing.assert_array_equal(np.asarray(d_merged.peak), np.asarray(d_concat.peak))
+
+    def test_zeros_and_empty_rows(self):
+        values = np.zeros((2, 128), dtype=np.float32)
+        counts = np.array([128, 0], dtype=np.int32)
+        d = digest_ops.build_from_packed(self.SPEC, values, counts)
+        p = np.asarray(digest_ops.percentile(self.SPEC, d, 99.0))
+        assert p[0] == 0.0
+        assert np.isnan(p[1])
+        assert np.isnan(np.asarray(digest_ops.peak(d))[1])
+
+    def test_memory_peak_is_exact(self, rng):
+        mb = (rng.uniform(1, 4000, size=(6, 384))).astype(np.float32)
+        counts = np.array([384, 380, 100, 7, 1, 0], dtype=np.int32)
+        spec = DigestSpec(gamma=1.01, min_value=1e-3, num_buckets=2560)
+        d = digest_ops.build_from_packed(spec, mb, counts)
+        result = np.asarray(digest_ops.peak(d))
+        expected = np.asarray(masked_max(mb, counts))
+        np.testing.assert_array_equal(result[:5], expected[:5])
+        assert np.isnan(result[5])

@@ -1,0 +1,59 @@
+from decimal import Decimal
+
+import pytest
+
+from krr_tpu.utils import resource_units
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("100m", Decimal("0.1")),
+        ("1", Decimal(1)),
+        ("2.5", Decimal("2.5")),
+        ("1k", Decimal(1000)),
+        ("1Ki", Decimal(1024)),
+        ("128Mi", Decimal(134217728)),
+        ("1Gi", Decimal(1073741824)),
+        ("1M", Decimal(1_000_000)),
+        ("1G", Decimal(10) ** 9),
+        ("1Ti", Decimal(1024) ** 4),
+        ("1E", Decimal(10) ** 18),
+        ("1e3", Decimal(1000)),
+    ],
+)
+def test_parse(text: str, expected: Decimal):
+    assert resource_units.parse(text) == expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        (Decimal(0), "0"),
+        (Decimal(134217728), "128Mi"),
+        (Decimal(1000), "1k"),
+        (Decimal(1024), "1Ki"),
+        (Decimal(1_000_000), "1M"),
+        (Decimal("0.1"), "100m"),
+        (Decimal("0.005"), "5m"),
+        # Anything divisible by 1m renders via the m unit (largest-divisor
+        # scan ends at "m") — reference behavior.
+        (Decimal(3), "3000m"),
+        (Decimal("1.5"), "1500m"),
+        (Decimal("0.0015"), "0.0015"),  # not divisible by any unit -> plain str
+    ],
+)
+def test_format(value: Decimal, expected: str):
+    assert resource_units.format(value) == expected
+
+
+def test_format_truncates_precision():
+    # Truncation (not rounding) of significant digits, then unit selection.
+    assert resource_units.format(Decimal(123456789), 4) == "123400k"
+    assert resource_units.format(Decimal(105_000_000), 4) == "105M"
+    assert resource_units.format(Decimal("0.123456"), 4) == "0.123400"
+
+
+def test_parse_format_roundtrip():
+    for text in ["100m", "128Mi", "1Gi", "5M", "250m"]:
+        assert resource_units.format(resource_units.parse(text)) == text

@@ -1,0 +1,50 @@
+from decimal import Decimal
+
+import pytest
+
+from krr_tpu.core.rounding import round_value
+from krr_tpu.models import ResourceType
+
+from .oracle import oracle_round_cpu, oracle_round_memory
+
+
+class TestCpuRounding:
+    def test_ceils_to_millicore(self):
+        assert round_value(Decimal("0.1234"), ResourceType.CPU) == Decimal("0.124")
+        assert round_value(Decimal("0.123"), ResourceType.CPU) == Decimal("0.123")
+
+    def test_clamps_to_floor(self):
+        assert round_value(Decimal("0.0001"), ResourceType.CPU) == Decimal("0.005")
+        assert round_value(Decimal("0.0001"), ResourceType.CPU, cpu_min_value=0) == Decimal("0.001")
+
+    def test_nan_passthrough(self):
+        assert round_value(Decimal("nan"), ResourceType.CPU).is_nan()
+
+    def test_none_passthrough(self):
+        assert round_value(None, ResourceType.CPU) is None
+
+    def test_float_input_boundary(self):
+        # A float32-derived value like 0.105000004 must not ceil an extra step
+        # past what repr round-trips to.
+        assert round_value(0.105, ResourceType.CPU) == Decimal("0.105")
+
+
+class TestMemoryRounding:
+    def test_ceils_to_megabyte(self):
+        assert round_value(Decimal(123_456_789), ResourceType.Memory) == Decimal(124_000_000)
+        assert round_value(Decimal(124_000_000), ResourceType.Memory) == Decimal(124_000_000)
+
+    def test_clamps_to_floor(self):
+        assert round_value(Decimal(1), ResourceType.Memory) == Decimal(10_000_000)
+
+
+@pytest.mark.parametrize("raw", ["0.00123", "0.005", "0.0051", "1.5", "0.999999", "3"])
+def test_cpu_matches_oracle(raw: str):
+    value = Decimal(raw)
+    assert round_value(value, ResourceType.CPU) == oracle_round_cpu(value)
+
+
+@pytest.mark.parametrize("raw", ["1", "999999", "1000000", "1000001", "123456789.5", "105000000"])
+def test_memory_matches_oracle(raw: str):
+    value = Decimal(raw)
+    assert round_value(value, ResourceType.Memory) == oracle_round_memory(value)

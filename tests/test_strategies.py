@@ -1,0 +1,153 @@
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from krr_tpu.models import FleetBatch, K8sObjectData, ResourceAllocations, ResourceType
+from krr_tpu.strategies import BaseStrategy, SimpleStrategy, SimpleStrategySettings, TDigestStrategy, TDigestStrategySettings
+from krr_tpu.strategies.base import StrategySettings
+
+from .oracle import oracle_cpu_percentile, oracle_memory_max
+from .test_ops import ragged_fleet
+
+
+def make_batch(rng, n=13) -> FleetBatch:
+    objects = []
+    cpu, mem = ragged_fleet(rng, n=n), []
+    for i in range(n):
+        pods = list(cpu[i].keys())
+        objects.append(
+            K8sObjectData(
+                cluster="c",
+                namespace="default",
+                name=f"app-{i}",
+                kind="Deployment",
+                container="main",
+                pods=pods,
+                allocations=ResourceAllocations(
+                    requests={ResourceType.CPU: "100m", ResourceType.Memory: "128Mi"},
+                    limits={ResourceType.CPU: None, ResourceType.Memory: "256Mi"},
+                ),
+            )
+        )
+        # Memory magnitudes: tens to hundreds of MB, as byte counts.
+        mem.append({pod: (samples * 2e9 + 1e7).astype(np.float64) for pod, samples in cpu[i].items()})
+    return FleetBatch.build(objects, {ResourceType.CPU: cpu, ResourceType.Memory: mem})
+
+
+def to_decimal_history(pods: dict) -> dict:
+    return {k: [Decimal(repr(float(x))) for x in v] for k, v in pods.items()}
+
+
+class TestSimpleStrategy:
+    def test_registry(self):
+        assert BaseStrategy.find("simple") is SimpleStrategy
+        assert BaseStrategy.find("tdigest") is TDigestStrategy
+        assert SimpleStrategy.get_settings_type() is SimpleStrategySettings
+        assert TDigestStrategy.get_settings_type() is TDigestStrategySettings
+
+    def test_batch_matches_oracle(self, rng):
+        batch = make_batch(rng)
+        strategy = SimpleStrategy(SimpleStrategySettings())
+        results = strategy.run_batch(batch)
+        assert len(results) == len(batch)
+        for i, result in enumerate(results):
+            cpu_oracle = oracle_cpu_percentile(to_decimal_history(batch.ragged[ResourceType.CPU][i]))
+            mem_oracle = oracle_memory_max(to_decimal_history(batch.ragged[ResourceType.Memory][i]))
+            cpu_rec = result[ResourceType.CPU]
+            mem_rec = result[ResourceType.Memory]
+            assert cpu_rec.limit is None
+            if not mem_rec.request.is_nan():
+                assert mem_rec.request == mem_rec.limit
+            if cpu_oracle.is_nan():
+                assert cpu_rec.request.is_nan()
+                assert mem_rec.request.is_nan()
+            else:
+                assert float(cpu_rec.request) == pytest.approx(float(cpu_oracle), rel=1e-6)
+                assert float(mem_rec.request) == pytest.approx(float(mem_oracle), rel=1e-6)
+
+    def test_per_object_run_compat(self, rng):
+        batch = make_batch(rng, n=3)
+        strategy = SimpleStrategy(SimpleStrategySettings())
+        batched = strategy.run_batch(batch)
+        for i, obj in enumerate(batch.objects):
+            single = strategy.run(batch.history_for(i), obj)
+            for resource in ResourceType:
+                b, s = batched[i][resource], single[resource]
+                if b.request is not None and b.request.is_nan():
+                    assert s.request.is_nan()
+                else:
+                    assert s.request == b.request
+
+    def test_custom_percentile_and_buffer(self, rng):
+        batch = make_batch(rng, n=4)
+        strategy = SimpleStrategy(SimpleStrategySettings(cpu_percentile=50, memory_buffer_percentage=20))
+        results = strategy.run_batch(batch)
+        for i, result in enumerate(results):
+            cpu_oracle = oracle_cpu_percentile(
+                to_decimal_history(batch.ragged[ResourceType.CPU][i]), Decimal(50)
+            )
+            mem_oracle = oracle_memory_max(
+                to_decimal_history(batch.ragged[ResourceType.Memory][i]), Decimal(20)
+            )
+            if not cpu_oracle.is_nan():
+                assert float(result[ResourceType.CPU].request) == pytest.approx(float(cpu_oracle), rel=1e-6)
+                assert float(result[ResourceType.Memory].request) == pytest.approx(float(mem_oracle), rel=1e-6)
+
+    def test_memory_boundary_exactness(self):
+        """100 MB peak × 5% buffer must land on exactly 105 MB (no float drift
+        past the 1M ceiling) — the hard-parts case from SURVEY.md §7."""
+        obj = K8sObjectData(
+            cluster=None, namespace="ns", name="a", kind="Deployment", container="main", pods=["p"],
+            allocations=ResourceAllocations(requests={}, limits={}),
+        )
+        batch = FleetBatch.build(
+            [obj],
+            {
+                ResourceType.CPU: [{"p": np.array([0.1, 0.2])}],
+                ResourceType.Memory: [{"p": np.array([100_000_000.0, 50_000_000.0])}],
+            },
+        )
+        result = SimpleStrategy(SimpleStrategySettings()).run_batch(batch)[0]
+        assert result[ResourceType.Memory].request == Decimal(105_000_000)
+
+
+class TestTDigestStrategy:
+    def test_within_one_percent_of_simple(self, rng):
+        batch = make_batch(rng)
+        simple = SimpleStrategy(SimpleStrategySettings()).run_batch(batch)
+        sketch = TDigestStrategy(TDigestStrategySettings(chunk_size=128)).run_batch(batch)
+        for s, t in zip(simple, sketch):
+            cpu_s, cpu_t = s[ResourceType.CPU].request, t[ResourceType.CPU].request
+            if cpu_s.is_nan():
+                assert cpu_t.is_nan()
+                continue
+            if cpu_s != 0:
+                assert abs(float(cpu_t) - float(cpu_s)) / float(cpu_s) < 0.01
+            # Memory goes through the exactly-tracked peak: identical.
+            assert t[ResourceType.Memory].request == s[ResourceType.Memory].request
+
+
+class TestPluginCompat:
+    def test_reference_style_plugin_registers_and_runs(self, rng):
+        import pydantic as pd
+
+        class MyPluginSettings(StrategySettings):
+            param_1: Decimal = pd.Field(42, gt=0, description="First example parameter")
+
+        class MyPluginStrategy(BaseStrategy[MyPluginSettings]):
+            def run(self, history_data, object_data):
+                from krr_tpu.strategies.base import ResourceRecommendation
+
+                return {
+                    ResourceType.CPU: ResourceRecommendation(request=self.settings.param_1, limit=None),
+                    ResourceType.Memory: ResourceRecommendation(request=Decimal(1), limit=Decimal(1)),
+                }
+
+        assert BaseStrategy.find("myplugin") is MyPluginStrategy
+        assert MyPluginStrategy.get_settings_type() is MyPluginSettings
+
+        batch = make_batch(rng, n=2)
+        results = MyPluginStrategy(MyPluginSettings()).run_batch(batch)  # default per-object fallback
+        assert len(results) == 2
+        assert results[0][ResourceType.CPU].request == Decimal(42)
