@@ -1,0 +1,18 @@
+# Example: creating your own formatter plugin.
+#
+# Run as `python ./custom_formatter.py simple --formatter my_formatter`.
+
+import krr_tpu
+from krr_tpu.api.formatters import BaseFormatter
+from krr_tpu.api.models import Result
+
+
+class CustomFormatter(BaseFormatter):
+    __display_name__ = "my_formatter"
+
+    def format(self, result: Result) -> str:
+        return f"Custom formatter: {len(result.scans)} scans, score {result.score}"
+
+
+if __name__ == "__main__":
+    krr_tpu.run()

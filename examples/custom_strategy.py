@@ -1,0 +1,31 @@
+# Example: creating your own strategy plugin.
+#
+# Defining the subclass registers it; running this file adds a `custom`
+# sub-command to the CLI: `python ./custom_strategy.py custom`
+# (same plugin contract as the reference's examples/custom_strategy.py).
+
+from decimal import Decimal
+
+import pydantic as pd
+
+import krr_tpu
+from krr_tpu.api.models import HistoryData, K8sObjectData, ResourceRecommendation, ResourceType, RunResult
+from krr_tpu.api.strategies import BaseStrategy, StrategySettings
+
+
+# Field descriptions become CLI `--flag` help text.
+class CustomStrategySettings(StrategySettings):
+    param_1: Decimal = pd.Field(99, gt=0, description="First example parameter")
+    param_2: Decimal = pd.Field(105_000, gt=0, description="Second example parameter")
+
+
+class CustomStrategy(BaseStrategy[CustomStrategySettings]):
+    def run(self, history_data: HistoryData, object_data: K8sObjectData) -> RunResult:
+        return {
+            ResourceType.CPU: ResourceRecommendation(request=self.settings.param_1, limit=None),
+            ResourceType.Memory: ResourceRecommendation(request=self.settings.param_2, limit=self.settings.param_2),
+        }
+
+
+if __name__ == "__main__":
+    krr_tpu.run()

@@ -1,0 +1,3 @@
+from krr_tpu.main import run
+
+run()

@@ -1,0 +1,5 @@
+"""Public extension API for plugin authors (strategies & formatters).
+
+Mirrors the reference's supported import surface
+(`/root/reference/robusta_krr/api/` — re-exports only).
+"""

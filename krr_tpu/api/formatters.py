@@ -1,0 +1,3 @@
+from krr_tpu.formatters.base import BaseFormatter
+
+__all__ = ["BaseFormatter"]

@@ -1,0 +1,21 @@
+from krr_tpu.models.allocations import RecommendationValue, ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import Recommendation, ResourceScan, Result, Severity
+from krr_tpu.models.series import FleetBatch, PackedSeries
+from krr_tpu.strategies.base import HistoryData, ResourceRecommendation, RunResult
+
+__all__ = [
+    "RecommendationValue",
+    "ResourceAllocations",
+    "ResourceType",
+    "K8sObjectData",
+    "Recommendation",
+    "ResourceScan",
+    "Result",
+    "Severity",
+    "FleetBatch",
+    "PackedSeries",
+    "HistoryData",
+    "ResourceRecommendation",
+    "RunResult",
+]

@@ -1,0 +1,3 @@
+from krr_tpu.strategies.base import BaseStrategy, BatchedStrategy, StrategySettings
+
+__all__ = ["BaseStrategy", "BatchedStrategy", "StrategySettings"]

@@ -1,0 +1,4 @@
+from krr_tpu.core.config import Config
+from krr_tpu.core.rounding import round_value
+
+__all__ = ["Config", "round_value"]

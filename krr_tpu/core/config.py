@@ -1,0 +1,95 @@
+"""Global configuration — the two-level flag system.
+
+Level 1 (this model) holds cluster/namespace selectors, value floors,
+Prometheus settings, and logging flags, mirroring the reference's ``Config``
+(`/root/reference/robusta_krr/core/models/config.py:18-65`) plus a TPU group.
+Level 2 is the per-strategy ``StrategySettings`` carried as ``other_args`` and
+reflected into CLI flags by ``krr_tpu.main``.
+
+One deliberate divergence: the reference authenticates against kubeconfig at
+*import* time (`config.py:10-15` — flagged as a boundary hazard in SURVEY.md
+§3.1); here cluster detection is lazy and lives in the integrations layer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Literal, Optional, Union
+
+import pydantic as pd
+from pydantic import field_validator
+
+from krr_tpu.utils.logging import KrrLogger
+
+
+def detect_inside_cluster() -> bool:
+    """True when running inside a pod with a service-account token mounted."""
+    return bool(os.environ.get("KUBERNETES_SERVICE_HOST")) and os.path.exists(
+        "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    )
+
+
+class Config(pd.BaseModel):
+    quiet: bool = False
+    verbose: bool = False
+
+    clusters: Union[list[str], Literal["*"], None] = None
+    namespaces: Union[list[str], Literal["*"]] = "*"
+
+    # Value settings
+    cpu_min_value: int = pd.Field(5, ge=0)  # millicores
+    memory_min_value: int = pd.Field(10, ge=0)  # megabytes
+
+    # Prometheus settings
+    prometheus_url: Optional[str] = None
+    prometheus_auth_header: Optional[str] = None
+    prometheus_ssl_enabled: bool = False
+    prometheus_max_connections: int = pd.Field(32, ge=1)  # bulk-fetch fan-out width
+
+    # Kubernetes settings
+    kubeconfig: Optional[str] = None  # path override; default resolution in integrations
+
+    # Logging settings
+    format: str = "table"
+    strategy: str = "simple"
+    log_to_stderr: bool = False
+
+    # TPU backend settings
+    max_fleet_rows_per_device: int = pd.Field(200_000, ge=1)
+
+    other_args: dict[str, Any] = pd.Field(default_factory=dict)
+
+    @field_validator("namespaces")
+    @classmethod
+    def _empty_namespaces_mean_all(cls, v: Union[list[str], Literal["*"]]) -> Union[list[str], Literal["*"]]:
+        return "*" if v == [] else v
+
+    @field_validator("strategy")
+    @classmethod
+    def _strategy_exists(cls, v: str) -> str:
+        from krr_tpu.strategies.base import BaseStrategy
+
+        BaseStrategy.find(v)  # raises with the available list if unknown
+        return v
+
+    @field_validator("format")
+    @classmethod
+    def _format_exists(cls, v: str) -> str:
+        from krr_tpu.formatters.base import BaseFormatter
+
+        BaseFormatter.find(v)
+        return v
+
+    @property
+    def inside_cluster(self) -> bool:
+        return detect_inside_cluster()
+
+    def create_strategy(self):
+        from krr_tpu.strategies.base import BaseStrategy
+
+        strategy_type = BaseStrategy.find(self.strategy)
+        settings_type = strategy_type.get_settings_type()
+        return strategy_type(settings_type(**self.other_args))
+
+    def create_logger(self) -> KrrLogger:
+        return KrrLogger(quiet=self.quiet, verbose=self.verbose, log_to_stderr=self.log_to_stderr)

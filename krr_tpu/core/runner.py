@@ -1,0 +1,214 @@
+"""The orchestrator: discover → bulk-fetch → batched compute → round → render.
+
+Same outer shape as the reference Runner
+(`/root/reference/robusta_krr/core/runner.py:17-137`) — greet, collect, format,
+with per-cluster Prometheus loaders cached (exceptions cached too, so one
+broken cluster fails fast instead of retrying per object) — but the middle is
+inverted for the TPU: instead of per-object asyncio tasks each firing per-pod
+range queries and a per-object strategy call, the runner bulk-fetches the whole
+fleet into a ``FleetBatch`` and makes ONE ``run_batch`` call (SURVEY.md §7).
+
+Failure semantics (SURVEY.md §5 "failure detection"): a cluster whose
+Prometheus can't be reached degrades to empty histories for its objects —
+their scans render as UNKNOWN (``?``) instead of aborting the run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional, Protocol, Union
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.rounding import round_value
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import ResourceScan, Result
+from krr_tpu.models.series import FleetBatch, RaggedHistory
+from krr_tpu.strategies.base import RunResult
+from krr_tpu.utils.logging import KrrLogger
+from krr_tpu.utils.logo import ASCII_LOGO
+from krr_tpu.utils.version import get_version
+
+
+class HistorySource(Protocol):
+    """What the runner needs from a metrics backend (real or fake)."""
+
+    async def gather_fleet(
+        self, objects: list[K8sObjectData], history_seconds: float, step_seconds: float
+    ) -> dict[ResourceType, list[RaggedHistory]]:
+        ...
+
+
+class InventorySource(Protocol):
+    """What the runner needs from a cluster inventory (real or fake)."""
+
+    async def list_clusters(self) -> Optional[list[str]]:
+        ...
+
+    async def list_scannable_objects(self, clusters: Optional[list[str]]) -> list[K8sObjectData]:
+        ...
+
+
+def _empty_histories(objects: list[K8sObjectData]) -> dict[ResourceType, list[RaggedHistory]]:
+    return {resource: [{} for _ in objects] for resource in ResourceType}
+
+
+class Runner:
+    """End-to-end scan orchestration.
+
+    ``inventory_factory`` / ``history_factory`` are injectable so tests (and
+    alternative backends) can swap the cluster/metrics integrations; the
+    defaults build the real Kubernetes and Prometheus loaders.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        inventory: Optional[InventorySource] = None,
+        history_factory: Optional[Callable[[Optional[str]], HistorySource]] = None,
+        logger: Optional[KrrLogger] = None,
+    ) -> None:
+        self.config = config
+        self.logger = logger or config.create_logger()
+        self._strategy = config.create_strategy()
+        self._inventory = inventory
+        self._history_factory = history_factory
+        self._history_sources: dict[Optional[str], Union[HistorySource, Exception]] = {}
+        self.stats: dict[str, float] = {}
+
+    # ------------------------------------------------------------- plumbing
+    def _get_inventory(self) -> InventorySource:
+        if self._inventory is None:
+            from krr_tpu.integrations.kubernetes import KubernetesLoader
+
+            self._inventory = KubernetesLoader(self.config, logger=self.logger)
+        return self._inventory
+
+    def _get_history_source(self, cluster: Optional[str]) -> HistorySource:
+        if cluster not in self._history_sources:
+            try:
+                if self._history_factory is not None:
+                    self._history_sources[cluster] = self._history_factory(cluster)
+                else:
+                    from krr_tpu.integrations.prometheus import PrometheusLoader
+
+                    self._history_sources[cluster] = PrometheusLoader(
+                        self.config, cluster=cluster, logger=self.logger
+                    )
+            except Exception as e:  # cache the failure: fail fast per cluster
+                self._history_sources[cluster] = e
+        source = self._history_sources[cluster]
+        if isinstance(source, Exception):
+            raise source
+        return source
+
+    def _greet(self) -> None:
+        self.logger.echo(ASCII_LOGO, no_prefix=True, markup=True)
+        self.logger.echo(f"Running krr-tpu (TPU-native Kubernetes Resource Recommender) {get_version()}", no_prefix=True)
+        self.logger.echo(f"Using strategy: {self._strategy}", no_prefix=True)
+        self.logger.echo(f"Using formatter: {self.config.format}", no_prefix=True)
+        self.logger.echo(no_prefix=True)
+
+    # ------------------------------------------------------------- the scan
+    async def _gather_fleet_history(self, objects: list[K8sObjectData]) -> FleetBatch:
+        """Bulk-fetch usage history for every object, grouped per cluster.
+
+        Clusters fetch concurrently; a failing cluster degrades to empty
+        histories (scans become UNKNOWN) with a logged warning.
+        """
+        settings = self._strategy.settings
+        history_seconds = settings.history_timedelta.total_seconds()
+        step_seconds = settings.timeframe_timedelta.total_seconds()
+
+        by_cluster: dict[Optional[str], list[int]] = {}
+        for i, obj in enumerate(objects):
+            by_cluster.setdefault(obj.cluster, []).append(i)
+
+        histories = _empty_histories(objects)
+
+        async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
+            subset = [objects[i] for i in indices]
+            try:
+                source = self._get_history_source(cluster)
+                fetched = await source.gather_fleet(subset, history_seconds, step_seconds)
+            except Exception as e:
+                self.logger.warning(
+                    f"Failed to gather history for cluster {cluster or 'default'}: {e} — "
+                    f"marking {len(subset)} objects as unknown"
+                )
+                self.logger.debug_exception()
+                return
+            for resource in ResourceType:
+                for local_i, global_i in enumerate(indices):
+                    histories[resource][global_i] = fetched[resource][local_i]
+
+        await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
+        return FleetBatch.build(objects, histories)
+
+    def _round_result(self, raw: RunResult) -> ResourceAllocations:
+        return ResourceAllocations(
+            requests={
+                resource: round_value(
+                    raw[resource].request,
+                    resource,
+                    cpu_min_value=self.config.cpu_min_value,
+                    memory_min_value=self.config.memory_min_value,
+                )
+                for resource in ResourceType
+            },
+            limits={
+                resource: round_value(
+                    raw[resource].limit,
+                    resource,
+                    cpu_min_value=self.config.cpu_min_value,
+                    memory_min_value=self.config.memory_min_value,
+                )
+                for resource in ResourceType
+            },
+        )
+
+    async def _collect_result(self) -> Result:
+        inventory = self._get_inventory()
+        t0 = time.perf_counter()
+        clusters = await inventory.list_clusters()
+        self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
+        objects = await inventory.list_scannable_objects(clusters)
+        t1 = time.perf_counter()
+        self.logger.info(f"Found {len(objects)} scannable objects")
+
+        batch = await self._gather_fleet_history(objects)
+        t2 = time.perf_counter()
+
+        # The batched strategy call is CPU/TPU bound; keep the loop responsive.
+        raw_results = await asyncio.to_thread(self._strategy.run_batch, batch)
+        t3 = time.perf_counter()
+
+        scans = [
+            ResourceScan.calculate(obj, self._round_result(raw))
+            for obj, raw in zip(objects, raw_results)
+        ]
+        self.stats = {
+            "discover_seconds": t1 - t0,
+            "fetch_seconds": t2 - t1,
+            "compute_seconds": t3 - t2,
+            "objects": float(len(objects)),
+            "objects_per_second": len(objects) / (t3 - t2) if t3 > t2 and objects else 0.0,
+        }
+        self.logger.debug(
+            f"Timings: discover={self.stats['discover_seconds']:.2f}s "
+            f"fetch={self.stats['fetch_seconds']:.2f}s compute={self.stats['compute_seconds']:.2f}s"
+        )
+        return Result(scans=scans)
+
+    def _process_result(self, result: Result) -> None:
+        formatted = result.format(self.config.format)
+        self.logger.echo("\n", no_prefix=True)
+        self.logger.print_result(formatted)
+
+    async def run(self) -> Result:
+        self._greet()
+        result = await self._collect_result()
+        self._process_result(result)
+        return result

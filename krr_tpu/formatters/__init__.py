@@ -1,0 +1,5 @@
+from krr_tpu.formatters.base import BaseFormatter
+from krr_tpu.formatters.machine import JSONFormatter, PPrintFormatter, YAMLFormatter
+from krr_tpu.formatters.table import TableFormatter
+
+__all__ = ["BaseFormatter", "JSONFormatter", "PPrintFormatter", "YAMLFormatter", "TableFormatter"]

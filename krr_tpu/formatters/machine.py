@@ -1,0 +1,42 @@
+"""Machine-readable formatters: json, yaml, pprint.
+
+Mirrors `/root/reference/robusta_krr/formatters/{json,yaml,pprint}.py` — all
+three dump the pydantic result model; JSON numbers for Decimals.
+"""
+
+from __future__ import annotations
+
+import json
+from pprint import pformat
+
+import yaml as _yaml
+
+from krr_tpu.formatters.base import BaseFormatter
+from krr_tpu.models.result import Result
+
+
+class JSONFormatter(BaseFormatter):
+    """Formatter for JSON output."""
+
+    __display_name__ = "json"
+
+    def format(self, result: Result) -> str:
+        return result.model_dump_json(indent=2)
+
+
+class YAMLFormatter(BaseFormatter):
+    """Formatter for YAML output."""
+
+    __display_name__ = "yaml"
+
+    def format(self, result: Result) -> str:
+        return _yaml.dump(json.loads(result.model_dump_json()), sort_keys=False)
+
+
+class PPrintFormatter(BaseFormatter):
+    """Formatter for python pprint output."""
+
+    __display_name__ = "pprint"
+
+    def format(self, result: Result) -> str:
+        return pformat(result.model_dump())

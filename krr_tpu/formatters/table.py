@@ -1,0 +1,75 @@
+"""Rich-table formatter — the default human-facing output.
+
+Layout-compatible with the reference's table
+(`/root/reference/robusta_krr/formatters/table.py:45-92`): rows grouped by
+(cluster, namespace, name) with repeated fields blanked, each cell rendered as
+``current -> recommended`` in the cell severity's color, values humanized to 4
+significant digits, ``none`` for absent values and ``?`` for unknown.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from rich.table import Table
+
+from krr_tpu.formatters.base import BaseFormatter
+from krr_tpu.models.allocations import RecommendationValue, ResourceType
+from krr_tpu.models.result import ResourceScan, Result
+from krr_tpu.utils import resource_units
+
+NONE_LITERAL = "none"
+NAN_LITERAL = "?"
+PRECISION = 4
+
+
+def _humanize(value: RecommendationValue, precision: Optional[int] = None) -> str:
+    if value is None:
+        return NONE_LITERAL
+    if isinstance(value, str):
+        return NAN_LITERAL
+    return resource_units.format(value, precision)
+
+
+class TableFormatter(BaseFormatter):
+    """Formatter for rich text-table output."""
+
+    __display_name__ = "table"
+
+    def _format_cell(self, scan: ResourceScan, resource: ResourceType, selector: str) -> str:
+        allocated = getattr(scan.object.allocations, selector)[resource]
+        recommended = getattr(scan.recommended, selector)[resource]
+        color = recommended.severity.color
+        return f"[{color}]{_humanize(allocated)} -> {_humanize(recommended.value, PRECISION)}[/{color}]"
+
+    def format(self, result: Result) -> Table:
+        table = Table(show_header=True, header_style="bold magenta", title=f"Scan result ({result.score} points)")
+        table.add_column("Number", justify="right", no_wrap=True)
+        for column in ("Cluster", "Namespace", "Name", "Pods", "Type", "Container"):
+            table.add_column(column, style="cyan")
+        for resource in ResourceType:
+            table.add_column(f"{resource.name} Requests")
+            table.add_column(f"{resource.name} Limits")
+
+        group_key = lambda pair: (pair[1].object.cluster, pair[1].object.namespace, pair[1].object.name)
+        for _, group in itertools.groupby(enumerate(result.scans), key=group_key):
+            rows = list(group)
+            for j, (i, scan) in enumerate(rows):
+                first, last = j == 0, j == len(rows) - 1
+                table.add_row(
+                    f"[{scan.severity.color}]{i + 1}.[/{scan.severity.color}]",
+                    (scan.object.cluster or "") if first else "",
+                    scan.object.namespace if first else "",
+                    scan.object.name if first else "",
+                    str(len(scan.object.pods)) if first else "",
+                    (scan.object.kind or "") if first else "",
+                    scan.object.container,
+                    *[
+                        self._format_cell(scan, resource, selector)
+                        for resource in ResourceType
+                        for selector in ("requests", "limits")
+                    ],
+                    end_section=last,
+                )
+        return table

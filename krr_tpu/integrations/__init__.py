@@ -1,0 +1,15 @@
+from krr_tpu.integrations.kubeconfig import KubeConfig, resolve_credentials
+from krr_tpu.integrations.kubernetes import ClusterLoader, KubeApi, KubernetesLoader
+from krr_tpu.integrations.prometheus import PrometheusLoader, PrometheusNotFound
+from krr_tpu.integrations.service_discovery import ServiceDiscovery
+
+__all__ = [
+    "KubeConfig",
+    "resolve_credentials",
+    "ClusterLoader",
+    "KubeApi",
+    "KubernetesLoader",
+    "PrometheusLoader",
+    "PrometheusNotFound",
+    "ServiceDiscovery",
+]

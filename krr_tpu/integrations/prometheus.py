@@ -1,0 +1,225 @@
+"""Bulk Prometheus fetch: the whole fleet's history in one async fan-out.
+
+The reference fires one blocking ``custom_query_range`` per pod per resource
+per object through a thread pool and converts every sample to Decimal in
+Python (`/root/reference/robusta_krr/core/integrations/prometheus.py:108-155`)
+— the hot loop SURVEY.md §3.2 flags. This loader replaces it with:
+
+* one ``query_range`` per (object, resource), aggregated ``by (pod)`` over a
+  pod-name regex — O(pods) fewer HTTP round-trips with identical per-pod
+  series (the reference's ``sum(...)`` per pod == our ``sum by (pod)(...)``
+  row for that pod);
+* a bounded async fan-out (``prometheus_max_connections``) with retry +
+  exponential backoff (the reference has retries only at the urllib3 adapter
+  level, no backoff policy — SURVEY.md §5);
+* samples parsed straight into float64 numpy arrays, feeding the packed
+  ``[containers × timesteps]`` device batch — no per-sample Python objects.
+
+PromQL is kept byte-compatible with the reference's queries
+(`prometheus.py:123,136`) so recording-rule expectations carry over.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import re
+from typing import Any, Optional
+
+import httpx
+import numpy as np
+
+from krr_tpu.core.config import Config
+from krr_tpu.integrations.kubeconfig import resolve_credentials
+from krr_tpu.integrations.kubernetes import KubeApi
+from krr_tpu.integrations.service_discovery import PROMETHEUS_SELECTORS, ServiceDiscovery
+from krr_tpu.models.allocations import ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.series import RaggedHistory
+from krr_tpu.utils.logging import KrrLogger, NULL_LOGGER
+
+
+class PrometheusNotFound(Exception):
+    pass
+
+
+def cpu_query(namespace: str, pod_regex: str, container: str) -> str:
+    # Reference query (`prometheus.py:123`) with per-pod aggregation pushed
+    # into PromQL so one request covers every pod of the workload.
+    return (
+        "sum by (pod) (node_namespace_pod_container:container_cpu_usage_seconds_total:sum_irate"
+        f'{{namespace="{namespace}", pod=~"{pod_regex}", container="{container}"}})'
+    )
+
+
+def memory_query(namespace: str, pod_regex: str, container: str) -> str:
+    # Reference query (`prometheus.py:136`).
+    return (
+        'sum by (pod) (container_memory_working_set_bytes{job="kubelet", metrics_path="/metrics/cadvisor", '
+        f'image!="", namespace="{namespace}", pod=~"{pod_regex}", container="{container}"}})'
+    )
+
+
+QUERY_BUILDERS = {ResourceType.CPU: cpu_query, ResourceType.Memory: memory_query}
+
+
+def step_string(step_seconds: float) -> str:
+    """Step in whole minutes, matching the reference (`prometheus.py:126`)."""
+    return f"{max(int(step_seconds) // 60, 1)}m"
+
+
+class PrometheusLoader:
+    """Per-cluster bulk history source (the Runner's ``HistorySource``)."""
+
+    def __init__(self, config: Config, *, cluster: Optional[str] = None, logger: KrrLogger = NULL_LOGGER):
+        self.config = config
+        self.cluster = cluster
+        self.logger = logger
+        self.url: Optional[str] = config.prometheus_url
+        self._client: Optional[httpx.AsyncClient] = None
+        self._connect_lock = asyncio.Lock()
+        self._semaphore = asyncio.Semaphore(config.prometheus_max_connections)
+        self.retries = 3
+
+    # -------------------------------------------------------------- connect
+    async def _discover_url(self) -> tuple[Optional[str], Optional[KubeApi]]:
+        credentials = await asyncio.to_thread(
+            resolve_credentials, self.cluster, self.config.kubeconfig
+        )
+        api = KubeApi(credentials, max_connections=self.config.prometheus_max_connections)
+        discovery = ServiceDiscovery(api, inside_cluster=self.config.inside_cluster, logger=self.logger)
+        return await discovery.find_url(PROMETHEUS_SELECTORS), api
+
+    async def _ensure_connected(self) -> httpx.AsyncClient:
+        if self._client is not None:
+            return self._client
+        async with self._connect_lock:
+            if self._client is not None:
+                return self._client
+
+            kube_api: Optional[KubeApi] = None
+            client: Optional[httpx.AsyncClient] = None
+            try:
+                if not self.url:
+                    self.url, kube_api = await self._discover_url()
+                if not self.url:
+                    raise PrometheusNotFound(
+                        f"Prometheus url could not be found while scanning in {self.cluster or 'default'} cluster"
+                    )
+                self.logger.debug(f"Prometheus URL for {self.cluster or 'default'}: {self.url}")
+
+                headers: dict[str, str] = {}
+                verify: Any = self.config.prometheus_ssl_enabled
+                if self.config.prometheus_auth_header:
+                    headers["Authorization"] = self.config.prometheus_auth_header
+                elif kube_api is not None and not self.config.inside_cluster:
+                    # Apiserver-proxied URL: ride the kubeconfig auth + CA.
+                    # (auth_headers may run an exec plugin — off the loop.)
+                    headers.update(await asyncio.to_thread(kube_api.credentials.auth_headers))
+                    verify = kube_api.credentials.ssl_verify()
+
+                client = httpx.AsyncClient(
+                    base_url=self.url.rstrip("/"),
+                    headers=headers,
+                    verify=verify,
+                    timeout=60.0,
+                    limits=httpx.Limits(max_connections=self.config.prometheus_max_connections),
+                )
+                await self._probe(client)
+            except BaseException:
+                if client is not None:
+                    await client.aclose()
+                raise
+            finally:
+                if kube_api is not None:
+                    await kube_api.close()
+            self._client = client
+            return self._client
+
+    async def _probe(self, client: httpx.AsyncClient) -> None:
+        """Connectivity check with a trivial query (reference `prometheus.py:93-106`)."""
+        try:
+            response = await client.get("/api/v1/query", params={"query": "example"})
+            response.raise_for_status()
+        except (httpx.HTTPError, OSError) as e:
+            raise PrometheusNotFound(
+                f"Couldn't connect to Prometheus found under {self.url}\nCaused by {e.__class__.__name__}: {e}"
+            ) from e
+
+    # ---------------------------------------------------------------- fetch
+    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[dict[str, Any]]:
+        """Range query with retry + exponential backoff.
+
+        Only transient failures (transport errors, 5xx) are retried; a 4xx
+        (bad query) or malformed body fails immediately — retrying those only
+        adds fleet-sized futile sleeps.
+        """
+        client = await self._ensure_connected()
+        last_error: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                async with self._semaphore:
+                    response = await client.get(
+                        "/api/v1/query_range",
+                        params={"query": query, "start": start, "end": end, "step": step},
+                    )
+            except (httpx.TransportError, OSError) as e:
+                last_error = e
+            else:
+                if response.status_code < 500:
+                    response.raise_for_status()  # 4xx: non-retryable, surfaces now
+                    return response.json()["data"]["result"]
+                last_error = httpx.HTTPStatusError(
+                    f"server error {response.status_code}", request=response.request, response=response
+                )
+            if attempt + 1 < self.retries:
+                await asyncio.sleep(0.25 * 2**attempt)
+        assert last_error is not None
+        raise last_error
+
+    async def gather_fleet(
+        self, objects: list[K8sObjectData], history_seconds: float, step_seconds: float
+    ) -> dict[ResourceType, list[RaggedHistory]]:
+        """Fetch per-pod series for every (object, resource) concurrently.
+
+        Objects whose queries fail after retries degrade to empty histories
+        (→ UNKNOWN scans) rather than failing the run.
+        """
+        await self._ensure_connected()
+        end = datetime.datetime.now().timestamp()
+        start = end - history_seconds
+        step = step_string(step_seconds)
+
+        histories: dict[ResourceType, list[RaggedHistory]] = {
+            resource: [{} for _ in objects] for resource in ResourceType
+        }
+
+        async def fetch_one(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
+            if not obj.pods:
+                return
+            pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
+            query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
+            try:
+                series = await self._query_range(query, start, end, step)
+            except Exception as e:
+                self.logger.warning(f"Query failed for {obj} {resource}: {e}")
+                return
+            wanted = set(obj.pods)
+            history: RaggedHistory = {}
+            for entry in series:
+                pod = entry.get("metric", {}).get("pod")
+                values = entry.get("values") or []
+                if pod in wanted and values:
+                    # Pods without samples are dropped (reference `prometheus.py:154`).
+                    history[pod] = np.asarray([float(v) for _, v in values], dtype=np.float64)
+            histories[resource][i] = history
+
+        await asyncio.gather(
+            *[fetch_one(i, obj, resource) for i, obj in enumerate(objects) for resource in ResourceType]
+        )
+        return histories
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None

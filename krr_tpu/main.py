@@ -1,0 +1,152 @@
+"""CLI: one sub-command per registered strategy, flags reflected from settings.
+
+The reference builds these commands by ``exec``-ing a typer source template per
+strategy (`/root/reference/robusta_krr/main.py:39-134`). Here the same UX —
+``krr simple --cpu_percentile 95 -n default -f json`` — is built
+programmatically on click: each strategy's pydantic settings model is
+introspected and its fields become typed ``--flags`` (no ``exec``, and typer
+isn't in this image). Defining a strategy/formatter subclass before calling
+``krr_tpu.run()`` adds a command/option, preserving the plugin contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import decimal
+from typing import Any, Optional
+
+import click
+
+from krr_tpu.utils.version import get_version
+
+
+def _click_type(annotation: Any) -> Any:
+    """Map a settings-field annotation to a click param type."""
+    if annotation is bool:
+        return bool
+    if annotation is int:
+        return int
+    if annotation in (float, decimal.Decimal):
+        return float
+    if annotation is datetime.datetime:
+        return click.DateTime()
+    return str  # unknown types round-trip as str; pydantic re-validates
+
+
+def _strategy_options(strategy_type: Any) -> list[click.Option]:
+    """Reflect a StrategySettings model's fields into click options."""
+    options: list[click.Option] = []
+    for field_name, field in strategy_type.get_settings_type().model_fields.items():
+        default = field.default
+        if isinstance(default, decimal.Decimal):
+            default = float(default)
+        options.append(
+            click.Option(
+                [f"--{field_name}"],
+                type=_click_type(field.annotation),
+                default=default,
+                show_default=True,
+                help=field.description or "",
+            )
+        )
+    return options
+
+
+def _common_options() -> list[click.Option]:
+    return [
+        click.Option(
+            ["--cluster", "-c", "clusters"],
+            multiple=True,
+            help="List of clusters to run on. By default, will run on the current cluster. Use '*' to run on all clusters.",
+        ),
+        click.Option(
+            ["--namespace", "-n", "namespaces"],
+            multiple=True,
+            help="List of namespaces to run on. By default, will run on all namespaces.",
+        ),
+        click.Option(
+            ["--prometheus-url", "-p", "prometheus_url"],
+            default=None,
+            help="Prometheus URL. If not provided, will attempt to find it in kubernetes cluster",
+        ),
+        click.Option(["--prometheus-auth-header"], default=None, help="Prometheus authentication header."),
+        click.Option(["--prometheus-ssl-enabled"], is_flag=True, default=False, help="Enable SSL for Prometheus requests."),
+        click.Option(
+            ["--prometheus-max-connections"],
+            type=int,
+            default=32,
+            show_default=True,
+            help="Max concurrent Prometheus range-query connections for the bulk fetch.",
+        ),
+        click.Option(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
+        click.Option(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
+        click.Option(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
+        click.Option(["--formatter", "-f", "format"], default="table", show_default=True, help="Output formatter"),
+        click.Option(["--verbose", "-v"], is_flag=True, default=False, help="Enable verbose mode"),
+        click.Option(["--quiet", "-q"], is_flag=True, default=False, help="Enable quiet mode"),
+        click.Option(["--logtostderr", "log_to_stderr"], is_flag=True, default=False, help="Pass logs to stderr"),
+    ]
+
+
+def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Command:
+    settings_fields = list(strategy_type.get_settings_type().model_fields)
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+        from krr_tpu.core.runner import Runner
+
+        clusters = list(kwargs.pop("clusters") or [])
+        namespaces = list(kwargs.pop("namespaces") or [])
+        other_args = {name: kwargs.pop(name) for name in settings_fields}
+        try:
+            config = Config(
+                clusters="*" if "*" in clusters else (clusters or None),
+                namespaces="*" if ("*" in namespaces or not namespaces) else namespaces,
+                strategy=strategy_name,
+                other_args=other_args,
+                **kwargs,
+            )
+            runner = Runner(config)  # validates strategy settings (other_args)
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+        asyncio.run(runner.run())
+
+    return click.Command(
+        strategy_name,
+        callback=callback,
+        params=_common_options() + _strategy_options(strategy_type),
+        help=f"Run krr-tpu using the `{strategy_name}` strategy",
+    )
+
+
+@click.group(invoke_without_command=False)
+def app() -> None:
+    """krr-tpu: TPU-native Kubernetes Resource Recommender."""
+
+
+@app.command()
+def version() -> None:
+    """Print the version and exit."""
+    click.echo(get_version())
+
+
+def load_commands() -> None:
+    from krr_tpu.strategies.base import BaseStrategy
+
+    for strategy_name, strategy_type in BaseStrategy.get_all().items():
+        app.add_command(_make_strategy_command(strategy_name, strategy_type))
+
+
+def run() -> None:
+    load_commands()
+    app()
+
+
+if __name__ == "__main__":
+    run()

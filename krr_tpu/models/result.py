@@ -58,6 +58,12 @@ class Severity(str, enum.Enum):
         if current is None or recommended is None:
             return cls.WARNING
 
+        # Guard the reference doesn't have (it would raise DivisionByZero,
+        # reachable with --cpu-min-value 0 and an idle container): a zero
+        # recommendation with a non-zero allocation is maximal over-provisioning.
+        if recommended == 0:
+            return cls.GOOD if current == 0 else cls.CRITICAL
+
         diff = (current - recommended) / recommended
         if diff > 1 or diff < Decimal("-0.5"):
             return cls.CRITICAL

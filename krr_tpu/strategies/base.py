@@ -28,6 +28,7 @@ import pydantic as pd
 from krr_tpu.models.allocations import ResourceType
 from krr_tpu.models.objects import K8sObjectData
 from krr_tpu.models.series import FleetBatch
+from krr_tpu.utils.registry import PluginRegistry
 
 
 @dataclass
@@ -64,11 +65,7 @@ class StrategySettings(pd.BaseModel):
 
 _S = TypeVar("_S", bound=StrategySettings)
 
-_STRATEGY_REGISTRY: dict[str, type["BaseStrategy"]] = {}
-
-
-def _strip_postfix(name: str, postfix: str) -> str:
-    return name[: -len(postfix)] if name.lower().endswith(postfix.lower()) else name
+_STRATEGY_REGISTRY: PluginRegistry = PluginRegistry("strategy", "Strategy", "krr_tpu.strategies")
 
 
 class BaseStrategy(abc.ABC, Generic[_S]):
@@ -90,9 +87,7 @@ class BaseStrategy(abc.ABC, Generic[_S]):
         # intermediate abstract bases stay out of the CLI, either by not
         # defining `run` or by opting out with `__register__ = False`.
         if cls.run is not BaseStrategy.run and cls.__dict__.get("__register__", True):
-            name = cls.__dict__.get("__display_name__") or _strip_postfix(cls.__name__, "Strategy")
-            cls.__display_name__ = name
-            _STRATEGY_REGISTRY[name.lower()] = cls
+            _STRATEGY_REGISTRY.register(cls)
 
     def __init__(self, settings: _S):
         self.settings = settings
@@ -114,17 +109,11 @@ class BaseStrategy(abc.ABC, Generic[_S]):
     # ----------------------------------------------------------- reflection
     @classmethod
     def find(cls, name: str) -> type["BaseStrategy"]:
-        strategies = cls.get_all()
-        if name.lower() in strategies:
-            return strategies[name.lower()]
-        raise ValueError(f"Unknown strategy name: {name}. Available strategies: {', '.join(strategies)}")
+        return _STRATEGY_REGISTRY.find(name)
 
     @classmethod
     def get_all(cls) -> dict[str, type["BaseStrategy"]]:
-        # Importing the built-in package registers the default strategies.
-        import krr_tpu.strategies as _  # noqa: F401
-
-        return dict(_STRATEGY_REGISTRY)
+        return _STRATEGY_REGISTRY.get_all()
 
     @classmethod
     def get_settings_type(cls) -> type[StrategySettings]:

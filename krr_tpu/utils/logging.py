@@ -21,6 +21,7 @@ import inspect
 from typing import Any, Literal
 
 from rich.console import Console
+from rich.markup import escape
 
 _LEVEL_COLOR = {"INFO": "green", "WARNING": "yellow", "ERROR": "red", "DEBUG": "green"}
 
@@ -42,13 +43,23 @@ class KrrLogger:
         return self.verbose and not self.quiet
 
     def echo(
-        self, message: str = "", *, no_prefix: bool = False, type: Literal["INFO", "WARNING", "ERROR"] = "INFO"
+        self,
+        message: str = "",
+        *,
+        no_prefix: bool = False,
+        type: Literal["INFO", "WARNING", "ERROR"] = "INFO",
+        markup: bool = False,
     ) -> None:
+        """``markup=False`` (the default) escapes the message so interpolated
+        content — exception strings, label selectors — can't be eaten by (or
+        crash) rich markup parsing; pass ``markup=True`` for trusted styled
+        text like the banner."""
         if self.quiet:
             return
         color = _LEVEL_COLOR[type]
         prefix = "" if no_prefix else f"[bold {color}][{type}][/bold {color}] "
-        self.console.print(f"{prefix}{message}")
+        body = message if markup else escape(message)
+        self.console.print(f"{prefix}{body}")
 
     def info(self, message: str = "") -> None:
         self.echo(message, type="INFO")
@@ -64,7 +75,7 @@ class KrrLogger:
             return
         frame = inspect.stack()[1]
         self.console.print(
-            f"[bold green][DEBUG][/bold green] {message}\t\t({frame.filename}:{frame.lineno})"
+            f"[bold green][DEBUG][/bold green] {escape(message)}\t\t({frame.filename}:{frame.lineno})"
         )
 
     def debug_exception(self) -> None:

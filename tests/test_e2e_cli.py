@@ -1,0 +1,139 @@
+"""End-to-end tests: Runner and CLI against the fakes.
+
+Mirrors the reference's CLI test surface (`/root/reference/tests/test_krr.py`:
+help, run with -v/-q, all four output formats) but hermetic — no live cluster
+(SURVEY.md §4 item 5).
+"""
+
+import json
+from decimal import Decimal
+
+import numpy as np
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.runner import Runner
+from krr_tpu.main import app, load_commands
+from krr_tpu.models import ResourceType, Severity
+
+from .oracle import oracle_cpu_percentile, oracle_memory_max, oracle_round_cpu, oracle_round_memory
+from .test_integrations import fake_env, make_config  # noqa: F401  (fixture re-export)
+
+load_commands()
+runner = CliRunner()
+
+
+def run_scan(config: Config):
+    import asyncio
+
+    r = Runner(config)
+    return asyncio.run(r.run()), r
+
+
+class TestRunnerE2E:
+    def test_scan_matches_oracle(self, fake_env):  # noqa: F811
+        config = make_config(fake_env, quiet=True)
+        result, _ = run_scan(config)
+        scans = {(s.object.namespace, s.object.name, s.object.container): s for s in result.scans}
+        assert len(scans) == 4  # web×2 containers, db, migrate
+
+        web = scans[("default", "web", "main")]
+        per_pod_cpu = {
+            pod: [Decimal(repr(float(v))) for v in fake_env["metrics"].series[("default", "main", pod)][0]]
+            for pod in fake_env["web_pods"]
+        }
+        per_pod_mem = {
+            pod: [Decimal(repr(float(v))) for v in fake_env["metrics"].series[("default", "main", pod)][1]]
+            for pod in fake_env["web_pods"]
+        }
+        expected_cpu = oracle_round_cpu(oracle_cpu_percentile(per_pod_cpu))
+        expected_mem = oracle_round_memory(oracle_memory_max(per_pod_mem))
+        assert web.recommended.requests[ResourceType.CPU].value == expected_cpu
+        assert web.recommended.requests[ResourceType.Memory].value == expected_mem
+        assert web.recommended.limits[ResourceType.CPU].value is None
+
+        # No metrics at all -> unknown recommendation.
+        migrate = scans[("prod", "migrate", "main")]
+        assert migrate.recommended.requests[ResourceType.CPU].value == "?"
+        # Reference precedence scans OK before UNKNOWN: the (None -> None)
+        # cpu-limit cell is OK and wins over the "?" cells.
+        assert migrate.severity == Severity.OK
+
+    def test_prometheus_failure_degrades_to_unknown(self, fake_env):  # noqa: F811
+        fake_env["metrics"].fail_queries = True
+        try:
+            config = make_config(fake_env, quiet=True)
+            result, _ = run_scan(config)
+            assert result.scans
+            assert all(s.recommended.requests[ResourceType.CPU].value == "?" for s in result.scans)
+        finally:
+            fake_env["metrics"].fail_queries = False
+
+    def test_runner_stats(self, fake_env):  # noqa: F811
+        config = make_config(fake_env, quiet=True)
+        _, r = run_scan(config)
+        assert r.stats["objects"] == 4
+        assert r.stats["compute_seconds"] > 0
+
+
+class TestCLI:
+    def test_help(self):
+        result = runner.invoke(app, ["simple", "--help"])
+        assert result.exit_code == 0, result.output
+        assert "--cpu_percentile" in result.output
+        assert "--history_duration" in result.output
+
+    def test_version(self):
+        result = runner.invoke(app, ["version"])
+        assert result.exit_code == 0
+        assert result.output.strip() == "0.1.0"
+
+    def test_tdigest_command_exists(self):
+        result = runner.invoke(app, ["tdigest", "--help"])
+        assert result.exit_code == 0, result.output
+        assert "--digest_gamma" in result.output
+
+    @pytest.mark.parametrize("log_flag", ["-v", "-q"])
+    def test_run(self, fake_env, log_flag):  # noqa: F811
+        result = runner.invoke(
+            app,
+            ["simple", log_flag, "--kubeconfig", fake_env["kubeconfig"], "-p", fake_env["server"].url],
+        )
+        assert result.exit_code == 0, result.output
+
+    @pytest.mark.parametrize("format", ["json", "yaml", "table", "pprint"])
+    def test_output_formats(self, fake_env, format):  # noqa: F811
+        result = runner.invoke(
+            app,
+            ["simple", "-q", "-f", format, "--kubeconfig", fake_env["kubeconfig"], "-p", fake_env["server"].url],
+        )
+        assert result.exit_code == 0, result.output
+        if format == "json":
+            payload = json.loads(result.output)
+            assert payload["scans"]
+            cpu_cell = payload["scans"][0]["recommended"]["requests"]["cpu"]["value"]
+            assert cpu_cell == "?" or isinstance(cpu_cell, float)
+        if format == "yaml":
+            assert yaml.safe_load(result.output)["scans"]
+
+    def test_strategy_flag_overrides(self, fake_env):  # noqa: F811
+        result = runner.invoke(
+            app,
+            ["simple", "-q", "-f", "json", "--kubeconfig", fake_env["kubeconfig"],
+             "-p", fake_env["server"].url, "--cpu_percentile", "50", "--namespace", "prod"],
+        )
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)
+        assert all(s["object"]["namespace"] == "prod" for s in payload["scans"])
+
+    def test_unknown_strategy_shows_error(self):
+        result = runner.invoke(app, ["nope"])
+        assert result.exit_code != 0
+
+    def test_invalid_setting_value_shows_clean_error(self):
+        result = runner.invoke(app, ["simple", "--cpu_percentile", "200"])
+        assert result.exit_code != 0
+        assert "Invalid settings" in result.output
+        assert "cpu_percentile" in result.output

@@ -1,0 +1,167 @@
+"""Integration tests against the in-process fake apiserver + fake Prometheus."""
+
+import asyncio
+
+import numpy as np
+import pytest
+import yaml
+
+from krr_tpu.core.config import Config
+from krr_tpu.integrations.kubernetes import KubernetesLoader, build_selector_query
+from krr_tpu.integrations.prometheus import PrometheusLoader
+from krr_tpu.models import ResourceType
+
+from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread, make_workload
+
+
+@pytest.fixture(scope="module")
+def fake_env(tmp_path_factory):
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+
+    web_pods = cluster.add_workload_with_pods(
+        "Deployment", "web", "default", pod_count=2,
+        containers=[
+            {"name": "main", "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}}},
+            {"name": "sidecar", "resources": {}},
+        ],
+    )
+    db_pods = cluster.add_workload_with_pods("StatefulSet", "db", "prod", pod_count=3)
+    job_pods = cluster.add_workload_with_pods("Job", "migrate", "prod", pod_count=1)
+    cluster.add_workload_with_pods("DaemonSet", "logger", "kube-system", pod_count=1)
+
+    rng = np.random.default_rng(42)
+    for pod in web_pods:
+        for container in ("main", "sidecar"):
+            metrics.set_series("default", container, pod,
+                               cpu=rng.gamma(2.0, 0.05, 48), memory=rng.uniform(5e7, 2e8, 48))
+    for pod in db_pods:
+        metrics.set_series("prod", "main", pod,
+                           cpu=rng.gamma(2.0, 0.1, 48), memory=rng.uniform(1e8, 4e8, 48))
+    # migrate job: no metrics at all -> UNKNOWN scan
+
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+
+    kubeconfig_path = tmp_path_factory.mktemp("kube") / "config"
+    kubeconfig_path.write_text(yaml.dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+        "users": [{"name": "fake", "user": {"token": "test-token"}}],
+    }))
+
+    yield {
+        "server": server,
+        "cluster": cluster,
+        "metrics": metrics,
+        "kubeconfig": str(kubeconfig_path),
+        "web_pods": web_pods,
+        "db_pods": db_pods,
+        "job_pods": job_pods,
+    }
+    server.stop()
+
+
+def make_config(fake_env, **overrides) -> Config:
+    defaults = dict(kubeconfig=fake_env["kubeconfig"], prometheus_url=fake_env["server"].url)
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+class TestSelectorQuery:
+    def test_match_labels(self):
+        assert build_selector_query({"matchLabels": {"a": "1", "b": "2"}}) == "a=1,b=2"
+
+    def test_match_expressions(self):
+        selector = {
+            "matchLabels": {"app": "x"},
+            "matchExpressions": [
+                {"key": "tier", "operator": "In", "values": ["web", "api"]},
+                {"key": "gpu", "operator": "Exists"},
+                {"key": "legacy", "operator": "DoesNotExist"},
+            ],
+        }
+        assert build_selector_query(selector) == "app=x,tier In (web,api),gpu,!legacy"
+
+    def test_empty(self):
+        assert build_selector_query(None) is None
+        assert build_selector_query({}) is None
+
+
+class TestKubernetesLoader:
+    def test_discovery(self, fake_env):
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        clusters = asyncio.run(loader.list_clusters())
+        assert clusters == ["fake"]
+
+        objects = asyncio.run(loader.list_scannable_objects(clusters))
+        by_name = {(o.namespace, o.name, o.container): o for o in objects}
+        # web has two containers -> two objects; kube-system excluded.
+        assert ("default", "web", "main") in by_name
+        assert ("default", "web", "sidecar") in by_name
+        assert ("prod", "db", "main") in by_name
+        assert ("prod", "migrate", "main") in by_name
+        assert not any(o.namespace == "kube-system" for o in objects)
+
+        web = by_name[("default", "web", "main")]
+        assert web.kind == "Deployment"
+        assert sorted(web.pods) == sorted(fake_env["web_pods"])
+        from decimal import Decimal
+
+        assert web.allocations.requests[ResourceType.CPU] == Decimal("0.1")
+
+    def test_namespace_filter(self, fake_env):
+        config = make_config(fake_env, namespaces=["prod"])
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+        assert objects and all(o.namespace == "prod" for o in objects)
+
+
+class TestPrometheusLoader:
+    def test_gather_fleet(self, fake_env):
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                return await prom.gather_fleet(objects, history_seconds=3600, step_seconds=60)
+            finally:
+                await prom.close()
+
+        histories = asyncio.run(fetch())
+        by_key = {(o.namespace, o.name, o.container): i for i, o in enumerate(objects)}
+
+        web_i = by_key[("default", "web", "main")]
+        for pod in fake_env["web_pods"]:
+            expected_cpu, expected_mem = fake_env["metrics"].series[("default", "main", pod)]
+            np.testing.assert_allclose(histories[ResourceType.CPU][web_i][pod], expected_cpu)
+            np.testing.assert_allclose(histories[ResourceType.Memory][web_i][pod], expected_mem)
+
+        migrate_i = by_key[("prod", "migrate", "main")]
+        assert histories[ResourceType.CPU][migrate_i] == {}
+
+    def test_discovery_via_service_proxy(self, fake_env):
+        fake_env["cluster"].services.append({
+            "metadata": {"name": "prometheus-server", "namespace": "monitoring",
+                         "labels": {"app": "prometheus-server"}},
+            "spec": {"ports": [{"port": 9090}]},
+        })
+        config = make_config(fake_env, prometheus_url=None)
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                histories = await prom.gather_fleet(objects, 3600, 60)
+                return prom.url, histories
+            finally:
+                await prom.close()
+
+        url, histories = asyncio.run(fetch())
+        assert "/proxy" in url and url.startswith(fake_env["server"].url)
+        web_i = next(i for i, o in enumerate(objects) if (o.name, o.container) == ("web", "main"))
+        assert histories[ResourceType.CPU][web_i]  # data flowed through the proxy
