@@ -1,0 +1,124 @@
+"""Benchmark: containers right-sized per second on the available accelerator.
+
+Measures the compute path of the BASELINE.md headline config — the ``tdigest``
+strategy over 7 days of 5-second samples (120,960 timesteps/container) — and
+compares against the reference's algorithm (pure-Python Decimal
+flatten/sort/index, `/root/reference/robusta_krr/strategies/simple.py:24-36`)
+timed on a small sample and extrapolated per container.
+
+Data is generated on-device (the bench isolates kernel throughput from
+Prometheus-side fetch, which is network-bound and covered by the streaming
+design). Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "containers/s", "vs_baseline": N}
+
+Env knobs: BENCH_CONTAINERS (default 10000), BENCH_TIMESTEPS (default 120960),
+BENCH_CHUNK (default 8192), BENCH_PY_SAMPLE (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from decimal import Decimal
+
+
+def python_reference_seconds_per_container(timesteps: int, sample: int) -> float:
+    """Time the reference algorithm (Decimal flatten → percentile-index → max;
+    sorted, per its documented intent) on `sample` containers."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    histories = []
+    for _ in range(sample):
+        cpu = [Decimal(repr(float(v))) for v in rng.gamma(2.0, 0.05, size=timesteps)]
+        mem = [Decimal(repr(float(v))) for v in rng.uniform(1e7, 4e8, size=timesteps)]
+        histories.append((cpu, mem))
+
+    start = time.perf_counter()
+    for cpu, mem in histories:
+        data = sorted(cpu)
+        _ = data[int((len(data) - 1) * Decimal(99) / 100)]
+        _ = max(mem) * Decimal("1.05")
+    return (time.perf_counter() - start) / sample
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_CONTAINERS", 10_000))
+    t = int(os.environ.get("BENCH_TIMESTEPS", 120_960))
+    chunk = int(os.environ.get("BENCH_CHUNK", 8_192))
+    py_sample = int(os.environ.get("BENCH_PY_SAMPLE", 3))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from krr_tpu.ops import digest as digest_ops
+    from krr_tpu.ops.digest import DigestSpec
+
+    spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+    device = jax.devices()[0]
+    print(f"bench: {n} containers x {t} timesteps on {device.platform}:{device.device_kind}", file=sys.stderr)
+
+    # On-device data generation, chunked so RNG temp buffers stay small
+    # (a one-shot gamma at [10k × 120k] OOMs on threefry temps alone).
+    t_padded = ((t + chunk - 1) // chunk) * chunk
+    num_chunks = t_padded // chunk
+
+    @jax.jit
+    def generate(key):
+        def body(i, buf):
+            sub = jax.random.fold_in(key, i)
+            block = jax.random.uniform(sub, (n, chunk), dtype=jnp.float32)
+            block = block * block * 0.8 + 1e-4  # right-skewed cpu-like values
+            return jax.lax.dynamic_update_slice(buf, block, (0, i * chunk))
+
+        return jax.lax.fori_loop(0, num_chunks, body, jnp.zeros((n, t_padded), jnp.float32))
+
+    values = generate(jax.random.PRNGKey(0))
+    counts = jnp.full((n,), t, dtype=jnp.int32)
+    _ = np.asarray(values[:1, :4])  # force generation (relay: block_until_ready is async)
+
+    @jax.jit
+    def scan_step(values, counts):
+        d = digest_ops.build_from_packed(spec, values, counts, chunk_size=chunk)
+        return digest_ops.percentile(spec, d, 99.0), digest_ops.peak(d)
+
+    # Warmup/compile. NOTE: sync via small host readbacks — on the tunneled
+    # TPU backend block_until_ready returns before execution finishes.
+    p99, peak = scan_step(values, counts)
+    _ = np.asarray(p99)
+
+    runs = []
+    for _ in range(3):
+        start = time.perf_counter()
+        p99, peak = scan_step(values, counts)
+        _ = np.asarray(p99)
+        _ = np.asarray(peak)
+        runs.append(time.perf_counter() - start)
+    elapsed = min(runs)
+    throughput = n / elapsed
+
+    py_per_container = python_reference_seconds_per_container(t, py_sample)
+    baseline_throughput = 1.0 / py_per_container
+    print(
+        f"bench: device={elapsed:.3f}s ({throughput:.0f}/s), "
+        f"python-reference={py_per_container:.3f}s/container ({baseline_throughput:.2f}/s)",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "containers_per_sec_tdigest_7d_at_5s",
+                "value": round(throughput, 1),
+                "unit": "containers/s",
+                "vs_baseline": round(throughput / baseline_throughput, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

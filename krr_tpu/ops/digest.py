@@ -136,7 +136,11 @@ def peak(digest: Digest) -> jax.Array:
 
 
 def build_from_packed(
-    spec: DigestSpec, values: jax.Array, counts: jax.Array, chunk_size: int = 4096
+    spec: DigestSpec,
+    values: jax.Array,
+    counts: jax.Array,
+    chunk_size: int = 4096,
+    time_offset: "int | jax.Array" = 0,
 ) -> Digest:
     """Build a digest from a packed ``[N, T]`` array by scanning time chunks.
 
@@ -144,6 +148,11 @@ def build_from_packed(
     integer addition), so tests pin ``chunked == one-shot`` — and the same
     code path serves true streaming, where chunks arrive from the fetch
     pipeline over time.
+
+    ``time_offset`` is the global position of ``values[:, 0]`` when this array
+    is one time-shard of a larger matrix (the sharded build in
+    ``krr_tpu.parallel.fleet``): validity is decided against the row's global
+    count.
     """
     n, t = values.shape
     pad = (-t) % chunk_size
@@ -151,13 +160,16 @@ def build_from_packed(
         values = jnp.pad(values, ((0, 0), (0, pad)))
     num_chunks = values.shape[1] // chunk_size
     chunks = jnp.moveaxis(values.reshape(n, num_chunks, chunk_size), 1, 0)
-    offsets = jnp.arange(num_chunks, dtype=jnp.int32) * chunk_size
+    local_offsets = jnp.arange(num_chunks, dtype=jnp.int32) * chunk_size
 
     def step(digest: Digest, inp: tuple[jax.Array, jax.Array]) -> tuple[Digest, None]:
-        chunk, offset = inp
-        local = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + offset
-        valid = local < counts[:, None]
+        chunk, local_offset = inp
+        local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + local_offset
+        # Valid iff inside this array's real width AND the row's global count
+        # (chunk-alignment pad zeros must never count, even when a later time
+        # shard still holds real samples for the row).
+        valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts[:, None])
         return add_chunk(spec, digest, chunk, valid), None
 
-    digest, _ = jax.lax.scan(step, empty(spec, n), (chunks, offsets))
+    digest, _ = jax.lax.scan(step, empty(spec, n), (chunks, local_offsets))
     return digest

@@ -1,0 +1,29 @@
+from krr_tpu.parallel.fleet import (
+    sharded_fleet_digest,
+    sharded_masked_max,
+    sharded_peak,
+    sharded_percentile,
+    transfer_to_mesh,
+)
+from krr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    TIME_AXIS,
+    fleet_sharding,
+    initialize_distributed,
+    make_mesh,
+    rows_sharding,
+)
+
+__all__ = [
+    "sharded_masked_max",
+    "transfer_to_mesh",
+    "sharded_fleet_digest",
+    "sharded_peak",
+    "sharded_percentile",
+    "DATA_AXIS",
+    "TIME_AXIS",
+    "fleet_sharding",
+    "initialize_distributed",
+    "make_mesh",
+    "rows_sharding",
+]

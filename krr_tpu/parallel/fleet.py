@@ -1,0 +1,141 @@
+"""Sharded fleet reductions: the multi-device digest build.
+
+This is the TPU-native replacement for the reference's per-object asyncio
+fan-out (SURVEY.md §2.9): the packed ``[N, T]`` fleet matrix is laid out over
+a ``(data, time)`` mesh — containers sharded over ``data``, timesteps over
+``time`` — each device builds a digest of its local block, and the digests
+merge with ``psum``/``pmax`` collectives *along the time axis only* (digest
+merges are associative adds, so the collective is exact, not approximate).
+After the merge every row's digest lives replicated along time and sharded
+along data, so quantile extraction is embarrassingly parallel.
+
+Host→device padding: rows pad with count-0 entries (they produce NaN → sliced
+off), time pads with zeros beyond each row's count (masked out by the global
+position test inside each shard).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops.digest import Digest, DigestSpec
+from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS, fleet_sharding, fleet_spec, rows_sharding, rows_spec
+
+
+def pad_for_mesh(values: np.ndarray, counts: np.ndarray, mesh: Mesh) -> tuple[np.ndarray, np.ndarray, int]:
+    """Pad rows/time so both axes divide the mesh; returns (values, counts, real_rows)."""
+    n, t = values.shape
+    data_size = mesh.shape[DATA_AXIS]
+    time_size = mesh.shape[TIME_AXIS]
+    row_pad = (-n) % data_size
+    time_pad = (-t) % time_size
+    if row_pad or time_pad:
+        values = np.pad(values, ((0, row_pad), (0, time_pad)))
+        counts = np.pad(counts, (0, row_pad))
+    return values, counts, n
+
+
+def transfer_to_mesh(
+    values: np.ndarray, counts: np.ndarray, mesh: Mesh
+) -> tuple[jax.Array, jax.Array, int]:
+    """Pad + cast on host, then shard host→device directly.
+
+    The cast happens in numpy and the float32 host array goes straight into
+    ``jax.device_put`` with the target sharding — routing through a device
+    array first would stage the full matrix on one device before resharding,
+    which is exactly the OOM the mesh exists to avoid.
+    """
+    values, counts, real_rows = pad_for_mesh(values, counts, mesh)
+    values_d = jax.device_put(np.ascontiguousarray(values, dtype=np.float32), fleet_sharding(mesh))
+    counts_d = jax.device_put(np.ascontiguousarray(counts, dtype=np.int32), rows_sharding(mesh))
+    return values_d, counts_d, real_rows
+
+
+@partial(jax.jit, static_argnames=("spec", "mesh", "chunk_size"))
+def _sharded_digest_build(
+    spec: DigestSpec, mesh: Mesh, values: jax.Array, counts: jax.Array, chunk_size: int
+) -> Digest:
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(fleet_spec(), rows_spec()),
+        out_specs=(rows_spec(), rows_spec(), rows_spec()),
+        check_vma=False,
+    )
+    def build(local_values: jax.Array, local_counts: jax.Array):
+        # Global time offset of this shard's block: validity is decided against
+        # the row's total count, not the local width.
+        t_local = local_values.shape[1]
+        offset = jax.lax.axis_index(TIME_AXIS) * t_local
+        local = digest_ops.build_from_packed(
+            spec, local_values, local_counts, chunk_size=min(chunk_size, t_local), time_offset=offset
+        )
+        # Exact merge across the time axis (counts add; peak is a max).
+        merged_counts = jax.lax.psum(local.counts, TIME_AXIS)
+        merged_total = jax.lax.psum(local.total, TIME_AXIS)
+        merged_peak = jax.lax.pmax(local.peak, TIME_AXIS)
+        return merged_counts, merged_total, merged_peak
+
+    bucket_counts, total, peak = build(values, counts)
+    return Digest(counts=bucket_counts, total=total, peak=peak)
+
+
+def sharded_fleet_digest(
+    spec: DigestSpec,
+    values: np.ndarray,
+    counts: np.ndarray,
+    mesh: Mesh,
+    chunk_size: int = 4096,
+) -> tuple[Digest, int]:
+    """Build the fleet digest over a mesh. Returns (digest, real_row_count) —
+    the digest's leading axis may be padded to the mesh shape."""
+    values_d, counts_d, real_rows = transfer_to_mesh(values, counts, mesh)
+    return _sharded_digest_build(spec, mesh, values_d, counts_d, chunk_size), real_rows
+
+
+def sharded_percentile(
+    spec: DigestSpec, digest: Digest, q: float, real_rows: int
+) -> np.ndarray:
+    """Quantile extraction over the sharded digest (row-parallel, no collectives),
+    sliced back to the real row count on host."""
+    return np.asarray(digest_ops.percentile(spec, digest, q))[:real_rows]
+
+
+def sharded_peak(digest: Digest, real_rows: int) -> np.ndarray:
+    return np.asarray(digest_ops.peak(digest))[:real_rows]
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _sharded_max_build(mesh: Mesh, values: jax.Array, counts: jax.Array) -> jax.Array:
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(fleet_spec(), rows_spec()),
+        out_specs=rows_spec(),
+        check_vma=False,
+    )
+    def build(local_values: jax.Array, local_counts: jax.Array) -> jax.Array:
+        t_local = local_values.shape[1]
+        offset = jax.lax.axis_index(TIME_AXIS) * t_local
+        position = jnp.arange(t_local, dtype=jnp.int32)[None, :] + offset
+        valid = position < local_counts[:, None]
+        local_peak = jnp.max(jnp.where(valid, local_values, -jnp.inf), axis=1)
+        return jax.lax.pmax(local_peak, TIME_AXIS)
+
+    peak = build(values, counts)
+    return jnp.where(counts > 0, peak, jnp.nan)
+
+
+def sharded_masked_max(
+    values: np.ndarray, counts: np.ndarray, mesh: Mesh
+) -> np.ndarray:
+    """Exact per-row max over the mesh (memory recommendations): local masked
+    max then a pmax along the time axis."""
+    values_d, counts_d, real_rows = transfer_to_mesh(values, counts, mesh)
+    return np.asarray(_sharded_max_build(mesh, values_d, counts_d))[:real_rows]

@@ -1,0 +1,83 @@
+"""Device mesh construction for fleet-scale scans.
+
+The fleet recommendation problem has two natural parallel axes (SURVEY.md
+§2.9): the **containers axis** (data parallelism — shard rows of the
+``[N, T]`` matrix) and the **time axis** (sequence/context parallelism — shard
+long histories, reduce via mergeable digests). A v5e-8 slice is typically
+meshed as ``(data=4, time=2)`` or ``(data=8, time=1)`` depending on whether
+rows or samples dominate.
+
+Multi-host: call :func:`initialize_distributed` first (coordinator env vars or
+explicit args), then the same mesh code spans all hosts' devices — collectives
+ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+TIME_AXIS = "time"
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    time: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, time)`` mesh over the available devices.
+
+    With no arguments, all devices go to the data (containers) axis — the
+    right default when fleets are wide and histories fit per-device.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % time != 0:
+            raise ValueError(f"{len(devices)} devices not divisible by time={time}")
+        data = len(devices) // time
+    if data * time != len(devices):
+        raise ValueError(f"mesh {data}x{time} != {len(devices)} devices")
+    import numpy as np
+
+    return Mesh(np.asarray(devices).reshape(data, time), (DATA_AXIS, TIME_AXIS))
+
+
+def fleet_spec() -> PartitionSpec:
+    """Partitioning of the packed ``[N, T]`` fleet matrix: rows over data,
+    timesteps over time."""
+    return PartitionSpec(DATA_AXIS, TIME_AXIS)
+
+
+def rows_spec() -> PartitionSpec:
+    """Per-row vectors (counts, results): sharded over data, replicated over time."""
+    return PartitionSpec(DATA_AXIS)
+
+
+def fleet_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, fleet_spec())
+
+
+def rows_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, rows_spec())
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: thin wrapper over ``jax.distributed.initialize``.
+
+    With no arguments JAX reads the standard cluster env (coordinator address,
+    process count/index) — the TPU-native analogue of the NCCL/MPI rendezvous
+    the reference ecosystem would use (the reference itself has no distributed
+    backend, SURVEY.md §2.9).
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

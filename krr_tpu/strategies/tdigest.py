@@ -39,6 +39,10 @@ class TDigestStrategySettings(SimpleStrategySettings):
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
     chunk_size: int = pd.Field(4096, ge=128, description="Time-axis chunk size for the streaming digest build.")
+    use_mesh: bool = pd.Field(True, description="Shard the fleet over all devices when more than one is available.")
+    mesh_time_axis: int = pd.Field(
+        1, ge=1, description="Devices on the time (sequence-parallel) mesh axis; the rest shard containers."
+    )
 
     def cpu_spec(self) -> DigestSpec:
         # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
@@ -48,16 +52,41 @@ class TDigestStrategySettings(SimpleStrategySettings):
 class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
     __display_name__ = "tdigest"
 
+    def _mesh(self):
+        import jax
+
+        from krr_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        if not self.settings.use_mesh or len(devices) <= 1:
+            return None
+        # An explicit mesh_time_axis that doesn't divide the device count is a
+        # misconfiguration — make_mesh raises rather than silently degrading
+        # to a data-only mesh (which would defeat the sequence parallelism the
+        # setting asks for).
+        return make_mesh(time=self.settings.mesh_time_axis, devices=devices)
+
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         if not batch.objects:
             return []
         spec = self.settings.cpu_spec()
+        chunk = self.settings.chunk_size
+        mesh = self._mesh()
+        q = float(self.settings.cpu_percentile)
 
-        cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
-        mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+        if mesh is not None:
+            from krr_tpu.parallel import sharded_fleet_digest, sharded_masked_max, sharded_percentile
 
-        cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size)
-        cpu_p = digest_ops.percentile(spec, cpu_digest, float(self.settings.cpu_percentile))
-        mem_max = masked_max(mem_values, mem_counts)
+            cpu = batch.packed(ResourceType.CPU)
+            mem = batch.packed(ResourceType.Memory)
+            cpu_digest, real_rows = sharded_fleet_digest(spec, cpu.values, cpu.counts, mesh, chunk_size=chunk)
+            cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
+            mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
+        else:
+            cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+            mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+            cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=chunk)
+            cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
+            mem_max = np.asarray(masked_max(mem_values, mem_counts))
 
         return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)

@@ -1,0 +1,57 @@
+"""Multi-device tests on the virtual 8-device CPU mesh (SURVEY.md §4 item 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops.digest import DigestSpec
+from krr_tpu.ops.quantile import masked_max, masked_percentile
+from krr_tpu.parallel import make_mesh, sharded_fleet_digest, sharded_peak, sharded_percentile
+
+SPEC = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+
+
+@pytest.fixture(scope="module")
+def fleet(request):
+    rng = np.random.default_rng(99)
+    n, t = 37, 1500  # deliberately not divisible by mesh axes
+    values = rng.gamma(2.0, 0.05, size=(n, t))
+    counts = rng.integers(0, t + 1, size=n).astype(np.int32)
+    counts[0] = 0
+    counts[1] = t
+    return values, counts
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_digest_matches_single_device(fleet, mesh_shape):
+    values, counts = fleet
+    mesh = make_mesh(data=mesh_shape[0], time=mesh_shape[1])
+
+    single = digest_ops.build_from_packed(SPEC, values.astype(np.float32), counts, chunk_size=512)
+    sharded, real_rows = sharded_fleet_digest(SPEC, values, counts, mesh, chunk_size=512)
+
+    assert real_rows == values.shape[0]
+    np.testing.assert_array_equal(np.asarray(sharded.counts)[:real_rows], np.asarray(single.counts))
+    np.testing.assert_array_equal(np.asarray(sharded.total)[:real_rows], np.asarray(single.total))
+    np.testing.assert_array_equal(np.asarray(sharded.peak)[:real_rows], np.asarray(single.peak))
+
+
+def test_sharded_percentile_within_digest_error(fleet):
+    values, counts = fleet
+    mesh = make_mesh(data=4, time=2)
+    sharded, real_rows = sharded_fleet_digest(SPEC, values, counts, mesh, chunk_size=512)
+
+    estimate = sharded_percentile(SPEC, sharded, 99.0, real_rows)
+    exact = np.asarray(masked_percentile(values.astype(np.float32), counts, 99.0))
+    valid = counts > 0
+    np.testing.assert_allclose(estimate[valid], exact[valid], rtol=SPEC.relative_error * 1.05)
+    assert np.isnan(estimate[~valid]).all()
+
+    peak = sharded_peak(sharded, real_rows)
+    expected_peak = np.asarray(masked_max(values.astype(np.float32), counts))
+    np.testing.assert_array_equal(peak[valid], expected_peak[valid])
