@@ -1,18 +1,30 @@
 """Benchmark: containers right-sized per second on the available accelerator.
 
-Measures the compute path of the BASELINE.md headline config — the ``tdigest``
-strategy over 7 days of 5-second samples (120,960 timesteps/container) — and
-compares against the reference's algorithm (pure-Python Decimal
-flatten/sort/index, `/root/reference/robusta_krr/strategies/simple.py:24-36`)
-timed on a small sample and extrapolated per container.
+Measures the full fleet recommendation step at the BASELINE.md headline
+*workload shape* (10k containers × 7 days of 5-second samples = 120,960
+timesteps/container, the config-3 scale) using the production
+``simple``-strategy kernels: **exact** bit-space bisection selection
+(`krr_tpu.ops.selection`) + masked max. Note this is a stronger result than
+BASELINE.md's config-3 row asks for (that row names the approximate tdigest
+sketch): the exact kernel turned out faster than the sketch for HBM-resident
+data, so the headline metric was renamed from
+``containers_per_sec_tdigest_7d_at_5s`` (recorded through 2026-07-29) to
+``containers_per_sec_exact_p99_7d_at_5s``. The ``tdigest`` sketch path —
+still the right tool for streamed/multi-source/incremental data — is timed as
+a secondary number on stderr.
 
-Data is generated on-device (the bench isolates kernel throughput from
-Prometheus-side fetch, which is network-bound and covered by the streaming
-design). Prints ONE JSON line:
+Baseline: the reference's algorithm (pure-Python Decimal flatten/sort/index,
+`/root/reference/robusta_krr/strategies/simple.py:24-36`) timed on a small
+sample and extrapolated per container.
+
+Data is generated on-device in chunks (the bench isolates kernel throughput
+from Prometheus-side fetch, which is network-bound). NOTE: on the tunneled
+TPU backend ``block_until_ready`` returns early — sync is via small host
+readbacks. Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "containers/s", "vs_baseline": N}
 
 Env knobs: BENCH_CONTAINERS (default 10000), BENCH_TIMESTEPS (default 120960),
-BENCH_CHUNK (default 8192), BENCH_PY_SAMPLE (default 3).
+BENCH_CHUNK (default 8192), BENCH_PY_SAMPLE (default 3), BENCH_SKIP_DIGEST.
 """
 
 from __future__ import annotations
@@ -56,13 +68,14 @@ def main() -> None:
 
     from krr_tpu.ops import digest as digest_ops
     from krr_tpu.ops.digest import DigestSpec
+    from krr_tpu.ops.quantile import masked_max
+    from krr_tpu.ops.selection import masked_percentile_bisect
 
-    spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
     device = jax.devices()[0]
     print(f"bench: {n} containers x {t} timesteps on {device.platform}:{device.device_kind}", file=sys.stderr)
 
     # On-device data generation, chunked so RNG temp buffers stay small
-    # (a one-shot gamma at [10k × 120k] OOMs on threefry temps alone).
+    # (a one-shot gamma at [10k x 120k] OOMs on threefry temps alone).
     t_padded = ((t + chunk - 1) // chunk) * chunk
     num_chunks = t_padded // chunk
 
@@ -78,40 +91,54 @@ def main() -> None:
 
     values = generate(jax.random.PRNGKey(0))
     counts = jnp.full((n,), t, dtype=jnp.int32)
-    _ = np.asarray(values[:1, :4])  # force generation (relay: block_until_ready is async)
+    _ = np.asarray(values[:1, :4])  # force generation
 
     @jax.jit
-    def scan_step(values, counts):
-        d = digest_ops.build_from_packed(spec, values, counts, chunk_size=chunk)
-        return digest_ops.percentile(spec, d, 99.0), digest_ops.peak(d)
+    def exact_step(values, counts):
+        return masked_percentile_bisect(values, counts, 99.0), masked_max(values, counts)
 
-    # Warmup/compile. NOTE: sync via small host readbacks — on the tunneled
-    # TPU backend block_until_ready returns before execution finishes.
-    p99, peak = scan_step(values, counts)
-    _ = np.asarray(p99)
+    def timed(step) -> float:
+        p99, peak = step(values, counts)
+        _ = np.asarray(p99)  # warmup/compile
+        best = float("inf")
+        for _i in range(3):
+            start = time.perf_counter()
+            p99, peak = step(values, counts)
+            _ = np.asarray(p99)
+            _ = np.asarray(peak)
+            best = min(best, time.perf_counter() - start)
+        return best
 
-    runs = []
-    for _ in range(3):
-        start = time.perf_counter()
-        p99, peak = scan_step(values, counts)
-        _ = np.asarray(p99)
-        _ = np.asarray(peak)
-        runs.append(time.perf_counter() - start)
-    elapsed = min(runs)
-    throughput = n / elapsed
+    exact_elapsed = timed(exact_step)
+    throughput = n / exact_elapsed
+    print(f"bench: exact bisect+max {exact_elapsed:.3f}s -> {throughput:.0f} containers/s", file=sys.stderr)
+
+    if not os.environ.get("BENCH_SKIP_DIGEST"):
+        spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+
+        @jax.jit
+        def digest_step(values, counts):
+            d = digest_ops.build_from_packed(spec, values, counts, chunk_size=chunk)
+            return digest_ops.percentile(spec, d, 99.0), digest_ops.peak(d)
+
+        digest_elapsed = timed(digest_step)
+        print(
+            f"bench: tdigest sketch {digest_elapsed:.3f}s -> {n / digest_elapsed:.0f} containers/s "
+            f"(streaming/mergeable path)",
+            file=sys.stderr,
+        )
 
     py_per_container = python_reference_seconds_per_container(t, py_sample)
     baseline_throughput = 1.0 / py_per_container
     print(
-        f"bench: device={elapsed:.3f}s ({throughput:.0f}/s), "
-        f"python-reference={py_per_container:.3f}s/container ({baseline_throughput:.2f}/s)",
+        f"bench: python-reference {py_per_container:.3f}s/container ({baseline_throughput:.2f}/s)",
         file=sys.stderr,
     )
 
     print(
         json.dumps(
             {
-                "metric": "containers_per_sec_tdigest_7d_at_5s",
+                "metric": "containers_per_sec_exact_p99_7d_at_5s",
                 "value": round(throughput, 1),
                 "unit": "containers/s",
                 "vs_baseline": round(throughput / baseline_throughput, 1),

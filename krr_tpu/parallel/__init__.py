@@ -3,6 +3,7 @@ from krr_tpu.parallel.fleet import (
     sharded_masked_max,
     sharded_peak,
     sharded_percentile,
+    sharded_percentile_bisect,
     transfer_to_mesh,
 )
 from krr_tpu.parallel.mesh import (
@@ -15,6 +16,7 @@ from krr_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "sharded_percentile_bisect",
     "sharded_masked_max",
     "transfer_to_mesh",
     "sharded_fleet_digest",

@@ -21,9 +21,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops import selection
 from krr_tpu.ops.digest import Digest, DigestSpec
 from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS, fleet_sharding, fleet_spec, rows_sharding, rows_spec
 
@@ -139,3 +140,44 @@ def sharded_masked_max(
     max then a pmax along the time axis."""
     values_d, counts_d, real_rows = transfer_to_mesh(values, counts, mesh)
     return np.asarray(_sharded_max_build(mesh, values_d, counts_d))[:real_rows]
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_iters"))
+def _sharded_bisect_build(
+    mesh: Mesh, values: jax.Array, counts: jax.Array, q: jax.Array, num_iters: int = 31
+) -> jax.Array:
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(fleet_spec(), rows_spec(), PartitionSpec()),
+        out_specs=rows_spec(),
+        check_vma=False,
+    )
+    def run(local_values: jax.Array, local_counts: jax.Array, q_val: jax.Array) -> jax.Array:
+        t_local = local_values.shape[1]
+        offset = jax.lax.axis_index(TIME_AXIS) * t_local
+        position = jnp.arange(t_local, dtype=jnp.int32)[None, :] + offset
+        mask = position < local_counts[:, None]
+        # Same core as the single-device path; the only difference is the
+        # count reduction — an exact integer psum across the time shards.
+        return selection.bisect_loop(
+            selection.as_ordered_bits(local_values),
+            mask,
+            selection.selection_rank(local_counts, q_val),
+            count_reduce=lambda le: jax.lax.psum(le, TIME_AXIS),
+            num_iters=num_iters,
+        )
+
+    result = run(values, counts, jnp.float32(q))
+    return jnp.where(counts > 0, result, jnp.nan)
+
+
+def sharded_percentile_bisect(
+    values: np.ndarray, counts: np.ndarray, q: float, mesh: Mesh
+) -> np.ndarray:
+    """Exact per-row percentile over the mesh via bit-space bisection
+    (`krr_tpu.ops.selection`): 31 counting passes, each reduced with an exact
+    integer psum along the time axis — bit-identical to the single-device
+    sort/bisect paths, but sequence-parallel."""
+    values_d, counts_d, real_rows = transfer_to_mesh(values, counts, mesh)
+    return np.asarray(_sharded_bisect_build(mesh, values_d, counts_d, q))[:real_rows]

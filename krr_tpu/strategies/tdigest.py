@@ -30,6 +30,7 @@ from krr_tpu.strategies.simple import (
     SimpleStrategySettings,
     finalize_fleet,
     fleet_device_arrays,
+    resolve_mesh,
 )
 
 
@@ -39,11 +40,6 @@ class TDigestStrategySettings(SimpleStrategySettings):
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
     chunk_size: int = pd.Field(4096, ge=128, description="Time-axis chunk size for the streaming digest build.")
-    use_mesh: bool = pd.Field(True, description="Shard the fleet over all devices when more than one is available.")
-    mesh_time_axis: int = pd.Field(
-        1, ge=1, description="Devices on the time (sequence-parallel) mesh axis; the rest shard containers."
-    )
-
     def cpu_spec(self) -> DigestSpec:
         # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
         return DigestSpec(gamma=self.digest_gamma, min_value=1e-7, num_buckets=self.digest_buckets)
@@ -52,26 +48,12 @@ class TDigestStrategySettings(SimpleStrategySettings):
 class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
     __display_name__ = "tdigest"
 
-    def _mesh(self):
-        import jax
-
-        from krr_tpu.parallel.mesh import make_mesh
-
-        devices = jax.devices()
-        if not self.settings.use_mesh or len(devices) <= 1:
-            return None
-        # An explicit mesh_time_axis that doesn't divide the device count is a
-        # misconfiguration — make_mesh raises rather than silently degrading
-        # to a data-only mesh (which would defeat the sequence parallelism the
-        # setting asks for).
-        return make_mesh(time=self.settings.mesh_time_axis, devices=devices)
-
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         if not batch.objects:
             return []
         spec = self.settings.cpu_spec()
         chunk = self.settings.chunk_size
-        mesh = self._mesh()
+        mesh = resolve_mesh(self.settings)
         q = float(self.settings.cpu_percentile)
 
         if mesh is not None:

@@ -134,3 +134,39 @@ class TestDigest:
         expected = np.asarray(masked_max(mb, counts))
         np.testing.assert_array_equal(result[:5], expected[:5])
         assert np.isnan(result[5])
+
+
+class TestBisectSelection:
+    def test_exactly_matches_sort_path(self, rng):
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        values = rng.gamma(2.0, 0.05, size=(9, 700)).astype(np.float32)
+        counts = np.array([700, 699, 512, 100, 31, 2, 1, 0, 350], dtype=np.int32)
+        for q in [0.0, 33.0, 50.0, 90.0, 99.0, 100.0]:
+            exact = np.asarray(masked_percentile(values, counts, q))
+            bisect = np.asarray(masked_percentile_bisect(values, counts, q))
+            valid = counts > 0
+            # Bit-exact: the bisection selects the very same sample.
+            np.testing.assert_array_equal(bisect[valid], exact[valid])
+            assert np.isnan(bisect[~valid]).all()
+
+    def test_with_zeros_and_duplicates(self):
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        values = np.zeros((3, 128), dtype=np.float32)
+        values[1, :64] = 1.5  # duplicates
+        counts = np.array([128, 128, 5], dtype=np.int32)
+        for q in [50.0, 99.0]:
+            exact = np.asarray(masked_percentile(values, counts, q))
+            bisect = np.asarray(masked_percentile_bisect(values, counts, q))
+            np.testing.assert_array_equal(bisect, exact)
+
+    def test_rank_clamp_at_and_beyond_q100(self, rng):
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        values = rng.gamma(2.0, 0.05, size=(2, 256)).astype(np.float32)
+        counts = np.array([256, 10], dtype=np.int32)
+        for q in [100.0, 120.0]:  # sort path clips the index; bisect must match
+            exact = np.asarray(masked_percentile(values, counts, q))
+            bisect = np.asarray(masked_percentile_bisect(values, counts, q))
+            np.testing.assert_array_equal(bisect, exact)

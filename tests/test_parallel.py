@@ -55,3 +55,16 @@ def test_sharded_percentile_within_digest_error(fleet):
     peak = sharded_peak(sharded, real_rows)
     expected_peak = np.asarray(masked_max(values.astype(np.float32), counts))
     np.testing.assert_array_equal(peak[valid], expected_peak[valid])
+
+
+def test_sharded_bisect_is_bit_exact(fleet):
+    from krr_tpu.parallel import sharded_percentile_bisect
+
+    values, counts = fleet
+    exact = np.asarray(masked_percentile(values.astype(np.float32), counts, 99.0))
+    for mesh_shape in [(8, 1), (4, 2), (1, 8)]:
+        mesh = make_mesh(data=mesh_shape[0], time=mesh_shape[1])
+        result = sharded_percentile_bisect(values, counts, 99.0, mesh)
+        valid = counts > 0
+        np.testing.assert_array_equal(result[valid], exact[valid])
+        assert np.isnan(result[~valid]).all()
