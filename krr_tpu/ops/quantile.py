@@ -36,10 +36,11 @@ def masked_percentile(values: jax.Array, counts: jax.Array, q: jax.Array | float
     # Padding sorts to the top and is never selected (index < count <= first pad).
     padded = jnp.where(mask, values, jnp.inf)
     ordered = jnp.sort(padded, axis=1)
-    idx = jnp.floor((counts.astype(jnp.float32) - 1.0) * jnp.float32(q) / 100.0).astype(jnp.int32)
-    # Clip to the row's own count (not the padded capacity) so q >= 100 and
-    # float rounding can never select the +inf padding.
-    idx = jnp.clip(idx, 0, jnp.maximum(counts - 1, 0))
+    # Shared rank semantics (incl. the count clamp that keeps q >= 100 and
+    # float rounding from ever selecting the +inf padding).
+    from krr_tpu.ops.selection import selection_rank
+
+    idx = selection_rank(counts, q)
     picked = jnp.take_along_axis(ordered, idx[:, None], axis=1)[:, 0]
     return jnp.where(counts > 0, picked, jnp.nan)
 

@@ -88,6 +88,9 @@ class SimpleStrategySettings(StrategySettings):
     mesh_time_axis: int = pd.Field(
         1, ge=1, description="Devices on the time (sequence-parallel) mesh axis; the rest shard containers."
     )
+    use_pallas: bool = pd.Field(
+        True, description="Use the fused Pallas selection kernel on TPU (bit-identical; ~2x faster)."
+    )
 
 
 def resolve_mesh(settings: SimpleStrategySettings):
@@ -131,7 +134,12 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
         else:
             cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
             mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-            cpu_p = np.asarray(masked_percentile_bisect(cpu_values, cpu_counts, q))
+            if self.settings.use_pallas:
+                from krr_tpu.ops.pallas_select import masked_percentile_bisect_pallas
+
+                cpu_p = np.asarray(masked_percentile_bisect_pallas(cpu_values, cpu_counts, q))
+            else:
+                cpu_p = np.asarray(masked_percentile_bisect(cpu_values, cpu_counts, q))
             mem_max = np.asarray(masked_max(mem_values, mem_counts))
 
         return finalize_fleet(

@@ -170,3 +170,39 @@ class TestBisectSelection:
             exact = np.asarray(masked_percentile(values, counts, q))
             bisect = np.asarray(masked_percentile_bisect(values, counts, q))
             np.testing.assert_array_equal(bisect, exact)
+
+
+class TestPallasSelection:
+    def test_interpret_parity_with_jnp(self, rng):
+        from krr_tpu.ops.pallas_select import masked_percentile_bisect_pallas
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        values = rng.gamma(2.0, 0.05, size=(19, 700)).astype(np.float32)
+        counts = rng.integers(0, 701, size=19).astype(np.int32)
+        for q in [50.0, 99.0, 100.0]:
+            ref = np.asarray(masked_percentile_bisect(values, counts, q))
+            ker = np.asarray(masked_percentile_bisect_pallas(values, counts, q, interpret=True))
+            valid = counts > 0
+            np.testing.assert_array_equal(ker[valid], ref[valid])
+            assert np.isnan(ker[~valid]).all()
+
+    def test_fallback_on_oversized_tile(self, rng):
+        from krr_tpu.ops import pallas_select
+
+        assert not pallas_select.supports(10_000_000)
+        assert not pallas_select.supports(0)
+        values = rng.gamma(2.0, 0.05, size=(4, 256)).astype(np.float32)
+        counts = np.full(4, 256, dtype=np.int32)
+        # On CPU without interpret the wrapper must route to the jnp path.
+        result = np.asarray(pallas_select.masked_percentile_bisect_pallas(values, counts, 99.0))
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        np.testing.assert_array_equal(result, np.asarray(masked_percentile_bisect(values, counts, 99.0)))
+
+    def test_empty_time_axis(self):
+        from krr_tpu.ops.pallas_select import masked_percentile_bisect_pallas
+
+        values = np.zeros((3, 0), dtype=np.float32)
+        counts = np.zeros(3, dtype=np.int32)
+        result = np.asarray(masked_percentile_bisect_pallas(values, counts, 99.0, interpret=True))
+        assert np.isnan(result).all()
