@@ -1,0 +1,193 @@
+"""Incremental digest state: streaming, multi-source merge, checkpoint/resume.
+
+The reference is stateless end-to-end (SURVEY.md §5 "checkpoint/resume:
+absent"); its only knob for long histories is a coarser Prometheus step. The
+digest's associative merge gives us something stronger for free: persist each
+container's digest, and
+
+* **streaming** = merge the new window's digest into the stored one (no
+  re-fetch of old history);
+* **multi-source** = scan each Prometheus source (cluster, federated shard,
+  region) separately against the same store — merges commute, order doesn't
+  matter (BASELINE.md config 5);
+* **checkpoint/resume** = the store *is* the checkpoint; a killed run loses
+  only the unmerged window.
+
+State lives in one ``.npz`` (bucket counts / totals / peaks / memory peaks)
+plus row keys, keyed by the object identity string, so fleets can grow,
+shrink, and reorder between scans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.ops.digest import DigestSpec
+
+
+def object_key(obj: K8sObjectData) -> str:
+    return f"{obj.cluster or ''}/{obj.namespace}/{obj.name}/{obj.container}/{obj.kind or ''}"
+
+
+@dataclass
+class DigestStore:
+    """Host-side persistent digest state for a fleet."""
+
+    spec: DigestSpec
+    keys: list[str] = field(default_factory=list)
+    cpu_counts: np.ndarray = None  # [N, B] float32
+    cpu_total: np.ndarray = None  # [N] float32
+    cpu_peak: np.ndarray = None  # [N] float32 (-inf when empty)
+    mem_total: np.ndarray = None  # [N] float32
+    mem_peak: np.ndarray = None  # [N] float32, in MB (-inf when empty)
+
+    def __post_init__(self) -> None:
+        n, b = len(self.keys), self.spec.num_buckets
+        if self.cpu_counts is None:
+            self.cpu_counts = np.zeros((n, b), dtype=np.float32)
+            self.cpu_total = np.zeros(n, dtype=np.float32)
+            self.cpu_peak = np.full(n, -np.inf, dtype=np.float32)
+            self.mem_total = np.zeros(n, dtype=np.float32)
+            self.mem_peak = np.full(n, -np.inf, dtype=np.float32)
+        self._index = {key: i for i, key in enumerate(self.keys)}
+
+    # ------------------------------------------------------------------ merge
+    def _ensure_rows(self, keys: list[str]) -> np.ndarray:
+        """Indices for ``keys``, growing the store for unseen objects."""
+        new = [key for key in keys if key not in self._index]
+        if new:
+            grow = len(new)
+            self.cpu_counts = np.vstack([self.cpu_counts, np.zeros((grow, self.spec.num_buckets), np.float32)])
+            self.cpu_total = np.concatenate([self.cpu_total, np.zeros(grow, np.float32)])
+            self.cpu_peak = np.concatenate([self.cpu_peak, np.full(grow, -np.inf, np.float32)])
+            self.mem_total = np.concatenate([self.mem_total, np.zeros(grow, np.float32)])
+            self.mem_peak = np.concatenate([self.mem_peak, np.full(grow, -np.inf, np.float32)])
+            for key in new:
+                self._index[key] = len(self.keys)
+                self.keys.append(key)
+        return np.asarray([self._index[key] for key in keys], dtype=np.int64)
+
+    def merge_window(
+        self,
+        keys: list[str],
+        cpu_counts: np.ndarray,
+        cpu_total: np.ndarray,
+        cpu_peak: np.ndarray,
+        mem_total: np.ndarray,
+        mem_peak: np.ndarray,
+    ) -> np.ndarray:
+        """Fold one scanned window (any source, any order) into the store;
+        returns the store row index for each input key."""
+        rows = self._ensure_rows(keys)
+        np.add.at(self.cpu_counts, rows, cpu_counts.astype(np.float32))
+        np.add.at(self.cpu_total, rows, cpu_total.astype(np.float32))
+        np.maximum.at(self.cpu_peak, rows, cpu_peak.astype(np.float32))
+        np.add.at(self.mem_total, rows, mem_total.astype(np.float32))
+        np.maximum.at(self.mem_peak, rows, mem_peak.astype(np.float32))
+        return rows
+
+    # -------------------------------------------------------------- quantiles
+    def cpu_percentile(self, rows: np.ndarray, q: float) -> np.ndarray:
+        """Quantile estimate from merged counts (host numpy; same math as
+        ``krr_tpu.ops.digest.percentile``). NaN where no data."""
+        counts = self.cpu_counts[rows]
+        total = self.cpu_total[rows]
+        rank = np.maximum(np.floor((total - 1.0) * q / 100.0), 0.0)
+        cum = np.cumsum(counts, axis=1)
+        k = np.argmax(cum > rank[:, None], axis=1).astype(np.float64)
+        estimate = np.where(
+            k == 0, 0.0, self.spec.min_value * np.exp((k - 0.5) * np.log(self.spec.gamma))
+        )
+        estimate = np.minimum(estimate, self.cpu_peak[rows])
+        return np.where(total > 0, estimate, np.nan).astype(np.float32)
+
+    def memory_peak(self, rows: np.ndarray) -> np.ndarray:
+        return np.where(self.mem_total[rows] > 0, self.mem_peak[rows], np.nan).astype(np.float32)
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path: str) -> None:
+        """Atomic write (tmp + rename): a crash mid-save keeps the old state."""
+        meta = {
+            "gamma": self.spec.gamma,
+            "min_value": self.spec.min_value,
+            "num_buckets": self.spec.num_buckets,
+        }
+        directory = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(
+                    f,
+                    meta=json.dumps(meta),
+                    keys=np.asarray(self.keys),
+                    cpu_counts=self.cpu_counts,
+                    cpu_total=self.cpu_total,
+                    cpu_peak=self.cpu_peak,
+                    mem_total=self.mem_total,
+                    mem_peak=self.mem_peak,
+                )
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    @classmethod
+    def load(cls, path: str) -> "DigestStore":
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(str(data["meta"]))
+            spec = DigestSpec(gamma=meta["gamma"], min_value=meta["min_value"], num_buckets=meta["num_buckets"])
+            return cls(
+                spec=spec,
+                keys=[str(k) for k in data["keys"]],
+                cpu_counts=data["cpu_counts"],
+                cpu_total=data["cpu_total"],
+                cpu_peak=data["cpu_peak"],
+                mem_total=data["mem_total"],
+                mem_peak=data["mem_peak"],
+            )
+
+    @staticmethod
+    @contextlib.contextmanager
+    def locked(path: str) -> Iterator[None]:
+        """Advisory exclusive lock for one load-merge-save cycle, so concurrent
+        multi-source scans against the same state serialize instead of the
+        last save silently discarding the other's merge."""
+        lock_path = path + ".lock"
+        with open(lock_path, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+    @classmethod
+    def open_or_create(cls, path: Optional[str], spec: DigestSpec) -> "DigestStore":
+        if path and os.path.exists(path):
+            try:
+                store = cls.load(path)
+            except Exception as e:  # BadZipFile / KeyError / EOFError / ValueError
+                raise ValueError(
+                    f"digest state at {path} is unreadable ({type(e).__name__}: {e}); "
+                    f"delete the file to start fresh"
+                ) from e
+            if (store.spec.gamma, store.spec.min_value, store.spec.num_buckets) != (
+                spec.gamma,
+                spec.min_value,
+                spec.num_buckets,
+            ):
+                raise ValueError(
+                    f"digest state at {path} was built with spec {store.spec}, "
+                    f"incompatible with requested {spec}; delete the state file or match the settings"
+                )
+            return store
+        return cls(spec=spec)

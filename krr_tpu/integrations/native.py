@@ -1,0 +1,130 @@
+"""ctypes bridge to the native Prometheus-matrix parser (`native/fastsamples.cpp`).
+
+Loads ``libfastsamples.so``, building it with g++ on first use if missing
+(cached next to the source; falls back silently to the pure-Python parser when
+no compiler is available — the native path is an optimization, not a
+requirement). ``parse_matrix`` has the same contract as the Python fallback:
+response bytes → list of (pod_name, float64 samples).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libfastsamples.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH):
+                source = os.path.join(_NATIVE_DIR, "fastsamples.cpp")
+                if not os.path.exists(source):
+                    raise FileNotFoundError(source)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, source],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.krr_parse_matrix.restype = ctypes.c_long
+            lib.krr_parse_matrix.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.c_char_p,
+                ctypes.c_long,
+            ]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def parse_matrix_python(body: bytes) -> list[tuple[str, np.ndarray]]:
+    """Reference implementation: json.loads + per-sample float().
+
+    Raises on a non-success or shape-less payload (e.g. a proxy answering 200
+    with ``{"status":"error"}``) so misconfigurations surface as logged query
+    failures instead of silent empty histories."""
+    payload = json.loads(body)
+    if payload.get("status") != "success" or "result" not in payload.get("data", {}):
+        raise ValueError(
+            f"unexpected Prometheus response: status={payload.get('status')!r}, "
+            f"error={payload.get('error')!r}"
+        )
+    result = payload["data"]["result"]
+    series = []
+    for entry in result:
+        pod = entry.get("metric", {}).get("pod", "")
+        values = entry.get("values") or []
+        series.append((pod, np.asarray([float(v) for _, v in values], dtype=np.float64)))
+    return series
+
+
+def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
+    """Native parse; None when the library is unavailable or reports malformed
+    input (caller falls back to Python)."""
+    lib = _load_library()
+    if lib is None:
+        return None
+
+    values_cap = max(len(body) // 8, 1024)  # every sample costs >8 response bytes
+    series_cap = max(len(body) // 64, 64)
+    names_cap = max(len(body) // 16, 4096)
+    values = np.empty(values_cap, dtype=np.float64)
+    lens = np.empty(series_cap, dtype=np.int64)
+    names = ctypes.create_string_buffer(names_cap)
+
+    n = lib.krr_parse_matrix(
+        body,
+        len(body),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        values_cap,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        series_cap,
+        names,
+        names_cap,
+    )
+    if n < 0:
+        return None
+    pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
+    series = []
+    offset = 0
+    for i in range(n):
+        length = int(lens[i])
+        series.append((pods[i], values[offset : offset + length].copy()))
+        offset += length
+    return series
+
+
+def parse_matrix(body: bytes) -> list[tuple[str, np.ndarray]]:
+    """Parse a query_range matrix response: native when possible, Python otherwise."""
+    # Error payloads route through the Python parser, which raises with the
+    # server's error message (the native scanner only understands matrices).
+    if b'"status":"error"' not in body[:4096]:
+        native = parse_matrix_native(body)
+        if native is not None:
+            return native
+    return parse_matrix_python(body)

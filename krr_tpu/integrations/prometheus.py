@@ -147,13 +147,17 @@ class PrometheusLoader:
             ) from e
 
     # ---------------------------------------------------------------- fetch
-    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[dict[str, Any]]:
-        """Range query with retry + exponential backoff.
+    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[tuple[str, np.ndarray]]:
+        """Range query with retry + exponential backoff; returns parsed
+        (pod, samples) series via the native matrix parser
+        (`krr_tpu.integrations.native`, pure-Python fallback).
 
         Only transient failures (transport errors, 5xx) are retried; a 4xx
         (bad query) or malformed body fails immediately — retrying those only
         adds fleet-sized futile sleeps.
         """
+        from krr_tpu.integrations.native import parse_matrix
+
         client = await self._ensure_connected()
         last_error: Optional[Exception] = None
         for attempt in range(self.retries):
@@ -168,7 +172,9 @@ class PrometheusLoader:
             else:
                 if response.status_code < 500:
                     response.raise_for_status()  # 4xx: non-retryable, surfaces now
-                    return response.json()["data"]["result"]
+                    # Parsing is CPU-bound (up to ~MBs per response): keep it
+                    # off the event loop so the fetch fan-out stays concurrent.
+                    return await asyncio.to_thread(parse_matrix, response.content)
                 last_error = httpx.HTTPStatusError(
                     f"server error {response.status_code}", request=response.request, response=response
                 )
@@ -206,12 +212,10 @@ class PrometheusLoader:
                 return
             wanted = set(obj.pods)
             history: RaggedHistory = {}
-            for entry in series:
-                pod = entry.get("metric", {}).get("pod")
-                values = entry.get("values") or []
-                if pod in wanted and values:
+            for pod, samples in series:
+                if pod in wanted and samples.size:
                     # Pods without samples are dropped (reference `prometheus.py:154`).
-                    history[pod] = np.asarray([float(v) for _, v in values], dtype=np.float64)
+                    history[pod] = samples
             histories[resource][i] = history
 
         await asyncio.gather(

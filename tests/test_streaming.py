@@ -1,0 +1,162 @@
+"""Streaming / multi-source / checkpoint-resume tests (BASELINE.md config 5)."""
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.streaming import DigestStore, object_key
+from krr_tpu.models import FleetBatch, K8sObjectData, ResourceAllocations, ResourceType
+from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops.digest import DigestSpec
+from krr_tpu.strategies import TDigestStrategy, TDigestStrategySettings
+
+SPEC = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+
+
+def make_obj(name: str, pods: list[str]) -> K8sObjectData:
+    return K8sObjectData(
+        cluster="c", namespace="ns", name=name, kind="Deployment", container="main", pods=pods,
+        allocations=ResourceAllocations(requests={}, limits={}),
+    )
+
+
+def window_batch(rng, objects: list[K8sObjectData], t: int) -> FleetBatch:
+    cpu = [{pod: rng.gamma(2.0, 0.05, size=t) for pod in obj.pods} for obj in objects]
+    mem = [{pod: rng.uniform(5e7, 3e8, size=t) for pod in obj.pods} for obj in objects]
+    return FleetBatch.build(objects, {ResourceType.CPU: cpu, ResourceType.Memory: mem})
+
+
+class TestDigestStore:
+    def test_save_load_roundtrip(self, tmp_path, rng):
+        store = DigestStore(spec=SPEC, keys=["a", "b"])
+        store.cpu_counts[:] = rng.integers(0, 5, size=store.cpu_counts.shape)
+        store.cpu_total[:] = store.cpu_counts.sum(axis=1)
+        store.cpu_peak[:] = [0.5, 1.5]
+        store.mem_total[:] = [10, 0]
+        store.mem_peak[:] = [100.0, -np.inf]
+        path = str(tmp_path / "state.npz")
+        store.save(path)
+        loaded = DigestStore.load(path)
+        assert loaded.keys == ["a", "b"]
+        np.testing.assert_array_equal(loaded.cpu_counts, store.cpu_counts)
+        np.testing.assert_array_equal(loaded.mem_peak, store.mem_peak)
+
+    def test_incremental_windows_equal_oneshot(self, rng):
+        """4 disjoint windows (4 'Prometheus sources') merged in any order
+        must equal one digest over the concatenated history — exactly."""
+        t = 512
+        windows = [rng.gamma(2.0, 0.05, size=(3, t)).astype(np.float32) for _ in range(4)]
+        counts = np.full(3, t, dtype=np.int32)
+
+        store = DigestStore(spec=SPEC)
+        keys = ["x", "y", "z"]
+        order = [2, 0, 3, 1]  # merge out of order: merges must commute
+        for w in order:
+            d = digest_ops.build_from_packed(SPEC, windows[w], counts, chunk_size=128)
+            rows = store.merge_window(
+                keys,
+                np.asarray(d.counts),
+                np.asarray(d.total),
+                np.asarray(d.peak),
+                counts.astype(np.float32),
+                np.zeros(3, np.float32),
+            )
+
+        full = np.concatenate(windows, axis=1)
+        d_full = digest_ops.build_from_packed(SPEC, full, np.full(3, 4 * t, np.int32), chunk_size=128)
+        np.testing.assert_array_equal(store.cpu_counts[rows], np.asarray(d_full.counts))
+        np.testing.assert_array_equal(store.cpu_total[rows], np.asarray(d_full.total))
+        np.testing.assert_array_equal(store.cpu_peak[rows], np.asarray(d_full.peak))
+
+        # Quantile from the merged store matches the one-shot device estimate.
+        np.testing.assert_allclose(
+            store.cpu_percentile(rows, 99.0),
+            np.asarray(digest_ops.percentile(SPEC, d_full, 99.0)),
+            rtol=1e-6,
+        )
+
+    def test_spec_mismatch_raises(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        DigestStore(spec=SPEC).save(path)
+        other = DigestSpec(gamma=1.02, min_value=1e-7, num_buckets=2560)
+        with pytest.raises(ValueError, match="incompatible"):
+            DigestStore.open_or_create(path, other)
+
+
+class TestStatefulStrategy:
+    def test_two_windows_accumulate_and_fleet_grows(self, tmp_path, rng):
+        path = str(tmp_path / "state.npz")
+        settings = TDigestStrategySettings(state_path=path, chunk_size=128)
+        strategy = TDigestStrategy(settings)
+
+        obj_a = make_obj("a", ["a-0"])
+        obj_b = make_obj("b", ["b-0"])
+
+        # Window 1: only object a, low cpu values.
+        batch1 = window_batch(rng, [obj_a], t=256)
+        r1 = strategy.run_batch(batch1)[0]
+
+        # Window 2: a and a brand-new b; a gets much hotter cpu.
+        cpu_hot = {"a-0": rng.gamma(2.0, 0.5, size=256)}
+        mem2 = {"a-0": rng.uniform(5e7, 3e8, size=256)}
+        batch2 = FleetBatch.build(
+            [obj_a, obj_b],
+            {
+                ResourceType.CPU: [cpu_hot, {"b-0": rng.gamma(2.0, 0.05, size=256)}],
+                ResourceType.Memory: [mem2, {"b-0": rng.uniform(5e7, 3e8, size=256)}],
+            },
+        )
+        r2 = strategy.run_batch(batch2)
+        # a's merged p99 reflects the hot window (way above window-1's rec).
+        assert float(r2[0][ResourceType.CPU].request) > float(r1[ResourceType.CPU].request) * 2
+        # b exists only in window 2 and still gets a recommendation.
+        assert not r2[1][ResourceType.CPU].request.is_nan()
+
+        # The state survives process boundaries (fresh strategy instance).
+        strategy2 = TDigestStrategy(TDigestStrategySettings(state_path=path, chunk_size=128))
+        store = DigestStore.open_or_create(path, settings.cpu_spec())
+        assert sorted(store.keys) == sorted([object_key(obj_a), object_key(obj_b)])
+        assert store.cpu_total[store._index[object_key(obj_a)]] == 512  # 2 windows x 256
+
+
+class TestStoreLocking:
+    def test_lock_serializes_concurrent_merges(self, tmp_path):
+        import threading
+        import time as time_mod
+
+        path = str(tmp_path / "state.npz")
+        order = []
+
+        def worker(name: str, hold: float) -> None:
+            with DigestStore.locked(path):
+                order.append(f"{name}-in")
+                store = DigestStore.open_or_create(path, SPEC)
+                store.merge_window(
+                    [name],
+                    np.ones((1, SPEC.num_buckets), np.float32),
+                    np.asarray([float(SPEC.num_buckets)], np.float32),
+                    np.asarray([1.0], np.float32),
+                    np.asarray([1.0], np.float32),
+                    np.asarray([1.0], np.float32),
+                )
+                time_mod.sleep(hold)
+                store.save(path)
+                order.append(f"{name}-out")
+
+        t1 = threading.Thread(target=worker, args=("a", 0.2))
+        t1.start()
+        time_mod.sleep(0.05)
+        t2 = threading.Thread(target=worker, args=("b", 0.0))
+        t2.start()
+        t1.join()
+        t2.join()
+        # Critical sections must not interleave, and both merges must survive.
+        assert order in (["a-in", "a-out", "b-in", "b-out"], ["b-in", "b-out", "a-in", "a-out"])
+        final = DigestStore.load(path)
+        assert sorted(final.keys) == ["a", "b"]
+
+    def test_corrupt_state_error_message(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        with open(path, "w") as f:
+            f.write("garbage")
+        with pytest.raises(ValueError, match="delete the file to start fresh"):
+            DigestStore.open_or_create(path, SPEC)
