@@ -151,11 +151,17 @@ class Result(pd.BaseModel):
             return 0
         total = 0.0
         for scan, resource in itertools.product(self.scans, ResourceType):
+            # .get: a container may have no allocation set, and a strategy may
+            # omit a resource entirely (empty history) — both contribute 0.
+            requests_cell = scan.recommended.requests.get(resource)
+            limits_cell = scan.recommended.limits.get(resource)
             total += _percentage_difference(
-                scan.object.allocations.requests[resource], scan.recommended.requests[resource].value
+                scan.object.allocations.requests.get(resource),
+                requests_cell.value if requests_cell is not None else None,
             )
             total += _percentage_difference(
-                scan.object.allocations.limits[resource], scan.recommended.limits[resource].value
+                scan.object.allocations.limits.get(resource),
+                limits_cell.value if limits_cell is not None else None,
             )
         # Average percentage diff per cell (2 resources × 2 selectors), mapped
         # onto 0-100: a fleet perfectly at its recommendations scores 100.

@@ -1,0 +1,52 @@
+"""Force JAX onto a virtual n-device CPU platform.
+
+The image's sitecustomize imports jax pointed at the real TPU before any env
+var a caller sets can take effect, so tests and the driver's multi-chip dry
+run both need to (a) rewrite ``XLA_FLAGS`` with the requested virtual device
+count — replacing a stale count if one is already present — and (b) override
+the already-captured ``jax_platforms`` config. Shared here so the workaround
+lives in exactly one place (used by ``tests/conftest.py`` and
+``__graft_entry__.dryrun_multichip``).
+
+Both knobs only take effect before the first JAX backend initialization:
+``XLA_FLAGS`` is read when the CPU client is created, and the platform
+config is consulted on first device lookup. ``force_virtual_cpu`` verifies
+the result and raises a clear error when it was called too late.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Point JAX at ``n_devices`` virtual CPU devices.
+
+    Must run before the first JAX backend initialization in this process
+    (importing jax is fine; running any computation is not). Raises
+    ``RuntimeError`` if the platform could not be forced — typically because
+    a backend was already initialized.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"{_COUNT_FLAG}={n_devices}"
+    if _COUNT_FLAG in flags:
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    devices = jax.devices()
+    if len(devices) < n_devices or devices[0].platform != "cpu":
+        raise RuntimeError(
+            f"force_virtual_cpu({n_devices}) got {len(devices)} {devices[0].platform} device(s); "
+            "a JAX backend was already initialized in this process — call this before any "
+            "JAX computation runs, or use a fresh process."
+        )

@@ -5,19 +5,9 @@ mesh exercises the same sharding/collective code paths
 (SURVEY.md §4 item 4). Must run before the first `import jax` anywhere.
 """
 
-import os
+from krr_tpu.utils.cpu_platform import force_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may point at a real TPU
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The image's sitecustomize imports jax and registers a TPU plugin before this
-# conftest runs, so the env var alone is captured too late — override the
-# already-initialized config as well.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
 
 import numpy as np
 import pytest
