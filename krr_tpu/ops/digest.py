@@ -82,19 +82,37 @@ def bucketize(spec: DigestSpec, values: jax.Array) -> jax.Array:
 
 
 def _histogram(spec: DigestSpec, idx: jax.Array, valid: jax.Array) -> jax.Array:
-    """Per-row bucket counts from bucket indices, via sort + rank difference.
+    """Per-row bucket counts from bucket indices, via two sorts and no scatter.
 
-    Sort-based counting keeps everything dense (no scatter): invalid entries
-    get a sentinel index that sorts past every real bucket, then the count of
-    bucket ``b`` is the rank difference of ``b``'s first/last occurrence,
-    recovered with a batched searchsorted.
+    TPU scatter-add runs at ~100 M updates/s and a batched ``searchsorted``
+    over all buckets is worse; single-key (radix) sorts run ~6x faster. So the
+    histogram is built from sorts alone:
+
+    1. Sort the interleaved encoding ``data -> 2*idx`` (even) with one marker
+       per bucket ``b -> 2*b + 1`` (odd). After sorting, the marker for bucket
+       ``b`` sits after exactly ``cum[b]`` data elements (side='right'
+       semantics) plus the ``b`` markers below it, so its position ``p`` gives
+       ``cum[b] = p - b`` directly.
+    2. Compact the marker slots back into bucket order with one key-value
+       sort (markers keep their rank; data slots get an infinite key).
+
+    Bucket counts are then the first difference of the cumulative counts.
+    Invalid entries get an even sentinel above every marker, so they never
+    count toward any bucket.
     """
+    n, t = idx.shape
     b = spec.num_buckets
-    sentinel = jnp.int32(b)
-    sorted_idx = jnp.sort(jnp.where(valid, idx, sentinel), axis=1)
-    queries = jnp.arange(b, dtype=jnp.int32)
-    cum = jax.vmap(lambda row: jnp.searchsorted(row, queries, side="right", method="sort"))(sorted_idx)
-    return jnp.diff(cum, axis=1, prepend=0).astype(jnp.float32)
+    sentinel = jnp.int32(2 * b + 2)
+    enc_data = jnp.where(valid, 2 * idx, sentinel)
+    enc_markers = jnp.broadcast_to(2 * jnp.arange(b, dtype=jnp.int32) + 1, (n, b))
+    sorted_enc = jnp.sort(jnp.concatenate([enc_data, enc_markers], axis=1), axis=1)
+    is_marker = (sorted_enc & 1) == 1
+    rank = jnp.cumsum(is_marker.astype(jnp.int32), axis=1)  # b + 1 at bucket b's marker
+    pos = jnp.broadcast_to(jnp.arange(t + b, dtype=jnp.int32), (n, t + b))
+    cum_here = pos - (rank - 1)  # data elements <= b, at marker slots
+    compact_key = jnp.where(is_marker, rank - 1, jnp.int32(2**31 - 1))
+    _, cum = jax.lax.sort((compact_key, cum_here), dimension=1, num_keys=1)
+    return jnp.diff(cum[:, :b], axis=1, prepend=0).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -139,7 +157,7 @@ def build_from_packed(
     spec: DigestSpec,
     values: jax.Array,
     counts: jax.Array,
-    chunk_size: int = 4096,
+    chunk_size: int = 8192,
     time_offset: "int | jax.Array" = 0,
 ) -> Digest:
     """Build a digest from a packed ``[N, T]`` array by scanning time chunks.

@@ -92,7 +92,7 @@ def sharded_fleet_digest(
     values: np.ndarray,
     counts: np.ndarray,
     mesh: Mesh,
-    chunk_size: int = 4096,
+    chunk_size: int = 8192,
 ) -> tuple[Digest, int]:
     """Build the fleet digest over a mesh. Returns (digest, real_row_count) —
     the digest's leading axis may be padded to the mesh shape."""

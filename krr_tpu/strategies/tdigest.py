@@ -41,7 +41,7 @@ class TDigestStrategySettings(SimpleStrategySettings):
         1.01, gt=1, description="Log-bucket growth factor; relative quantile error is sqrt(gamma) - 1."
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
-    chunk_size: int = pd.Field(4096, ge=128, description="Time-axis chunk size for the streaming digest build.")
+    chunk_size: int = pd.Field(8192, ge=128, description="Time-axis chunk size for the streaming digest build.")
     state_path: Optional[str] = pd.Field(
         None,
         description=(
