@@ -1,0 +1,52 @@
+"""Shared time-axis chunk scan for mergeable sketch builds.
+
+Both sketch families (log-bucket digest, exact top-K) stream a packed
+``[N, T]`` matrix through a ``lax.scan`` over fixed-size time chunks, folding
+each chunk into a fixed-size carry. The chunking, padding, and — critically —
+the validity contract live here, once: a position is valid iff it is inside
+this array's real width AND its *global* position (local + ``time_offset``) is
+below the row's total count. Chunk-alignment pad zeros must never count, even
+when a later time shard still holds real samples for the row (the sharded
+builds in `krr_tpu.parallel.fleet` pass a per-shard ``time_offset``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+State = TypeVar("State")
+
+
+def scan_time_chunks(
+    values: jax.Array,
+    counts: jax.Array,
+    init: State,
+    fold: Callable[[State, jax.Array, jax.Array], State],
+    chunk_size: int,
+    time_offset: "int | jax.Array" = 0,
+) -> State:
+    """Fold ``fold(state, chunk, valid)`` over ``[N, T]`` in time chunks.
+
+    The fold must be an exact merge (integer adds, maxes, top-k) so the result
+    is bit-identical for any chunk size — the property the chunked == one-shot
+    tests pin, and what makes the same code path serve true streaming.
+    """
+    n, t = values.shape
+    pad = (-t) % chunk_size
+    if pad:
+        values = jnp.pad(values, ((0, 0), (0, pad)))
+    num_chunks = values.shape[1] // chunk_size
+    chunks = jnp.moveaxis(values.reshape(n, num_chunks, chunk_size), 1, 0)
+    local_offsets = jnp.arange(num_chunks, dtype=jnp.int32) * chunk_size
+
+    def step(state: State, inp: tuple[jax.Array, jax.Array]) -> tuple[State, None]:
+        chunk, local_offset = inp
+        local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + local_offset
+        valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts[:, None])
+        return fold(state, chunk, valid), None
+
+    state, _ = jax.lax.scan(step, init, (chunks, local_offsets))
+    return state

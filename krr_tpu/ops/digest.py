@@ -170,24 +170,16 @@ def build_from_packed(
     ``time_offset`` is the global position of ``values[:, 0]`` when this array
     is one time-shard of a larger matrix (the sharded build in
     ``krr_tpu.parallel.fleet``): validity is decided against the row's global
-    count.
+    count (see `krr_tpu.ops.chunked` for the shared contract).
     """
-    n, t = values.shape
-    pad = (-t) % chunk_size
-    if pad:
-        values = jnp.pad(values, ((0, 0), (0, pad)))
-    num_chunks = values.shape[1] // chunk_size
-    chunks = jnp.moveaxis(values.reshape(n, num_chunks, chunk_size), 1, 0)
-    local_offsets = jnp.arange(num_chunks, dtype=jnp.int32) * chunk_size
+    from krr_tpu.ops.chunked import scan_time_chunks
 
-    def step(digest: Digest, inp: tuple[jax.Array, jax.Array]) -> tuple[Digest, None]:
-        chunk, local_offset = inp
-        local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + local_offset
-        # Valid iff inside this array's real width AND the row's global count
-        # (chunk-alignment pad zeros must never count, even when a later time
-        # shard still holds real samples for the row).
-        valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts[:, None])
-        return add_chunk(spec, digest, chunk, valid), None
-
-    digest, _ = jax.lax.scan(step, empty(spec, n), (chunks, local_offsets))
-    return digest
+    n = values.shape[0]
+    return scan_time_chunks(
+        values,
+        counts,
+        empty(spec, n),
+        lambda digest, chunk, valid: add_chunk(spec, digest, chunk, valid),
+        chunk_size,
+        time_offset,
+    )

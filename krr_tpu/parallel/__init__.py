@@ -1,5 +1,6 @@
 from krr_tpu.parallel.fleet import (
     sharded_fleet_digest,
+    sharded_fleet_topk,
     sharded_masked_max,
     sharded_peak,
     sharded_percentile,
@@ -20,6 +21,7 @@ __all__ = [
     "sharded_masked_max",
     "transfer_to_mesh",
     "sharded_fleet_digest",
+    "sharded_fleet_topk",
     "sharded_peak",
     "sharded_percentile",
     "DATA_AXIS",

@@ -25,7 +25,9 @@ from jax.sharding import Mesh, PartitionSpec
 
 from krr_tpu.ops import digest as digest_ops
 from krr_tpu.ops import selection
+from krr_tpu.ops import topk_sketch as topk_ops
 from krr_tpu.ops.digest import Digest, DigestSpec
+from krr_tpu.ops.topk_sketch import TopKSketch
 from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS, fleet_sharding, fleet_spec, rows_sharding, rows_spec
 
 
@@ -110,6 +112,46 @@ def sharded_percentile(
 
 def sharded_peak(digest: Digest, real_rows: int) -> np.ndarray:
     return np.asarray(digest_ops.peak(digest))[:real_rows]
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "chunk_size"))
+def _sharded_topk_build(
+    mesh: Mesh, values: jax.Array, counts: jax.Array, k: int, chunk_size: int
+) -> TopKSketch:
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(fleet_spec(), rows_spec()),
+        out_specs=(PartitionSpec(DATA_AXIS, None), rows_spec()),
+        check_vma=False,
+    )
+    def build(local_values: jax.Array, local_counts: jax.Array):
+        t_local = local_values.shape[1]
+        offset = jax.lax.axis_index(TIME_AXIS) * t_local
+        local = topk_ops.build_from_packed(
+            local_values, local_counts, k=k, chunk_size=min(chunk_size, t_local), time_offset=offset
+        )
+        # Exact merge across the time shards: the union's top-K is inside the
+        # gathered per-shard top-Ks, so one all_gather + top_k finishes it.
+        gathered = jax.lax.all_gather(local.values, TIME_AXIS, axis=1, tiled=True)
+        top, _ = jax.lax.top_k(gathered, k)
+        return top, jax.lax.psum(local.total, TIME_AXIS)
+
+    top, total = build(values, counts)
+    return TopKSketch(values=top, total=total)
+
+
+def sharded_fleet_topk(
+    values: np.ndarray,
+    counts: np.ndarray,
+    k: int,
+    mesh: Mesh,
+    chunk_size: int = 8192,
+) -> tuple[TopKSketch, int]:
+    """Build the exact top-K sketch over the mesh (the sequence-parallel form
+    of `krr_tpu.ops.topk_sketch`). Returns (sketch, real_row_count)."""
+    values_d, counts_d, real_rows = transfer_to_mesh(values, counts, mesh)
+    return _sharded_topk_build(mesh, values_d, counts_d, k, chunk_size), real_rows
 
 
 @partial(jax.jit, static_argnames=("mesh",))

@@ -12,6 +12,13 @@ guaranteed relative error (0.5 % at the default gamma), inside the ±1 % gate.
 The digest state is mergeable (counts add), which is also what powers
 multi-device psum merges (`krr_tpu.parallel`), incremental multi-source
 re-merge, and checkpoint/resume (BASELINE.md configs 3-5).
+
+When the configured percentile is high enough that its rank-from-the-top fits
+in ``exact_sketch_budget`` (always true for the default p99 at reference
+sample rates), the one-shot streaming build upgrades itself to the exact
+top-K sketch (`krr_tpu.ops.topk_sketch`) — same chunked scan, zero error,
+about half the cost. The persistent ``state_path`` store stays on the
+histogram digest, whose merged state answers any percentile later.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ import pydantic as pd
 from krr_tpu.models.allocations import ResourceType
 from krr_tpu.models.series import FleetBatch
 from krr_tpu.ops import digest as digest_ops
+from krr_tpu.ops import topk_sketch as topk_ops
 from krr_tpu.ops.digest import DigestSpec
 from krr_tpu.ops.quantile import masked_max
 from krr_tpu.strategies.base import BatchedStrategy, RunResult
@@ -42,6 +50,16 @@ class TDigestStrategySettings(SimpleStrategySettings):
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
     chunk_size: int = pd.Field(8192, ge=128, description="Time-axis chunk size for the streaming digest build.")
+    exact_sketch_budget: int = pd.Field(
+        8192,
+        ge=0,
+        description=(
+            "Max top-K sketch width for the exact high-percentile path "
+            "(krr_tpu.ops.topk_sketch): when the configured cpu_percentile's "
+            "rank-from-the-top fits, the streaming build is exact (no digest "
+            "error) and ~2x faster. 0 forces the histogram digest."
+        ),
+    )
     state_path: Optional[str] = pd.Field(
         None,
         description=(
@@ -111,20 +129,41 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
                 mem_max = store.memory_peak(rows)
                 store.save(self.settings.state_path)
         elif mesh is not None:
-            from krr_tpu.parallel import sharded_fleet_digest, sharded_masked_max, sharded_percentile
+            from krr_tpu.parallel import (
+                sharded_fleet_digest,
+                sharded_fleet_topk,
+                sharded_masked_max,
+                sharded_percentile,
+            )
 
             cpu = batch.packed(ResourceType.CPU)
             mem = batch.packed(ResourceType.Memory)
-            cpu_digest, real_rows = sharded_fleet_digest(
-                spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
-            )
-            cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
+            k = topk_ops.required_k(cpu.capacity, q)
+            if 0 < k <= self.settings.exact_sketch_budget:
+                sketch, real_rows = sharded_fleet_topk(
+                    cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
+                )
+                cpu_p = np.asarray(topk_ops.percentile(sketch, q))[:real_rows]
+            else:
+                cpu_digest, real_rows = sharded_fleet_digest(
+                    spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
+                )
+                cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
             mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
         else:
             cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
             mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-            cpu_digest = digest_ops.build_from_packed(spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size)
-            cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
+            k = topk_ops.required_k(batch.packed(ResourceType.CPU).capacity, q)
+            if 0 < k <= self.settings.exact_sketch_budget:
+                sketch = topk_ops.build_from_packed(
+                    cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
+                )
+                cpu_p = np.asarray(topk_ops.percentile(sketch, q))
+            else:
+                cpu_digest = digest_ops.build_from_packed(
+                    spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size
+                )
+                cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
             mem_max = np.asarray(masked_max(mem_values, mem_counts))
 
         return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)

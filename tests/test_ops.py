@@ -206,3 +206,59 @@ class TestPallasSelection:
         counts = np.zeros(3, dtype=np.int32)
         result = np.asarray(masked_percentile_bisect_pallas(values, counts, 99.0, interpret=True))
         assert np.isnan(result).all()
+
+
+class TestTopKSketch:
+    def test_exact_match_with_percentile(self, rng):
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        values = rng.gamma(2.0, 0.05, size=(9, 700)).astype(np.float32)
+        counts = np.array([700, 699, 512, 300, 100, 7, 2, 1, 0], dtype=np.int32)
+        for q in [97.0, 99.0, 99.9, 100.0]:
+            k = topk_ops.required_k(values.shape[1], q)
+            sketch = topk_ops.build_from_packed(values, counts, k=k, chunk_size=256)
+            got = np.asarray(topk_ops.percentile(sketch, q))
+            exact = np.asarray(masked_percentile(values, counts, q))
+            np.testing.assert_array_equal(got[:-1], exact[:-1])
+            assert np.isnan(got[-1])
+
+    def test_required_k_covers_rank(self):
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        import math
+
+        for capacity in [1, 2, 100, 1344, 120_960]:
+            for q in [97.0, 99.0, 99.99]:
+                k = topk_ops.required_k(capacity, q)
+                assert k % 128 == 0
+                for n in range(1, capacity + 1, max(1, capacity // 97)):
+                    rank_top = (n - 1) - math.floor((n - 1) * q / 100.0)
+                    assert rank_top < k
+
+    def test_chunked_equals_oneshot(self, rng):
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        values = rng.gamma(2.0, 0.05, size=(4, 1024)).astype(np.float32)
+        counts = np.array([1024, 1000, 513, 0], dtype=np.int32)
+        one = topk_ops.build_from_packed(values, counts, k=128, chunk_size=1024)
+        chunked = topk_ops.build_from_packed(values, counts, k=128, chunk_size=128)
+        np.testing.assert_array_equal(np.asarray(one.values), np.asarray(chunked.values))
+        np.testing.assert_array_equal(np.asarray(one.total), np.asarray(chunked.total))
+
+    def test_merge_is_concatenation(self, rng):
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        a = rng.gamma(2.0, 0.05, size=(3, 256)).astype(np.float32)
+        b = rng.gamma(2.0, 0.05, size=(3, 512)).astype(np.float32)
+        ca = np.full(3, 256, dtype=np.int32)
+        cb = np.array([512, 100, 0], dtype=np.int32)
+        merged = topk_ops.merge(
+            topk_ops.build_from_packed(a, ca, k=128),
+            topk_ops.build_from_packed(b, cb, k=128),
+        )
+        mask_a = np.arange(256)[None, :] < ca[:, None]
+        mask_b = np.arange(512)[None, :] < cb[:, None]
+        packed, counts = pack_ragged([[ra[ma], rb[mb]] for ra, ma, rb, mb in zip(a, mask_a, b, mask_b)])
+        concat = topk_ops.build_from_packed(packed.astype(np.float32), counts, k=128)
+        np.testing.assert_array_equal(np.asarray(merged.values), np.asarray(concat.values))
+        np.testing.assert_array_equal(np.asarray(merged.total), np.asarray(concat.total))

@@ -68,3 +68,19 @@ def test_sharded_bisect_is_bit_exact(fleet):
         valid = counts > 0
         np.testing.assert_array_equal(result[valid], exact[valid])
         assert np.isnan(result[~valid]).all()
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (1, 8)])
+def test_sharded_topk_is_bit_exact(fleet, mesh_shape):
+    from krr_tpu.ops import topk_sketch as topk_ops
+    from krr_tpu.parallel import sharded_fleet_topk
+
+    values, counts = fleet
+    mesh = make_mesh(data=mesh_shape[0], time=mesh_shape[1])
+    k = topk_ops.required_k(values.shape[1], 99.0)
+    sketch, real_rows = sharded_fleet_topk(values, counts, k, mesh, chunk_size=512)
+    got = np.asarray(topk_ops.percentile(sketch, 99.0))[:real_rows]
+    exact = np.asarray(masked_percentile(values.astype(np.float32), counts, 99.0))
+    valid = counts > 0
+    np.testing.assert_array_equal(got[valid], exact[valid])
+    assert np.isnan(got[~valid]).all()
