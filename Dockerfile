@@ -1,0 +1,29 @@
+# Container image for krr-tpu — the TPU-native equivalent of the reference's
+# image (/root/reference/Dockerfile: python-slim + poetry + `python krr.py simple`).
+# The base here must carry a TPU-enabled jax; `python:slim` + `pip install
+# jax[tpu]` works for Cloud TPU VMs, and the same image runs CPU-only (XLA
+# host platform) for development and CI.
+FROM python:3.12-slim AS builder
+
+WORKDIR /app
+
+# Native toolchain for the optional C++ fast-ingest extension (native/).
+RUN apt-get update && \
+    apt-get install --no-install-recommends -y g++ make && \
+    apt-get clean && \
+    rm -rf /var/lib/apt/lists/*
+
+COPY pyproject.toml README.md ./
+COPY krr_tpu ./krr_tpu
+COPY native ./native
+
+# TPU wheels come from the libtpu releases index; on non-TPU hosts the same
+# install falls back to the bundled CPU backend at runtime.
+RUN pip install --no-cache-dir . \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
+    make -C native
+
+COPY krr.py ./
+
+# Same default entrypoint shape as the reference: scan with the simple strategy.
+CMD ["python", "krr.py", "simple"]

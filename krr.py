@@ -1,0 +1,8 @@
+"""Entry shim, mirroring the reference's `/root/reference/krr.py:1-4`:
+``python krr.py simple ...`` runs the CLI (also installed as the ``krr-tpu``
+console script)."""
+
+from krr_tpu import run
+
+if __name__ == "__main__":
+    run()

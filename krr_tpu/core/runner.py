@@ -147,6 +147,65 @@ class Runner:
         await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
         return FleetBatch.build(objects, histories)
 
+    async def _gather_fleet_digests(self, objects: list[K8sObjectData]) -> "DigestedFleet":
+        """Digest-ingest fetch (tdigest ``--digest_ingest``): per cluster, use
+        the source's fused parse+digest path when it has one; otherwise fetch
+        raw and digest on host — so fakes and third-party sources keep working.
+        Failure semantics match the raw path (cluster failure → empty digests
+        → UNKNOWN scans)."""
+        from krr_tpu.integrations.native import _digest_python
+        from krr_tpu.models.series import DigestedFleet
+
+        settings = self._strategy.settings
+        spec = settings.cpu_spec()
+        history_seconds = settings.history_timedelta.total_seconds()
+        step_seconds = settings.timeframe_timedelta.total_seconds()
+
+        by_cluster: dict[Optional[str], list[int]] = {}
+        for i, obj in enumerate(objects):
+            by_cluster.setdefault(obj.cluster, []).append(i)
+
+        fleet = DigestedFleet.empty(objects, spec.gamma, spec.min_value, spec.num_buckets)
+
+        def fold_histories(indices: list[int], fetched: dict[ResourceType, list[RaggedHistory]]) -> None:
+            for local_i, global_i in enumerate(indices):
+                for pod, samples in fetched[ResourceType.CPU][local_i].items():
+                    counts, total, peak = _digest_python(samples, spec.gamma, spec.min_value, spec.num_buckets)
+                    fleet.cpu_counts[global_i] += counts
+                    fleet.cpu_total[global_i] += total
+                    fleet.cpu_peak[global_i] = max(fleet.cpu_peak[global_i], peak)
+                for pod, samples in fetched[ResourceType.Memory][local_i].items():
+                    if samples.size:
+                        fleet.mem_total[global_i] += samples.size
+                        fleet.mem_peak[global_i] = max(fleet.mem_peak[global_i], float(samples.max()))
+
+        async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
+            subset = [objects[i] for i in indices]
+            try:
+                source = self._get_history_source(cluster)
+                if hasattr(source, "gather_fleet_digests"):
+                    sub_fleet = await source.gather_fleet_digests(
+                        subset, history_seconds, step_seconds, spec.gamma, spec.min_value, spec.num_buckets
+                    )
+                    for local_i, global_i in enumerate(indices):
+                        fleet.cpu_counts[global_i] += sub_fleet.cpu_counts[local_i]
+                        fleet.cpu_total[global_i] += sub_fleet.cpu_total[local_i]
+                        fleet.cpu_peak[global_i] = max(fleet.cpu_peak[global_i], sub_fleet.cpu_peak[local_i])
+                        fleet.mem_total[global_i] += sub_fleet.mem_total[local_i]
+                        fleet.mem_peak[global_i] = max(fleet.mem_peak[global_i], sub_fleet.mem_peak[local_i])
+                else:
+                    fetched = await source.gather_fleet(subset, history_seconds, step_seconds)
+                    fold_histories(indices, fetched)
+            except Exception as e:
+                self.logger.warning(
+                    f"Failed to gather digests for cluster {cluster or 'default'}: {e} — "
+                    f"marking {len(subset)} objects as unknown"
+                )
+                self.logger.debug_exception()
+
+        await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
+        return fleet
+
     def _round_result(self, raw: RunResult) -> ResourceAllocations:
         return ResourceAllocations(
             requests={
@@ -178,11 +237,18 @@ class Runner:
         t1 = time.perf_counter()
         self.logger.info(f"Found {len(objects)} scannable objects")
 
-        batch = await self._gather_fleet_history(objects)
-        t2 = time.perf_counter()
-
-        # The batched strategy call is CPU/TPU bound; keep the loop responsive.
-        raw_results = await asyncio.to_thread(self._strategy.run_batch, batch)
+        digest_ingest = bool(getattr(self._strategy.settings, "digest_ingest", False)) and hasattr(
+            self._strategy, "run_digested"
+        )
+        if digest_ingest:
+            fleet = await self._gather_fleet_digests(objects)
+            t2 = time.perf_counter()
+            raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
+        else:
+            batch = await self._gather_fleet_history(objects)
+            t2 = time.perf_counter()
+            # The batched strategy call is CPU/TPU bound; keep the loop responsive.
+            raw_results = await asyncio.to_thread(self._strategy.run_batch, batch)
         t3 = time.perf_counter()
 
         scans = [

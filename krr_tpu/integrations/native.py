@@ -34,8 +34,13 @@ def _load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_SO_PATH):
-                source = os.path.join(_NATIVE_DIR, "fastsamples.cpp")
+            source = os.path.join(_NATIVE_DIR, "fastsamples.cpp")
+            # Rebuild when missing OR stale: a cached .so from an older source
+            # would load but lack newer symbols, and the blanket failure
+            # handling below would then silently disable the whole native path.
+            if not os.path.exists(_SO_PATH) or (
+                os.path.exists(source) and os.path.getmtime(source) > os.path.getmtime(_SO_PATH)
+            ):
                 if not os.path.exists(source):
                     raise FileNotFoundError(source)
                 subprocess.run(
@@ -56,6 +61,32 @@ def _load_library() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p,
                 ctypes.c_long,
             ]
+            lib.krr_parse_matrix_digest.restype = ctypes.c_long
+            lib.krr_parse_matrix_digest.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.c_double,
+                ctypes.c_double,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long,
+                ctypes.c_char_p,
+                ctypes.c_long,
+            ]
+            lib.krr_parse_matrix_stats.restype = ctypes.c_long
+            lib.krr_parse_matrix_stats.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long,
+                ctypes.c_char_p,
+                ctypes.c_long,
+            ]
+            lib.krr_count_series.restype = ctypes.c_long
+            lib.krr_count_series.argtypes = [ctypes.c_char_p, ctypes.c_long]
             _lib = lib
         except Exception:
             _build_failed = True
@@ -128,3 +159,100 @@ def parse_matrix(body: bytes) -> list[tuple[str, np.ndarray]]:
         if native is not None:
             return native
     return parse_matrix_python(body)
+
+
+#: Result of a fused parse+digest pass: per-series (pod, bucket counts,
+#: total sample count, exact max).
+DigestedSeries = list[tuple[str, np.ndarray, float, float]]
+
+
+def _digest_python(samples: np.ndarray, gamma: float, min_value: float, num_buckets: int):
+    """Vectorized fallback with the bucketize semantics of `krr_tpu.ops.digest`."""
+    counts = np.zeros(num_buckets, dtype=np.float64)
+    if samples.size == 0:
+        return counts, 0.0, -np.inf
+    safe = np.maximum(samples, min_value)
+    raw = np.floor(np.log(safe / min_value) / np.log(gamma)).astype(np.int64)
+    idx = np.where(samples <= min_value, 0, 1 + np.clip(raw, 0, num_buckets - 2))
+    np.add.at(counts, idx, 1.0)
+    return counts, float(samples.size), float(samples.max())
+
+
+def parse_matrix_digest(
+    body: bytes, gamma: float, min_value: float, num_buckets: int
+) -> DigestedSeries:
+    """Fused parse + per-series digest accumulation.
+
+    The streaming-ingest hot path: every sample goes straight from the
+    response bytes into its log bucket (native single pass, O(num_buckets)
+    memory per series — raw sample arrays are never materialized). Bucket
+    layout matches `krr_tpu.ops.digest.bucketize`; note the native path
+    computes ``log`` in float64 while the device path uses float32, so a
+    sample sitting exactly on a bucket boundary may land one bucket apart —
+    within the digest's stated relative error, but not bit-identical.
+    """
+    lib = _load_library()
+    if lib is not None and b'"status":"error"' not in body[:4096]:
+        # Exact series count up front: the counts matrix is
+        # series x num_buckets doubles, so a body-length-proportional guess
+        # would allocate ~320x the response size for nothing.
+        series_cap = lib.krr_count_series(body, len(body))
+        if series_cap >= 0:
+            names_cap = max(len(body) // 16, 4096)
+            counts = np.zeros((series_cap, num_buckets), dtype=np.float64)
+            totals = np.zeros(series_cap, dtype=np.float64)
+            peaks = np.zeros(series_cap, dtype=np.float64)
+            names = ctypes.create_string_buffer(names_cap)
+            n = lib.krr_parse_matrix_digest(
+                body,
+                len(body),
+                gamma,
+                min_value,
+                num_buckets,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                totals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                peaks.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                series_cap,
+                names,
+                names_cap,
+            )
+            if n >= 0:
+                pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
+                return [(pods[i], counts[i].copy(), float(totals[i]), float(peaks[i])) for i in range(n)]
+    return [
+        (pod, *_digest_python(samples, gamma, min_value, num_buckets))
+        for pod, samples in parse_matrix(body)
+    ]
+
+
+#: Result of a stats-only parse: per-series (pod, total sample count, exact max).
+SeriesStats = list[tuple[str, float, float]]
+
+
+def parse_matrix_stats(body: bytes) -> SeriesStats:
+    """Per-series count + exact max in one native pass — the memory-resource
+    ingest (max × buffer needs no histogram, and no per-sample log())."""
+    lib = _load_library()
+    if lib is not None and b'"status":"error"' not in body[:4096]:
+        series_cap = lib.krr_count_series(body, len(body))
+        if series_cap >= 0:
+            names_cap = max(len(body) // 16, 4096)
+            totals = np.zeros(series_cap, dtype=np.float64)
+            peaks = np.zeros(series_cap, dtype=np.float64)
+            names = ctypes.create_string_buffer(names_cap)
+            n = lib.krr_parse_matrix_stats(
+                body,
+                len(body),
+                totals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                peaks.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                series_cap,
+                names,
+                names_cap,
+            )
+            if n >= 0:
+                pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
+                return [(pods[i], float(totals[i]), float(peaks[i])) for i in range(n)]
+    return [
+        (pod, float(samples.size), float(samples.max()) if samples.size else float("-inf"))
+        for pod, samples in parse_matrix(body)
+    ]

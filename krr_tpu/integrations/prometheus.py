@@ -147,17 +147,14 @@ class PrometheusLoader:
             ) from e
 
     # ---------------------------------------------------------------- fetch
-    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[tuple[str, np.ndarray]]:
-        """Range query with retry + exponential backoff; returns parsed
-        (pod, samples) series via the native matrix parser
-        (`krr_tpu.integrations.native`, pure-Python fallback).
+    async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
+        """Range query with retry + exponential backoff; returns the raw
+        response body (callers pick their parser).
 
         Only transient failures (transport errors, 5xx) are retried; a 4xx
-        (bad query) or malformed body fails immediately — retrying those only
-        adds fleet-sized futile sleeps.
+        (bad query) fails immediately — retrying those only adds fleet-sized
+        futile sleeps.
         """
-        from krr_tpu.integrations.native import parse_matrix
-
         client = await self._ensure_connected()
         last_error: Optional[Exception] = None
         for attempt in range(self.retries):
@@ -172,9 +169,7 @@ class PrometheusLoader:
             else:
                 if response.status_code < 500:
                     response.raise_for_status()  # 4xx: non-retryable, surfaces now
-                    # Parsing is CPU-bound (up to ~MBs per response): keep it
-                    # off the event loop so the fetch fan-out stays concurrent.
-                    return await asyncio.to_thread(parse_matrix, response.content)
+                    return response.content
                 last_error = httpx.HTTPStatusError(
                     f"server error {response.status_code}", request=response.request, response=response
                 )
@@ -182,6 +177,16 @@ class PrometheusLoader:
                 await asyncio.sleep(0.25 * 2**attempt)
         assert last_error is not None
         raise last_error
+
+    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[tuple[str, np.ndarray]]:
+        """Range query → parsed (pod, samples) series via the native matrix
+        parser (`krr_tpu.integrations.native`, pure-Python fallback)."""
+        from krr_tpu.integrations.native import parse_matrix
+
+        body = await self._fetch_range_body(query, start, end, step)
+        # Parsing is CPU-bound (up to ~MBs per response): keep it off the
+        # event loop so the fetch fan-out stays concurrent.
+        return await asyncio.to_thread(parse_matrix, body)
 
     async def gather_fleet(
         self, objects: list[K8sObjectData], history_seconds: float, step_seconds: float
@@ -222,6 +227,81 @@ class PrometheusLoader:
             *[fetch_one(i, obj, resource) for i, obj in enumerate(objects) for resource in ResourceType]
         )
         return histories
+
+    async def _query_range_digest(
+        self, query: str, start: float, end: float, step: str, gamma: float, min_value: float, num_buckets: int
+    ) -> "list[tuple[str, np.ndarray, float, float]]":
+        """Range query whose response folds straight into per-series digests
+        (fused native parse+digest, `krr_tpu.integrations.native`) — raw
+        sample arrays are never materialized."""
+        from krr_tpu.integrations.native import parse_matrix_digest
+
+        body = await self._fetch_range_body(query, start, end, step)
+        return await asyncio.to_thread(parse_matrix_digest, body, gamma, min_value, num_buckets)
+
+    async def _query_range_stats(
+        self, query: str, start: float, end: float, step: str
+    ) -> "list[tuple[str, float, float]]":
+        """Range query → per-series (pod, count, max) only — the memory
+        ingest, which needs no histogram and no per-sample log()."""
+        from krr_tpu.integrations.native import parse_matrix_stats
+
+        body = await self._fetch_range_body(query, start, end, step)
+        return await asyncio.to_thread(parse_matrix_stats, body)
+
+    async def gather_fleet_digests(
+        self,
+        objects: list[K8sObjectData],
+        history_seconds: float,
+        step_seconds: float,
+        gamma: float,
+        min_value: float,
+        num_buckets: int,
+    ) -> "DigestedFleet":
+        """Digest-ingest fetch: every (object, resource) query's samples are
+        bucketized at parse time; per-pod digests merge into per-object
+        digests by exact count addition / peak max. Ingest memory is
+        O(num_buckets) per object instead of O(window length). Failed queries
+        degrade to empty digests (→ UNKNOWN scans), like ``gather_fleet``."""
+        from krr_tpu.models.series import DigestedFleet
+
+        await self._ensure_connected()
+        end = datetime.datetime.now().timestamp()
+        start = end - history_seconds
+        step = step_string(step_seconds)
+        fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
+
+        async def fetch_one(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
+            if not obj.pods:
+                return
+            pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
+            query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
+            wanted = set(obj.pods)
+            try:
+                if resource is ResourceType.CPU:
+                    series = await self._query_range_digest(
+                        query, start, end, step, gamma, min_value, num_buckets
+                    )
+                    for pod, counts, total, peak in series:
+                        if pod in wanted and total > 0:
+                            fleet.cpu_counts[i] += counts
+                            fleet.cpu_total[i] += total
+                            fleet.cpu_peak[i] = max(fleet.cpu_peak[i], peak)
+                else:
+                    # Memory needs only count+max (max × buffer): the cheaper
+                    # stats pass, no histogram.
+                    for pod, total, peak in await self._query_range_stats(query, start, end, step):
+                        if pod in wanted and total > 0:
+                            fleet.mem_total[i] += total
+                            fleet.mem_peak[i] = max(fleet.mem_peak[i], peak)
+            except Exception as e:
+                self.logger.warning(f"Query failed for {obj} {resource}: {e}")
+                return
+
+        await asyncio.gather(
+            *[fetch_one(i, obj, resource) for i, obj in enumerate(objects) for resource in ResourceType]
+        )
+        return fleet
 
     async def close(self) -> None:
         if self._client is not None:

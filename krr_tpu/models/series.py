@@ -39,6 +39,45 @@ class PackedSeries:
 
 
 @dataclass
+class DigestedFleet:
+    """Pre-digested usage history: the O(buckets) ingest form.
+
+    Produced by the fused native parse+digest path
+    (`krr_tpu.integrations.native.parse_matrix_digest`) when the strategy asks
+    for digest ingest: raw sample arrays are never materialized — each
+    response's samples fold straight into per-object log-bucket digests at
+    parse time. CPU carries full bucket counts (any-percentile queries);
+    memory needs only exact totals/peaks (max × buffer).
+    """
+
+    objects: list[K8sObjectData]
+    gamma: float
+    min_value: float
+    cpu_counts: np.ndarray  # [N, num_buckets] float64 bucket counts
+    cpu_total: np.ndarray  # [N] float64
+    cpu_peak: np.ndarray  # [N] float64, -inf when empty
+    mem_total: np.ndarray  # [N] float64
+    mem_peak: np.ndarray  # [N] float64 bytes, -inf when empty
+
+    def __len__(self) -> int:
+        return len(self.objects)
+
+    @classmethod
+    def empty(cls, objects: list[K8sObjectData], gamma: float, min_value: float, num_buckets: int) -> "DigestedFleet":
+        n = len(objects)
+        return cls(
+            objects=objects,
+            gamma=gamma,
+            min_value=min_value,
+            cpu_counts=np.zeros((n, num_buckets), dtype=np.float64),
+            cpu_total=np.zeros(n, dtype=np.float64),
+            cpu_peak=np.full(n, -np.inf, dtype=np.float64),
+            mem_total=np.zeros(n, dtype=np.float64),
+            mem_peak=np.full(n, -np.inf, dtype=np.float64),
+        )
+
+
+@dataclass
 class FleetBatch:
     """Everything a strategy needs to right-size the whole fleet in one call."""
 

@@ -50,6 +50,17 @@ class TDigestStrategySettings(SimpleStrategySettings):
     )
     digest_buckets: int = pd.Field(2560, ge=16, description="Number of digest buckets (static shape on device).")
     chunk_size: int = pd.Field(8192, ge=128, description="Time-axis chunk size for the streaming digest build.")
+    digest_ingest: bool = pd.Field(
+        False,
+        description=(
+            "Digest-at-ingest mode: Prometheus responses fold straight into "
+            "per-object digests at parse time (native fused parse+bucketize), "
+            "so raw sample arrays are never materialized — O(buckets) host "
+            "memory per object regardless of window length. CPU accuracy is "
+            "the digest bound (0.5% at default gamma) instead of the exact "
+            "top-K path; memory stays exact."
+        ),
+    )
     exact_sketch_budget: int = pd.Field(
         8192,
         ge=0,
@@ -106,6 +117,42 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         # An empty memory row reads NaN from masked_max; the store wants -inf.
         mem_peak = np.where(np.isnan(mem_peak), -np.inf, mem_peak)
         return counts, total, peak, mem_total, mem_peak
+
+    def run_digested(self, fleet: "DigestedFleet") -> list[RunResult]:
+        """Recommend from pre-digested history (the ``digest_ingest`` fetch
+        mode): the window's digests are already built, so this is just the
+        percentile query — and, with ``state_path``, the same store merge as
+        the raw path."""
+        from krr_tpu.models.series import DigestedFleet  # noqa: F401  (typing)
+
+        q = float(self.settings.cpu_percentile)
+        spec = DigestSpec(
+            gamma=fleet.gamma, min_value=fleet.min_value, num_buckets=fleet.cpu_counts.shape[1]
+        )
+        mem_peak_mb = np.where(
+            np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
+        )
+        if self.settings.state_path:
+            from krr_tpu.core.streaming import DigestStore, object_key
+
+            keys = [object_key(obj) for obj in fleet.objects]
+            with DigestStore.locked(self.settings.state_path):
+                store = DigestStore.open_or_create(self.settings.state_path, spec)
+                rows = store.merge_window(
+                    keys, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, fleet.mem_total, mem_peak_mb
+                )
+                cpu_p = store.cpu_percentile(rows, q)
+                mem_max = store.memory_peak(rows)
+                store.save(self.settings.state_path)
+        else:
+            window = digest_ops.Digest(
+                counts=np.asarray(fleet.cpu_counts, dtype=np.float32),
+                total=np.asarray(fleet.cpu_total, dtype=np.float32),
+                peak=np.asarray(fleet.cpu_peak, dtype=np.float32),
+            )
+            cpu_p = np.asarray(digest_ops.percentile(spec, window, q))
+            mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
+        return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
 
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         if not batch.objects:

@@ -2,12 +2,22 @@
 //
 // The fetch path's host-side hot loop is turning response JSON —
 //   {"data":{"result":[{"metric":{"pod":"..."},"values":[[t,"0.123"],...]},...]}}
-// — into packed float64 sample arrays. The reference does this per sample in
-// Python (Decimal(value) over every element,
+// — into packed sample data. The reference does this per sample in Python
+// (Decimal(value) over every element,
 // /root/reference/robusta_krr/core/integrations/prometheus.py:150-155); at
 // fleet scale (1e8+ samples) interpreter-loop parsing dominates the fetch
-// wall-clock. This scanner extracts every series' pod label and sample values
-// in one pass with strtod — ~20x faster than json.loads + float().
+// wall-clock. One shared scanner walks every series' pod label and samples in
+// a single pass with strtod (~20x faster than json.loads + float()); three
+// entry points differ only in their per-sample sink:
+//
+//   krr_parse_matrix        — collect raw float64 samples (packed arrays)
+//   krr_parse_matrix_digest — fold each sample into a per-series log-bucket
+//                             digest (the DDSketch layout of
+//                             krr_tpu/ops/digest.py); raw samples are never
+//                             materialized, so ingest memory is
+//                             O(num_buckets) per series
+//   krr_parse_matrix_stats  — per-series count + exact max only (memory
+//                             recommendations need nothing else)
 //
 // Exposed via a plain C ABI for ctypes (no pybind11 in this image; see
 // krr_tpu/integrations/native.py for the Python side and the pure-Python
@@ -15,6 +25,7 @@
 //
 // Build: g++ -O3 -shared -fPIC -o libfastsamples.so fastsamples.cpp
 
+#include <cmath>
 #include <cstdlib>
 #include <cstring>
 
@@ -37,27 +48,18 @@ struct Cursor {
     }
 };
 
-}  // namespace
-
-extern "C" {
-
-// Parse all series in `body`. Outputs:
-//   values      — all samples, series-concatenated (capacity values_cap)
-//   series_lens — sample count per series (capacity series_cap)
-//   names       — '\n'-joined pod label per series (capacity names_cap bytes)
-// Returns the number of series parsed, or:
-//   -1  output capacity exceeded (caller should retry with larger buffers)
-//   -2  malformed input (no "result" array)
-long krr_parse_matrix(const char* body, long body_len,
-                      double* values, long values_cap,
-                      long* series_lens, long series_cap,
-                      char* names, long names_cap) {
+// Walk every series in `body`, invoking the sink once per series and once per
+// sample. Sink contract:
+//   bool begin_series(long series_index, const char* pod, long pod_len)
+//       -> false aborts with -1 (capacity exhausted)
+//   void sample(long series_index, double value)
+// Returns the number of series parsed, or -1 (capacity) / -2 (malformed).
+template <typename Sink>
+long scan_matrix(const char* body, long body_len, Sink& sink) {
     Cursor c{body, body + body_len};
     if (!c.seek("\"result\"")) return -2;
 
     long num_series = 0;
-    long values_used = 0;
-    long names_used = 0;
 
     // Each series: a "metric" object (with optional "pod" label) followed by
     // a "values" array. Prometheus emits them in this order.
@@ -66,7 +68,6 @@ long krr_parse_matrix(const char* body, long body_len,
         if (!probe.seek("\"metric\"")) break;
         c = probe;
 
-        // Pod label: scan within the metric object (up to the "values" key).
         Cursor metric_end = c;
         if (!metric_end.seek("\"values\"")) break;
         const char* values_key_at = metric_end.p;
@@ -97,15 +98,10 @@ long krr_parse_matrix(const char* body, long body_len,
             }
         }
 
-        if (num_series >= series_cap) return -1;
-        if (names_used + pod_len + 1 > names_cap) return -1;
-        std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
-        names_used += pod_len;
-        names[names_used++] = '\n';
+        if (!sink.begin_series(num_series, pod, pod_len)) return -1;
 
         // Samples: sequence of [ts, "value"] pairs until the closing ']]'.
         c.p = values_key_at;
-        long count = 0;
         while (c.p < c.end) {
             // Skip to the next '[' (a sample) or ']' (end of values array).
             while (c.p < c.end && *c.p != '[' && *c.p != ']') c.p++;
@@ -119,17 +115,157 @@ long krr_parse_matrix(const char* body, long body_len,
             char* after = nullptr;
             double v = std::strtod(c.p, &after);
             if (after == c.p) break;  // malformed number
-            if (values_used >= values_cap) return -1;
-            values[values_used++] = v;
-            count++;
+            if (!sink.sample(num_series, v)) return -1;
             c.p = after;
             // Skip to the end of this sample pair.
             while (c.p < c.end && *c.p != ']') c.p++;
             if (c.p < c.end) c.p++;
         }
-        series_lens[num_series++] = count;
+        num_series++;
     }
     return num_series;
+}
+
+// Shared names-buffer emission: '\n'-joined pod label per series.
+struct NameWriter {
+    char* names;
+    long names_cap;
+    long names_used = 0;
+
+    bool write(const char* pod, long pod_len) {
+        if (names_used + pod_len + 1 > names_cap) return false;
+        std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
+        names_used += pod_len;
+        names[names_used++] = '\n';
+        return true;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Count the series in `body` without parsing samples — lets callers allocate
+// exactly-sized output buffers instead of body-length-proportional guesses.
+long krr_count_series(const char* body, long body_len) {
+    Cursor c{body, body + body_len};
+    if (!c.seek("\"result\"")) return -2;
+    long n = 0;
+    while (c.seek("\"metric\"")) n++;
+    return n;
+}
+
+// Parse all series in `body`. Outputs:
+//   values      — all samples, series-concatenated (capacity values_cap)
+//   series_lens — sample count per series (capacity series_cap)
+//   names       — '\n'-joined pod label per series (capacity names_cap bytes)
+// Returns the number of series parsed, or:
+//   -1  output capacity exceeded (caller should retry with larger buffers)
+//   -2  malformed input (no "result" array)
+long krr_parse_matrix(const char* body, long body_len,
+                      double* values, long values_cap,
+                      long* series_lens, long series_cap,
+                      char* names, long names_cap) {
+    struct CollectSink {
+        double* values;
+        long values_cap;
+        long values_used = 0;
+        long* series_lens;
+        long series_cap;
+        NameWriter namew;
+
+        bool begin_series(long i, const char* pod, long pod_len) {
+            if (i >= series_cap) return false;
+            series_lens[i] = 0;
+            return namew.write(pod, pod_len);
+        }
+        bool sample(long i, double v) {
+            if (values_used >= values_cap) return false;
+            values[values_used++] = v;
+            series_lens[i]++;
+            return true;
+        }
+    } sink{values, values_cap, 0, series_lens, series_cap, {names, names_cap}};
+    return scan_matrix(body, body_len, sink);
+}
+
+// Fused parse + digest accumulation (bucket layout of krr_tpu/ops/digest.py:
+// bucket 0 holds values <= min_value, bucket j >= 1 covers
+// [min*gamma^(j-1), min*gamma^j)). Outputs, all caller-allocated; `counts`
+// must be zero-initialized (bucket accumulation is `+=`):
+//   counts — [series_cap x num_buckets] row-major bucket counts
+//   totals — [series_cap] sample counts
+//   peaks  — [series_cap] exact maxima (-inf when empty)
+//   names  — '\n'-joined pod label per series
+long krr_parse_matrix_digest(const char* body, long body_len,
+                             double gamma, double min_value, long num_buckets,
+                             double* counts, double* totals, double* peaks,
+                             long series_cap, char* names, long names_cap) {
+    if (num_buckets < 2 || gamma <= 1.0 || min_value <= 0.0) return -2;
+
+    struct DigestSink {
+        double inv_log_gamma;
+        double inv_min;
+        double min_value;
+        long num_buckets;
+        double* counts;
+        double* totals;
+        double* peaks;
+        long series_cap;
+        NameWriter namew;
+
+        bool begin_series(long i, const char* pod, long pod_len) {
+            if (i >= series_cap) return false;
+            totals[i] = 0.0;
+            peaks[i] = -HUGE_VAL;
+            return namew.write(pod, pod_len);
+        }
+        bool sample(long i, double v) {
+            // Same bucketize as ops/digest.py: values <= min_value -> bucket 0.
+            long idx = 0;
+            if (v > min_value) {
+                long raw = static_cast<long>(std::floor(std::log(v * inv_min) * inv_log_gamma));
+                if (raw < 0) raw = 0;
+                if (raw > num_buckets - 2) raw = num_buckets - 2;
+                idx = 1 + raw;
+            }
+            counts[i * num_buckets + idx] += 1.0;
+            totals[i] += 1.0;
+            if (v > peaks[i]) peaks[i] = v;
+            return true;
+        }
+    } sink{1.0 / std::log(gamma), 1.0 / min_value, min_value, num_buckets,
+           counts,  totals,        peaks,           series_cap, {names, names_cap}};
+    return scan_matrix(body, body_len, sink);
+}
+
+// Per-series count + exact max only — the memory-resource ingest (max x
+// buffer needs no histogram): O(1) state per series, no log() per sample.
+//   totals — [series_cap] sample counts
+//   peaks  — [series_cap] exact maxima (-inf when empty)
+//   names  — '\n'-joined pod label per series
+long krr_parse_matrix_stats(const char* body, long body_len,
+                            double* totals, double* peaks,
+                            long series_cap, char* names, long names_cap) {
+    struct StatsSink {
+        double* totals;
+        double* peaks;
+        long series_cap;
+        NameWriter namew;
+
+        bool begin_series(long i, const char* pod, long pod_len) {
+            if (i >= series_cap) return false;
+            totals[i] = 0.0;
+            peaks[i] = -HUGE_VAL;
+            return namew.write(pod, pod_len);
+        }
+        bool sample(long i, double v) {
+            totals[i] += 1.0;
+            if (v > peaks[i]) peaks[i] = v;
+            return true;
+        }
+    } sink{totals, peaks, series_cap, {names, names_cap}};
+    return scan_matrix(body, body_len, sink);
 }
 
 }  // extern "C"

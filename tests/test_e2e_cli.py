@@ -61,6 +61,34 @@ class TestRunnerE2E:
         # cpu-limit cell is OK and wins over the "?" cells.
         assert migrate.severity == Severity.OK
 
+
+
+    def test_digest_ingest_mode_matches_raw_scan(self, fake_env):  # noqa: F811
+        """tdigest --digest_ingest: fused parse+digest fetch end-to-end; CPU
+        within the digest error bound of the raw-fetch scan, memory exact."""
+        raw_cfg = make_config(fake_env, quiet=True, strategy="tdigest")
+        ingest_cfg = make_config(
+            fake_env, quiet=True, strategy="tdigest", other_args={"digest_ingest": True}
+        )
+        raw_result, _ = run_scan(raw_cfg)
+        ingest_result, _ = run_scan(ingest_cfg)
+        raw = {(s.object.namespace, s.object.name, s.object.container): s for s in raw_result.scans}
+        ingest = {(s.object.namespace, s.object.name, s.object.container): s for s in ingest_result.scans}
+        assert raw.keys() == ingest.keys() and raw
+        for key in raw:
+            r_cpu = raw[key].recommended.requests[ResourceType.CPU].value
+            i_cpu = ingest[key].recommended.requests[ResourceType.CPU].value
+            if r_cpu == "?":
+                assert i_cpu == "?"
+            else:
+                # Both are post-rounding millicore ceilings; digest error (0.5%)
+                # plus a 1m rounding step.
+                assert abs(float(i_cpu) - float(r_cpu)) <= 0.01 * float(r_cpu) + 0.001
+            assert (
+                ingest[key].recommended.requests[ResourceType.Memory].value
+                == raw[key].recommended.requests[ResourceType.Memory].value
+            )
+
     def test_prometheus_failure_degrades_to_unknown(self, fake_env):  # noqa: F811
         fake_env["metrics"].fail_queries = True
         try:

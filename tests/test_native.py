@@ -97,3 +97,92 @@ class TestNativeParser:
         body = b'{"status":"error","errorType":"bad_data","error":"query too long"}'
         with pytest.raises(ValueError, match="query too long"):
             native.parse_matrix(body)
+
+
+class TestNativeDigestIngest:
+    GAMMA, MIN_VALUE, BUCKETS = 1.01, 1e-7, 2560
+
+    def test_native_matches_python_fallback(self, library_available, rng):
+        series = [
+            ("pod-a", list(rng.gamma(2.0, 0.05, 500))),
+            ("pod-b", [0.0, 1e-9, 12345.678, 0.25]),
+            ("pod-empty", []),
+        ]
+        body = make_response(series)
+        got = native.parse_matrix_digest(body, self.GAMMA, self.MIN_VALUE, self.BUCKETS)
+        assert [pod for pod, *_ in got] == ["pod-a", "pod-b", "pod-empty"]
+        for (pod, vals), (_, counts, total, peak) in zip(series, got):
+            ref_counts, ref_total, ref_peak = native._digest_python(
+                np.asarray(vals, dtype=np.float64), self.GAMMA, self.MIN_VALUE, self.BUCKETS
+            )
+            np.testing.assert_array_equal(counts, ref_counts), pod
+            assert total == ref_total
+            assert peak == ref_peak or (np.isneginf(peak) and np.isneginf(ref_peak))
+
+    def test_matches_device_digest_percentile(self, library_available, rng):
+        from krr_tpu.ops import digest as digest_ops
+        from krr_tpu.ops.digest import Digest, DigestSpec
+
+        samples = rng.gamma(2.0, 0.05, 4000)
+        body = make_response([("pod-x", list(samples))])
+        [(_, counts, total, peak)] = native.parse_matrix_digest(
+            body, self.GAMMA, self.MIN_VALUE, self.BUCKETS
+        )
+        spec = DigestSpec(gamma=self.GAMMA, min_value=self.MIN_VALUE, num_buckets=self.BUCKETS)
+        host_digest = Digest(
+            counts=np.asarray(counts, dtype=np.float32)[None, :],
+            total=np.asarray([total], dtype=np.float32),
+            peak=np.asarray([peak], dtype=np.float32),
+        )
+        device_digest = digest_ops.build_from_packed(
+            spec, samples[None, :].astype(np.float32), np.asarray([len(samples)], dtype=np.int32)
+        )
+        for q in [50.0, 90.0, 99.0]:
+            host_p = float(np.asarray(digest_ops.percentile(spec, host_digest, q))[0])
+            device_p = float(np.asarray(digest_ops.percentile(spec, device_digest, q))[0])
+            # float64 (host log) vs float32 (device log) may differ by one
+            # bucket at boundaries — one gamma step of relative difference.
+            assert abs(host_p - device_p) <= (self.GAMMA - 1) * max(host_p, device_p) * 1.5
+        exact = float(np.quantile(samples, 0.99, method="lower"))
+        assert abs(host_p - exact) / exact < 2 * (np.sqrt(self.GAMMA) - 1)
+
+    def test_error_payload_raises(self, library_available):
+        body = b'{"status":"error","error":"bad query"}'
+        with pytest.raises(ValueError):
+            native.parse_matrix_digest(body, self.GAMMA, self.MIN_VALUE, self.BUCKETS)
+
+
+class TestNativeStats:
+    def test_stats_matches_parse(self, library_available, rng):
+        series = [
+            ("pod-a", list(rng.uniform(1e7, 4e8, 300))),
+            ("pod-empty", []),
+            ("pod-b", [5.0]),
+        ]
+        body = make_response(series)
+        got = native.parse_matrix_stats(body)
+        assert [p for p, *_ in got] == ["pod-a", "pod-empty", "pod-b"]
+        for (pod, vals), (_, total, peak) in zip(series, got):
+            assert total == len(vals)
+            if vals:
+                assert peak == pytest.approx(max(float(v) for v in vals))
+            else:
+                assert np.isneginf(peak)
+
+    def test_count_series(self, library_available):
+        body = make_response([("a", [1.0]), ("b", [2.0, 3.0])])
+        lib = native._load_library()
+        assert lib.krr_count_series(body, len(body)) == 2
+
+    def test_stale_so_rebuilds(self, library_available, tmp_path):
+        import os
+        # Touching the source newer than the .so must trigger a rebuild on
+        # next load (fresh process state simulated by resetting the cache).
+        so = native._SO_PATH
+        src = os.path.join(native._NATIVE_DIR, "fastsamples.cpp")
+        os.utime(src, None)  # now newer than the .so
+        native._lib = None
+        native._build_failed = False
+        lib = native._load_library()
+        assert lib is not None
+        assert os.path.getmtime(so) >= os.path.getmtime(src)
