@@ -23,6 +23,11 @@ RUN pip install --no-cache-dir . \
     "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
     make -C native
 
+# The pip-installed package has no sibling native/ directory — point the
+# ctypes bridge at the built library explicitly so the `krr-tpu` console
+# script gets the native parser too (not just `python krr.py` from /app).
+ENV KRR_TPU_NATIVE_DIR=/app/native
+
 COPY krr.py ./
 
 # Same default entrypoint shape as the reference: scan with the simple strategy.

@@ -171,13 +171,10 @@ class Runner:
             for local_i, global_i in enumerate(indices):
                 for pod, samples in fetched[ResourceType.CPU][local_i].items():
                     counts, total, peak = _digest_python(samples, spec.gamma, spec.min_value, spec.num_buckets)
-                    fleet.cpu_counts[global_i] += counts
-                    fleet.cpu_total[global_i] += total
-                    fleet.cpu_peak[global_i] = max(fleet.cpu_peak[global_i], peak)
+                    fleet.merge_cpu_row(global_i, counts, total, peak)
                 for pod, samples in fetched[ResourceType.Memory][local_i].items():
                     if samples.size:
-                        fleet.mem_total[global_i] += samples.size
-                        fleet.mem_peak[global_i] = max(fleet.mem_peak[global_i], float(samples.max()))
+                        fleet.merge_mem_row(global_i, float(samples.size), float(samples.max()))
 
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
@@ -187,12 +184,7 @@ class Runner:
                     sub_fleet = await source.gather_fleet_digests(
                         subset, history_seconds, step_seconds, spec.gamma, spec.min_value, spec.num_buckets
                     )
-                    for local_i, global_i in enumerate(indices):
-                        fleet.cpu_counts[global_i] += sub_fleet.cpu_counts[local_i]
-                        fleet.cpu_total[global_i] += sub_fleet.cpu_total[local_i]
-                        fleet.cpu_peak[global_i] = max(fleet.cpu_peak[global_i], sub_fleet.cpu_peak[local_i])
-                        fleet.mem_total[global_i] += sub_fleet.mem_total[local_i]
-                        fleet.mem_peak[global_i] = max(fleet.mem_peak[global_i], sub_fleet.mem_peak[local_i])
+                    fleet.merge_from(sub_fleet, indices)
                 else:
                     fetched = await source.gather_fleet(subset, history_seconds, step_seconds)
                     fold_histories(indices, fetched)

@@ -18,7 +18,13 @@ from typing import Optional
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+#: Where the C++ source lives: the repo checkout layout by default,
+#: overridable for installed deployments whose site-packages copy has no
+#: sibling ``native/`` directory (e.g. a pip-installed console script).
+_NATIVE_DIR = os.environ.get(
+    "KRR_TPU_NATIVE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"),
+)
 _SO_PATH = os.path.join(_NATIVE_DIR, "libfastsamples.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -88,8 +94,19 @@ def _load_library() -> Optional[ctypes.CDLL]:
             lib.krr_count_series.restype = ctypes.c_long
             lib.krr_count_series.argtypes = [ctypes.c_char_p, ctypes.c_long]
             _lib = lib
-        except Exception:
+        except Exception as e:
             _build_failed = True
+            # One-time notice: the pure-Python fallback is correct but ~20x
+            # slower, and silence here has historically hidden deployment
+            # mistakes (missing source dir, stale .so, no compiler).
+            import logging
+
+            logging.getLogger("krr_tpu").info(
+                "native parser unavailable (%s: %s) — using the pure-Python parser; "
+                "set KRR_TPU_NATIVE_DIR to the directory holding fastsamples.cpp to enable it",
+                type(e).__name__,
+                e,
+            )
     return _lib
 
 
@@ -123,7 +140,7 @@ def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
 
     values_cap = max(len(body) // 8, 1024)  # every sample costs >8 response bytes
     series_cap = max(len(body) // 64, 64)
-    names_cap = max(len(body) // 16, 4096)
+    names_cap = max(len(body), 4096)
     values = np.empty(values_cap, dtype=np.float64)
     lens = np.empty(series_cap, dtype=np.int64)
     names = ctypes.create_string_buffer(names_cap)
@@ -198,7 +215,7 @@ def parse_matrix_digest(
         # would allocate ~320x the response size for nothing.
         series_cap = lib.krr_count_series(body, len(body))
         if series_cap >= 0:
-            names_cap = max(len(body) // 16, 4096)
+            names_cap = max(len(body), 4096)
             counts = np.zeros((series_cap, num_buckets), dtype=np.float64)
             totals = np.zeros(series_cap, dtype=np.float64)
             peaks = np.zeros(series_cap, dtype=np.float64)
@@ -236,7 +253,7 @@ def parse_matrix_stats(body: bytes) -> SeriesStats:
     if lib is not None and b'"status":"error"' not in body[:4096]:
         series_cap = lib.krr_count_series(body, len(body))
         if series_cap >= 0:
-            names_cap = max(len(body) // 16, 4096)
+            names_cap = max(len(body), 4096)
             totals = np.zeros(series_cap, dtype=np.float64)
             peaks = np.zeros(series_cap, dtype=np.float64)
             names = ctypes.create_string_buffer(names_cap)

@@ -284,16 +284,13 @@ class PrometheusLoader:
                     )
                     for pod, counts, total, peak in series:
                         if pod in wanted and total > 0:
-                            fleet.cpu_counts[i] += counts
-                            fleet.cpu_total[i] += total
-                            fleet.cpu_peak[i] = max(fleet.cpu_peak[i], peak)
+                            fleet.merge_cpu_row(i, counts, total, peak)
                 else:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
                     for pod, total, peak in await self._query_range_stats(query, start, end, step):
                         if pod in wanted and total > 0:
-                            fleet.mem_total[i] += total
-                            fleet.mem_peak[i] = max(fleet.mem_peak[i], peak)
+                            fleet.merge_mem_row(i, total, peak)
             except Exception as e:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return

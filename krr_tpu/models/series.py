@@ -62,6 +62,24 @@ class DigestedFleet:
     def __len__(self) -> int:
         return len(self.objects)
 
+    def merge_cpu_row(self, i: int, counts: np.ndarray, total: float, peak: float) -> None:
+        """Fold one CPU series digest into object ``i`` (exact count add / peak max)."""
+        self.cpu_counts[i] += counts
+        self.cpu_total[i] += total
+        self.cpu_peak[i] = max(self.cpu_peak[i], peak)
+
+    def merge_mem_row(self, i: int, total: float, peak: float) -> None:
+        """Fold one memory series' count/max into object ``i``."""
+        self.mem_total[i] += total
+        self.mem_peak[i] = max(self.mem_peak[i], peak)
+
+    def merge_from(self, sub: "DigestedFleet", indices: "list[int]") -> None:
+        """Fold a sub-fleet (same spec, ``sub``'s row ``j`` → our row
+        ``indices[j]``) into this fleet — the cross-cluster merge."""
+        for j, i in enumerate(indices):
+            self.merge_cpu_row(i, sub.cpu_counts[j], sub.cpu_total[j], sub.cpu_peak[j])
+            self.merge_mem_row(i, sub.mem_total[j], sub.mem_peak[j])
+
     @classmethod
     def empty(cls, objects: list[K8sObjectData], gamma: float, min_value: float, num_buckets: int) -> "DigestedFleet":
         n = len(objects)

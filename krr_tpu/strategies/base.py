@@ -139,6 +139,20 @@ class BatchedStrategy(BaseStrategy[_S]):
     def run(self, history_data: HistoryData, object_data: K8sObjectData) -> RunResult:
         return self.run_batch(FleetBatch.from_history(history_data, object_data))[0]
 
+    def profile_span(self):
+        """Context manager tracing the device compute with ``jax.profiler``
+        when the strategy's settings carry a ``profile_dir`` (SURVEY.md §5
+        "tracing": the reference has none; the TPU-native equivalent is an
+        xprof trace of the fleet kernels)."""
+        import contextlib
+
+        profile_dir = getattr(self.settings, "profile_dir", None)
+        if not profile_dir:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(profile_dir)
+
     @abc.abstractmethod
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         ...

@@ -132,26 +132,27 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         mem_peak_mb = np.where(
             np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
         )
-        if self.settings.state_path:
-            from krr_tpu.core.streaming import DigestStore, object_key
+        with self.profile_span():
+            if self.settings.state_path:
+                from krr_tpu.core.streaming import DigestStore, object_key
 
-            keys = [object_key(obj) for obj in fleet.objects]
-            with DigestStore.locked(self.settings.state_path):
-                store = DigestStore.open_or_create(self.settings.state_path, spec)
-                rows = store.merge_window(
-                    keys, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, fleet.mem_total, mem_peak_mb
+                keys = [object_key(obj) for obj in fleet.objects]
+                with DigestStore.locked(self.settings.state_path):
+                    store = DigestStore.open_or_create(self.settings.state_path, spec)
+                    rows = store.merge_window(
+                        keys, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, fleet.mem_total, mem_peak_mb
+                    )
+                    cpu_p = store.cpu_percentile(rows, q)
+                    mem_max = store.memory_peak(rows)
+                    store.save(self.settings.state_path)
+            else:
+                window = digest_ops.Digest(
+                    counts=np.asarray(fleet.cpu_counts, dtype=np.float32),
+                    total=np.asarray(fleet.cpu_total, dtype=np.float32),
+                    peak=np.asarray(fleet.cpu_peak, dtype=np.float32),
                 )
-                cpu_p = store.cpu_percentile(rows, q)
-                mem_max = store.memory_peak(rows)
-                store.save(self.settings.state_path)
-        else:
-            window = digest_ops.Digest(
-                counts=np.asarray(fleet.cpu_counts, dtype=np.float32),
-                total=np.asarray(fleet.cpu_total, dtype=np.float32),
-                peak=np.asarray(fleet.cpu_peak, dtype=np.float32),
-            )
-            cpu_p = np.asarray(digest_ops.percentile(spec, window, q))
-            mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
+                cpu_p = np.asarray(digest_ops.percentile(spec, window, q))
+                mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
         return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
 
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
@@ -161,56 +162,57 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         mesh = resolve_mesh(self.settings)
         q = float(self.settings.cpu_percentile)
 
-        if self.settings.state_path:
-            # Incremental path: fold this window into the persistent store and
-            # recommend from the merged history (streaming / multi-source /
-            # resume — krr_tpu.core.streaming).
-            from krr_tpu.core.streaming import DigestStore, object_key
+        with self.profile_span():
+            if self.settings.state_path:
+                # Incremental path: fold this window into the persistent store and
+                # recommend from the merged history (streaming / multi-source /
+                # resume — krr_tpu.core.streaming).
+                from krr_tpu.core.streaming import DigestStore, object_key
 
-            counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
-            keys = [object_key(obj) for obj in batch.objects]
-            with DigestStore.locked(self.settings.state_path):
-                store = DigestStore.open_or_create(self.settings.state_path, spec)
-                rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
-                cpu_p = store.cpu_percentile(rows, q)
-                mem_max = store.memory_peak(rows)
-                store.save(self.settings.state_path)
-        elif mesh is not None:
-            from krr_tpu.parallel import (
-                sharded_fleet_digest,
-                sharded_fleet_topk,
-                sharded_masked_max,
-                sharded_percentile,
-            )
+                counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
+                keys = [object_key(obj) for obj in batch.objects]
+                with DigestStore.locked(self.settings.state_path):
+                    store = DigestStore.open_or_create(self.settings.state_path, spec)
+                    rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
+                    cpu_p = store.cpu_percentile(rows, q)
+                    mem_max = store.memory_peak(rows)
+                    store.save(self.settings.state_path)
+            elif mesh is not None:
+                from krr_tpu.parallel import (
+                    sharded_fleet_digest,
+                    sharded_fleet_topk,
+                    sharded_masked_max,
+                    sharded_percentile,
+                )
 
-            cpu = batch.packed(ResourceType.CPU)
-            mem = batch.packed(ResourceType.Memory)
-            k = topk_ops.required_k(cpu.capacity, q)
-            if 0 < k <= self.settings.exact_sketch_budget:
-                sketch, real_rows = sharded_fleet_topk(
-                    cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
-                )
-                cpu_p = np.asarray(topk_ops.percentile(sketch, q))[:real_rows]
+                cpu = batch.packed(ResourceType.CPU)
+                mem = batch.packed(ResourceType.Memory)
+                k = topk_ops.required_k(cpu.capacity, q)
+                if 0 < k <= self.settings.exact_sketch_budget:
+                    sketch, real_rows = sharded_fleet_topk(
+                        cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
+                    )
+                    cpu_p = np.asarray(topk_ops.percentile(sketch, q))[:real_rows]
+                else:
+                    cpu_digest, real_rows = sharded_fleet_digest(
+                        spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
+                    )
+                    cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
+                mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
             else:
-                cpu_digest, real_rows = sharded_fleet_digest(
-                    spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
-                )
-                cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
-            mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
-        else:
-            cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
-            mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-            k = topk_ops.required_k(batch.packed(ResourceType.CPU).capacity, q)
-            if 0 < k <= self.settings.exact_sketch_budget:
-                sketch = topk_ops.build_from_packed(
-                    cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
-                )
-                cpu_p = np.asarray(topk_ops.percentile(sketch, q))
-            else:
-                cpu_digest = digest_ops.build_from_packed(
-                    spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size
-                )
-                cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
-            mem_max = np.asarray(masked_max(mem_values, mem_counts))
+                cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+                mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+                k = topk_ops.required_k(batch.packed(ResourceType.CPU).capacity, q)
+                if 0 < k <= self.settings.exact_sketch_budget:
+                    sketch = topk_ops.build_from_packed(
+                        cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
+                    )
+                    cpu_p = np.asarray(topk_ops.percentile(sketch, q))
+                else:
+                    cpu_digest = digest_ops.build_from_packed(
+                        spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size
+                    )
+                    cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
+                mem_max = np.asarray(masked_max(mem_values, mem_counts))
 
         return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
