@@ -131,6 +131,13 @@ def parse_matrix_python(body: bytes) -> list[tuple[str, np.ndarray]]:
     return series
 
 
+def _names_cap(body: bytes, series_count: int) -> int:
+    """Name-buffer size: series × (k8s name limit 253 + '\\n'), never more than
+    the response itself. If an exotic label still overflows, the native parser
+    returns -1 and the caller falls back to Python — never truncation."""
+    return max(4096, min(len(body), series_count * 256))
+
+
 def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
     """Native parse; None when the library is unavailable or reports malformed
     input (caller falls back to Python)."""
@@ -138,9 +145,12 @@ def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
     if lib is None:
         return None
 
+    series_count = lib.krr_count_series(body, len(body))
+    if series_count < 0:
+        return None
     values_cap = max(len(body) // 8, 1024)  # every sample costs >8 response bytes
-    series_cap = max(len(body) // 64, 64)
-    names_cap = max(len(body), 4096)
+    series_cap = max(series_count, 1)
+    names_cap = _names_cap(body, series_count)
     values = np.empty(values_cap, dtype=np.float64)
     lens = np.empty(series_cap, dtype=np.int64)
     names = ctypes.create_string_buffer(names_cap)
@@ -215,7 +225,7 @@ def parse_matrix_digest(
         # would allocate ~320x the response size for nothing.
         series_cap = lib.krr_count_series(body, len(body))
         if series_cap >= 0:
-            names_cap = max(len(body), 4096)
+            names_cap = _names_cap(body, series_cap)
             counts = np.zeros((series_cap, num_buckets), dtype=np.float64)
             totals = np.zeros(series_cap, dtype=np.float64)
             peaks = np.zeros(series_cap, dtype=np.float64)
@@ -253,7 +263,7 @@ def parse_matrix_stats(body: bytes) -> SeriesStats:
     if lib is not None and b'"status":"error"' not in body[:4096]:
         series_cap = lib.krr_count_series(body, len(body))
         if series_cap >= 0:
-            names_cap = max(len(body), 4096)
+            names_cap = _names_cap(body, series_cap)
             totals = np.zeros(series_cap, dtype=np.float64)
             peaks = np.zeros(series_cap, dtype=np.float64)
             names = ctypes.create_string_buffer(names_cap)

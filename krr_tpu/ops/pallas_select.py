@@ -1,17 +1,35 @@
-"""Pallas TPU kernel: fused bit-space bisection selection.
+"""Pallas TPU kernels: fused bit-space bisection selection + row max.
 
 The jnp bisection (`krr_tpu.ops.selection`) launches 31 counting passes, each
 re-reading the full ``[N, T]`` matrix from HBM — correct, but 31× the memory
 traffic of the theoretical minimum. Each row's selection is *independent*, so
-this kernel tiles rows, DMAs a row-tile's **entire** time extent into VMEM
-once, and runs all 31 bisection iterations in-kernel against the resident
+the selection kernel tiles rows, DMAs a row-tile's **entire** time extent into
+VMEM once, and runs all 31 bisection iterations in-kernel against the resident
 tile — including the float→ordered-bits conversion, so raw float32 values are
-read from HBM exactly once. At fleet scale the jnp path is bandwidth-bound,
-so collapsing the passes converts the op to VPU-compare-bound (~2× measured
-on v5e at 10k × 120k).
+read from HBM exactly once.
 
-Shapes: the row-tile's time extent must fit VMEM (ROW_TILE × T × 4 bytes;
-ROW_TILE=8 handles T up to ~400k — 23 days @ 5 s). Larger T, CPU backends
+Two in-kernel layout tricks matter on the VPU (measured on v5e at the
+BASELINE.md headline shape, 10k × 120,960):
+
+* **Premasked sentinel bits.** Invalid positions are folded into the ordered
+  bit space *once* (``INT32_MAX`` sorts above every finite sample) so the
+  bisection loop is a bare compare+accumulate — 2 ops/element/iteration
+  instead of 4 (mask AND, compare, select, accumulate). ~1.4× on the loop.
+* **Lane-folded reductions.** A row-wise reduce along the minor (lane) axis is
+  a cross-lane operation the VPU does poorly. Reshaping the tile to
+  ``[rows, T/128, 128]`` and reducing the *middle* axis turns almost the whole
+  reduction into element-wise vector-register ops, leaving one final 128-wide
+  cross-lane pass per row. ~1.5× on the loop, ~3× on the row max.
+
+``fleet_exact`` fuses the whole exact `simple`-strategy device program — CPU
+percentile selection + memory peak — into ONE dispatch returning ONE stacked
+array, because on a tunneled TPU backend each dispatch+readback round trip
+costs tens of milliseconds: one call, one readback. Together with the kernel
+tricks this took the headline bench from ~35k to ~75k containers/s.
+
+Shapes: the row-tile's time extent must fit VMEM three times over (input
+double-buffering + the premasked-bits temporary): ROW_TILE × T × 4 B × 3 ≤
+~12 MB handles T up to ~131k — over 7 days @ 5 s. Larger T, non-TPU backends
 (tests use interpret mode), and degenerate shapes fall back to the jnp path.
 """
 
@@ -26,73 +44,162 @@ from jax.experimental.pallas import tpu as pltpu
 
 ROW_TILE = 8
 LANE = 128
-#: VMEM budget for one row-tile's samples (bytes); beyond this fall back to jnp.
+#: Ordered-bit sentinel for invalid positions: sorts above every finite
+#: non-negative float's bit pattern (it is the NaN pattern 0x7fffffff).
+INT32_MAX = 2**31 - 1
+#: VMEM budget for one row-tile's working set (bytes); beyond this fall back
+#: to jnp. Working set ≈ 3 tiles: double-buffered input + premasked bits.
 VMEM_TILE_BUDGET = 12 * 1024 * 1024
 
 
-def _bisect_kernel(values_ref, counts_ref, rank_ref, out_ref, *, num_iters: int):
-    # Float→value-monotone int bits, computed in VMEM: HBM only ever serves
-    # the raw float32 tile, once.
-    bits = pltpu.bitcast(jnp.maximum(values_ref[:], 0.0), jnp.int32)
-    counts = counts_ref[:]  # [ROW_TILE, LANE] (count broadcast along lanes)
-    rank = rank_ref[:]  # [ROW_TILE, LANE]
-    position = jax.lax.broadcasted_iota(jnp.int32, bits.shape, 1)
-    valid = position < counts[:, :1]
+def _fold(tile: jax.Array) -> jax.Array:
+    """[rows, T] → [rows, T/128, 128] so reductions ride element-wise vregs."""
+    rows, t = tile.shape
+    return tile.reshape(rows, t // LANE, LANE)
 
-    lo = jnp.zeros((ROW_TILE, LANE), dtype=jnp.int32)
-    hi = jnp.full((ROW_TILE, LANE), jnp.int32(2**31 - 1), dtype=jnp.int32)
+
+def _bisect_kernel(values_ref, meta_ref, out_ref, *, num_iters: int):
+    rows, t = values_ref.shape
+    counts = meta_ref[:, :1]
+    rank = meta_ref[:, 1:2]
+    position = jax.lax.broadcasted_iota(jnp.int32, (rows, t), 1)
+    # Float→value-monotone int bits with invalid positions premasked to the
+    # top of the order, computed in VMEM: HBM serves the raw float32 tile once.
+    bits = _fold(
+        jnp.where(
+            position < counts,
+            pltpu.bitcast(jnp.maximum(values_ref[:], 0.0), jnp.int32),
+            jnp.int32(INT32_MAX),
+        )
+    )
+
+    lo = jnp.zeros((rows, LANE), dtype=jnp.int32)
+    hi = jnp.full((rows, LANE), jnp.int32(INT32_MAX), dtype=jnp.int32)
 
     def body(_, carry):
         low, high = carry
         mid = low + (high - low) // 2
-        le = jnp.sum(
-            jnp.where(valid & (bits <= mid[:, :1]), 1, 0), axis=1, keepdims=True, dtype=jnp.int32
-        )
-        go_low = le >= rank[:, :1] + 1
+        cmp = (bits <= mid[:, :1].reshape(rows, 1, 1)).astype(jnp.int32)
+        le = jnp.sum(jnp.sum(cmp, axis=1), axis=1, keepdims=True)
+        # If enough samples are <= mid, the answer is <= mid. Sentinel rows
+        # (count 0) converge to INT32_MAX whose float bit pattern is NaN.
+        go_low = le >= rank + 1
         return jnp.where(go_low, low, mid + 1), jnp.where(go_low, mid, high)
 
     low, _ = jax.lax.fori_loop(0, num_iters, body, (lo, hi))
-    out_ref[:] = pltpu.bitcast(low, jnp.float32)
+    out_ref[:] = pltpu.bitcast(jnp.broadcast_to(low[:, :1], (rows, LANE)), jnp.float32)
+
+
+def _rowmax_kernel(values_ref, counts_ref, out_ref):
+    rows, t = values_ref.shape
+    position = jax.lax.broadcasted_iota(jnp.int32, (rows, t), 1)
+    masked = _fold(jnp.where(position < counts_ref[:, :1], values_ref[:], -jnp.inf))
+    folded = jnp.max(masked, axis=1)  # element-wise vreg maxes
+    out_ref[:] = jnp.broadcast_to(jnp.max(folded, axis=1, keepdims=True), (rows, LANE))
 
 
 def supports(t: int) -> bool:
-    """Whether one row-tile's time extent fits the VMEM budget."""
-    return 0 < ROW_TILE * t * 4 <= VMEM_TILE_BUDGET
+    """Whether one row-tile's working set fits the VMEM budget."""
+    return 0 < 3 * ROW_TILE * t * 4 <= VMEM_TILE_BUDGET
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
-def _pallas_bisect(
-    values: jax.Array, counts: jax.Array, q: jax.Array, num_iters: int, interpret: bool
-) -> jax.Array:
-    from krr_tpu.ops.selection import selection_rank
-
+def _pad_inputs(values: jax.Array, counts: jax.Array):
+    """Pad rows to ROW_TILE and T to LANE; padding never enters any result:
+    padded rows carry count 0 and padded columns sit past every row's count,
+    so the in-kernel validity premask excludes them regardless of value."""
     n, t = values.shape
     pad_rows = (-n) % ROW_TILE
     pad_t = (-t) % LANE
     if pad_rows or pad_t:
-        # Padded rows have count 0 and padded columns sit past every row's
-        # count, so the validity mask excludes them regardless of value.
         values = jnp.pad(values, ((0, pad_rows), (0, pad_t)))
-    counts_p = jnp.pad(counts.astype(jnp.int32), (0, pad_rows))
-    rank = selection_rank(counts_p, q)
+    return values, jnp.pad(counts.astype(jnp.int32), (0, pad_rows))
 
+
+def _row_meta(counts: jax.Array, rank: jax.Array) -> jax.Array:
+    """Per-row scalars ride as one [N, LANE] block: col 0 count, col 1 rank."""
+    meta = jnp.concatenate([counts[:, None], rank[:, None]], axis=1)
+    return jnp.pad(meta, ((0, 0), (0, LANE - 2)))
+
+
+def _tile_specs(t: int):
+    return [
+        pl.BlockSpec((ROW_TILE, t), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+
+
+_OUT_SPEC = pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _select_device(values: jax.Array, counts: jax.Array, q, num_iters: int, interpret: bool):
+    """Padded-and-masked selection pallas_call; returns per-row [N] floats."""
+    from krr_tpu.ops.selection import selection_rank
+
+    n = values.shape[0]
+    values, counts_p = _pad_inputs(values, counts)
     np_, tp = values.shape
-    # Per-row scalars ride as [N, LANE] lane-broadcast arrays (TPU-friendly tiles).
-    counts_b = jnp.broadcast_to(counts_p[:, None], (np_, LANE))
-    rank_b = jnp.broadcast_to(rank[:, None], (np_, LANE))
     out = pl.pallas_call(
         functools.partial(_bisect_kernel, num_iters=num_iters),
         grid=(np_ // ROW_TILE,),
-        in_specs=[
-            pl.BlockSpec((ROW_TILE, tp), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        in_specs=_tile_specs(tp),
+        out_specs=_OUT_SPEC,
         out_shape=jax.ShapeDtypeStruct((np_, LANE), jnp.float32),
         interpret=interpret,
-    )(values, counts_b, rank_b)
+    )(values, _row_meta(counts_p, selection_rank(counts_p, q)))
     return jnp.where(counts > 0, out[:n, 0], jnp.nan)
+
+
+def _rowmax_device(values: jax.Array, counts: jax.Array, interpret: bool):
+    n = values.shape[0]
+    values, counts_p = _pad_inputs(values, counts)
+    np_, tp = values.shape
+    out = pl.pallas_call(
+        _rowmax_kernel,
+        grid=(np_ // ROW_TILE,),
+        in_specs=_tile_specs(tp),
+        out_specs=_OUT_SPEC,
+        out_shape=jax.ShapeDtypeStruct((np_, LANE), jnp.float32),
+        interpret=interpret,
+    )(values, jnp.broadcast_to(counts_p[:, None], (np_, LANE)))
+    return jnp.where(counts > 0, out[:n, 0], jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+def _pallas_bisect(values, counts, q, num_iters: int, interpret: bool):
+    return _select_device(values, counts, q, num_iters, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_rowmax(values, counts, interpret: bool):
+    return _rowmax_device(values, counts, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+def _fleet_exact(cpu_values, cpu_counts, mem_values, mem_counts, q, num_iters: int, interpret: bool):
+    return jnp.stack(
+        [
+            _select_device(cpu_values, cpu_counts, q, num_iters, interpret),
+            _rowmax_device(mem_values, mem_counts, interpret),
+        ]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters",))
+def _fleet_exact_jnp(cpu_values, cpu_counts, mem_values, mem_counts, q, num_iters: int):
+    """Module-level jitted jnp fallback (cache persists across batches)."""
+    from krr_tpu.ops.quantile import masked_max
+    from krr_tpu.ops.selection import masked_percentile_bisect
+
+    return jnp.stack(
+        [
+            masked_percentile_bisect(cpu_values, cpu_counts, q, num_iters=num_iters),
+            masked_max(mem_values, mem_counts),
+        ]
+    )
+
+
+def _use_pallas(t: int, interpret: bool) -> bool:
+    return supports(t) and (interpret or jax.default_backend() == "tpu")
 
 
 def masked_percentile_bisect_pallas(
@@ -110,6 +217,53 @@ def masked_percentile_bisect_pallas(
     n, t = values.shape
     if n == 0 or t == 0:
         return jnp.full((n,), jnp.nan, dtype=jnp.float32)
-    if not supports(t) or (not interpret and jax.default_backend() != "tpu"):
+    if not _use_pallas(t, interpret):
         return masked_percentile_bisect(values, counts, q, num_iters=num_iters)
     return _pallas_bisect(values, counts, jnp.float32(q), num_iters, interpret)
+
+
+def masked_max_pallas(values: jax.Array, counts: jax.Array, interpret: bool = False) -> jax.Array:
+    """Drop-in (bit-identical) replacement for ``quantile.masked_max`` backed
+    by the lane-folded row-max kernel; same fallback rules as the selection."""
+    from krr_tpu.ops.quantile import masked_max
+
+    n, t = values.shape
+    if n == 0 or t == 0:
+        return jnp.full((n,), jnp.nan, dtype=jnp.float32)
+    if not _use_pallas(t, interpret):
+        return masked_max(values, counts)
+    return _pallas_rowmax(values, counts, interpret)
+
+
+def fleet_exact(
+    cpu_values: jax.Array,
+    cpu_counts: jax.Array,
+    mem_values: jax.Array,
+    mem_counts: jax.Array,
+    q: float,
+    num_iters: int = 31,
+    interpret: bool = False,
+) -> jax.Array:
+    """The exact `simple`-strategy device program in ONE dispatch.
+
+    Returns a stacked ``[2, N]`` float32 array — row 0 the per-container CPU
+    percentile (reference rank semantics, NaN for empty rows), row 1 the
+    memory peak — so the host needs exactly one readback. CPU and memory
+    histories may have different time extents. Falls back to the jnp ops off
+    TPU (still one fused XLA program)."""
+    n, tc = cpu_values.shape
+    tm = mem_values.shape[1]
+    if n == 0:
+        return jnp.zeros((2, 0), dtype=jnp.float32)
+    if tc == 0 or tm == 0:
+        nan_row = jnp.full((n,), jnp.nan, jnp.float32)
+        p99 = masked_percentile_bisect_pallas(cpu_values, cpu_counts, q, num_iters, interpret) if tc else nan_row
+        peak = masked_max_pallas(mem_values, mem_counts, interpret) if tm else nan_row
+        return jnp.stack([p99, peak])
+    if not (_use_pallas(tc, interpret) and _use_pallas(tm, interpret)):
+        return _fleet_exact_jnp(
+            cpu_values, cpu_counts, mem_values, mem_counts, jnp.float32(q), num_iters
+        )
+    return _fleet_exact(
+        cpu_values, cpu_counts, mem_values, mem_counts, jnp.float32(q), num_iters, interpret
+    )

@@ -143,12 +143,15 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
                 cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
                 mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
                 if self.settings.use_pallas:
-                    from krr_tpu.ops.pallas_select import masked_percentile_bisect_pallas
+                    from krr_tpu.ops.pallas_select import fleet_exact
 
-                    cpu_p = np.asarray(masked_percentile_bisect_pallas(cpu_values, cpu_counts, q))
+                    # One dispatch, one readback: on a tunneled TPU backend
+                    # each round trip costs tens of ms (see pallas_select).
+                    stacked = np.asarray(fleet_exact(cpu_values, cpu_counts, mem_values, mem_counts, q))
+                    cpu_p, mem_max = stacked[0], stacked[1]
                 else:
                     cpu_p = np.asarray(masked_percentile_bisect(cpu_values, cpu_counts, q))
-                mem_max = np.asarray(masked_max(mem_values, mem_counts))
+                    mem_max = np.asarray(masked_max(mem_values, mem_counts))
 
         return finalize_fleet(
             np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage

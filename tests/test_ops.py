@@ -207,6 +207,53 @@ class TestPallasSelection:
         result = np.asarray(masked_percentile_bisect_pallas(values, counts, 99.0, interpret=True))
         assert np.isnan(result).all()
 
+    def test_rowmax_interpret_parity(self, rng):
+        from krr_tpu.ops.pallas_select import masked_max_pallas
+        from krr_tpu.ops.quantile import masked_max
+
+        values = rng.uniform(0.0, 4000.0, size=(19, 700)).astype(np.float32)
+        counts = rng.integers(0, 701, size=19).astype(np.int32)
+        ref = np.asarray(masked_max(values, counts))
+        ker = np.asarray(masked_max_pallas(values, counts, interpret=True))
+        valid = counts > 0
+        np.testing.assert_array_equal(ker[valid], ref[valid])
+        assert np.isnan(ker[~valid]).all()
+
+    def test_fleet_exact_interpret_parity(self, rng):
+        """The fused one-dispatch program must match the two jnp ops exactly,
+        including ragged counts, empty rows, and differing time extents."""
+        from krr_tpu.ops.pallas_select import fleet_exact
+        from krr_tpu.ops.quantile import masked_max
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        cpu = rng.gamma(2.0, 0.05, size=(13, 700)).astype(np.float32)
+        cpu_counts = rng.integers(0, 701, size=13).astype(np.int32)
+        mem = rng.uniform(10.0, 4000.0, size=(13, 450)).astype(np.float32)
+        mem_counts = rng.integers(0, 451, size=13).astype(np.int32)
+        for q in [50.0, 99.0, 100.0]:
+            out = np.asarray(fleet_exact(cpu, cpu_counts, mem, mem_counts, q, interpret=True))
+            ref_p = np.asarray(masked_percentile_bisect(cpu, cpu_counts, q))
+            ref_m = np.asarray(masked_max(mem, mem_counts))
+            np.testing.assert_array_equal(out[0][cpu_counts > 0], ref_p[cpu_counts > 0])
+            assert np.isnan(out[0][cpu_counts == 0]).all()
+            np.testing.assert_array_equal(out[1][mem_counts > 0], ref_m[mem_counts > 0])
+            assert np.isnan(out[1][mem_counts == 0]).all()
+
+    def test_fleet_exact_cpu_fallback_and_empty(self, rng):
+        from krr_tpu.ops.pallas_select import fleet_exact
+        from krr_tpu.ops.quantile import masked_max
+        from krr_tpu.ops.selection import masked_percentile_bisect
+
+        cpu = rng.gamma(2.0, 0.05, size=(4, 256)).astype(np.float32)
+        counts = np.full(4, 256, dtype=np.int32)
+        # On CPU without interpret the wrapper routes to the jnp path.
+        out = np.asarray(fleet_exact(cpu, counts, cpu, counts, 99.0))
+        np.testing.assert_array_equal(out[0], np.asarray(masked_percentile_bisect(cpu, counts, 99.0)))
+        np.testing.assert_array_equal(out[1], np.asarray(masked_max(cpu, counts)))
+        empty = np.asarray(fleet_exact(np.zeros((0, 8), np.float32), np.zeros(0, np.int32),
+                                       np.zeros((0, 8), np.float32), np.zeros(0, np.int32), 99.0))
+        assert empty.shape == (2, 0)
+
 
 class TestTopKSketch:
     def test_exact_match_with_percentile(self, rng):
