@@ -2,6 +2,11 @@
 #
 # Run as `python ./custom_formatter.py simple --formatter my_formatter`.
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a checkout
+
 import krr_tpu
 from krr_tpu.api.formatters import BaseFormatter
 from krr_tpu.api.models import Result

@@ -4,9 +4,13 @@
 # sub-command to the CLI: `python ./custom_strategy.py custom`
 # (same plugin contract as the reference's examples/custom_strategy.py).
 
+import os
+import sys
 from decimal import Decimal
 
 import pydantic as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a checkout
 
 import krr_tpu
 from krr_tpu.api.models import HistoryData, K8sObjectData, ResourceRecommendation, ResourceType, RunResult

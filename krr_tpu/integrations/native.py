@@ -145,12 +145,14 @@ def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
     if lib is None:
         return None
 
-    series_count = lib.krr_count_series(body, len(body))
-    if series_count < 0:
-        return None
+    # Size buffers by over-allocation rather than a krr_count_series pre-scan:
+    # the count would cost a full extra pass over every response on the bulk-
+    # fetch hot path, while these buffers are transient and ~body-sized. (The
+    # digest path keeps the pre-scan — there counting avoids a buckets×series
+    # allocation that dwarfs the body.) Caps too small ⇒ -1 ⇒ Python fallback.
     values_cap = max(len(body) // 8, 1024)  # every sample costs >8 response bytes
-    series_cap = max(series_count, 1)
-    names_cap = _names_cap(body, series_count)
+    series_cap = max(len(body) // 24, 64)  # a series entry costs >24 bytes
+    names_cap = max(len(body), 4096)
     values = np.empty(values_cap, dtype=np.float64)
     lens = np.empty(series_cap, dtype=np.int64)
     names = ctypes.create_string_buffer(names_cap)
