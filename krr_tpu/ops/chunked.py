@@ -8,14 +8,27 @@ this array's real width AND its *global* position (local + ``time_offset``) is
 below the row's total count. Chunk-alignment pad zeros must never count, even
 when a later time shard still holds real samples for the row (the sharded
 builds in `krr_tpu.parallel.fleet` pass a per-shard ``time_offset``).
+
+Two drivers share that contract:
+
+* :func:`scan_time_chunks` — the matrix is device-resident; chunks ride a
+  ``lax.scan`` (bounds compute temporaries, not HBM residency).
+* :func:`stream_host_chunks` — the matrix stays in **host** memory; each time
+  slice is transferred on its own with the next transfer enqueued before the
+  current fold is dispatched (double buffering via JAX async dispatch), so
+  device memory holds only the carry plus ~2 chunks. This is how 7 d @ 5 s
+  histories that exceed HBM are digested (SURVEY.md §7 step 6 / "feeding the
+  beast").
 """
 
 from __future__ import annotations
 
-from typing import Callable, TypeVar
+from functools import partial
+from typing import Callable, Optional, TypeVar
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 State = TypeVar("State")
 
@@ -49,4 +62,91 @@ def scan_time_chunks(
         return fold(state, chunk, valid), None
 
     state, _ = jax.lax.scan(step, init, (chunks, local_offsets))
+    return state
+
+
+def stream_host_chunks(
+    values: np.ndarray,
+    counts: np.ndarray,
+    init: State,
+    fold: Callable[[State, jax.Array, jax.Array], State],
+    chunk_size: int,
+    time_offset: int = 0,
+    scale: float = 1.0,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> State:
+    """Fold ``fold(state, chunk, valid)`` over a host ``[N, T]`` array.
+
+    Bit-identical to :func:`scan_time_chunks` on the same data (the fold must
+    be an exact merge and **row-local**), but ``values`` never materializes on
+    device: time slices are divided by ``scale`` when given (e.g. bytes→MB),
+    cast to float32, and transferred one chunk at a time. Each transfer is
+    enqueued before the previous fold's dispatch returns, so host→device
+    copies overlap device compute. With ``sharding`` (rows over mesh devices),
+    chunks land pre-sharded and the row-local fold runs collective-free on
+    every device; a row count that doesn't divide the device count is padded
+    chunk-wise (pad rows carry count 0 — never valid) and the carry's leaves
+    are zero-padded/sliced on their row axis, so the caller sees exactly
+    ``n`` rows.
+    """
+    n, t = values.shape
+    if t == 0 or n == 0:
+        return init
+
+    if sharding is None:
+        rows_sharding = None
+    else:  # rows use the chunk sharding's first (row) axis, replicated over time
+        rows_sharding = jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec(*sharding.spec[:1])
+        )
+
+    pad_rows = 0 if sharding is None else (-n) % sharding.mesh.devices.size
+    if sharding is not None:
+        # Every carry leaf has rows as axis 0 (the fold is row-local): pad to
+        # the device count and place the carry row-sharded alongside the chunks.
+        init = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(
+                jnp.pad(jnp.asarray(leaf), [(0, pad_rows)] + [(0, 0)] * (jnp.ndim(leaf) - 1)),
+                rows_sharding,
+            ),
+            init,
+        )
+    else:
+        # The first step donates the carry; copy so a caller-held init (which
+        # may be reused, e.g. a baseline digest merged into several windows)
+        # is never invalidated.
+        init = jax.tree_util.tree_map(jnp.copy, init)
+
+    def put(chunk: np.ndarray) -> jax.Array:
+        pad_t = chunk_size - chunk.shape[1]  # trailing partial chunk: pad, mask below
+        if pad_t or pad_rows:
+            chunk = np.pad(chunk, ((0, pad_rows), (0, pad_t)))
+        return jax.device_put(chunk, sharding)
+
+    def host_chunk(i: int) -> np.ndarray:
+        block = values[:, i * chunk_size : (i + 1) * chunk_size]
+        if scale != 1.0:  # divide before the f32 cast — matches the resident path
+            block = block / scale
+        return np.asarray(block, dtype=np.float32)
+
+    counts_dev = jax.device_put(
+        np.pad(np.asarray(counts, dtype=np.int32), (0, pad_rows)), rows_sharding
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: State, chunk: jax.Array, start: jax.Array) -> State:
+        local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + start
+        valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts_dev[:, None])
+        return fold(state, chunk, valid)
+
+    num_chunks = -(-t // chunk_size)
+    state = init
+    next_chunk = put(host_chunk(0))
+    for i in range(num_chunks):
+        current = next_chunk
+        if i + 1 < num_chunks:
+            next_chunk = put(host_chunk(i + 1))  # enqueue H2D before the fold
+        state = step(state, current, jnp.int32(i * chunk_size))
+    if pad_rows:
+        state = jax.tree_util.tree_map(lambda leaf: leaf[:n], state)
     return state

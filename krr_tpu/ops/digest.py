@@ -183,3 +183,29 @@ def build_from_packed(
         chunk_size,
         time_offset,
     )
+
+
+def build_from_host(
+    spec: DigestSpec,
+    values: "np.ndarray",
+    counts: "np.ndarray",
+    chunk_size: int = 8192,
+    time_offset: int = 0,
+    sharding=None,
+) -> Digest:
+    """Build a digest from a **host-resident** ``[N, T]`` array, streaming
+    time chunks to the device (double-buffered) — bit-identical to
+    :func:`build_from_packed`, but device memory holds only the digest state
+    plus ~2 chunks, so windows larger than HBM digest fine
+    (`krr_tpu.ops.chunked.stream_host_chunks`)."""
+    from krr_tpu.ops.chunked import stream_host_chunks
+
+    return stream_host_chunks(
+        values,
+        counts,
+        empty(spec, values.shape[0]),
+        lambda digest, chunk, valid: add_chunk(spec, digest, chunk, valid),
+        chunk_size,
+        time_offset,
+        sharding=sharding,
+    )

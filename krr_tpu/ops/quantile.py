@@ -54,6 +54,40 @@ def masked_max(values: jax.Array, counts: jax.Array) -> jax.Array:
     return jnp.where(counts > 0, peak, jnp.nan)
 
 
+def masked_max_from_host(
+    values: "np.ndarray",
+    counts: "np.ndarray",
+    chunk_size: int = 8192,
+    scale: float = 1.0,
+    sharding=None,
+) -> "np.ndarray":
+    """Per-row max of a **host-resident** ``[N, T]`` array (optionally divided
+    by ``scale`` first), streamed to the device in time chunks so the full
+    matrix never lives in HBM; NaN for empty rows. Matches :func:`masked_max`
+    on the same (scaled) data."""
+    import numpy as np
+
+    from krr_tpu.ops.chunked import stream_host_chunks
+
+    n = values.shape[0]
+    if n == 0:
+        return np.zeros((0,), dtype=np.float32)
+    init = jnp.full((n,), -jnp.inf, dtype=jnp.float32)
+    peak = stream_host_chunks(
+        values,
+        counts,
+        init,
+        lambda state, chunk, valid: jnp.maximum(
+            state, jnp.max(jnp.where(valid, chunk, -jnp.inf), axis=1)
+        ),
+        chunk_size,
+        scale=scale,
+        sharding=sharding,
+    )
+    peak = np.asarray(peak)
+    return np.where(np.asarray(counts) > 0, peak, np.nan)
+
+
 @jax.jit
 def masked_sum_count(values: jax.Array, counts: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Per-row (sum, count) over the valid prefix — building block for means

@@ -106,3 +106,21 @@ def build_from_packed(
 
     n = values.shape[0]
     return scan_time_chunks(values, counts, empty(n, k), add_chunk, chunk_size, time_offset)
+
+
+def build_from_host(
+    values: "np.ndarray",
+    counts: "np.ndarray",
+    k: int,
+    chunk_size: int = 8192,
+    time_offset: int = 0,
+    sharding=None,
+) -> TopKSketch:
+    """Build the sketch from a **host-resident** ``[N, T]`` array, streaming
+    time chunks to the device — bit-identical to :func:`build_from_packed`
+    with device memory bounded by the ``[N, K]`` state plus ~2 chunks."""
+    from krr_tpu.ops.chunked import stream_host_chunks
+
+    return stream_host_chunks(
+        values, counts, empty(values.shape[0], k), add_chunk, chunk_size, time_offset, sharding=sharding
+    )

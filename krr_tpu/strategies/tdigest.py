@@ -79,18 +79,117 @@ class TDigestStrategySettings(SimpleStrategySettings):
             "history (multi-source scans against the same state commute)."
         ),
     )
+    host_stream_mb: int = pd.Field(
+        0,
+        ge=-1,
+        description=(
+            "Stream the packed window from host memory in double-buffered "
+            "time chunks when its float32 footprint exceeds this many MB per "
+            "device, so the full matrix never lives in device memory. "
+            "0 = auto (stream past ~40% of device memory); -1 = never stream."
+        ),
+    )
 
     def cpu_spec(self) -> DigestSpec:
         # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
         return DigestSpec(gamma=self.digest_gamma, min_value=1e-7, num_buckets=self.digest_buckets)
 
 
+def _stream_threshold_bytes(setting_mb: int) -> Optional[int]:
+    """Per-device bytes past which the window streams from host; None = never."""
+    if setting_mb == -1:
+        return None
+    if setting_mb > 0:
+        return setting_mb * 1_000_000
+    import jax
+
+    try:  # auto: leave room for the carry, temporaries, and double buffering
+        limit = jax.local_devices()[0].memory_stats().get("bytes_limit")
+    except Exception:
+        limit = None
+    return int(limit * 0.4) if limit else 6_000_000_000
+
+
+def _chunk_sharding(mesh):
+    """Chunk rows spread over every mesh device; time columns replicated
+    (each device folds its own rows — collective-free)."""
+    import jax
+
+    from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec((DATA_AXIS, TIME_AXIS)))
+
+
 class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
     __display_name__ = "tdigest"
+
+    def _exact_topk_k(self, capacity: int, q: float) -> Optional[int]:
+        """K for the exact top-K sketch, or None when the histogram digest
+        must serve — the single decision site shared by the resident, mesh,
+        and host-streamed builds (they must always pick the same sketch)."""
+        k = topk_ops.required_k(capacity, q)
+        return k if 0 < k <= self.settings.exact_sketch_budget else None
+
+    def _use_host_stream(self, batch: FleetBatch, mesh) -> bool:
+        threshold = _stream_threshold_bytes(self.settings.host_stream_mb)
+        if threshold is None:
+            return False
+        cpu = batch.packed(ResourceType.CPU)
+        mem = batch.packed(ResourceType.Memory)
+        f32_bytes = 4 * (cpu.values.size + mem.values.size)
+        num_devices = 1 if mesh is None else mesh.devices.size
+        return f32_bytes / num_devices > threshold
+
+    def _streamed_window_digest(self, batch: FleetBatch, spec: DigestSpec, mesh):
+        """`_window_digest` without device residency: host-streamed builds."""
+        from krr_tpu.ops.quantile import masked_max_from_host
+
+        chunk = self.settings.chunk_size
+        sharding = None if mesh is None else _chunk_sharding(mesh)
+        cpu = batch.packed(ResourceType.CPU)
+        mem = batch.packed(ResourceType.Memory)
+        cpu_digest = digest_ops.build_from_host(
+            spec, cpu.values, cpu.counts, chunk_size=chunk, sharding=sharding
+        )
+        counts = np.asarray(cpu_digest.counts)
+        total = np.asarray(cpu_digest.total)
+        peak = np.asarray(cpu_digest.peak)
+        mem_peak = masked_max_from_host(
+            mem.values, mem.counts, chunk_size=chunk, scale=MEMORY_SCALE, sharding=sharding
+        )
+        mem_total = np.asarray(mem.counts, dtype=np.float32)
+        mem_peak = np.where(np.isnan(mem_peak), -np.inf, mem_peak)
+        return counts, total, peak, mem_total, mem_peak
+
+    def _streamed_sketch(self, batch: FleetBatch, spec: DigestSpec, q: float, mesh):
+        """CPU percentile + memory peak with the window streamed from host."""
+        from krr_tpu.ops.quantile import masked_max_from_host
+
+        chunk = self.settings.chunk_size
+        sharding = None if mesh is None else _chunk_sharding(mesh)
+        cpu = batch.packed(ResourceType.CPU)
+        mem = batch.packed(ResourceType.Memory)
+        k = self._exact_topk_k(cpu.capacity, q)
+        if k is not None:
+            sketch = topk_ops.build_from_host(
+                cpu.values, cpu.counts, k=k, chunk_size=chunk, sharding=sharding
+            )
+            cpu_p = np.asarray(topk_ops.percentile(sketch, q))
+        else:
+            cpu_digest = digest_ops.build_from_host(
+                spec, cpu.values, cpu.counts, chunk_size=chunk, sharding=sharding
+            )
+            cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
+        mem_max = masked_max_from_host(
+            mem.values, mem.counts, chunk_size=chunk, scale=MEMORY_SCALE, sharding=sharding
+        )
+        return cpu_p, mem_max
 
     def _window_digest(self, batch: FleetBatch, spec: DigestSpec, mesh):
         """Digest + memory peak of the fetched window. Returns host arrays
         (cpu Digest sliced to real rows, mem peak in MB)."""
+        if self._use_host_stream(batch, mesh):
+            return self._streamed_window_digest(batch, spec, mesh)
         chunk = self.settings.chunk_size
         n = len(batch)
         if mesh is not None:
@@ -177,6 +276,8 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
                     cpu_p = store.cpu_percentile(rows, q)
                     mem_max = store.memory_peak(rows)
                     store.save(self.settings.state_path)
+            elif self._use_host_stream(batch, mesh):
+                cpu_p, mem_max = self._streamed_sketch(batch, spec, q, mesh)
             elif mesh is not None:
                 from krr_tpu.parallel import (
                     sharded_fleet_digest,
@@ -187,8 +288,8 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
 
                 cpu = batch.packed(ResourceType.CPU)
                 mem = batch.packed(ResourceType.Memory)
-                k = topk_ops.required_k(cpu.capacity, q)
-                if 0 < k <= self.settings.exact_sketch_budget:
+                k = self._exact_topk_k(cpu.capacity, q)
+                if k is not None:
                     sketch, real_rows = sharded_fleet_topk(
                         cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
                     )
@@ -202,8 +303,8 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
             else:
                 cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
                 mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-                k = topk_ops.required_k(batch.packed(ResourceType.CPU).capacity, q)
-                if 0 < k <= self.settings.exact_sketch_budget:
+                k = self._exact_topk_k(batch.packed(ResourceType.CPU).capacity, q)
+                if k is not None:
                     sketch = topk_ops.build_from_packed(
                         cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
                     )

@@ -128,6 +128,59 @@ class TestTDigestStrategy:
             assert t[ResourceType.Memory].request == s[ResourceType.Memory].request
 
 
+    @staticmethod
+    def _force_tiny_threshold(monkeypatch):
+        """Unit batches are far below the real MB-scale floor; drop the
+        threshold to one byte (keeping -1 = never) so the streamed arm truly
+        streams."""
+        import krr_tpu.strategies.tdigest as td
+
+        monkeypatch.setattr(td, "_stream_threshold_bytes", lambda mb: None if mb == -1 else 1)
+
+    def test_host_streamed_equals_resident(self, rng, monkeypatch):
+        """A tiny threshold forces the host→device chunk pipeline (mesh path
+        under the 8-device conftest); results must match the resident build
+        exactly — same sketch, same validity, same Decimal edge."""
+        self._force_tiny_threshold(monkeypatch)
+        batch = make_batch(rng)
+        resident = TDigestStrategy(
+            TDigestStrategySettings(chunk_size=128, host_stream_mb=-1)
+        ).run_batch(batch)
+        streaming = TDigestStrategy(TDigestStrategySettings(chunk_size=128, host_stream_mb=0))
+        from krr_tpu.strategies.simple import resolve_mesh
+
+        assert streaming._use_host_stream(batch, resolve_mesh(streaming.settings))
+        streamed = streaming.run_batch(batch)
+        assert len(resident) == len(streamed)
+        for r, s in zip(resident, streamed):
+            for resource in ResourceType:
+                rv, sv = r[resource].request, s[resource].request
+                if rv is None or (hasattr(rv, "is_nan") and rv.is_nan()):
+                    assert sv is None or sv.is_nan()
+                else:
+                    assert rv == sv, (resource, rv, sv)
+
+    def test_host_streamed_single_device(self, rng, monkeypatch):
+        """Streaming without a mesh (use_mesh=False): same equality."""
+        self._force_tiny_threshold(monkeypatch)
+        batch = make_batch(rng)
+        resident = TDigestStrategy(
+            TDigestStrategySettings(chunk_size=128, host_stream_mb=-1, use_mesh=False)
+        ).run_batch(batch)
+        streaming = TDigestStrategy(
+            TDigestStrategySettings(chunk_size=128, host_stream_mb=0, use_mesh=False)
+        )
+        assert streaming._use_host_stream(batch, None)
+        streamed = streaming.run_batch(batch)
+        for r, s in zip(resident, streamed):
+            for resource in ResourceType:
+                rv, sv = r[resource].request, s[resource].request
+                if rv is None or (hasattr(rv, "is_nan") and rv.is_nan()):
+                    assert sv is None or sv.is_nan()
+                else:
+                    assert rv == sv, (resource, rv, sv)
+
+
 class TestPluginCompat:
     def test_reference_style_plugin_registers_and_runs(self, rng):
         import pydantic as pd

@@ -160,3 +160,33 @@ class TestStoreLocking:
             f.write("garbage")
         with pytest.raises(ValueError, match="delete the file to start fresh"):
             DigestStore.open_or_create(path, SPEC)
+
+
+class TestStreamedStatePath:
+    def test_host_streamed_window_equals_resident(self, tmp_path, rng, monkeypatch):
+        """The state-path window digest built via the host→device chunk
+        pipeline must write the same store (bit-identical digests) as the
+        resident build."""
+        import krr_tpu.strategies.tdigest as td
+
+        obj = make_obj("a", ["a-0"])
+        batch = window_batch(rng, [obj], t=300)
+
+        resident_path = str(tmp_path / "resident.npz")
+        TDigestStrategy(
+            TDigestStrategySettings(state_path=resident_path, chunk_size=128, host_stream_mb=-1)
+        ).run_batch(batch)
+
+        monkeypatch.setattr(td, "_stream_threshold_bytes", lambda mb: None if mb == -1 else 1)
+        streamed_path = str(tmp_path / "streamed.npz")
+        TDigestStrategy(
+            TDigestStrategySettings(state_path=streamed_path, chunk_size=128, host_stream_mb=0)
+        ).run_batch(batch)
+
+        spec = TDigestStrategySettings().cpu_spec()
+        a = DigestStore.open_or_create(resident_path, spec)
+        b = DigestStore.open_or_create(streamed_path, spec)
+        np.testing.assert_array_equal(a.cpu_counts, b.cpu_counts)
+        np.testing.assert_array_equal(a.cpu_total, b.cpu_total)
+        np.testing.assert_array_equal(a.cpu_peak, b.cpu_peak)
+        np.testing.assert_array_equal(a.mem_peak, b.mem_peak)
