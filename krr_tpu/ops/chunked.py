@@ -65,17 +65,8 @@ def scan_time_chunks(
     return state
 
 
-def stream_host_chunks(
-    values: np.ndarray,
-    counts: np.ndarray,
-    init: State,
-    fold: Callable[[State, jax.Array, jax.Array], State],
-    chunk_size: int,
-    time_offset: int = 0,
-    scale: float = 1.0,
-    sharding: Optional[jax.sharding.Sharding] = None,
-) -> State:
-    """Fold ``fold(state, chunk, valid)`` over a host ``[N, T]`` array.
+class HostChunkStreamer:
+    """Folds over a host ``[N, T]`` array, streaming time chunks to the device.
 
     Bit-identical to :func:`scan_time_chunks` on the same data (the fold must
     be an exact merge and **row-local**), but ``values`` never materializes on
@@ -88,65 +79,116 @@ def stream_host_chunks(
     chunk-wise (pad rows carry count 0 — never valid) and the carry's leaves
     are zero-padded/sliced on their row axis, so the caller sees exactly
     ``n`` rows.
+
+    Construct once, then :meth:`run` any number of folds over the same matrix
+    (the multi-pass streamed bisection runs 31): the per-fold jitted step and
+    the device-resident counts are cached, so repeated passes re-transfer only
+    the chunks themselves.
     """
-    n, t = values.shape
-    if t == 0 or n == 0:
-        return init
 
-    if sharding is None:
-        rows_sharding = None
-    else:  # rows use the chunk sharding's first (row) axis, replicated over time
-        rows_sharding = jax.sharding.NamedSharding(
-            sharding.mesh, jax.sharding.PartitionSpec(*sharding.spec[:1])
-        )
+    def __init__(
+        self,
+        values: np.ndarray,
+        counts: np.ndarray,
+        chunk_size: int,
+        time_offset: int = 0,
+        scale: float = 1.0,
+        sharding: Optional[jax.sharding.Sharding] = None,
+    ):
+        self.values = values
+        self.chunk_size = chunk_size
+        self.time_offset = time_offset
+        self.scale = scale
+        self.sharding = sharding
+        self.n, self.t = values.shape
 
-    pad_rows = 0 if sharding is None else (-n) % sharding.mesh.devices.size
-    if sharding is not None:
-        # Every carry leaf has rows as axis 0 (the fold is row-local): pad to
-        # the device count and place the carry row-sharded alongside the chunks.
-        init = jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(
-                jnp.pad(jnp.asarray(leaf), [(0, pad_rows)] + [(0, 0)] * (jnp.ndim(leaf) - 1)),
-                rows_sharding,
-            ),
-            init,
+        if sharding is None:
+            self.rows_sharding = None
+            self.pad_rows = 0
+        else:  # rows use the chunk sharding's first (row) axis, replicated over time
+            self.rows_sharding = jax.sharding.NamedSharding(
+                sharding.mesh, jax.sharding.PartitionSpec(*sharding.spec[:1])
+            )
+            self.pad_rows = (-self.n) % sharding.mesh.devices.size
+        self.counts_dev = jax.device_put(
+            np.pad(np.asarray(counts, dtype=np.int32), (0, self.pad_rows)), self.rows_sharding
         )
-    else:
+        self._steps: dict[Callable, Callable] = {}
+
+    def _place_init(self, init: State) -> State:
+        if self.sharding is not None:
+            # Every carry leaf has rows as axis 0 (the fold is row-local): pad
+            # to the device count and place the carry row-sharded alongside
+            # the chunks.
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(
+                    jnp.pad(
+                        jnp.asarray(leaf), [(0, self.pad_rows)] + [(0, 0)] * (jnp.ndim(leaf) - 1)
+                    ),
+                    self.rows_sharding,
+                ),
+                init,
+            )
         # The first step donates the carry; copy so a caller-held init (which
         # may be reused, e.g. a baseline digest merged into several windows)
         # is never invalidated.
-        init = jax.tree_util.tree_map(jnp.copy, init)
+        return jax.tree_util.tree_map(jnp.copy, init)
 
-    def put(chunk: np.ndarray) -> jax.Array:
-        pad_t = chunk_size - chunk.shape[1]  # trailing partial chunk: pad, mask below
-        if pad_t or pad_rows:
-            chunk = np.pad(chunk, ((0, pad_rows), (0, pad_t)))
-        return jax.device_put(chunk, sharding)
+    def _put(self, chunk: np.ndarray) -> jax.Array:
+        pad_t = self.chunk_size - chunk.shape[1]  # trailing partial chunk: pad, mask below
+        if pad_t or self.pad_rows:
+            chunk = np.pad(chunk, ((0, self.pad_rows), (0, pad_t)))
+        return jax.device_put(chunk, self.sharding)
 
-    def host_chunk(i: int) -> np.ndarray:
-        block = values[:, i * chunk_size : (i + 1) * chunk_size]
-        if scale != 1.0:  # divide before the f32 cast — matches the resident path
-            block = block / scale
+    def _host_chunk(self, i: int) -> np.ndarray:
+        block = self.values[:, i * self.chunk_size : (i + 1) * self.chunk_size]
+        if self.scale != 1.0:  # divide before the f32 cast — matches the resident path
+            block = block / self.scale
         return np.asarray(block, dtype=np.float32)
 
-    counts_dev = jax.device_put(
-        np.pad(np.asarray(counts, dtype=np.int32), (0, pad_rows)), rows_sharding
-    )
+    def _step_for(self, fold: Callable[[State, jax.Array, jax.Array], State]) -> Callable:
+        step = self._steps.get(fold)
+        if step is None:
+            t, time_offset, counts_dev, chunk_size = self.t, self.time_offset, self.counts_dev, self.chunk_size
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step(state: State, chunk: jax.Array, start: jax.Array) -> State:
-        local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + start
-        valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts_dev[:, None])
-        return fold(state, chunk, valid)
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state: State, chunk: jax.Array, start: jax.Array) -> State:
+                local_pos = jnp.arange(chunk_size, dtype=jnp.int32)[None, :] + start
+                valid = (local_pos < t) & (local_pos + jnp.int32(time_offset) < counts_dev[:, None])
+                return fold(state, chunk, valid)
 
-    num_chunks = -(-t // chunk_size)
-    state = init
-    next_chunk = put(host_chunk(0))
-    for i in range(num_chunks):
-        current = next_chunk
-        if i + 1 < num_chunks:
-            next_chunk = put(host_chunk(i + 1))  # enqueue H2D before the fold
-        state = step(state, current, jnp.int32(i * chunk_size))
-    if pad_rows:
-        state = jax.tree_util.tree_map(lambda leaf: leaf[:n], state)
-    return state
+            self._steps[fold] = step
+        return step
+
+    def run(self, init: State, fold: Callable[[State, jax.Array, jax.Array], State]) -> State:
+        """One full pass: fold every chunk into ``init``, double-buffered."""
+        if self.t == 0 or self.n == 0:
+            return init
+        step = self._step_for(fold)
+        state = self._place_init(init)
+        num_chunks = -(-self.t // self.chunk_size)
+        next_chunk = self._put(self._host_chunk(0))
+        for i in range(num_chunks):
+            current = next_chunk
+            if i + 1 < num_chunks:
+                next_chunk = self._put(self._host_chunk(i + 1))  # enqueue H2D before the fold
+            state = step(state, current, jnp.int32(i * self.chunk_size))
+        if self.pad_rows:
+            state = jax.tree_util.tree_map(lambda leaf: leaf[: self.n], state)
+        return state
+
+
+def stream_host_chunks(
+    values: np.ndarray,
+    counts: np.ndarray,
+    init: State,
+    fold: Callable[[State, jax.Array, jax.Array], State],
+    chunk_size: int,
+    time_offset: int = 0,
+    scale: float = 1.0,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> State:
+    """One-shot convenience wrapper over :class:`HostChunkStreamer`."""
+    return HostChunkStreamer(
+        values, counts, chunk_size, time_offset=time_offset, scale=scale, sharding=sharding
+    ).run(init, fold)

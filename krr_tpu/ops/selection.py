@@ -46,6 +46,25 @@ def selection_rank(counts: jax.Array, q: jax.Array | float) -> jax.Array:
     return jnp.clip(rank, 0, jnp.maximum(counts - 1, 0))
 
 
+def bisect_bounds(n: int) -> tuple[jax.Array, jax.Array]:
+    """Initial inclusive (lo, hi) over the 31-bit pattern space."""
+    return jnp.zeros((n,), dtype=jnp.int32), jnp.full((n,), jnp.int32(2**31 - 1), dtype=jnp.int32)
+
+
+def bisect_mid(low: jax.Array, high: jax.Array) -> jax.Array:
+    return low + (high - low) // 2
+
+
+def bisect_update(
+    low: jax.Array, high: jax.Array, mid: jax.Array, le: jax.Array, rank: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """One bound update from the global ≤-mid counts. The tie rule ("if enough
+    samples are ≤ mid, the answer is ≤ mid") lives ONLY here — shared by the
+    in-device loop, the sharded build, and the host-streamed loop."""
+    go_low = le >= rank + 1
+    return jnp.where(go_low, low, mid + 1), jnp.where(go_low, mid, high)
+
+
 def bisect_loop(
     bits: jax.Array,
     mask: jax.Array,
@@ -57,21 +76,16 @@ def bisect_loop(
 
     ``count_reduce`` folds per-shard counts into global counts — identity on a
     single device, an exact integer ``psum`` along the mesh's time axis in the
-    sharded build (`krr_tpu.parallel.fleet`). Both callers therefore share
+    sharded build (`krr_tpu.parallel.fleet`). All callers therefore share
     every subtle semantic (rank formula, clamps, tie handling) by construction.
     """
-    n = bits.shape[0]
-    lo = jnp.zeros((n,), dtype=jnp.int32)  # inclusive
-    hi = jnp.full((n,), jnp.int32(2**31 - 1), dtype=jnp.int32)  # inclusive
+    lo, hi = bisect_bounds(bits.shape[0])
 
     def body(_, carry):
         low, high = carry
-        mid = low + (high - low) // 2
+        mid = bisect_mid(low, high)
         le_local = jnp.sum(jnp.where(mask & (bits <= mid[:, None]), 1, 0), axis=1, dtype=jnp.int32)
-        le = count_reduce(le_local)
-        # If enough samples are <= mid, the answer is <= mid.
-        go_low = le >= rank + 1
-        return jnp.where(go_low, low, mid + 1), jnp.where(go_low, mid, high)
+        return bisect_update(low, high, mid, count_reduce(le_local), rank)
 
     low, _ = jax.lax.fori_loop(0, num_iters, body, (lo, hi))
     return jax.lax.bitcast_convert_type(low, jnp.float32)
@@ -94,3 +108,50 @@ def masked_percentile_bisect(
     mask = jnp.arange(t, dtype=jnp.int32)[None, :] < counts[:, None]
     result = bisect_loop(as_ordered_bits(values), mask, selection_rank(counts, q), num_iters=num_iters)
     return jnp.where(counts > 0, result, jnp.nan)
+
+
+def masked_percentile_bisect_from_host(
+    values: "object",
+    counts: "object",
+    q: float,
+    chunk_size: int = 8192,
+    num_iters: int = 31,
+    sharding=None,
+) -> "object":
+    """Exact percentile of a **host-resident** ``[N, T]`` matrix that doesn't
+    fit in device memory: the same bit-space bisection, with each iteration's
+    counting pass streamed over host chunks (`stream_host_chunks`).
+
+    Selects the exact same sample as :func:`masked_percentile_bisect` for any
+    ``q`` — the escape hatch for mid-range percentiles, where no bounded exact
+    sketch exists. Host→device traffic is ``num_iters ×`` the matrix, so when
+    the rank-from-the-top fits a top-K sketch (q ≳ 97 at reference sample
+    rates), prefer the one-pass `krr_tpu.ops.topk_sketch.build_from_host`.
+    Returns a host float32 array; NaN for empty rows.
+    """
+    import numpy as np
+
+    from krr_tpu.ops.chunked import HostChunkStreamer
+
+    n = values.shape[0]
+    counts32 = np.asarray(counts, dtype=np.int32)
+    rank = selection_rank(jnp.asarray(counts32), q)
+    lo, hi = bisect_bounds(n)
+    streamer = HostChunkStreamer(values, counts32, chunk_size, sharding=sharding)
+
+    def count_le(carry, chunk, valid):
+        mid, le = carry
+        le_chunk = jnp.sum(
+            jnp.where(valid & (as_ordered_bits(chunk) <= mid[:, None]), 1, 0),
+            axis=1,
+            dtype=jnp.int32,
+        )
+        return mid, le + le_chunk
+
+    for _ in range(num_iters):
+        mid = bisect_mid(lo, hi)
+        _, le = streamer.run((mid, jnp.zeros((n,), dtype=jnp.int32)), count_le)
+        lo, hi = bisect_update(lo, hi, mid, le, rank)
+
+    result = np.asarray(jax.lax.bitcast_convert_type(lo, jnp.float32))
+    return np.where(counts32 > 0, result, np.nan)

@@ -77,6 +77,51 @@ def fleet_device_arrays(batch: FleetBatch, resource: ResourceType, scale: float 
     return values, counts
 
 
+#: Top-K width cap for the streamed exact path; past this the multi-pass
+#: streamed bisection serves (still exact, but num_iters × the transfer).
+HOST_STREAM_TOPK_BUDGET = 8192
+#: Time-chunk width for host-streamed builds in the simple strategy.
+HOST_STREAM_CHUNK = 8192
+
+
+def _stream_threshold_bytes(setting_mb: int) -> Optional[int]:
+    """Per-device bytes past which the window streams from host; None = never."""
+    if setting_mb == -1:
+        return None
+    if setting_mb > 0:
+        return setting_mb * 1_000_000
+    import jax
+
+    try:  # auto: leave room for the carry, temporaries, and double buffering
+        limit = jax.local_devices()[0].memory_stats().get("bytes_limit")
+    except Exception:
+        limit = None
+    return int(limit * 0.4) if limit else 6_000_000_000
+
+
+def _chunk_sharding(mesh):
+    """Chunk rows spread over every mesh device; time columns replicated
+    (each device folds its own rows — collective-free)."""
+    import jax
+
+    from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec((DATA_AXIS, TIME_AXIS)))
+
+
+def use_host_stream(batch: FleetBatch, mesh, setting_mb: int) -> bool:
+    """Whether the packed window should stream from host rather than live on
+    device — shared by the simple and tdigest strategies."""
+    threshold = _stream_threshold_bytes(setting_mb)
+    if threshold is None:
+        return False
+    cpu = batch.packed(ResourceType.CPU)
+    mem = batch.packed(ResourceType.Memory)
+    f32_bytes = 4 * (cpu.values.size + mem.values.size)
+    num_devices = 1 if mesh is None else mesh.devices.size
+    return f32_bytes / num_devices > threshold
+
+
 class SimpleStrategySettings(StrategySettings):
     cpu_percentile: Decimal = pd.Field(
         Decimal(99), gt=0, le=100, description="The percentile to use for the CPU recommendation."
@@ -96,6 +141,16 @@ class SimpleStrategySettings(StrategySettings):
         description=(
             "Write a jax.profiler trace of the fleet compute to this directory "
             "(open with TensorBoard / xprof to see per-kernel TPU timings)."
+        ),
+    )
+    host_stream_mb: int = pd.Field(
+        0,
+        ge=-1,
+        description=(
+            "Stream the packed window from host memory in double-buffered "
+            "time chunks when its float32 footprint exceeds this many MB per "
+            "device, so the full matrix never lives in device memory. "
+            "0 = auto (stream past ~40% of device memory); -1 = never stream."
         ),
     )
 
@@ -125,6 +180,33 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
 
     __display_name__ = "simple"
 
+    def _streamed_exact(self, batch: FleetBatch, q: float, mesh):
+        """Exact recommendations with the window streamed from host (window
+        larger than device memory): one-pass exact top-K when the
+        rank-from-the-top fits, multi-pass streamed bisection otherwise —
+        both select the same sample as the resident path."""
+        from krr_tpu.ops import topk_sketch as topk_ops
+        from krr_tpu.ops.quantile import masked_max_from_host
+        from krr_tpu.ops.selection import masked_percentile_bisect_from_host
+
+        sharding = None if mesh is None else _chunk_sharding(mesh)
+        cpu = batch.packed(ResourceType.CPU)
+        mem = batch.packed(ResourceType.Memory)
+        k = topk_ops.required_k(cpu.capacity, q)
+        if 0 < k <= HOST_STREAM_TOPK_BUDGET:
+            sketch = topk_ops.build_from_host(
+                cpu.values, cpu.counts, k=k, chunk_size=HOST_STREAM_CHUNK, sharding=sharding
+            )
+            cpu_p = np.asarray(topk_ops.percentile(sketch, q))
+        else:  # mid-range percentile: no bounded exact sketch — multi-pass
+            cpu_p = masked_percentile_bisect_from_host(
+                cpu.values, cpu.counts, q, chunk_size=HOST_STREAM_CHUNK, sharding=sharding
+            )
+        mem_max = masked_max_from_host(
+            mem.values, mem.counts, chunk_size=HOST_STREAM_CHUNK, scale=MEMORY_SCALE, sharding=sharding
+        )
+        return cpu_p, mem_max
+
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         if not batch.objects:
             return []
@@ -132,7 +214,9 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
         mesh = resolve_mesh(self.settings)
 
         with self.profile_span():
-            if mesh is not None:
+            if use_host_stream(batch, mesh, self.settings.host_stream_mb):
+                cpu_p, mem_max = self._streamed_exact(batch, q, mesh)
+            elif mesh is not None:
                 from krr_tpu.parallel import sharded_masked_max, sharded_percentile_bisect
 
                 cpu = batch.packed(ResourceType.CPU)

@@ -38,9 +38,11 @@ from krr_tpu.strategies.base import BatchedStrategy, RunResult
 from krr_tpu.strategies.simple import (
     MEMORY_SCALE,
     SimpleStrategySettings,
+    _chunk_sharding,
     finalize_fleet,
     fleet_device_arrays,
     resolve_mesh,
+    use_host_stream,
 )
 
 
@@ -79,45 +81,9 @@ class TDigestStrategySettings(SimpleStrategySettings):
             "history (multi-source scans against the same state commute)."
         ),
     )
-    host_stream_mb: int = pd.Field(
-        0,
-        ge=-1,
-        description=(
-            "Stream the packed window from host memory in double-buffered "
-            "time chunks when its float32 footprint exceeds this many MB per "
-            "device, so the full matrix never lives in device memory. "
-            "0 = auto (stream past ~40% of device memory); -1 = never stream."
-        ),
-    )
-
     def cpu_spec(self) -> DigestSpec:
         # 1e-7 cores ≈ 0.1 µcore resolution floor; top bucket ≥ 10k cores.
         return DigestSpec(gamma=self.digest_gamma, min_value=1e-7, num_buckets=self.digest_buckets)
-
-
-def _stream_threshold_bytes(setting_mb: int) -> Optional[int]:
-    """Per-device bytes past which the window streams from host; None = never."""
-    if setting_mb == -1:
-        return None
-    if setting_mb > 0:
-        return setting_mb * 1_000_000
-    import jax
-
-    try:  # auto: leave room for the carry, temporaries, and double buffering
-        limit = jax.local_devices()[0].memory_stats().get("bytes_limit")
-    except Exception:
-        limit = None
-    return int(limit * 0.4) if limit else 6_000_000_000
-
-
-def _chunk_sharding(mesh):
-    """Chunk rows spread over every mesh device; time columns replicated
-    (each device folds its own rows — collective-free)."""
-    import jax
-
-    from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS
-
-    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec((DATA_AXIS, TIME_AXIS)))
 
 
 class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
@@ -131,14 +97,7 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         return k if 0 < k <= self.settings.exact_sketch_budget else None
 
     def _use_host_stream(self, batch: FleetBatch, mesh) -> bool:
-        threshold = _stream_threshold_bytes(self.settings.host_stream_mb)
-        if threshold is None:
-            return False
-        cpu = batch.packed(ResourceType.CPU)
-        mem = batch.packed(ResourceType.Memory)
-        f32_bytes = 4 * (cpu.values.size + mem.values.size)
-        num_devices = 1 if mesh is None else mesh.devices.size
-        return f32_bytes / num_devices > threshold
+        return use_host_stream(batch, mesh, self.settings.host_stream_mb)
 
     def _streamed_window_digest(self, batch: FleetBatch, spec: DigestSpec, mesh):
         """`_window_digest` without device residency: host-streamed builds."""

@@ -395,3 +395,34 @@ class TestHostStreaming:
         resident = topk_ops.build_from_packed(values.astype(np.float32), counts, k=128, chunk_size=256)
         streamed = topk_ops.build_from_host(values, counts, k=128, chunk_size=256, sharding=sharding)
         np.testing.assert_array_equal(np.asarray(resident.values), np.asarray(streamed.values))
+
+    def test_bisect_streamed_equals_resident(self, rng):
+        from krr_tpu.ops.selection import (
+            masked_percentile_bisect,
+            masked_percentile_bisect_from_host,
+        )
+
+        values, counts = self._data(rng)
+        for q in [50.0, 90.0, 99.0]:
+            resident = np.asarray(masked_percentile_bisect(values.astype(np.float32), counts, q))
+            streamed = masked_percentile_bisect_from_host(values, counts, q, chunk_size=256)
+            np.testing.assert_array_equal(resident, streamed)
+
+    def test_bisect_streamed_sharded(self, rng):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from krr_tpu.ops.selection import (
+            masked_percentile_bisect,
+            masked_percentile_bisect_from_host,
+        )
+        from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS, make_mesh
+
+        mesh = make_mesh(devices=jax.devices())
+        sharding = NamedSharding(mesh, PartitionSpec((DATA_AXIS, TIME_AXIS)))
+        values, counts = self._data(rng, n=13)
+        resident = np.asarray(masked_percentile_bisect(values.astype(np.float32), counts, 50.0))
+        streamed = masked_percentile_bisect_from_host(
+            values, counts, 50.0, chunk_size=256, sharding=sharding
+        )
+        np.testing.assert_array_equal(resident, streamed)

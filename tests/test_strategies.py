@@ -39,6 +39,27 @@ def to_decimal_history(pods: dict) -> dict:
     return {k: [Decimal(repr(float(x))) for x in v] for k, v in pods.items()}
 
 
+
+def force_tiny_stream_threshold(monkeypatch):
+    """Unit batches are far below the real MB-scale floor; drop the streaming
+    threshold to one byte (keeping -1 = never) so streamed arms truly stream."""
+    import krr_tpu.strategies.simple as sp
+
+    monkeypatch.setattr(sp, "_stream_threshold_bytes", lambda mb: None if mb == -1 else 1)
+
+
+def assert_results_equal(resident, streamed):
+    """NaN-aware equality of per-object raw recommendations (requests)."""
+    assert len(resident) == len(streamed)
+    for r, s in zip(resident, streamed):
+        for resource in ResourceType:
+            rv, sv = r[resource].request, s[resource].request
+            if rv is None or (hasattr(rv, "is_nan") and rv.is_nan()):
+                assert sv is None or sv.is_nan()
+            else:
+                assert rv == sv, (resource, rv, sv)
+
+
 class TestSimpleStrategy:
     def test_registry(self):
         assert BaseStrategy.find("simple") is SimpleStrategy
@@ -128,20 +149,11 @@ class TestTDigestStrategy:
             assert t[ResourceType.Memory].request == s[ResourceType.Memory].request
 
 
-    @staticmethod
-    def _force_tiny_threshold(monkeypatch):
-        """Unit batches are far below the real MB-scale floor; drop the
-        threshold to one byte (keeping -1 = never) so the streamed arm truly
-        streams."""
-        import krr_tpu.strategies.tdigest as td
-
-        monkeypatch.setattr(td, "_stream_threshold_bytes", lambda mb: None if mb == -1 else 1)
-
     def test_host_streamed_equals_resident(self, rng, monkeypatch):
         """A tiny threshold forces the host→device chunk pipeline (mesh path
         under the 8-device conftest); results must match the resident build
         exactly — same sketch, same validity, same Decimal edge."""
-        self._force_tiny_threshold(monkeypatch)
+        force_tiny_stream_threshold(monkeypatch)
         batch = make_batch(rng)
         resident = TDigestStrategy(
             TDigestStrategySettings(chunk_size=128, host_stream_mb=-1)
@@ -150,19 +162,11 @@ class TestTDigestStrategy:
         from krr_tpu.strategies.simple import resolve_mesh
 
         assert streaming._use_host_stream(batch, resolve_mesh(streaming.settings))
-        streamed = streaming.run_batch(batch)
-        assert len(resident) == len(streamed)
-        for r, s in zip(resident, streamed):
-            for resource in ResourceType:
-                rv, sv = r[resource].request, s[resource].request
-                if rv is None or (hasattr(rv, "is_nan") and rv.is_nan()):
-                    assert sv is None or sv.is_nan()
-                else:
-                    assert rv == sv, (resource, rv, sv)
+        assert_results_equal(resident, streaming.run_batch(batch))
 
     def test_host_streamed_single_device(self, rng, monkeypatch):
         """Streaming without a mesh (use_mesh=False): same equality."""
-        self._force_tiny_threshold(monkeypatch)
+        force_tiny_stream_threshold(monkeypatch)
         batch = make_batch(rng)
         resident = TDigestStrategy(
             TDigestStrategySettings(chunk_size=128, host_stream_mb=-1, use_mesh=False)
@@ -171,14 +175,45 @@ class TestTDigestStrategy:
             TDigestStrategySettings(chunk_size=128, host_stream_mb=0, use_mesh=False)
         )
         assert streaming._use_host_stream(batch, None)
-        streamed = streaming.run_batch(batch)
-        for r, s in zip(resident, streamed):
-            for resource in ResourceType:
-                rv, sv = r[resource].request, s[resource].request
-                if rv is None or (hasattr(rv, "is_nan") and rv.is_nan()):
-                    assert sv is None or sv.is_nan()
-                else:
-                    assert rv == sv, (resource, rv, sv)
+        assert_results_equal(resident, streaming.run_batch(batch))
+
+
+class TestSimpleStreamed:
+    """The exact `simple` strategy must survive windows larger than device
+    memory: streamed results (top-K one-pass or multi-pass bisection) are
+    bit-identical to the resident exact path."""
+
+    def _compare(self, rng, monkeypatch, percentile, use_mesh, force_bisect=False):
+        force_tiny_stream_threshold(monkeypatch)
+        if force_bisect:  # tiny unit batches fit top-K even at p50
+            import krr_tpu.strategies.simple as sp
+
+            monkeypatch.setattr(sp, "HOST_STREAM_TOPK_BUDGET", 0)
+        batch = make_batch(rng)
+        resident = SimpleStrategy(
+            SimpleStrategySettings(
+                host_stream_mb=-1, cpu_percentile=percentile, use_mesh=use_mesh
+            )
+        ).run_batch(batch)
+        streaming = SimpleStrategy(
+            SimpleStrategySettings(host_stream_mb=0, cpu_percentile=percentile, use_mesh=use_mesh)
+        )
+        from krr_tpu.strategies.simple import resolve_mesh, use_host_stream
+
+        assert use_host_stream(batch, resolve_mesh(streaming.settings), 0)
+        assert_results_equal(resident, streaming.run_batch(batch))
+
+    def test_streamed_topk_path_equals_resident(self, rng, monkeypatch):
+        """Default p99: the streamed arm takes the one-pass exact top-K."""
+        self._compare(rng, monkeypatch, Decimal(99), use_mesh=True)
+
+    def test_streamed_bisect_path_equals_resident(self, rng, monkeypatch):
+        """p50: rank-from-top exceeds the top-K budget, so the streamed arm
+        takes the multi-pass exact bisection — still bit-identical."""
+        self._compare(rng, monkeypatch, Decimal(50), use_mesh=True, force_bisect=True)
+
+    def test_streamed_bisect_single_device(self, rng, monkeypatch):
+        self._compare(rng, monkeypatch, Decimal(50), use_mesh=False, force_bisect=True)
 
 
 class TestPluginCompat:
