@@ -254,9 +254,11 @@ class Runner:
             "objects": float(len(objects)),
             "objects_per_second": len(objects) / (t3 - t2) if t3 > t2 and objects else 0.0,
         }
-        self.logger.debug(
-            f"Timings: discover={self.stats['discover_seconds']:.2f}s "
-            f"fetch={self.stats['fetch_seconds']:.2f}s compute={self.stats['compute_seconds']:.2f}s"
+        end_to_end = (len(objects) / (t3 - t0)) if t3 > t0 and objects else 0.0
+        self.logger.info(
+            f"Scanned {len(objects)} objects: discover {self.stats['discover_seconds']:.2f}s, "
+            f"fetch {self.stats['fetch_seconds']:.2f}s, compute {self.stats['compute_seconds']:.2f}s "
+            f"({end_to_end:.1f} objects/s end-to-end)"
         )
         return Result(scans=scans)
 

@@ -77,9 +77,6 @@ def fleet_device_arrays(batch: FleetBatch, resource: ResourceType, scale: float 
     return values, counts
 
 
-#: Top-K width cap for the streamed exact path; past this the multi-pass
-#: streamed bisection serves (still exact, but num_iters × the transfer).
-HOST_STREAM_TOPK_BUDGET = 8192
 #: Time-chunk width for host-streamed builds in the simple strategy.
 HOST_STREAM_CHUNK = 8192
 
@@ -153,6 +150,18 @@ class SimpleStrategySettings(StrategySettings):
             "0 = auto (stream past ~40% of device memory); -1 = never stream."
         ),
     )
+    exact_sketch_budget: int = pd.Field(
+        8192,
+        ge=0,
+        description=(
+            "Max top-K sketch width for the exact high-percentile streaming "
+            "path (krr_tpu.ops.topk_sketch): when the configured "
+            "cpu_percentile's rank-from-the-top fits, streamed builds are "
+            "exact in one pass; past it the simple strategy falls back to "
+            "multi-pass streamed bisection (still exact) and tdigest to the "
+            "histogram digest. 0 disables the top-K path."
+        ),
+    )
 
 
 def resolve_mesh(settings: SimpleStrategySettings):
@@ -193,7 +202,7 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
         cpu = batch.packed(ResourceType.CPU)
         mem = batch.packed(ResourceType.Memory)
         k = topk_ops.required_k(cpu.capacity, q)
-        if 0 < k <= HOST_STREAM_TOPK_BUDGET:
+        if 0 < k <= self.settings.exact_sketch_budget:
             sketch = topk_ops.build_from_host(
                 cpu.values, cpu.counts, k=k, chunk_size=HOST_STREAM_CHUNK, sharding=sharding
             )

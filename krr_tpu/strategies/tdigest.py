@@ -63,16 +63,8 @@ class TDigestStrategySettings(SimpleStrategySettings):
             "top-K path; memory stays exact."
         ),
     )
-    exact_sketch_budget: int = pd.Field(
-        8192,
-        ge=0,
-        description=(
-            "Max top-K sketch width for the exact high-percentile path "
-            "(krr_tpu.ops.topk_sketch): when the configured cpu_percentile's "
-            "rank-from-the-top fits, the streaming build is exact (no digest "
-            "error) and ~2x faster. 0 forces the histogram digest."
-        ),
-    )
+    # exact_sketch_budget is inherited from SimpleStrategySettings — one
+    # tunable cut-over shared by the simple and tdigest streamed paths.
     state_path: Optional[str] = pd.Field(
         None,
         description=(

@@ -165,3 +165,83 @@ class TestCLI:
         assert result.exit_code != 0
         assert "Invalid settings" in result.output
         assert "cpu_percentile" in result.output
+
+
+class TestMultiClusterMultiSource:
+    """BASELINE config 5: one scan spanning several clusters, each with its own
+    (auto-discovered) Prometheus source, folding into one digest state —
+    incremental re-merge across runs."""
+
+    @staticmethod
+    def _make_cluster_env(i: int, rng):
+        from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        pods = cluster.add_workload_with_pods("Deployment", f"app{i}", "default", pod_count=1)
+        metrics.set_series(
+            "default", "main", pods[0],
+            cpu=rng.gamma(2.0, 0.05 * (i + 1), size=96),
+            memory=rng.uniform(1e8, 2e8, size=96),
+        )
+        cluster.services.append({
+            "metadata": {"name": "prometheus-server", "namespace": "monitoring",
+                         "labels": {"app": "prometheus-server"}},
+            "spec": {"ports": [{"port": 9090}]},
+        })
+        return ServerThread(FakeBackend(cluster, metrics)).start()
+
+    def test_four_sources_one_state(self, tmp_path, rng):
+        import asyncio
+
+        import yaml
+
+        from krr_tpu.core.config import Config
+        from krr_tpu.core.runner import Runner
+        from krr_tpu.core.streaming import DigestStore
+        from krr_tpu.strategies import TDigestStrategySettings
+
+        servers = [self._make_cluster_env(i, rng) for i in range(4)]
+        try:
+            kubeconfig = tmp_path / "config"
+            kubeconfig.write_text(yaml.dump({
+                "current-context": "c0",
+                "contexts": [{"name": f"c{i}", "context": {"cluster": f"c{i}", "user": "u"}}
+                             for i in range(4)],
+                "clusters": [{"name": f"c{i}", "cluster": {"server": servers[i].url}}
+                             for i in range(4)],
+                "users": [{"name": "u", "user": {"token": "t"}}],
+            }))
+            state = str(tmp_path / "state.npz")
+
+            def scan():
+                config = Config(
+                    kubeconfig=str(kubeconfig),
+                    clusters=[f"c{i}" for i in range(4)],
+                    strategy="tdigest",
+                    quiet=True,
+                    other_args={"state_path": state, "chunk_size": 128},
+                )
+                return asyncio.run(Runner(config).run())
+
+            result = scan()
+            # One object per cluster, each fetched from its own discovered source.
+            assert len(result.scans) == 4
+            clusters_seen = {s.object.cluster for s in result.scans}
+            assert clusters_seen == {f"c{i}" for i in range(4)}
+            for s in result.scans:
+                assert s.recommended.requests and not s.object.pods == []
+
+            # Second scan re-merges into the same state: totals double.
+            spec = TDigestStrategySettings().cpu_spec()
+            store1 = DigestStore.open_or_create(state, spec)
+            totals1 = dict(zip(store1.keys, store1.cpu_total))
+            scan()
+            store2 = DigestStore.open_or_create(state, spec)
+            totals2 = dict(zip(store2.keys, store2.cpu_total))
+            assert set(totals1) == set(totals2) and len(totals1) == 4
+            for key, total in totals1.items():
+                assert totals2[key] == 2 * total
+        finally:
+            for s in servers:
+                s.stop()

@@ -185,10 +185,9 @@ class TestSimpleStreamed:
 
     def _compare(self, rng, monkeypatch, percentile, use_mesh, force_bisect=False):
         force_tiny_stream_threshold(monkeypatch)
-        if force_bisect:  # tiny unit batches fit top-K even at p50
-            import krr_tpu.strategies.simple as sp
-
-            monkeypatch.setattr(sp, "HOST_STREAM_TOPK_BUDGET", 0)
+        # exact_sketch_budget=0 forces the bisect arm (tiny unit batches
+        # fit top-K even at p50); the budget only affects the streamed path.
+        budget = 0 if force_bisect else 8192
         batch = make_batch(rng)
         resident = SimpleStrategy(
             SimpleStrategySettings(
@@ -196,7 +195,12 @@ class TestSimpleStreamed:
             )
         ).run_batch(batch)
         streaming = SimpleStrategy(
-            SimpleStrategySettings(host_stream_mb=0, cpu_percentile=percentile, use_mesh=use_mesh)
+            SimpleStrategySettings(
+                host_stream_mb=0,
+                cpu_percentile=percentile,
+                use_mesh=use_mesh,
+                exact_sketch_budget=budget,
+            )
         )
         from krr_tpu.strategies.simple import resolve_mesh, use_host_stream
 

@@ -167,7 +167,7 @@ class TestStreamedStatePath:
         """The state-path window digest built via the host→device chunk
         pipeline must write the same store (bit-identical digests) as the
         resident build."""
-        import krr_tpu.strategies.simple as sp
+        from .test_strategies import force_tiny_stream_threshold
 
         obj = make_obj("a", ["a-0"])
         batch = window_batch(rng, [obj], t=300)
@@ -177,7 +177,7 @@ class TestStreamedStatePath:
             TDigestStrategySettings(state_path=resident_path, chunk_size=128, host_stream_mb=-1)
         ).run_batch(batch)
 
-        monkeypatch.setattr(sp, "_stream_threshold_bytes", lambda mb: None if mb == -1 else 1)
+        force_tiny_stream_threshold(monkeypatch)
         streamed_path = str(tmp_path / "streamed.npz")
         TDigestStrategy(
             TDigestStrategySettings(state_path=streamed_path, chunk_size=128, host_stream_mb=0)
