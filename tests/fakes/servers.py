@@ -104,6 +104,7 @@ class FakeMetrics:
 
     series: dict[tuple[str, str, str], tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
     fail_queries: bool = False
+    fail_next: int = 0  # inject N transient 500s, then succeed (retry tests)
     request_count: int = 0
 
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
@@ -168,6 +169,9 @@ class FakeBackend:
         self.metrics.request_count += 1
         if self.metrics.fail_queries:
             return web.json_response({"status": "error", "error": "injected failure"}, status=500)
+        if self.metrics.fail_next > 0:
+            self.metrics.fail_next -= 1
+            return web.json_response({"status": "error", "error": "transient failure"}, status=500)
         query = request.query.get("query", "")
         match = _QUERY_RE.search(query)
         if not match:

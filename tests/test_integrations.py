@@ -165,3 +165,39 @@ class TestPrometheusLoader:
         assert "/proxy" in url and url.startswith(fake_env["server"].url)
         web_i = next(i for i, o in enumerate(objects) if (o.name, o.container) == ("web", "main"))
         assert histories[ResourceType.CPU][web_i]  # data flowed through the proxy
+
+
+class TestRetryBackoff:
+    def test_transient_500s_are_retried(self, fake_env):
+        """SURVEY.md §5 failure detection: the bulk fetch retries transient
+        server errors with backoff instead of degrading the scan."""
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+
+        fake_env["metrics"].fail_next = 2  # first two range queries 500, then heal
+        base_count = fake_env["metrics"].request_count
+
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                return await prom.gather_fleet(objects, 3600, 60)
+            finally:
+                await prom.close()
+
+        histories = asyncio.run(fetch())
+        assert fake_env["metrics"].fail_next == 0
+        # Whichever queries drew the two 500s must have been re-sent: every
+        # object with metrics ends up with data for BOTH resources, and the
+        # server saw exactly two extra (retried) requests.
+        series_keys = set(fake_env["metrics"].series)
+        with_metrics = [
+            i for i, o in enumerate(objects)
+            if any((o.namespace, o.container, pod) in series_keys for pod in o.pods)
+        ]
+        assert with_metrics
+        for i in with_metrics:
+            assert histories[ResourceType.CPU][i], objects[i]
+            assert histories[ResourceType.Memory][i], objects[i]
+        queries = 2 * len(objects)  # one per (object, resource)
+        assert fake_env["metrics"].request_count - base_count == queries + 2
