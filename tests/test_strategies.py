@@ -7,7 +7,7 @@ from krr_tpu.models import FleetBatch, K8sObjectData, ResourceAllocations, Resou
 from krr_tpu.strategies import BaseStrategy, SimpleStrategy, SimpleStrategySettings, TDigestStrategy, TDigestStrategySettings
 from krr_tpu.strategies.base import StrategySettings
 
-from .oracle import oracle_cpu_percentile, oracle_memory_max
+from .oracle import oracle_cpu_percentile, oracle_memory_max, oracle_round_cpu, oracle_round_memory
 from .test_ops import ragged_fleet
 
 
@@ -243,3 +243,62 @@ class TestPluginCompat:
         results = MyPluginStrategy(MyPluginSettings()).run_batch(batch)  # default per-object fallback
         assert len(results) == 2
         assert results[0][ResourceType.CPU].request == Decimal(42)
+
+
+class TestRandomizedOracleSweep:
+    """Fuzz the parity gate: random fleet shapes, percentiles, buffers, and
+    floors — the batched pipeline (device reductions + host Decimal rounding)
+    must match the Decimal oracle exactly, not just to ±1%."""
+
+    def test_sweep(self, rng):
+        from krr_tpu.core.rounding import round_value
+
+        for trial in range(12):
+            n = int(rng.integers(1, 9))
+            q = Decimal(int(rng.integers(1, 101)))
+            buffer_pct = Decimal(int(rng.integers(0, 40)) + 1)
+            cpu_min = int(rng.integers(0, 20))
+            mem_min = int(rng.integers(0, 50))
+            objects, cpu, mem = [], [], []
+            for i in range(n):
+                # float32 from the start: the device path reduces in float32,
+                # so the Decimal oracle must see the same representable values
+                # or ULP-boundary ceilings would spuriously diverge.
+                pods = {f"p{j}": rng.gamma(2.0, 0.05, size=int(rng.integers(0, 90))).astype(np.float32)
+                        for j in range(int(rng.integers(0, 4)))}
+                objects.append(
+                    K8sObjectData(cluster="c", namespace="ns", name=f"o{i}", kind="Deployment",
+                                  container="main", pods=list(pods),
+                                  allocations=ResourceAllocations(requests={}, limits={}))
+                )
+                cpu.append(pods)
+                mem.append({k: (v * np.float32(3e9) + np.float32(1e7)).astype(np.float32)
+                            for k, v in pods.items()})
+            batch = FleetBatch.build(objects, {ResourceType.CPU: cpu, ResourceType.Memory: mem})
+            results = SimpleStrategy(
+                SimpleStrategySettings(cpu_percentile=q, memory_buffer_percentage=buffer_pct)
+            ).run_batch(batch)
+
+            for i in range(n):
+                dec_cpu = to_decimal_history(cpu[i])
+                dec_mem = to_decimal_history(mem[i])
+                want_cpu = oracle_round_cpu(oracle_cpu_percentile(dec_cpu, q), cpu_min)
+                want_mem = oracle_round_memory(oracle_memory_max(dec_mem, buffer_pct), mem_min)
+                got_cpu = round_value(results[i][ResourceType.CPU].request, ResourceType.CPU,
+                                      cpu_min_value=cpu_min, memory_min_value=mem_min)
+                got_mem = round_value(results[i][ResourceType.Memory].request, ResourceType.Memory,
+                                      cpu_min_value=cpu_min, memory_min_value=mem_min)
+                ctx = (trial, i, q, buffer_pct)
+                if want_cpu.is_nan():
+                    assert got_cpu.is_nan(), ctx
+                else:
+                    # CPU is exact by construction: no scaling on the device
+                    # path, and the selected value is an actual f32 sample.
+                    assert got_cpu == want_cpu, (ctx, got_cpu, want_cpu)
+                if want_mem.is_nan():
+                    assert got_mem.is_nan(), ctx
+                else:
+                    # Memory passes through a bytes->MB f32 scaling on device
+                    # (MEMORY_SCALE), which can move a value within one f32 ULP
+                    # of an MB ceiling boundary: allow one granularity step.
+                    assert abs(got_mem - want_mem) <= Decimal(1_000_000), (ctx, got_mem, want_mem)
