@@ -14,7 +14,7 @@ from __future__ import annotations
 import asyncio
 import datetime
 import decimal
-from typing import Any, Optional
+from typing import Any
 
 import click
 

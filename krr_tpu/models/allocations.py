@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import enum
 from decimal import Decimal
-from typing import Any, Literal, Mapping, Optional, Union
+from typing import Any, Literal, Mapping, Union
 
 import pydantic as pd
 from pydantic import ConfigDict, field_validator

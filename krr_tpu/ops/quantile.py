@@ -13,8 +13,6 @@ quirk (`simple.py:32-36`; divergence noted in SURVEY.md §7). Empty rows
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

@@ -6,7 +6,7 @@ get/set with expiry)."""
 from __future__ import annotations
 
 import time
-from typing import Any, Hashable, Optional
+from typing import Any, Hashable
 
 
 class TTLCache:
