@@ -218,8 +218,9 @@ class PrometheusLoader:
             wanted = set(obj.pods)
             history: RaggedHistory = {}
             for pod, samples in series:
-                if pod in wanted and samples.size:
-                    # Pods without samples are dropped (reference `prometheus.py:154`).
+                # Keep only the first series per pod; drop pods without
+                # samples (reference `prometheus.py:152-154`).
+                if pod in wanted and samples.size and pod not in history:
                     history[pod] = samples
             histories[resource][i] = history
 
@@ -277,19 +278,22 @@ class PrometheusLoader:
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
             query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
             wanted = set(obj.pods)
+            seen: set[str] = set()  # first series per pod, like gather_fleet
             try:
                 if resource is ResourceType.CPU:
                     series = await self._query_range_digest(
                         query, start, end, step, gamma, min_value, num_buckets
                     )
                     for pod, counts, total, peak in series:
-                        if pod in wanted and total > 0:
+                        if pod in wanted and total > 0 and pod not in seen:
+                            seen.add(pod)
                             fleet.merge_cpu_row(i, counts, total, peak)
                 else:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
                     for pod, total, peak in await self._query_range_stats(query, start, end, step):
-                        if pod in wanted and total > 0:
+                        if pod in wanted and total > 0 and pod not in seen:
+                            seen.add(pod)
                             fleet.merge_mem_row(i, total, peak)
             except Exception as e:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")

@@ -105,6 +105,7 @@ class FakeMetrics:
     series: dict[tuple[str, str, str], tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
     fail_queries: bool = False
     fail_next: int = 0  # inject N transient 500s, then succeed (retry tests)
+    duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     request_count: int = 0
 
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
@@ -188,6 +189,9 @@ class FakeBackend:
                 if len(samples):
                     values = [[start + i * step, repr(float(v))] for i, v in enumerate(samples)]
                     result.append({"metric": {"pod": pod}, "values": values})
+                    if self.metrics.duplicate_pods:
+                        dupe = [[t, repr(float(v) + 1000.0)] for t, v in values]
+                        result.append({"metric": {"pod": pod}, "values": dupe})
         return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": result}})
 
     # ----------------------------------------------------------------- app

@@ -201,3 +201,57 @@ class TestRetryBackoff:
             assert histories[ResourceType.Memory][i], objects[i]
         queries = 2 * len(objects)  # one per (object, resource)
         assert fake_env["metrics"].request_count - base_count == queries + 2
+
+
+class TestFirstSeriesPerPod:
+    def test_duplicate_pod_series_keeps_first(self, fake_env):
+        """The reference keeps only the first series returned for a pod
+        (`prometheus.py:152`); a second series for the same pod is ignored."""
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+        fake_env["metrics"].duplicate_pods = True
+        try:
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet(objects, 3600, 60)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+        finally:
+            fake_env["metrics"].duplicate_pods = False
+        web_i = next(i for i, o in enumerate(objects) if (o.name, o.container) == ("web", "main"))
+        pod = fake_env["web_pods"][0]
+        got = histories[ResourceType.CPU][web_i][pod]
+        want = fake_env["metrics"].series[("default", "main", pod)][0]
+        # First series won: values match the original, not the +1000 dupe.
+        assert abs(float(got[0]) - float(want[0])) < 1e-9
+
+    def test_duplicate_pod_series_digest_ingest_no_double_count(self, fake_env):
+        """Digest-at-ingest honors the same first-series-per-pod rule — a
+        duplicate series must not double the object's sample totals."""
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                return await prom.gather_fleet_digests(
+                    objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128
+                )
+            finally:
+                await prom.close()
+
+        baseline = asyncio.run(fetch())
+        fake_env["metrics"].duplicate_pods = True
+        try:
+            duped = asyncio.run(fetch())
+        finally:
+            fake_env["metrics"].duplicate_pods = False
+        np.testing.assert_array_equal(baseline.cpu_total, duped.cpu_total)
+        np.testing.assert_array_equal(baseline.mem_total, duped.mem_total)
+        np.testing.assert_array_equal(baseline.cpu_peak, duped.cpu_peak)
