@@ -255,3 +255,91 @@ class TestFirstSeriesPerPod:
         np.testing.assert_array_equal(baseline.cpu_total, duped.cpu_total)
         np.testing.assert_array_equal(baseline.mem_total, duped.mem_total)
         np.testing.assert_array_equal(baseline.cpu_peak, duped.cpu_peak)
+
+
+class TestClusterSelection:
+    def test_star_selects_all_contexts(self, fake_env, tmp_path):
+        """clusters='*' scans every kubeconfig context (reference
+        `kubernetes.py:171-197`)."""
+        kubeconfig = tmp_path / "multi"
+        kubeconfig.write_text(yaml.dump({
+            "current-context": "a",
+            "contexts": [{"name": n, "context": {"cluster": n, "user": "u"}} for n in ("a", "b")],
+            "clusters": [{"name": n, "cluster": {"server": fake_env["server"].url}} for n in ("a", "b")],
+            "users": [{"name": "u", "user": {}}],
+        }))
+        config = Config(kubeconfig=str(kubeconfig), clusters="*",
+                        prometheus_url=fake_env["server"].url)
+        loader = KubernetesLoader(config)
+        assert asyncio.run(loader.list_clusters()) == ["a", "b"]
+
+    def test_default_selects_current_context(self, fake_env):
+        config = make_config(fake_env)
+        loader = KubernetesLoader(config)
+        assert asyncio.run(loader.list_clusters()) == ["fake"]
+
+
+class TestIngressFallback:
+    def test_discovery_falls_back_to_ingress(self, fake_env):
+        """No matching Service → the discovery tries Ingress hosts
+        (reference `service_discovery.py:42-56`)."""
+        from krr_tpu.integrations.service_discovery import ServiceDiscovery
+        from krr_tpu.integrations.kubernetes import KubeApi
+
+        fake_env["cluster"].ingresses.append({
+            "metadata": {"name": "prom-ingress", "namespace": "monitoring",
+                         "labels": {"app": "prometheus-server"}},
+            "spec": {"rules": [{"host": "prom.example.test"}]},
+        })
+        try:
+            from krr_tpu.integrations.kubeconfig import KubeConfig
+
+            creds = KubeConfig.load(fake_env["kubeconfig"]).credentials_for("fake")
+
+            async def run():
+                api = KubeApi(creds)
+                try:
+                    # ServiceDiscovery.cache is class-level and may hold a
+                    # service URL from earlier tests in this module; wipe it
+                    # so this lookup really hits the (service-less) fake.
+                    disco = ServiceDiscovery(api, inside_cluster=False)
+                    disco.cache.clear()
+                    return await disco.find_url(["app=does-not-exist", "app=prometheus-server"])
+                finally:
+                    await api.close()
+
+            # The service with app=prometheus-server exists from an earlier
+            # test in this module; remove services so ingress must serve.
+            saved = fake_env["cluster"].services[:]
+            fake_env["cluster"].services.clear()
+            try:
+                url = asyncio.run(run())
+            finally:
+                fake_env["cluster"].services.extend(saved)
+            assert url == "http://prom.example.test"
+        finally:
+            fake_env["cluster"].ingresses.clear()
+
+
+class TestInClusterCredentials:
+    def test_service_account_mount(self, tmp_path, monkeypatch):
+        from krr_tpu.integrations import kubeconfig as kc
+
+        sa = tmp_path / "sa"
+        sa.mkdir()
+        (sa / "token").write_text("sa-token\n")
+        (sa / "ca.crt").write_text("CERT")
+        monkeypatch.setattr(kc, "SERVICE_ACCOUNT_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+        creds = kc.in_cluster_credentials()
+        assert creds.server == "https://10.0.0.1:6443"
+        assert creds.resolve_token() == "sa-token"
+        assert creds.ca_pem == "CERT"
+
+    def test_not_in_cluster_raises(self, monkeypatch):
+        from krr_tpu.integrations import kubeconfig as kc
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(kc.KubeConfigError):
+            kc.in_cluster_credentials()
