@@ -127,7 +127,11 @@ def parse_matrix_python(body: bytes) -> list[tuple[str, np.ndarray]]:
     for entry in result:
         pod = entry.get("metric", {}).get("pod", "")
         values = entry.get("values") or []
-        series.append((pod, np.asarray([float(v) for _, v in values], dtype=np.float64)))
+        samples = np.asarray([float(v) for _, v in values], dtype=np.float64)
+        # Stale markers ("NaN") / division artifacts ("+Inf") carry no usage
+        # information and would poison max/percentile reductions — drop them
+        # (same rule as the native parser).
+        series.append((pod, samples[np.isfinite(samples)]))
     return series
 
 

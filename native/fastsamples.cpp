@@ -115,7 +115,12 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
             char* after = nullptr;
             double v = std::strtod(c.p, &after);
             if (after == c.p) break;  // malformed number
-            if (!sink.sample(num_series, v)) return -1;
+            // Prometheus stale markers / division artifacts arrive as "NaN"
+            // or "+Inf"; they carry no usage information and would poison
+            // downstream max/percentile reductions — drop them here.
+            if (std::isfinite(v)) {
+                if (!sink.sample(num_series, v)) return -1;
+            }
             c.p = after;
             // Skip to the end of this sample pair.
             while (c.p < c.end && *c.p != ']') c.p++;

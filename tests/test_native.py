@@ -186,3 +186,31 @@ class TestNativeStats:
         lib = native._load_library()
         assert lib is not None
         assert os.path.getmtime(so) >= os.path.getmtime(src)
+
+
+class TestNonFiniteSamples:
+    """Prometheus stale markers ("NaN") and division artifacts ("+Inf"/"-Inf")
+    must be dropped at parse — one stale marker would otherwise poison the
+    fleet max/percentile reductions into NaN (→ spurious "?" scans)."""
+
+    BODY = json.dumps({
+        "status": "success",
+        "data": {"resultType": "matrix", "result": [
+            {"metric": {"pod": "p0"},
+             "values": [[1, "0.5"], [2, "NaN"], [3, "+Inf"], [4, "-Inf"], [5, "1.5"]]},
+            {"metric": {"pod": "p1"}, "values": [[1, "NaN"]]},
+        ]},
+    }).encode()
+
+    def test_values_parsers_drop_nonfinite(self):
+        for parser in (native.parse_matrix_native, native.parse_matrix_python):
+            series = parser(self.BODY)
+            assert series is not None
+            by_pod = dict(series)
+            np.testing.assert_array_equal(by_pod["p0"], [0.5, 1.5])
+            assert by_pod["p1"].size == 0  # all-stale pod -> empty (dropped upstream)
+
+    def test_digest_and_stats_drop_nonfinite(self):
+        digests = native.parse_matrix_digest(self.BODY, 1.01, 1e-7, 64)
+        assert [(p, t, pk) for p, _c, t, pk in digests] == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
+        assert native.parse_matrix_stats(self.BODY) == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
