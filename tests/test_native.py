@@ -214,3 +214,46 @@ class TestNonFiniteSamples:
         digests = native.parse_matrix_digest(self.BODY, 1.01, 1e-7, 64)
         assert [(p, t, pk) for p, _c, t, pk in digests] == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
         assert native.parse_matrix_stats(self.BODY) == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
+
+
+class TestParserFuzz:
+    def test_mutated_bodies_never_crash(self, library_available, rng):
+        """The C scanner must reject or survive arbitrary corruption —
+        truncations, byte flips, deletions, duplications — without memory
+        errors (a segfault would kill the test process) and with every
+        failure surfacing as None/[] or a Python-level exception."""
+        if not library_available:
+            pytest.skip("native library unavailable — nothing to fuzz")
+        good = json.dumps({
+            "status": "success",
+            "data": {"resultType": "matrix", "result": [
+                {"metric": {"pod": f"p{i}"},
+                 "values": [[t, repr(float(v))] for t, v in enumerate(rng.uniform(0, 1, 30))]}
+                for i in range(8)
+            ]},
+        }).encode()
+        for trial in range(300):
+            body = bytearray(good)
+            r = np.random.default_rng(trial)
+            op = trial % 4
+            if op == 0:
+                body = body[: r.integers(0, len(body))]
+            elif op == 1:
+                for _ in range(int(r.integers(1, 8))):
+                    body[int(r.integers(0, len(body)))] = int(r.integers(32, 127))
+            elif op == 2:
+                a = int(r.integers(0, len(body)))
+                del body[a : min(len(body), a + int(r.integers(1, 200)))]
+            else:
+                a = int(r.integers(0, len(body)))
+                b = min(len(body), a + int(r.integers(1, 200)))
+                body = body[:a] + body[a:b] + body[a:]
+            for call in (
+                lambda bb: native.parse_matrix_native(bb),
+                lambda bb: native.parse_matrix_digest(bb, 1.01, 1e-7, 64),
+                lambda bb: native.parse_matrix_stats(bb),
+            ):
+                try:
+                    call(bytes(body))
+                except Exception:
+                    pass  # clean Python exceptions are acceptable outcomes
