@@ -1,0 +1,130 @@
+"""Multi-host validation: a real 2-process `jax.distributed` run.
+
+The in-process tests shard over one process's 8 virtual CPU devices; this
+spawns TWO OS processes (the unit the framework maps to TPU hosts —
+SURVEY.md §2.9 / §5 "distributed communication backend"), connects them with
+``initialize_distributed`` (the production multi-host bring-up in
+`krr_tpu/parallel/mesh.py`), builds a digest over a globally-sharded fleet
+array, and checks each host's rows against a single-process reference.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os
+    import sys
+
+    sys.path.insert(0, {repo!r})
+
+    # 2 local virtual CPU devices per process -> 4 global. Env must be set
+    # before ANY backend init, and jax.distributed.initialize before
+    # jax.devices() -- so set the flags directly rather than via
+    # force_virtual_cpu (which verifies by calling jax.devices()).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from krr_tpu.parallel.mesh import initialize_distributed
+
+    process_id = int(sys.argv[1])
+    initialize_distributed(
+        coordinator_address="127.0.0.1:{port}", num_processes=2, process_id=process_id
+    )
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from krr_tpu.ops import digest as digest_ops
+    from krr_tpu.ops.digest import DigestSpec
+
+    assert jax.process_count() == 2, jax.process_count()
+    devices = np.asarray(jax.devices()).reshape(4, 1)
+    mesh = Mesh(devices, ("data", "time"))
+
+    spec = DigestSpec(gamma=1.1, min_value=1e-3, num_buckets=128)
+    rng = np.random.default_rng(0)  # same global array on both hosts
+    values = rng.gamma(2.0, 0.05, size=(8, 256)).astype(np.float32)
+    counts = np.full(8, 256, dtype=np.int32)
+
+    rows = NamedSharding(mesh, PartitionSpec(("data", "time")))
+    local_rows = values[process_id * 4 : (process_id + 1) * 4]
+    garr = jax.make_array_from_process_local_data(rows, local_rows, values.shape)
+    gcounts = jax.make_array_from_process_local_data(
+        rows, counts[process_id * 4 : (process_id + 1) * 4], counts.shape
+    )
+
+    d = digest_ops.build_from_packed(spec, garr, gcounts, chunk_size=64)
+    p99 = digest_ops.percentile(spec, d, 99.0)
+    # addressable_shards order is not guaranteed: sort by global row index.
+    shards = sorted(p99.addressable_shards, key=lambda s: s.index[0].start or 0)
+    local = np.concatenate([np.asarray(s.data) for s in shards])
+
+    local_counts = counts[process_id * 4 : (process_id + 1) * 4]
+    ref = np.asarray(
+        digest_ops.percentile(
+            spec,
+            digest_ops.build_from_packed(
+                spec, jnp.asarray(local_rows), jnp.asarray(local_counts), chunk_size=64
+            ),
+            99.0,
+        )
+    )
+    np.testing.assert_allclose(local, ref, rtol=1e-6)
+    print("proc", process_id, "ok", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestTwoProcessDistributed:
+    def test_digest_build_across_processes(self, tmp_path):
+        port = _free_port()
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER.format(repo=REPO_ROOT, port=port))
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")  # workers set their own
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), str(i)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+            for i in range(2)
+        ]
+        outputs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outputs.append(out)
+        finally:
+            # A worker that died pre-rendezvous leaves its peer blocked in
+            # jax.distributed.initialize past our timeout — never leak it.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for i, (p, out) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+            assert f"proc {i} ok" in out

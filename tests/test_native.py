@@ -238,9 +238,9 @@ class TestParserFuzz:
             op = trial % 4
             if op == 0:
                 body = body[: r.integers(0, len(body))]
-            elif op == 1:
+            elif op == 1:  # arbitrary bytes, incl. NUL and 0x80-0xFF
                 for _ in range(int(r.integers(1, 8))):
-                    body[int(r.integers(0, len(body)))] = int(r.integers(32, 127))
+                    body[int(r.integers(0, len(body)))] = int(r.integers(0, 256))
             elif op == 2:
                 a = int(r.integers(0, len(body)))
                 del body[a : min(len(body), a + int(r.integers(1, 200)))]
