@@ -86,9 +86,3 @@ def masked_max_from_host(
     return np.where(np.asarray(counts) > 0, peak, np.nan)
 
 
-@jax.jit
-def masked_sum_count(values: jax.Array, counts: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Per-row (sum, count) over the valid prefix — building block for means
-    and for observability counters."""
-    mask = _row_mask(counts, values.shape[1])
-    return jnp.sum(jnp.where(mask, values, 0.0), axis=1), counts.astype(jnp.float32)
