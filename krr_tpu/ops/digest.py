@@ -115,9 +115,47 @@ def _histogram(spec: DigestSpec, idx: jax.Array, valid: jax.Array) -> jax.Array:
     return jnp.diff(cum[:, :b], axis=1, prepend=0).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def add_chunk(spec: DigestSpec, digest: Digest, values: jax.Array, valid: jax.Array) -> Digest:
-    """Fold one ``[N, Tc]`` time chunk (with validity mask) into the digest."""
+def _use_kernel(spec: DigestSpec, t: int, interpret: bool) -> bool:
+    from krr_tpu.ops import pallas_sketch
+
+    return pallas_sketch.digest_supported(spec.num_buckets, t) and (
+        interpret or jax.default_backend() == "tpu"
+    )
+
+
+@partial(jax.jit, static_argnames=("spec", "interpret", "use_kernel"))
+def add_chunk(
+    spec: DigestSpec,
+    digest: Digest,
+    values: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+    use_kernel: bool = True,
+) -> Digest:
+    """Fold one ``[N, Tc]`` time chunk (with validity mask) into the digest.
+
+    On TPU the histogram + chunk peak come from the Pallas matmul-histogram
+    kernel (`krr_tpu.ops.pallas_sketch.digest_hist`) — exact integer counts,
+    no sorts. The kernel consumes the mask as a per-row prefix length, which
+    every driver's mask is (`krr_tpu.ops.chunked`: valid positions are always
+    a leading run); the jnp sort-based histogram remains the generic path for
+    arbitrary masks and non-TPU backends. ``use_kernel=False`` forces the jnp
+    path — required when the operands are mesh-sharded under plain ``jit``
+    (a ``pallas_call`` has no partitioning rule there; inside ``shard_map``,
+    where operands are device-local, the kernel path is fine).
+    """
+    if use_kernel and values.shape[0] and _use_kernel(spec, values.shape[1], interpret):
+        from krr_tpu.ops import pallas_sketch
+
+        eff = jnp.sum(valid, axis=1, dtype=jnp.int32)
+        hist, chunk_peak = pallas_sketch.digest_hist(
+            values, eff, spec.num_buckets, spec.min_value, spec.log_gamma, interpret=interpret
+        )
+        return Digest(
+            counts=digest.counts + hist,
+            total=digest.total + eff.astype(jnp.float32),
+            peak=jnp.maximum(digest.peak, chunk_peak),
+        )
     idx = bucketize(spec, values)
     counts = digest.counts + _histogram(spec, idx, valid)
     total = digest.total + jnp.sum(valid, axis=1).astype(jnp.float32)
@@ -159,6 +197,7 @@ def build_from_packed(
     counts: jax.Array,
     chunk_size: int = 8192,
     time_offset: "int | jax.Array" = 0,
+    interpret: bool = False,
 ) -> Digest:
     """Build a digest from a packed ``[N, T]`` array by scanning time chunks.
 
@@ -167,6 +206,12 @@ def build_from_packed(
     code path serves true streaming, where chunks arrive from the fetch
     pipeline over time.
 
+    On TPU the build runs as ONE Pallas grid over the resident array
+    (`krr_tpu.ops.pallas_sketch.digest_hist` — the kernel tiles time
+    internally, so ``chunk_size`` is irrelevant there); elsewhere it scans
+    ``chunk_size`` chunks through `add_chunk`. Counts are exact integers on
+    every path, which is what keeps chunked == one-shot == kernel.
+
     ``time_offset`` is the global position of ``values[:, 0]`` when this array
     is one time-shard of a larger matrix (the sharded build in
     ``krr_tpu.parallel.fleet``): validity is decided against the row's global
@@ -174,7 +219,15 @@ def build_from_packed(
     """
     from krr_tpu.ops.chunked import scan_time_chunks
 
-    n = values.shape[0]
+    n, t = values.shape
+    if n and _use_kernel(spec, t, interpret):
+        from krr_tpu.ops import pallas_sketch
+
+        eff = jnp.clip(counts.astype(jnp.int32) - jnp.int32(time_offset), 0, t)
+        hist, peak = pallas_sketch.digest_hist(
+            values, eff, spec.num_buckets, spec.min_value, spec.log_gamma, interpret=interpret
+        )
+        return Digest(counts=hist, total=eff.astype(jnp.float32), peak=peak)
     return scan_time_chunks(
         values,
         counts,
@@ -197,14 +250,18 @@ def build_from_host(
     time chunks to the device (double-buffered) — bit-identical to
     :func:`build_from_packed`, but device memory holds only the digest state
     plus ~2 chunks, so windows larger than HBM digest fine
-    (`krr_tpu.ops.chunked.stream_host_chunks`)."""
+    (`krr_tpu.ops.chunked.stream_host_chunks`). With ``sharding`` the fold
+    runs on mesh-sharded operands under plain ``jit``, where a Pallas call
+    can't be partitioned — the fold pins the jnp path there."""
     from krr_tpu.ops.chunked import stream_host_chunks
 
     return stream_host_chunks(
         values,
         counts,
         empty(spec, values.shape[0]),
-        lambda digest, chunk, valid: add_chunk(spec, digest, chunk, valid),
+        lambda digest, chunk, valid: add_chunk(
+            spec, digest, chunk, valid, use_kernel=sharding is None
+        ),
         chunk_size,
         time_offset,
         sharding=sharding,

@@ -6,20 +6,28 @@ rank-from-the-top of that percentile is a small, *a-priori bounded* number
 ``K`` — e.g. 1,211 for p99 over 7 d @ 5 s — so keeping each row's top-K
 samples is a fixed-size, **exact** sketch:
 
-* streaming: fold a time chunk with ``top_k(concat(state, chunk))``,
-* mergeable: ``merge(a, b) = top_k(concat)`` is associative and commutative
-  (the top-K of a union is contained in the union of top-Ks),
-* query: the percentile at rank ``r`` from the top is ``state[:, r]``.
+* streaming: fold a time chunk into the kept top-K multiset,
+* mergeable: the top-K of a union is contained in the union of top-Ks, so
+  merging is associative and commutative,
+* query: the percentile at rank ``r`` from the top is the r-th largest kept
+  value.
+
+**State contract** (round 2): ``values[i]`` holds the top-``min(K, total_i)``
+multiset in its *first* ``min(K, total_i)`` slots — in **unspecified order**
+— and ``-inf`` in the rest. Unordered slots are what let the TPU build drop
+every sort: the Pallas kernel (`krr_tpu.ops.pallas_sketch.topk_select`) pins
+the K-th-largest value by bit-space bisection and compacts survivors with
+rank matmuls, and :func:`percentile` queries by masked bisection
+(`krr_tpu.ops.selection`) instead of indexing a sorted row. The jnp fallback
+(``lax.top_k``) happens to fill slots descending, which satisfies the same
+contract. Values must be non-negative (CPU seconds / byte counts; the device
+paths clamp, and the bit-space query relies on it).
 
 Compared to the log-bucket digest (`krr_tpu.ops.digest`) this has **zero
-error** and roughly half the cost (one single-key sort per chunk instead of
-two), but only answers quantiles whose top-rank fits in ``K`` — the tdigest
-strategy auto-selects it when the configured percentile qualifies and falls
-back to the histogram digest otherwise.
-
-TPU notes: ``lax.top_k`` lowers to a fast single-operand sort + slice; the
-state rides along the scan carry, so HBM traffic per chunk is ``C + K``
-values. ``K`` is rounded up to the 128-lane boundary.
+error**, but only answers quantiles whose top-rank fits in ``K`` — the
+tdigest strategy auto-selects it when the configured percentile qualifies
+and falls back to the histogram digest otherwise. ``K`` is rounded up to the
+128-lane boundary.
 """
 
 from __future__ import annotations
@@ -35,7 +43,8 @@ import jax.numpy as jnp
 class TopKSketch(NamedTuple):
     """Per-row exact top-K state — a pytree, shardable and tree-mergeable."""
 
-    values: jax.Array  # [N, K] float32, descending; -inf beyond the real samples
+    values: jax.Array  # [N, K] float32; top-min(K, total) multiset in the
+    #                    first slots (order unspecified), -inf beyond
     total: jax.Array  # [N] float32 total (valid) sample count
 
 
@@ -58,16 +67,63 @@ def empty(num_rows: int, k: int) -> TopKSketch:
     )
 
 
-def add_chunk(sketch: TopKSketch, values: jax.Array, valid: jax.Array) -> TopKSketch:
-    """Fold one ``[N, Tc]`` time chunk (with validity mask) into the sketch."""
+def _use_kernel(k: int, t: int, state_k: int, interpret: bool) -> bool:
+    from krr_tpu.ops import pallas_sketch
+
+    return pallas_sketch.topk_supported(k, t, state_k) and (
+        interpret or jax.default_backend() == "tpu"
+    )
+
+
+def _valid_slots(sketch: TopKSketch) -> jax.Array:
+    """Per-row count of populated slots: min(K, total), int32."""
     k = sketch.values.shape[1]
+    return jnp.minimum(sketch.total, float(k)).astype(jnp.int32)
+
+
+def add_chunk(
+    sketch: TopKSketch,
+    values: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+    use_kernel: bool = True,
+) -> TopKSketch:
+    """Fold one ``[N, Tc]`` time chunk (with validity mask) into the sketch.
+
+    On TPU the fold is the sort-free Pallas kernel (state and chunk are two
+    premasked parts of one bisect+compact pass); it consumes the mask as a
+    per-row prefix length, which every driver's mask is
+    (`krr_tpu.ops.chunked`). The jnp path is one ``top_k(concat)``.
+    ``use_kernel=False`` forces the jnp path — required when operands are
+    mesh-sharded under plain ``jit`` (no partitioning rule for a
+    ``pallas_call`` there; inside ``shard_map`` the kernel path is fine).
+    """
+    n, k = sketch.values.shape
+    if use_kernel and n and _use_kernel(k, values.shape[1], k, interpret):
+        from krr_tpu.ops import pallas_sketch
+
+        eff = jnp.sum(valid, axis=1, dtype=jnp.int32)
+        new_values = pallas_sketch.topk_select(
+            values,
+            eff,
+            k,
+            state=sketch.values,
+            state_counts=_valid_slots(sketch),
+            interpret=interpret,
+        )
+        return TopKSketch(values=new_values, total=sketch.total + eff.astype(jnp.float32))
     masked = jnp.where(valid, values, -jnp.inf)
     top, _ = jax.lax.top_k(jnp.concatenate([sketch.values, masked], axis=1), k)
     return TopKSketch(values=top, total=sketch.total + jnp.sum(valid, axis=1).astype(jnp.float32))
 
 
 def merge(a: TopKSketch, b: TopKSketch) -> TopKSketch:
-    """Associative, commutative merge — also the cross-device collective body."""
+    """Associative, commutative merge — also the cross-device collective body.
+
+    ``top_k`` of the concatenated slot arrays: the top-K of a multiset union
+    never depends on slot order, so merging kernel-built (unordered) and
+    jnp-built (descending) states is exact either way.
+    """
     k = a.values.shape[1]
     top, _ = jax.lax.top_k(jnp.concatenate([a.values, b.values], axis=1), k)
     return TopKSketch(values=top, total=a.total + b.total)
@@ -79,32 +135,62 @@ def percentile(sketch: TopKSketch, q: jax.Array | float) -> jax.Array:
     the rank-from-top fits in K (guaranteed by ``required_k``); NaN for empty
     rows — and NaN, not a silently-wrong clipped value, for rows whose rank
     falls outside the sketch (a caller-chosen K that is too small for this
-    q/total combination)."""
+    q/total combination).
+
+    Slot order is unspecified (see module docstring), so the query runs the
+    shared bit-space bisection over the populated prefix rather than indexing
+    a sorted row: ~31 counting passes over [N, K] — microseconds at fleet
+    scale, and exactly the same sample either way.
+    """
+    from krr_tpu.ops.selection import bisect_loop
+
     k = sketch.values.shape[1]
+    kv = _valid_slots(sketch)
     rank_bottom = jnp.floor(jnp.maximum(sketch.total - 1.0, 0.0) * jnp.float32(q) / 100.0)
     rank_top = jnp.maximum(sketch.total - 1.0, 0.0) - rank_bottom
-    idx = jnp.clip(rank_top.astype(jnp.int32), 0, k - 1)
-    out = jnp.take_along_axis(sketch.values, idx[:, None], axis=1)[:, 0]
+    # Ascending rank of the wanted sample inside the populated prefix.
+    rank_in_state = jnp.clip(kv - 1 - rank_top.astype(jnp.int32), 0, jnp.maximum(kv - 1, 0))
+    mask = jnp.arange(k, dtype=jnp.int32)[None, :] < kv[:, None]
+    bits = jax.lax.bitcast_convert_type(jnp.maximum(sketch.values, 0.0), jnp.int32)
+    out = bisect_loop(bits, mask, rank_in_state)
     answerable = (sketch.total > 0) & (rank_top < k)
     return jnp.where(answerable, out, jnp.nan)
 
 
-@partial(jax.jit, static_argnames=("k", "chunk_size"))
+@jax.jit
+def peak(sketch: TopKSketch) -> jax.Array:
+    """Exact per-row max — the top-1 sample is always in the sketch, so the
+    max costs one reduce over [N, K] instead of a full-matrix pass; NaN for
+    empty rows."""
+    return jnp.where(sketch.total > 0, jnp.max(sketch.values, axis=1), jnp.nan)
+
+
+@partial(jax.jit, static_argnames=("k", "chunk_size", "interpret"))
 def build_from_packed(
     values: jax.Array,
     counts: jax.Array,
     k: int,
     chunk_size: int = 8192,
     time_offset: "int | jax.Array" = 0,
+    interpret: bool = False,
 ) -> TopKSketch:
-    """Build the sketch from a packed ``[N, T]`` array by scanning time chunks.
+    """Build the sketch from a packed ``[N, T]`` array.
 
-    Shares the chunking/validity driver (`krr_tpu.ops.chunked`) with the
-    digest build; chunked == one-shot because the merge is exact.
+    On TPU (when the row-tile working set fits VMEM) this is ONE Pallas
+    dispatch over the resident array — no scan, no sorts; otherwise it scans
+    time chunks through `add_chunk`, sharing the chunking/validity driver
+    (`krr_tpu.ops.chunked`) with the digest build. Same multiset either way
+    (the merge is exact), which is what the chunked == one-shot tests pin.
     """
     from krr_tpu.ops.chunked import scan_time_chunks
 
-    n = values.shape[0]
+    n, t = values.shape
+    if n and _use_kernel(k, t, 0, interpret):
+        from krr_tpu.ops import pallas_sketch
+
+        eff = jnp.clip(counts.astype(jnp.int32) - jnp.int32(time_offset), 0, t)
+        state = pallas_sketch.topk_select(values, eff, k, interpret=interpret)
+        return TopKSketch(values=state, total=eff.astype(jnp.float32))
     return scan_time_chunks(values, counts, empty(n, k), add_chunk, chunk_size, time_offset)
 
 
@@ -117,10 +203,20 @@ def build_from_host(
     sharding=None,
 ) -> TopKSketch:
     """Build the sketch from a **host-resident** ``[N, T]`` array, streaming
-    time chunks to the device — bit-identical to :func:`build_from_packed`
-    with device memory bounded by the ``[N, K]`` state plus ~2 chunks."""
+    time chunks to the device — the same multiset as :func:`build_from_packed`
+    with device memory bounded by the ``[N, K]`` state plus ~2 chunks. With
+    ``sharding`` the fold runs on mesh-sharded operands under plain ``jit``,
+    where a Pallas call can't be partitioned — the fold pins the jnp path."""
     from krr_tpu.ops.chunked import stream_host_chunks
 
     return stream_host_chunks(
-        values, counts, empty(values.shape[0], k), add_chunk, chunk_size, time_offset, sharding=sharding
+        values,
+        counts,
+        empty(values.shape[0], k),
+        lambda sketch, chunk, valid: add_chunk(
+            sketch, chunk, valid, use_kernel=sharding is None
+        ),
+        chunk_size,
+        time_offset,
+        sharding=sharding,
     )

@@ -426,3 +426,123 @@ class TestHostStreaming:
             values, counts, 50.0, chunk_size=256, sharding=sharding
         )
         np.testing.assert_array_equal(resident, streamed)
+
+
+class TestPallasSketchKernels:
+    """Interpret-mode parity for the chunk-fold sketch kernels
+    (`krr_tpu.ops.pallas_sketch`): the same multisets/counts as the jnp
+    paths, including ragged counts, empty rows, ties, and fold chaining.
+    On real TPU the identical code paths run compiled (bench.py gates on-chip
+    parity every run)."""
+
+    def _fleet(self, rng, n=37, t=700):
+        values = rng.gamma(2.0, 0.05, size=(n, t)).astype(np.float32)
+        counts = rng.integers(0, t + 1, size=n).astype(np.int32)
+        counts[0] = 0
+        counts[1] = t
+        return values, counts
+
+    def test_digest_hist_matches_sort_histogram(self, rng):
+        import jax.numpy as jnp
+
+        from krr_tpu.ops import pallas_sketch as ps
+
+        spec = DigestSpec()
+        values, counts = self._fleet(rng)
+        mask = np.arange(values.shape[1])[None, :] < counts[:, None]
+        want = np.asarray(
+            digest_ops._histogram(spec, digest_ops.bucketize(spec, jnp.asarray(values)), jnp.asarray(mask))
+        )
+        hist, peak = ps.digest_hist(
+            jnp.asarray(values), jnp.asarray(counts), spec.num_buckets, spec.min_value,
+            spec.log_gamma, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(hist), want)
+        want_peak = np.where(counts > 0, np.max(np.where(mask, values, -np.inf), axis=1), -np.inf)
+        np.testing.assert_array_equal(np.asarray(peak), want_peak)
+
+    def test_digest_build_kernel_equals_scan(self, rng):
+        spec = DigestSpec()
+        values, counts = self._fleet(rng)
+        scan = digest_ops.build_from_packed(spec, values, counts, chunk_size=256)
+        kernel = digest_ops.build_from_packed(spec, values, counts, interpret=True)
+        np.testing.assert_array_equal(np.asarray(scan.counts), np.asarray(kernel.counts))
+        np.testing.assert_array_equal(np.asarray(scan.total), np.asarray(kernel.total))
+        np.testing.assert_array_equal(np.asarray(scan.peak), np.asarray(kernel.peak))
+
+    def test_digest_fold_kernel_accumulates(self, rng):
+        import jax.numpy as jnp
+
+        spec = DigestSpec()
+        values, counts = self._fleet(rng, n=16, t=384)
+        mask = jnp.asarray(np.arange(384)[None, :] < counts[:, None])
+        base = digest_ops.build_from_packed(spec, values, counts, chunk_size=128)
+        folded = digest_ops.add_chunk(
+            spec, base, jnp.asarray(values), mask, interpret=True
+        )
+        want = digest_ops.add_chunk(spec, base, jnp.asarray(values), mask)
+        np.testing.assert_array_equal(np.asarray(folded.counts), np.asarray(want.counts))
+        np.testing.assert_array_equal(np.asarray(folded.peak), np.asarray(want.peak))
+        np.testing.assert_array_equal(np.asarray(folded.total), np.asarray(want.total))
+
+    def _topk_reference(self, values, counts, k):
+        masked = np.where(np.arange(values.shape[1])[None, :] < counts[:, None], values, -np.inf)
+        return -np.sort(-masked, axis=1)[:, :k]
+
+    def test_topk_build_multiset_and_percentile(self, rng):
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        values, counts = self._fleet(rng)
+        # Inject ties so the τ-fill path is exercised.
+        values[2, :50] = values[2, 60]
+        k = 256
+        sketch = topk_ops.build_from_packed(values, counts, k=k, interpret=True)
+        want = self._topk_reference(values, counts, k)
+        got = np.asarray(sketch.values)
+        for r in range(values.shape[0]):
+            kv = min(k, counts[r])
+            got_sorted = np.sort(got[r])[::-1]
+            np.testing.assert_array_equal(got_sorted[:kv], want[r, :kv], err_msg=f"row {r}")
+            assert np.all(np.isneginf(got_sorted[kv:]))
+        for q in [99.0, 99.9]:
+            np.testing.assert_array_equal(
+                np.asarray(topk_ops.percentile(sketch, q)),
+                np.asarray(topk_ops.percentile(
+                    topk_ops.build_from_packed(values, counts, k=k, chunk_size=128), q
+                )),
+            )
+
+    def test_topk_fold_kernel_equals_jnp_fold(self, rng):
+        import jax.numpy as jnp
+
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        values, counts = self._fleet(rng, n=16, t=512)
+        base = topk_ops.build_from_packed(values, counts, k=128, chunk_size=256)
+        chunk = rng.gamma(2.0, 0.05, size=(16, 384)).astype(np.float32)
+        chunk_counts = rng.integers(0, 385, size=16).astype(np.int32)
+        mask = jnp.asarray(np.arange(384)[None, :] < chunk_counts[:, None])
+        ker = topk_ops.add_chunk(base, jnp.asarray(chunk), mask, interpret=True)
+        ref = topk_ops.add_chunk(base, jnp.asarray(chunk), mask)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(ker.values), axis=1), np.sort(np.asarray(ref.values), axis=1)
+        )
+        np.testing.assert_array_equal(np.asarray(ker.total), np.asarray(ref.total))
+
+    def test_percentile_order_independent(self, rng):
+        from krr_tpu.ops import topk_sketch as topk_ops
+        from krr_tpu.ops.topk_sketch import TopKSketch
+
+        values, counts = self._fleet(rng, n=8, t=300)
+        sketch = topk_ops.build_from_packed(values, counts, k=128, chunk_size=128)
+        vals = np.asarray(sketch.values)
+        shuffled = vals.copy()
+        for r in range(vals.shape[0]):  # permute populated slots only
+            kv = int(min(128, counts[r]))
+            shuffled[r, :kv] = rng.permutation(shuffled[r, :kv])
+        shuffled_sketch = TopKSketch(values=shuffled, total=sketch.total)
+        for q in [97.0, 99.0, 100.0]:
+            np.testing.assert_array_equal(
+                np.asarray(topk_ops.percentile(sketch, q)),
+                np.asarray(topk_ops.percentile(shuffled_sketch, q)),
+            )
