@@ -1,8 +1,16 @@
 # Example: creating your own strategy plugin.
 #
-# Defining the subclass registers it; running this file adds a `custom`
-# sub-command to the CLI: `python ./custom_strategy.py custom`
-# (same plugin contract as the reference's examples/custom_strategy.py).
+# Defining the subclass registers it; running this file adds a
+# `spikeguard` sub-command to the CLI:
+#
+#     python ./custom_strategy.py spikeguard --cpu-percentile 95 --spike-guard 60
+#
+# The scenario: a latency-sensitive service whose p95 usage is low but which
+# takes short request bursts. A plain p95 request starves the bursts, a
+# plain-max request wastes quota — so this strategy recommends the p95
+# *floored at a fraction of the observed peak* ("never give the container
+# less than 60% of what its worst burst actually used"), and sizes memory at
+# the peak plus a fixed per-pod slack for connection buffers.
 
 import os
 import sys
@@ -18,16 +26,46 @@ from krr_tpu.api.strategies import BaseStrategy, StrategySettings
 
 
 # Field descriptions become CLI `--flag` help text.
-class CustomStrategySettings(StrategySettings):
-    param_1: Decimal = pd.Field(99, gt=0, description="First example parameter")
-    param_2: Decimal = pd.Field(105_000, gt=0, description="Second example parameter")
+class SpikeGuardStrategySettings(StrategySettings):
+    cpu_percentile: Decimal = pd.Field(
+        95, gt=0, le=100, description="Steady-state CPU percentile before the spike floor."
+    )
+    spike_guard: Decimal = pd.Field(
+        60, ge=0, le=100, description="CPU request is never below this percent of the observed peak."
+    )
+    memory_slack_mb: Decimal = pd.Field(
+        64, ge=0, description="Flat memory slack added on top of the observed peak, in MB."
+    )
 
 
-class CustomStrategy(BaseStrategy[CustomStrategySettings]):
+def _flat_sorted(samples_by_pod: "dict[str, list[Decimal]]") -> "list[Decimal]":
+    return sorted(s for pod_samples in samples_by_pod.values() for s in pod_samples)
+
+
+class SpikeGuardStrategy(BaseStrategy[SpikeGuardStrategySettings]):
+    """p-th percentile CPU with a peak-fraction floor; peak-plus-slack memory."""
+
+    __display_name__ = "spikeguard"
+
     def run(self, history_data: HistoryData, object_data: K8sObjectData) -> RunResult:
+        cpu = _flat_sorted(history_data.get(ResourceType.CPU, {}))
+        mem = _flat_sorted(history_data.get(ResourceType.Memory, {}))
+
+        if cpu:
+            steady = cpu[int((len(cpu) - 1) * self.settings.cpu_percentile / 100)]
+            floor = cpu[-1] * self.settings.spike_guard / 100
+            cpu_request = max(steady, floor)
+        else:
+            cpu_request = Decimal("nan")
+
+        if mem:
+            mem_request = mem[-1] + self.settings.memory_slack_mb * 1_000_000
+        else:
+            mem_request = Decimal("nan")
+
         return {
-            ResourceType.CPU: ResourceRecommendation(request=self.settings.param_1, limit=None),
-            ResourceType.Memory: ResourceRecommendation(request=self.settings.param_2, limit=self.settings.param_2),
+            ResourceType.CPU: ResourceRecommendation(request=cpu_request, limit=None),
+            ResourceType.Memory: ResourceRecommendation(request=mem_request, limit=mem_request),
         }
 
 

@@ -93,7 +93,7 @@ class HostChunkStreamer:
         chunk_size: int,
         time_offset: int = 0,
         scale: float = 1.0,
-        sharding: Optional[jax.sharding.Sharding] = None,
+        sharding: Optional[jax.sharding.NamedSharding] = None,
     ):
         self.values = values
         self.chunk_size = chunk_size
@@ -105,6 +105,10 @@ class HostChunkStreamer:
         if sharding is None:
             self.rows_sharding = None
             self.pad_rows = 0
+        elif not isinstance(sharding, jax.sharding.NamedSharding):
+            # Only NamedSharding exposes the .mesh/.spec this class derives
+            # its row placement from; fail here, not deep in __init__.
+            raise TypeError(f"sharding must be a NamedSharding, got {type(sharding).__name__}")
         else:  # rows use the chunk sharding's first (row) axis, replicated over time
             self.rows_sharding = jax.sharding.NamedSharding(
                 sharding.mesh, jax.sharding.PartitionSpec(*sharding.spec[:1])
@@ -186,7 +190,7 @@ def stream_host_chunks(
     chunk_size: int,
     time_offset: int = 0,
     scale: float = 1.0,
-    sharding: Optional[jax.sharding.Sharding] = None,
+    sharding: Optional[jax.sharding.NamedSharding] = None,
 ) -> State:
     """One-shot convenience wrapper over :class:`HostChunkStreamer`."""
     return HostChunkStreamer(

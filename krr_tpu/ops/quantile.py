@@ -84,5 +84,3 @@ def masked_max_from_host(
     )
     peak = np.asarray(peak)
     return np.where(np.asarray(counts) > 0, peak, np.nan)
-
-

@@ -106,6 +106,18 @@ def _chunk_sharding(mesh):
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec((DATA_AXIS, TIME_AXIS)))
 
 
+def exact_topk_k(capacity: int, q: float, budget: int) -> Optional[int]:
+    """K for the exact top-K sketch, or None when it exceeds ``budget`` and
+    the caller must fall back (streamed bisection for simple, histogram
+    digest for tdigest). THE single cut-over decision site — shared by both
+    strategies and every build flavor (resident, mesh, host-streamed), so the
+    paths can never disagree about which sketch serves a percentile."""
+    from krr_tpu.ops import topk_sketch as topk_ops
+
+    k = topk_ops.required_k(capacity, q)
+    return k if 0 < k <= budget else None
+
+
 def use_host_stream(batch: FleetBatch, mesh, setting_mb: int) -> bool:
     """Whether the packed window should stream from host rather than live on
     device — shared by the simple and tdigest strategies."""
@@ -201,8 +213,8 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
         sharding = None if mesh is None else _chunk_sharding(mesh)
         cpu = batch.packed(ResourceType.CPU)
         mem = batch.packed(ResourceType.Memory)
-        k = topk_ops.required_k(cpu.capacity, q)
-        if 0 < k <= self.settings.exact_sketch_budget:
+        k = exact_topk_k(cpu.capacity, q, self.settings.exact_sketch_budget)
+        if k is not None:
             sketch = topk_ops.build_from_host(
                 cpu.values, cpu.counts, k=k, chunk_size=HOST_STREAM_CHUNK, sharding=sharding
             )

@@ -39,6 +39,7 @@ from krr_tpu.strategies.simple import (
     MEMORY_SCALE,
     SimpleStrategySettings,
     _chunk_sharding,
+    exact_topk_k,
     finalize_fleet,
     fleet_device_arrays,
     resolve_mesh,
@@ -83,10 +84,9 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
 
     def _exact_topk_k(self, capacity: int, q: float) -> Optional[int]:
         """K for the exact top-K sketch, or None when the histogram digest
-        must serve — the single decision site shared by the resident, mesh,
-        and host-streamed builds (they must always pick the same sketch)."""
-        k = topk_ops.required_k(capacity, q)
-        return k if 0 < k <= self.settings.exact_sketch_budget else None
+        must serve — delegates to the shared cut-over decision site
+        (`krr_tpu.strategies.simple.exact_topk_k`)."""
+        return exact_topk_k(capacity, q, self.settings.exact_sketch_budget)
 
     def _use_host_stream(self, batch: FleetBatch, mesh) -> bool:
         return use_host_stream(batch, mesh, self.settings.host_stream_mb)
