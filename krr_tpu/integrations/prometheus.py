@@ -151,6 +151,13 @@ class PrometheusLoader:
         """Range query with retry + exponential backoff; returns the raw
         response body (callers pick their parser).
 
+        Sent as a form-encoded POST (Prometheus accepts POST for
+        ``query_range``): our per-workload queries carry a pod-name regex
+        that grows with the pod count, and a workload with hundreds of pods
+        produces a multi-KB query — GET would overflow the ~8 KB URL caps of
+        Prometheus and most proxies at exactly the fleet scale this tool
+        targets.
+
         Only transient failures (transport errors, 5xx) are retried; a 4xx
         (bad query) fails immediately — retrying those only adds fleet-sized
         futile sleeps.
@@ -160,9 +167,9 @@ class PrometheusLoader:
         for attempt in range(self.retries):
             try:
                 async with self._semaphore:
-                    response = await client.get(
+                    response = await client.post(
                         "/api/v1/query_range",
-                        params={"query": query, "start": start, "end": end, "step": step},
+                        data={"query": query, "start": start, "end": end, "step": step},
                     )
             except (httpx.TransportError, OSError) as e:
                 last_error = e

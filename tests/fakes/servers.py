@@ -166,21 +166,30 @@ class FakeBackend:
     async def query(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "success", "data": {"resultType": "vector", "result": []}})
 
+    #: Real Prometheus (and most reverse proxies) cap the request line around
+    #: 8 KB; enforcing it here pins that the loader POSTs range queries (a
+    #: multi-hundred-pod workload's pod regex overflows any GET URL).
+    MAX_URL_BYTES = 8192
+
     async def query_range(self, request: web.Request) -> web.Response:
         self.metrics.request_count += 1
+        if len(str(request.rel_url)) > self.MAX_URL_BYTES:
+            return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
         if self.metrics.fail_queries:
             return web.json_response({"status": "error", "error": "injected failure"}, status=500)
         if self.metrics.fail_next > 0:
             self.metrics.fail_next -= 1
             return web.json_response({"status": "error", "error": "transient failure"}, status=500)
-        query = request.query.get("query", "")
+        form = await request.post()  # form-encoded POST, like real Prometheus
+        params = {**request.query, **form}
+        query = params.get("query", "")
         match = _QUERY_RE.search(query)
         if not match:
             return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": []}})
         namespace, container = match["namespace"], match["container"]
         pod_pattern = re.compile(f"^(?:{match['pods']})$")
         is_cpu = "cpu_usage" in query
-        start = float(request.query.get("start", 0))
+        start = float(params.get("start", 0))
         step = 60.0
         result = []
         for (ns, cont, pod), (cpu, memory) in self.metrics.series.items():
@@ -209,13 +218,16 @@ class FakeBackend:
         app.router.add_get("/api/v1/namespaces/{namespace}/pods", self.list_pods)
         app.router.add_get("/api/v1/services", self.list_services)
         app.router.add_get("/apis/networking.k8s.io/v1/ingresses", self.list_ingresses)
-        # Plain Prometheus endpoints…
+        # Plain Prometheus endpoints (query_range also via POST, which is
+        # what the loader uses — see PrometheusLoader._fetch_range_body)…
         app.router.add_get("/api/v1/query", self.query)
         app.router.add_get("/api/v1/query_range", self.query_range)
+        app.router.add_post("/api/v1/query_range", self.query_range)
         # …and the same API under the apiserver service-proxy prefix.
         proxy = "/api/v1/namespaces/{ns}/services/{svc}/proxy"
         app.router.add_get(proxy + "/api/v1/query", self.query)
         app.router.add_get(proxy + "/api/v1/query_range", self.query_range)
+        app.router.add_post(proxy + "/api/v1/query_range", self.query_range)
         return app
 
 

@@ -343,3 +343,49 @@ class TestInClusterCredentials:
         monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
         with pytest.raises(kc.KubeConfigError):
             kc.in_cluster_credentials()
+
+
+class TestWidePodFanout:
+    """A workload with hundreds of pods produces a multi-KB pod regex; the
+    fake server rejects over-long GET URLs (like real Prometheus / proxies),
+    so this passes only because the loader POSTs range queries."""
+
+    def test_wide_pod_workload_scan(self, tmp_path_factory):
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        pods = cluster.add_workload_with_pods("Deployment", "wide", "default", pod_count=1200)
+        rng = np.random.default_rng(7)
+        for pod in pods[:10]:  # series for a subset is enough to assert data flows
+            metrics.set_series("default", "main", pod,
+                               cpu=rng.gamma(2.0, 0.05, 24), memory=rng.uniform(5e7, 2e8, 24))
+        server = ServerThread(FakeBackend(cluster, metrics)).start()
+        try:
+            kubeconfig_path = tmp_path_factory.mktemp("kube-wide") / "config"
+            kubeconfig_path.write_text(yaml.dump({
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+                "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+                "users": [{"name": "fake", "user": {"token": "test-token"}}],
+            }))
+            config = Config(kubeconfig=str(kubeconfig_path), prometheus_url=server.url)
+            loader = KubernetesLoader(config)
+            objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+            wide = [o for o in objects if o.name == "wide"]
+            assert wide and len(wide[0].pods) == 1200
+            # The regex alone is far past any URL cap.
+            import re as _re
+            regex_len = len("|".join(_re.escape(p) for p in wide[0].pods))
+            assert regex_len > FakeBackend.MAX_URL_BYTES
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet(wide, history_seconds=3600, step_seconds=60)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+            got_pods = set(histories[ResourceType.CPU][0])
+            assert got_pods == set(pods[:10])
+        finally:
+            server.stop()
