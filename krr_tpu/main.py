@@ -21,6 +21,52 @@ import click
 from krr_tpu.utils.version import get_version
 
 
+#: Settings fields that tune the device backend rather than the strategy's
+#: recommendation math — rendered in their own help panel.
+TPU_BACKEND_FIELDS = {
+    "use_mesh",
+    "mesh_time_axis",
+    "use_pallas",
+    "profile_dir",
+    "host_stream_mb",
+    "exact_sketch_budget",
+}
+
+#: Help-panel render order (any unlisted panel prints after these).
+PANEL_ORDER = (
+    "General Settings",
+    "Logging Settings",
+    "Strategy Settings",
+    "TPU Backend Settings",
+)
+
+
+class PanelOption(click.Option):
+    """A click option carrying the help panel it renders under."""
+
+    def __init__(self, *args: Any, panel: str = "General Settings", **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.panel = panel
+
+
+class PanelCommand(click.Command):
+    """Groups ``--help`` output into titled option panels, mirroring the
+    reference CLI's ``rich_help_panel`` sections
+    (`/root/reference/robusta_krr/main.py:79-82`)."""
+
+    def format_options(self, ctx: click.Context, formatter: click.HelpFormatter) -> None:
+        panels: dict[str, list[tuple[str, str]]] = {}
+        for param in self.get_params(ctx):
+            record = param.get_help_record(ctx)
+            if record is not None:  # click's auto --help lands in General
+                panels.setdefault(getattr(param, "panel", "General Settings"), []).append(record)
+        ordered = [p for p in PANEL_ORDER if p in panels]
+        ordered += [p for p in panels if p not in PANEL_ORDER]
+        for panel in ordered:
+            with formatter.section(panel):
+                formatter.write_dl(panels[panel])
+
+
 def _click_type(annotation: Any) -> Any:
     """Map a settings-field annotation to a click param type."""
     if annotation is bool:
@@ -42,12 +88,13 @@ def _strategy_options(strategy_type: Any) -> list[click.Option]:
         if isinstance(default, decimal.Decimal):
             default = float(default)
         options.append(
-            click.Option(
+            PanelOption(
                 [f"--{field_name}"],
                 type=_click_type(field.annotation),
                 default=default,
                 show_default=True,
                 help=field.description or "",
+                panel="TPU Backend Settings" if field_name in TPU_BACKEND_FIELDS else "Strategy Settings",
             )
         )
     return options
@@ -55,37 +102,43 @@ def _strategy_options(strategy_type: Any) -> list[click.Option]:
 
 def _common_options() -> list[click.Option]:
     return [
-        click.Option(
+        PanelOption(
             ["--cluster", "-c", "clusters"],
             multiple=True,
             help="List of clusters to run on. By default, will run on the current cluster. Use '*' to run on all clusters.",
         ),
-        click.Option(
+        PanelOption(
             ["--namespace", "-n", "namespaces"],
             multiple=True,
             help="List of namespaces to run on. By default, will run on all namespaces.",
         ),
-        click.Option(
+        PanelOption(
             ["--prometheus-url", "-p", "prometheus_url"],
             default=None,
             help="Prometheus URL. If not provided, will attempt to find it in kubernetes cluster",
         ),
-        click.Option(["--prometheus-auth-header"], default=None, help="Prometheus authentication header."),
-        click.Option(["--prometheus-ssl-enabled"], is_flag=True, default=False, help="Enable SSL for Prometheus requests."),
-        click.Option(
+        PanelOption(["--prometheus-auth-header"], default=None, help="Prometheus authentication header."),
+        PanelOption(["--prometheus-ssl-enabled"], is_flag=True, default=False, help="Enable SSL for Prometheus requests."),
+        PanelOption(
             ["--prometheus-max-connections"],
             type=int,
             default=32,
             show_default=True,
             help="Max concurrent Prometheus range-query connections for the bulk fetch.",
         ),
-        click.Option(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
-        click.Option(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
-        click.Option(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
-        click.Option(["--formatter", "-f", "format"], default="table", show_default=True, help="Output formatter"),
-        click.Option(["--verbose", "-v"], is_flag=True, default=False, help="Enable verbose mode"),
-        click.Option(["--quiet", "-q"], is_flag=True, default=False, help="Enable quiet mode"),
-        click.Option(["--logtostderr", "log_to_stderr"], is_flag=True, default=False, help="Pass logs to stderr"),
+        PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
+        PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
+        PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
+        PanelOption(["--formatter", "-f", "format"], default="table", show_default=True, help="Output formatter"),
+        PanelOption(["--verbose", "-v"], is_flag=True, default=False, panel="Logging Settings", help="Enable verbose mode"),
+        PanelOption(["--quiet", "-q"], is_flag=True, default=False, panel="Logging Settings", help="Enable quiet mode"),
+        PanelOption(
+            ["--logtostderr", "log_to_stderr"],
+            is_flag=True,
+            default=False,
+            panel="Logging Settings",
+            help="Pass logs to stderr",
+        ),
     ]
 
 
@@ -117,7 +170,7 @@ def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Comm
             raise click.UsageError(f"Invalid settings — {details}") from e
         asyncio.run(runner.run())
 
-    return click.Command(
+    return PanelCommand(
         strategy_name,
         callback=callback,
         params=_common_options() + _strategy_options(strategy_type),

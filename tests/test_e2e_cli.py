@@ -113,6 +113,21 @@ class TestCLI:
         assert "--cpu_percentile" in result.output
         assert "--history_duration" in result.output
 
+    def test_help_panels(self):
+        """Options render grouped into titled panels (the reference groups
+        flags with rich_help_panel — same UX here)."""
+        result = runner.invoke(app, ["simple", "--help"])
+        out = result.output
+        for panel in ("General Settings:", "Logging Settings:", "Strategy Settings:", "TPU Backend Settings:"):
+            assert panel in out, out
+        # Spot-check membership: strategy math vs device backend vs logging.
+        strategy_part = out.split("Strategy Settings:")[1].split("TPU Backend Settings:")[0]
+        assert "--cpu_percentile" in strategy_part
+        tpu_part = out.split("TPU Backend Settings:")[1]
+        assert "--use_pallas" in tpu_part
+        logging_part = out.split("Logging Settings:")[1].split("Strategy Settings:")[0]
+        assert "--verbose" in logging_part
+
     def test_version(self):
         result = runner.invoke(app, ["version"])
         assert result.exit_code == 0
