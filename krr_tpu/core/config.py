@@ -55,6 +55,9 @@ class Config(pd.BaseModel):
     log_to_stderr: bool = False
 
     # TPU backend settings
+    #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
+    #: built (and run) at most this many rows at a time
+    #: (`krr_tpu.strategies.base.run_batch_row_chunks`).
     max_fleet_rows_per_device: int = pd.Field(200_000, ge=1)
 
     other_args: dict[str, Any] = pd.Field(default_factory=dict)

@@ -239,8 +239,15 @@ class Runner:
         else:
             batch = await self._gather_fleet_history(objects)
             t2 = time.perf_counter()
-            # The batched strategy call is CPU/TPU bound; keep the loop responsive.
-            raw_results = await asyncio.to_thread(self._strategy.run_batch, batch)
+            # The batched strategy call is CPU/TPU bound; keep the loop
+            # responsive. Row-chunked so the packed copy never exceeds
+            # max_fleet_rows_per_device rows at a time (fleet-axis host
+            # chunking; row-local strategies make chunked == unbatched).
+            from krr_tpu.strategies.base import run_batch_row_chunks
+
+            raw_results = await asyncio.to_thread(
+                run_batch_row_chunks, self._strategy, batch, self.config.max_fleet_rows_per_device
+            )
         t3 = time.perf_counter()
 
         scans = [

@@ -139,6 +139,18 @@ def _common_options() -> list[click.Option]:
             panel="Logging Settings",
             help="Pass logs to stderr",
         ),
+        PanelOption(
+            ["--max-fleet-rows-per-device"],
+            type=int,
+            default=200_000,
+            show_default=True,
+            panel="TPU Backend Settings",
+            help=(
+                "Process the fleet in row chunks of at most this many containers, "
+                "bounding the packed host/device footprint (row-local strategies "
+                "give identical results chunked or not)."
+            ),
+        ),
     ]
 
 

@@ -21,12 +21,20 @@ from krr_tpu.ops.packing import pack_ragged
 #: Reference-shaped history for one object: pod name → samples.
 RaggedHistory = dict[str, np.ndarray]
 
+#: Host dtype per resource for the packed view. CPU seconds fit float32
+#: exactly as far as the device math is concerned — the device casts to
+#: float32 anyway, and casting f64→f32 at pack time is the identical single
+#: rounding — so packing CPU at 4 bytes/sample halves the packed footprint.
+#: Memory stays float64 on host: byte counts overflow float32's 24-bit
+#: mantissa, and the MB scaling must divide *before* any float32 cast.
+PACK_DTYPES = {ResourceType.CPU: np.float32, ResourceType.Memory: np.float64}
+
 
 @dataclass
 class PackedSeries:
     """Left-justified packed samples: ``values[i, :counts[i]]`` are real."""
 
-    values: np.ndarray  # [N, T] float64
+    values: np.ndarray  # [N, T] — PACK_DTYPES[resource] on the host
     counts: np.ndarray  # [N] int32
 
     @property
@@ -109,9 +117,20 @@ class FleetBatch:
     def packed(self, resource: ResourceType) -> PackedSeries:
         """Packed [N, T] view for one resource (cached)."""
         if resource not in self._packed:
-            values, counts = pack_ragged(self.ragged[resource])
+            values, counts = pack_ragged(
+                self.ragged[resource], dtype=PACK_DTYPES.get(resource, np.float64)
+            )
             self._packed[resource] = PackedSeries(values=values, counts=counts)
         return self._packed[resource]
+
+    def row_slice(self, start: int, stop: int) -> "FleetBatch":
+        """A sub-batch of rows ``[start, stop)`` — objects and ragged views
+        share the originals; the packed cache is fresh, so the sub-batch packs
+        only its own rows (the point of fleet-axis host chunking)."""
+        return FleetBatch(
+            objects=self.objects[start:stop],
+            ragged={r: series[start:stop] for r, series in self.ragged.items()},
+        )
 
     def history_for(self, index: int) -> dict[ResourceType, dict[str, list[Decimal]]]:
         """Reference-shaped ``HistoryData`` for one object (Decimal samples) —

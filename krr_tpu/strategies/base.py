@@ -158,6 +158,29 @@ class BatchedStrategy(BaseStrategy[_S]):
         ...
 
 
+def run_batch_row_chunks(
+    strategy: "BaseStrategy", batch: FleetBatch, max_rows: int
+) -> list[RunResult]:
+    """Run ``strategy.run_batch`` over row chunks of at most ``max_rows``.
+
+    Every built-in strategy is row-local (each object's recommendation
+    depends only on its own samples), so chunked == unbatched exactly, while
+    the packed [rows × T] copy is bounded to ``max_rows`` rows at a time —
+    the fleet-axis analogue of the time-axis host streaming. Host-memory
+    ceiling per chunk: ``max_rows × T × 4 B`` for the float32 CPU pack plus
+    ``max_rows × T × 8 B`` for the float64 memory pack (the ragged fetch
+    buffers themselves are unaffected; for fleets whose *raw samples* exceed
+    host memory, use the tdigest strategy's ``--digest_ingest``, which never
+    materializes them).
+    """
+    if len(batch) <= max_rows:
+        return strategy.run_batch(batch)
+    results: list[RunResult] = []
+    for start in range(0, len(batch), max_rows):
+        results.extend(strategy.run_batch(batch.row_slice(start, start + max_rows)))
+    return results
+
+
 AnyStrategy = BaseStrategy[StrategySettings]
 
 __all__ = [

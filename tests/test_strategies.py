@@ -302,3 +302,52 @@ class TestRandomizedOracleSweep:
                     # (MEMORY_SCALE), which can move a value within one f32 ULP
                     # of an MB ceiling boundary: allow one granularity step.
                     assert abs(got_mem - want_mem) <= Decimal(1_000_000), (ctx, got_mem, want_mem)
+
+
+class TestFleetRowChunking:
+    """Fleet-axis host chunking (`run_batch_row_chunks`): the packed copy is
+    bounded to max_rows rows per chunk, and row-local strategies give exactly
+    the unbatched results for any chunk size."""
+
+    @pytest.mark.parametrize("max_rows", [1, 3, 5, 100])
+    def test_chunked_equals_unbatched_simple(self, rng, max_rows):
+        from krr_tpu.strategies.base import run_batch_row_chunks
+
+        batch = make_batch(rng, n=13)
+        strategy = SimpleStrategy(SimpleStrategySettings())
+        assert_results_equal(
+            strategy.run_batch(batch), run_batch_row_chunks(strategy, batch, max_rows)
+        )
+
+    def test_chunked_equals_unbatched_tdigest(self, rng):
+        from krr_tpu.strategies.base import run_batch_row_chunks
+        from krr_tpu.strategies.tdigest import TDigestStrategy, TDigestStrategySettings
+
+        batch = make_batch(rng, n=11)
+        strategy = TDigestStrategy(TDigestStrategySettings())
+        assert_results_equal(
+            strategy.run_batch(batch), run_batch_row_chunks(strategy, batch, 4)
+        )
+
+    def test_cpu_packs_float32_memory_float64(self, rng):
+        batch = make_batch(rng, n=5)
+        cpu = batch.packed(ResourceType.CPU)
+        mem = batch.packed(ResourceType.Memory)
+        assert cpu.values.dtype == np.float32
+        assert mem.values.dtype == np.float64
+        # f64→f32 at pack time is the same single rounding the device cast did.
+        for i, pods in enumerate(batch.ragged[ResourceType.CPU]):
+            flat = (
+                np.concatenate([np.asarray(v, dtype=np.float64) for v in pods.values()])
+                if pods else np.empty(0)
+            )
+            np.testing.assert_array_equal(cpu.values[i, : flat.size], flat.astype(np.float32))
+
+    def test_row_slice_is_fresh(self, rng):
+        batch = make_batch(rng, n=6)
+        _ = batch.packed(ResourceType.CPU)  # warm the parent cache
+        sub = batch.row_slice(2, 5)
+        assert len(sub) == 3
+        assert sub.objects == batch.objects[2:5]
+        packed = sub.packed(ResourceType.CPU)
+        assert packed.num_rows == 3
