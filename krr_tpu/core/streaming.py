@@ -97,18 +97,14 @@ class DigestStore:
 
     # -------------------------------------------------------------- quantiles
     def cpu_percentile(self, rows: np.ndarray, q: float) -> np.ndarray:
-        """Quantile estimate from merged counts (host numpy; same math as
-        ``krr_tpu.ops.digest.percentile``). NaN where no data."""
-        counts = self.cpu_counts[rows]
-        total = self.cpu_total[rows]
-        rank = np.maximum(np.floor((total - 1.0) * q / 100.0), 0.0)
-        cum = np.cumsum(counts, axis=1)
-        k = np.argmax(cum > rank[:, None], axis=1).astype(np.float64)
-        estimate = np.where(
-            k == 0, 0.0, self.spec.min_value * np.exp((k - 0.5) * np.log(self.spec.gamma))
+        """Quantile estimate from merged counts — the shared host-numpy query
+        (`krr_tpu.ops.digest.percentile_host`; that docstring records why the
+        host, not the device, serves host-resident digests). NaN where no data."""
+        from krr_tpu.ops.digest import percentile_host
+
+        return percentile_host(
+            self.spec, self.cpu_counts[rows], self.cpu_total[rows], self.cpu_peak[rows], q
         )
-        estimate = np.minimum(estimate, self.cpu_peak[rows])
-        return np.where(total > 0, estimate, np.nan).astype(np.float32)
 
     def memory_peak(self, rows: np.ndarray) -> np.ndarray:
         return np.where(self.mem_total[rows] > 0, self.mem_peak[rows], np.nan).astype(np.float32)

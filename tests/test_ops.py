@@ -546,3 +546,26 @@ class TestPallasSketchKernels:
                 np.asarray(topk_ops.percentile(sketch, q)),
                 np.asarray(topk_ops.percentile(shuffled_sketch, q)),
             )
+
+
+class TestPercentileHost:
+    def test_matches_device_percentile(self, rng):
+        import jax.numpy as jnp
+
+        spec = DigestSpec()
+        values = rng.gamma(2.0, 0.05, size=(23, 700)).astype(np.float32)
+        counts = rng.integers(0, 701, size=23).astype(np.int32)
+        counts[0] = 0
+        d = digest_ops.build_from_packed(spec, jnp.asarray(values), jnp.asarray(counts), chunk_size=256)
+        for q in [50.0, 95.0, 99.0]:
+            want = np.asarray(digest_ops.percentile(spec, d, q))
+            got = digest_ops.percentile_host(
+                spec,
+                np.asarray(d.counts),
+                np.asarray(d.total),
+                np.asarray(d.peak),
+                q,
+            )
+            # f64 host exp vs f32 device exp: ~1e-5 wobble, far inside the
+            # digest's 0.5% value-error contract.
+            np.testing.assert_allclose(got, want, rtol=5e-5, equal_nan=True)
