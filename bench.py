@@ -258,6 +258,28 @@ def main() -> None:
             "peak mismatch",
         )
 
+    if not os.environ.get("BENCH_SKIP_E2E"):
+        # End-to-end pipeline numbers (real Runner against the in-process
+        # fakes + digest-ingest at a 100k synthetic fleet) from bench_e2e.py,
+        # in a subprocess so a pipeline failure can't take down the headline.
+        import subprocess
+
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py")],
+                capture_output=True,
+                text=True,
+                timeout=900,
+            )
+            for line in proc.stderr.splitlines():
+                print(line, file=sys.stderr)
+            if proc.returncode == 0 and proc.stdout.strip():
+                secondary.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+            else:
+                secondary["e2e"] = f"failed rc={proc.returncode}"
+        except Exception as e:  # never let the e2e leg sink the headline
+            secondary["e2e"] = f"failed: {e.__class__.__name__}"
+
     py_per_container = python_reference_seconds_per_container(t, py_sample)
     baseline_throughput = 1.0 / py_per_container
     print(

@@ -1,0 +1,173 @@
+"""End-to-end scale benchmark: the real Runner against in-process fakes.
+
+`bench.py` isolates device-kernel throughput; this script measures the whole
+production pipeline — discover (apiserver list + pod resolution) → bulk
+Prometheus fan-out → native parse → ragged pack → device compute → severity
+— by driving the actual `Runner` against the hermetic fake apiserver +
+Prometheus from `tests/fakes/servers.py` at fleet scale, plus the
+digest-ingest compute path at a synthetic 100k-container fleet (the
+BASELINE.md config-4 fleet size; raw fetch at that scale is bounded by the
+Prometheus side, which a local fake can't represent — see README).
+
+The e2e number is a *lower bound*: the fake Prometheus renders its JSON in
+pure Python in-process, so at fleet scale the measured wall-clock is
+dominated by the fake server's own encoding, not by the scanner. It still
+catches regressions anywhere in the pipeline, which is its job.
+
+Prints ONE JSON line:
+    {"e2e_objects_per_sec": N, "e2e_containers": N, "discover_seconds": N,
+     "fetch_seconds": N, "compute_seconds": N,
+     "digest_ingest_100k_objects_per_sec": N}
+
+Env knobs: BENCH_E2E_CONTAINERS (default 1000), BENCH_E2E_SAMPLES (default
+1344 = 2 weeks @ 15 min, the reference's workload shape),
+BENCH_E2E_INGEST_ROWS (default 100000; 0 skips the ingest measurement).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def run_e2e(n_containers: int, samples: int) -> dict:
+    import numpy as np
+    import yaml
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.core.runner import Runner
+    from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    rng = np.random.default_rng(5)
+    for i in range(n_containers):
+        name = f"wl-{i}"
+        (pod,) = cluster.add_workload_with_pods("Deployment", name, "default", pod_count=1)
+        metrics.set_series(
+            "default",
+            "main",
+            pod,
+            cpu=rng.gamma(2.0, 0.05, samples),
+            memory=rng.uniform(5e7, 4e8, samples),
+        )
+
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = os.path.join(tmp, "config")
+            with open(kubeconfig, "w") as f:
+                yaml.safe_dump(
+                    {
+                        "current-context": "fake",
+                        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "u"}}],
+                        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+                        "users": [{"name": "u", "user": {"token": "t"}}],
+                    },
+                    f,
+                )
+            config = Config(
+                kubeconfig=kubeconfig,
+                prometheus_url=server.url,
+                quiet=True,
+                format="json",
+            )
+            def one_scan() -> tuple[float, dict]:
+                runner = Runner(config)
+                start = time.perf_counter()
+                with contextlib.redirect_stdout(io.StringIO()):  # result JSON isn't the metric
+                    asyncio.run(runner.run())
+                return time.perf_counter() - start, runner.stats
+
+            # Cold scan pays one-time JIT compiles; the warm scan is the
+            # steady-state a continuously-running recommender sees.
+            cold_elapsed, _cold = one_scan()
+            elapsed, stats = one_scan()
+    finally:
+        server.stop()
+
+    return {
+        "e2e_objects_per_sec": round(stats["objects"] / elapsed, 1),
+        "e2e_objects_per_sec_cold": round(stats["objects"] / cold_elapsed, 1),
+        "e2e_containers": int(stats["objects"]),
+        "discover_seconds": round(stats["discover_seconds"], 3),
+        "fetch_seconds": round(stats["fetch_seconds"], 3),
+        "compute_seconds": round(stats["compute_seconds"], 3),
+    }
+
+
+def run_digest_ingest(n_rows: int) -> dict:
+    """Time the digest-ingest compute path (run_digested: host percentile
+    query + Decimal finalize + severity-ready raw results) at config-4 fleet
+    scale on a synthetic pre-digested fleet."""
+    import numpy as np
+
+    from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+    from krr_tpu.models.objects import K8sObjectData
+    from krr_tpu.models.series import DigestedFleet
+    from krr_tpu.strategies.tdigest import TDigestStrategy, TDigestStrategySettings
+
+    settings = TDigestStrategySettings(digest_ingest=True)
+    spec = settings.cpu_spec()
+    allocations = ResourceAllocations(
+        requests={ResourceType.CPU: "100m", ResourceType.Memory: "128Mi"},
+        limits={ResourceType.CPU: None, ResourceType.Memory: None},
+    )
+    objects = [
+        K8sObjectData(
+            cluster="c", namespace="default", name=f"wl-{i}", kind="Deployment",
+            container="main", pods=[f"wl-{i}-0"], allocations=allocations,
+        )
+        for i in range(n_rows)
+    ]
+    fleet = DigestedFleet.empty(objects, spec.gamma, spec.min_value, spec.num_buckets)
+    rng = np.random.default_rng(9)
+    # ~2,000 samples/row spread over a band of buckets; exact values are
+    # irrelevant to the timing, the shapes are what matter.
+    band = rng.integers(400, 2000, size=n_rows)
+    fleet.cpu_counts[np.arange(n_rows), band] = 1500.0
+    fleet.cpu_counts[np.arange(n_rows), band + 10] = 500.0
+    fleet.cpu_total[:] = 2000.0
+    fleet.cpu_peak[:] = 1.0
+    fleet.mem_total[:] = 2000.0
+    fleet.mem_peak[:] = rng.uniform(5e7, 4e8, n_rows)
+
+    strategy = TDigestStrategy(settings)
+    start = time.perf_counter()
+    results = strategy.run_digested(fleet)
+    elapsed = time.perf_counter() - start
+    assert len(results) == n_rows
+    return {"digest_ingest_100k_objects_per_sec": round(n_rows / elapsed, 1)}
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_E2E_CONTAINERS", 1000))
+    samples = int(os.environ.get("BENCH_E2E_SAMPLES", 1344))
+    ingest_rows = int(os.environ.get("BENCH_E2E_INGEST_ROWS", 100_000))
+
+    out = run_e2e(n, samples)
+    print(
+        f"bench_e2e: {out['e2e_containers']} containers x {samples} samples -> "
+        f"{out['e2e_objects_per_sec']:.0f} objects/s end-to-end "
+        f"(discover {out['discover_seconds']}s, fetch {out['fetch_seconds']}s, "
+        f"compute {out['compute_seconds']}s)",
+        file=sys.stderr,
+    )
+    if ingest_rows:
+        out.update(run_digest_ingest(ingest_rows))
+        print(
+            f"bench_e2e: digest_ingest at {ingest_rows} rows -> "
+            f"{out['digest_ingest_100k_objects_per_sec']:.0f} objects/s",
+            file=sys.stderr,
+        )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

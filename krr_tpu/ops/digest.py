@@ -200,18 +200,29 @@ def percentile_host(
     This is a deliberate single-code-path decision, not a missing device
     route: digest-ingest counts are born on host (the native parse folds
     samples into numpy buckets), and measured on the tunneled v5e at
-    100k × 2,560 the host query takes ~3.4 s while the device query pays
-    ~50 s just moving the 1 GB count matrix through the tunnel — the query is
+    100k × 2,560 the host query takes ~2 s while the device query pays ~50 s
+    just moving the 1 GB count matrix through the tunnel — the query is
     transfer-bound, so ``use_mesh`` intentionally has no effect on it.
+
+    Rows are processed in blocks so the cumsum temporary stays cache-sized:
+    one-shot at 100k × 2,560 float64 allocates a 2 GB intermediate and runs
+    6× slower than the blocked loop (measured 11.7 s vs 1.9 s).
     """
     import numpy as np
 
-    rank = np.maximum(np.floor((np.asarray(total, np.float64) - 1.0) * q / 100.0), 0.0)
-    cum = np.cumsum(counts, axis=1)
-    k = np.argmax(cum > rank[:, None], axis=1).astype(np.float64)
-    estimate = np.where(k == 0, 0.0, spec.min_value * np.exp((k - 0.5) * spec.log_gamma))
-    estimate = np.minimum(estimate, peaks)
-    return np.where(np.asarray(total) > 0, estimate, np.nan).astype(np.float32)
+    n = counts.shape[0]
+    total = np.asarray(total)
+    out = np.empty(n, dtype=np.float32)
+    for s in range(0, max(n, 1), 4096):
+        e = min(s + 4096, n)
+        t_blk = total[s:e].astype(np.float64)
+        rank = np.maximum(np.floor((t_blk - 1.0) * q / 100.0), 0.0)
+        cum = np.cumsum(counts[s:e], axis=1)
+        k = np.argmax(cum > rank[:, None], axis=1).astype(np.float64)
+        estimate = np.where(k == 0, 0.0, spec.min_value * np.exp((k - 0.5) * spec.log_gamma))
+        estimate = np.minimum(estimate, peaks[s:e])
+        out[s:e] = np.where(t_blk > 0, estimate, np.nan).astype(np.float32)
+    return out[:n]
 
 
 def build_from_packed(
