@@ -107,9 +107,20 @@ class FakeMetrics:
     fail_next: int = 0  # inject N transient 500s, then succeed (retry tests)
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     request_count: int = 0
+    #: Pre-rendered response fragments per (ns, container, pod): rendering
+    #: the values JSON per request dominates fleet-scale benches and would
+    #: make `bench_e2e.py` measure the fake instead of the scanner. The
+    #: parser discards timestamps, so static ones are served.
+    _fragments: dict[tuple[str, str, str], tuple[str, str]] = field(default_factory=dict)
 
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
-        self.series[(namespace, container, pod)] = (np.asarray(cpu, float), np.asarray(memory, float))
+        key = (namespace, container, pod)
+        self.series[key] = (np.asarray(cpu, float), np.asarray(memory, float))
+        self._fragments[key] = tuple(
+            '{"metric":{"pod":"%s"},"values":[%s]}'
+            % (pod, ",".join(f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples)))
+            for samples in self.series[key]
+        )
 
 
 _QUERY_RE = re.compile(
@@ -191,6 +202,16 @@ class FakeBackend:
         is_cpu = "cpu_usage" in query
         start = float(params.get("start", 0))
         step = 60.0
+        if not self.metrics.duplicate_pods:
+            # Fast path: assemble the body from pre-rendered fragments.
+            fragments = [
+                frags[0 if is_cpu else 1]
+                for (ns, cont, pod), frags in self.metrics._fragments.items()
+                if ns == namespace and cont == container and pod_pattern.match(pod)
+                and len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
+            ]
+            body = '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
+            return web.Response(text=body, content_type="application/json")
         result = []
         for (ns, cont, pod), (cpu, memory) in self.metrics.series.items():
             if ns == namespace and cont == container and pod_pattern.match(pod):
