@@ -1,0 +1,21 @@
+"""Smoke-test the end-to-end benchmark harness at tiny scale.
+
+`bench.py` folds `bench_e2e.py`'s numbers into its JSON via a subprocess and
+degrades to a note on failure — so without this test, a broken e2e harness
+would silently drop the end-to-end metrics from every recorded round.
+"""
+
+import bench_e2e
+
+
+def test_run_e2e_small():
+    out = bench_e2e.run_e2e(n_containers=6, samples=48)
+    assert out["e2e_containers"] == 6
+    assert out["e2e_objects_per_sec"] > 0
+    assert out["e2e_objects_per_sec_cold"] > 0
+    assert out["fetch_seconds"] > 0 and out["compute_seconds"] > 0
+
+
+def test_run_digest_ingest_small():
+    out = bench_e2e.run_digest_ingest(64)
+    assert out["digest_ingest_100k_objects_per_sec"] > 0
