@@ -390,6 +390,9 @@ def _topk_kernel(
             seg_count = jnp.sum(surv.astype(jnp.int32), axis=1, keepdims=True)
             return base + seg_count, acc + placed
 
+        # Note: Mosaic's fori lowering is unroll=1-or-full; full unroll of the
+        # segment loop exceeds the 16 MB scoped-VMEM limit (temporaries of
+        # all iterations coexist), so the loop stays rolled.
         return jax.lax.fori_loop(0, nseg, seg_body, (base, acc))
 
     base = jnp.zeros((rows, 1), dtype=jnp.int32)
