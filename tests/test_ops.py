@@ -569,3 +569,60 @@ class TestPercentileHost:
             # f64 host exp vs f32 device exp: ~1e-5 wobble, far inside the
             # digest's 0.5% value-error contract.
             np.testing.assert_allclose(got, want, rtol=5e-5, equal_nan=True)
+
+
+class TestPallasSketchFuzz:
+    """Shape-space fuzz of the sketch kernels (interpret mode): random row
+    counts (padding), widths (segment divisors), K values, validity prefixes,
+    tie densities, and zero runs — each case pinned against the jnp paths."""
+
+    def test_digest_kernel_shape_sweep(self, rng):
+        import jax.numpy as jnp
+
+        from krr_tpu.ops import pallas_sketch as ps
+
+        spec = DigestSpec(num_buckets=512, gamma=1.02)
+        for _ in range(8):
+            n = int(rng.integers(1, 40))
+            t = int(rng.integers(1, 900))
+            values = rng.gamma(2.0, 0.05, size=(n, t)).astype(np.float32)
+            if rng.random() < 0.3:
+                values[:, : t // 2] = values[0, 0]  # heavy ties
+            if rng.random() < 0.3:
+                values[:, ::3] = 0.0  # underflow-bucket zeros
+            counts = rng.integers(0, t + 1, size=n).astype(np.int32)
+            mask = np.arange(t)[None, :] < counts[:, None]
+            want = np.asarray(
+                digest_ops._histogram(
+                    spec, digest_ops.bucketize(spec, jnp.asarray(values)), jnp.asarray(mask)
+                )
+            )
+            got, _peak = ps.digest_hist(
+                jnp.asarray(values), jnp.asarray(counts), spec.num_buckets,
+                spec.min_value, spec.log_gamma, interpret=True,
+            )
+            np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"n={n} t={t}")
+
+    def test_topk_kernel_shape_sweep(self, rng):
+        import jax.numpy as jnp
+
+        from krr_tpu.ops import pallas_sketch as ps
+
+        for _ in range(8):
+            n = int(rng.integers(1, 30))
+            t = int(rng.integers(1, 700))
+            k = 128 * int(rng.integers(1, 4))
+            values = rng.gamma(2.0, 0.05, size=(n, t)).astype(np.float32)
+            if rng.random() < 0.4:
+                values[:, : t // 2] = values[0, 0]  # ties across the τ boundary
+            counts = rng.integers(0, t + 1, size=n).astype(np.int32)
+            got = np.asarray(ps.topk_select(jnp.asarray(values), jnp.asarray(counts), k, interpret=True))
+            masked = np.where(np.arange(t)[None, :] < counts[:, None], values, -np.inf)
+            want = -np.sort(-masked, axis=1)
+            for r in range(n):
+                kv = min(k, counts[r])
+                g = np.sort(got[r])[::-1]
+                np.testing.assert_array_equal(
+                    g[:kv], want[r, :kv], err_msg=f"n={n} t={t} k={k} row={r}"
+                )
+                assert np.all(np.isneginf(g[kv:]))
