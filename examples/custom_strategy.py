@@ -3,7 +3,7 @@
 # Defining the subclass registers it; running this file adds a
 # `spikeguard` sub-command to the CLI:
 #
-#     python ./custom_strategy.py spikeguard --cpu-percentile 95 --spike-guard 60
+#     python ./custom_strategy.py spikeguard --cpu_percentile 95 --spike_guard 60
 #
 # The scenario: a latency-sensitive service whose p95 usage is low but which
 # takes short request bursts. A plain p95 request starves the bursts, a

@@ -147,30 +147,39 @@ class PrometheusLoader:
             ) from e
 
     # ---------------------------------------------------------------- fetch
+    #: GET/POST cut-over for range queries: below this many query characters
+    #: the request goes as GET (safe past read-only RBAC on the kube-apiserver
+    #: service proxy, where POST maps to the `create` verb); above it, POST
+    #: (Prometheus accepts it; GET would overflow the ~8 KB URL caps of
+    #: Prometheus and most proxies at exactly this pod-count scale, so
+    #: nothing is lost).
+    GET_QUERY_LIMIT = 6144
+
     async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
         """Range query with retry + exponential backoff; returns the raw
         response body (callers pick their parser).
 
-        Sent as a form-encoded POST (Prometheus accepts POST for
-        ``query_range``): our per-workload queries carry a pod-name regex
-        that grows with the pod count, and a workload with hundreds of pods
-        produces a multi-KB query — GET would overflow the ~8 KB URL caps of
-        Prometheus and most proxies at exactly the fleet scale this tool
-        targets.
+        Our per-workload queries carry a pod-name regex that grows with the
+        pod count: short queries go as GET (works under read-only RBAC on
+        apiserver-proxied URLs), multi-KB ones as form-encoded POST (the only
+        transport that survives URL caps — a proxy user at that pod scale
+        needs the extra `create services/proxy` RBAC verb either way).
 
         Only transient failures (transport errors, 5xx) are retried; a 4xx
         (bad query) fails immediately — retrying those only adds fleet-sized
         futile sleeps.
         """
         client = await self._ensure_connected()
+        params = {"query": query, "start": start, "end": end, "step": step}
+        use_get = len(query) <= self.GET_QUERY_LIMIT
         last_error: Optional[Exception] = None
         for attempt in range(self.retries):
             try:
                 async with self._semaphore:
-                    response = await client.post(
-                        "/api/v1/query_range",
-                        data={"query": query, "start": start, "end": end, "step": step},
-                    )
+                    if use_get:
+                        response = await client.get("/api/v1/query_range", params=params)
+                    else:
+                        response = await client.post("/api/v1/query_range", data=params)
             except (httpx.TransportError, OSError) as e:
                 last_error = e
             else:

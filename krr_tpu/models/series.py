@@ -110,6 +110,12 @@ class FleetBatch:
     objects: list[K8sObjectData]
     ragged: dict[ResourceType, list[RaggedHistory]]
     _packed: dict[ResourceType, PackedSeries] = field(default_factory=dict)
+    #: Minimum packed time capacity per resource. Row-sliced sub-batches pin
+    #: this to the parent's full-fleet capacity so every chunk packs to the
+    #: SAME width: strategies whose sketch cut-over depends on the capacity
+    #: (tdigest's exact-top-K-vs-digest choice) then decide identically for
+    #: every chunk, and the compiled kernel shapes are shared across chunks.
+    _capacity: dict[ResourceType, int] = field(default_factory=dict)
 
     def __len__(self) -> int:
         return len(self.objects)
@@ -118,18 +124,33 @@ class FleetBatch:
         """Packed [N, T] view for one resource (cached)."""
         if resource not in self._packed:
             values, counts = pack_ragged(
-                self.ragged[resource], dtype=PACK_DTYPES.get(resource, np.float64)
+                self.ragged[resource],
+                dtype=PACK_DTYPES.get(resource, np.float64),
+                capacity=self._capacity.get(resource),
             )
             self._packed[resource] = PackedSeries(values=values, counts=counts)
         return self._packed[resource]
 
+    def _row_length(self, resource: ResourceType, i: int) -> int:
+        return sum(np.asarray(s).size for s in self.ragged[resource][i].values())
+
     def row_slice(self, start: int, stop: int) -> "FleetBatch":
         """A sub-batch of rows ``[start, stop)`` — objects and ragged views
         share the originals; the packed cache is fresh, so the sub-batch packs
-        only its own rows (the point of fleet-axis host chunking)."""
+        only its own rows (the point of fleet-axis host chunking). The packed
+        capacity is pinned to the parent's full-fleet capacity (see
+        ``_capacity``) so chunked results equal unbatched ones even for
+        capacity-dependent strategy decisions."""
+        capacity = {
+            r: self._capacity.get(
+                r, max((self._row_length(r, i) for i in range(len(self.objects))), default=0)
+            )
+            for r in self.ragged
+        }
         return FleetBatch(
             objects=self.objects[start:stop],
             ragged={r: series[start:stop] for r, series in self.ragged.items()},
+            _capacity=capacity,
         )
 
     def history_for(self, index: int) -> dict[ResourceType, dict[str, list[Decimal]]]:

@@ -75,9 +75,14 @@ class BaseStrategy(abc.ABC, Generic[_S]):
         __display_name__: CLI name; defaults to the class name with the
             ``Strategy`` postfix stripped, lowercased (``SimpleStrategy`` →
             ``simple``). Override explicitly to customize.
+        row_chunkable: whether the Runner may split the fleet into row chunks
+            (`run_batch_row_chunks`). True for row-local strategies (every
+            built-in; also the per-object compat path by construction). Set
+            False on a plugin whose ``run_batch`` looks across objects.
     """
 
     __display_name__: str
+    row_chunkable: bool = True
 
     settings: _S
 
@@ -166,14 +171,20 @@ def run_batch_row_chunks(
     Every built-in strategy is row-local (each object's recommendation
     depends only on its own samples), so chunked == unbatched exactly, while
     the packed [rows × T] copy is bounded to ``max_rows`` rows at a time —
-    the fleet-axis analogue of the time-axis host streaming. Host-memory
-    ceiling per chunk: ``max_rows × T × 4 B`` for the float32 CPU pack plus
-    ``max_rows × T × 8 B`` for the float64 memory pack (the ragged fetch
-    buffers themselves are unaffected; for fleets whose *raw samples* exceed
-    host memory, use the tdigest strategy's ``--digest_ingest``, which never
-    materializes them).
+    the fleet-axis analogue of the time-axis host streaming. Two details make
+    the equality hold beyond mere row-locality: sub-batches pin the parent's
+    packed capacity (`FleetBatch.row_slice`), so capacity-dependent decisions
+    like tdigest's sketch cut-over can't vary with chunk boundaries; and a
+    strategy that is NOT row-local can set ``row_chunkable = False`` to
+    receive the whole fleet in one call regardless of ``max_rows``.
+
+    Host-memory ceiling per chunk: ``max_rows × T × 4 B`` for the float32
+    CPU pack plus ``max_rows × T × 8 B`` for the float64 memory pack (the
+    ragged fetch buffers themselves are unaffected; for fleets whose *raw
+    samples* exceed host memory, use the tdigest strategy's
+    ``--digest_ingest``, which never materializes them).
     """
-    if len(batch) <= max_rows:
+    if len(batch) <= max_rows or not getattr(strategy, "row_chunkable", True):
         return strategy.run_batch(batch)
     results: list[RunResult] = []
     for start in range(0, len(batch), max_rows):

@@ -244,11 +244,13 @@ class FakeBackend:
         app.router.add_get("/api/v1/query", self.query)
         app.router.add_get("/api/v1/query_range", self.query_range)
         app.router.add_post("/api/v1/query_range", self.query_range)
-        # …and the same API under the apiserver service-proxy prefix.
+        # …and the same API under the apiserver service-proxy prefix —
+        # deliberately GET-only: Kubernetes RBAC maps POST on services/proxy
+        # to the `create` verb, which read-only roles lack, so the loader
+        # must keep ordinary queries on GET (see PrometheusLoader.GET_QUERY_LIMIT).
         proxy = "/api/v1/namespaces/{ns}/services/{svc}/proxy"
         app.router.add_get(proxy + "/api/v1/query", self.query)
         app.router.add_get(proxy + "/api/v1/query_range", self.query_range)
-        app.router.add_post(proxy + "/api/v1/query_range", self.query_range)
         return app
 
 

@@ -351,3 +351,52 @@ class TestFleetRowChunking:
         assert sub.objects == batch.objects[2:5]
         packed = sub.packed(ResourceType.CPU)
         assert packed.num_rows == 3
+
+
+class TestRowChunkCapacityPinning:
+    """Row-sliced sub-batches pack to the parent's capacity, so
+    capacity-dependent decisions (tdigest's exact-top-K vs digest cut-over)
+    are identical for every chunk — without the pinning, a chunk that lacks
+    the fleet's longest row would flip to the exact sketch and its rows'
+    recommendations would depend on chunk placement."""
+
+    def test_cutover_stable_across_chunks(self, rng):
+        from krr_tpu.strategies.base import run_batch_row_chunks
+        from krr_tpu.strategies.tdigest import TDigestStrategy, TDigestStrategySettings
+
+        objects, cpu, mem = [], [], []
+        lengths = [800] * 9 + [13_000]  # one long row drives required_k past the budget
+        for i, length in enumerate(lengths):
+            pods = [f"p-{i}"]
+            objects.append(
+                K8sObjectData(
+                    cluster="c", namespace="default", name=f"app-{i}", kind="Deployment",
+                    container="main", pods=pods,
+                    allocations=ResourceAllocations(requests={}, limits={}),
+                )
+            )
+            cpu.append({pods[0]: rng.gamma(2.0, 0.05, size=length)})
+            mem.append({pods[0]: rng.uniform(1e7, 4e8, size=length)})
+        batch = FleetBatch.build(objects, {ResourceType.CPU: cpu, ResourceType.Memory: mem})
+
+        strategy = TDigestStrategy(TDigestStrategySettings(exact_sketch_budget=128))
+        assert_results_equal(
+            strategy.run_batch(batch), run_batch_row_chunks(strategy, batch, 4)
+        )
+
+    def test_row_chunkable_opt_out(self, rng):
+        from krr_tpu.strategies.base import run_batch_row_chunks
+
+        batch = make_batch(rng, n=6)
+        seen_sizes = []
+
+        class Spy(SimpleStrategy):
+            __register__ = False
+            row_chunkable = False
+
+            def run_batch(self, b):
+                seen_sizes.append(len(b))
+                return super().run_batch(b)
+
+        run_batch_row_chunks(Spy(SimpleStrategySettings()), batch, 2)
+        assert seen_sizes == [6]  # never split
