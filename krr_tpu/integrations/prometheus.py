@@ -13,7 +13,13 @@ Python (`/root/reference/robusta_krr/core/integrations/prometheus.py:108-155`)
   exponential backoff (the reference has retries only at the urllib3 adapter
   level, no backoff policy — SURVEY.md §5);
 * samples parsed straight into float64 numpy arrays, feeding the packed
-  ``[containers × timesteps]`` device batch — no per-sample Python objects.
+  ``[containers × timesteps]`` device batch — no per-sample Python objects;
+* sub-minute steps and automatic splitting of long fine-grained windows into
+  ≤11,000-point sub-queries (Prometheus's per-query resolution cap), fetched
+  concurrently and merged exactly — this is what makes the 7 d @ 5 s
+  headline workload (120,960 points/series) actually fetchable; the
+  reference clamps every step to whole minutes and would be rejected by
+  Prometheus long before that resolution.
 
 PromQL is kept byte-compatible with the reference's queries
 (`prometheus.py:123,136`) so recording-rule expectations carry over.
@@ -63,9 +69,50 @@ def memory_query(namespace: str, pod_regex: str, container: str) -> str:
 QUERY_BUILDERS = {ResourceType.CPU: cpu_query, ResourceType.Memory: memory_query}
 
 
+def effective_step_seconds(step_seconds: float) -> int:
+    """The step Prometheus will actually evaluate: whole minutes when ≥ 1 m
+    (reference parity — it formats ``{seconds // 60}m``,
+    `prometheus.py:126`), whole seconds below that. Sub-minute resolution is
+    a krr-tpu extension: the reference clamps every timeframe to ≥ 1 m, which
+    makes 5 s-scrape histories (the BASELINE headline workload) unreachable."""
+    if step_seconds >= 60:
+        return 60 * max(int(step_seconds) // 60, 1)
+    return max(int(step_seconds), 1)
+
+
 def step_string(step_seconds: float) -> str:
-    """Step in whole minutes, matching the reference (`prometheus.py:126`)."""
-    return f"{max(int(step_seconds) // 60, 1)}m"
+    """Prometheus duration string for :func:`effective_step_seconds`."""
+    eff = effective_step_seconds(step_seconds)
+    return f"{eff // 60}m" if eff >= 60 else f"{eff}s"
+
+
+#: Prometheus rejects range queries that would return more than this many
+#: points per series ("exceeded maximum resolution of 11,000 points").
+MAX_RANGE_POINTS = 11_000
+
+
+def subwindows(start: float, end: float, step_seconds: float) -> list[tuple[float, float]]:
+    """Split ``[start, end]`` into sub-ranges of ≤ ``MAX_RANGE_POINTS`` steps.
+
+    Prometheus evaluates a range query at ``start, start + step, … ≤ end``;
+    the sub-windows tile exactly that grid (window ``j`` starts at point
+    ``j · M``), so the union of the split queries returns the same samples
+    as the single query would — no duplicates, no gaps. Long fine-grained
+    windows (7 d @ 5 s = 120,960 points) split into ⌈n / 11,000⌉ concurrent
+    queries; the per-pod series concatenate in window order (raw path) or
+    merge exactly (digest/stats ingest — sketches are mergeable).
+    """
+    step = effective_step_seconds(step_seconds)
+    n_points = int((end - start) // step) + 1
+    if n_points <= MAX_RANGE_POINTS:
+        return [(start, end)]
+    windows = []
+    j = 0
+    while j < n_points:
+        last = min(j + MAX_RANGE_POINTS, n_points) - 1
+        windows.append((start + j * step, start + last * step))
+        j = last + 1
+    return windows
 
 
 class PrometheusLoader:
@@ -194,28 +241,61 @@ class PrometheusLoader:
         assert last_error is not None
         raise last_error
 
-    async def _query_range(self, query: str, start: float, end: float, step: str) -> list[tuple[str, np.ndarray]]:
+    async def _fetch_parsed_windows(
+        self, query: str, start: float, end: float, step_seconds: float, parse
+    ) -> "list[list]":
+        """Fetch every ≤11k-point sub-window of the range concurrently and
+        parse each body off the event loop; returns per-window parse results
+        in window (time) order. One window short-circuits to one fetch."""
+        step = step_string(step_seconds)
+
+        async def one(w_start: float, w_end: float):
+            body = await self._fetch_range_body(query, w_start, w_end, step)
+            # Parsing is CPU-bound (up to ~MBs per response): keep it off the
+            # event loop so the fetch fan-out stays concurrent.
+            return await asyncio.to_thread(parse, body)
+
+        return list(
+            await asyncio.gather(*[one(s, e) for s, e in subwindows(start, end, step_seconds)])
+        )
+
+    async def _query_range(
+        self, query: str, start: float, end: float, step_seconds: float
+    ) -> list[tuple[str, np.ndarray]]:
         """Range query → parsed (pod, samples) series via the native matrix
-        parser (`krr_tpu.integrations.native`, pure-Python fallback)."""
+        parser (`krr_tpu.integrations.native`, pure-Python fallback); long
+        fine-grained ranges split into sub-queries whose per-pod series
+        concatenate in time order."""
         from krr_tpu.integrations.native import parse_matrix
 
-        body = await self._fetch_range_body(query, start, end, step)
-        # Parsing is CPU-bound (up to ~MBs per response): keep it off the
-        # event loop so the fetch fan-out stays concurrent.
-        return await asyncio.to_thread(parse_matrix, body)
+        windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix)
+        if len(windows) == 1:
+            return windows[0]
+        merged: dict[str, list[np.ndarray]] = {}
+        for window in windows:
+            seen_in_window: set[str] = set()
+            for pod, samples in window:
+                if pod not in seen_in_window:  # first series per pod, per window
+                    seen_in_window.add(pod)
+                    merged.setdefault(pod, []).append(samples)
+        return [(pod, np.concatenate(parts)) for pod, parts in merged.items()]
 
     async def gather_fleet(
-        self, objects: list[K8sObjectData], history_seconds: float, step_seconds: float
+        self,
+        objects: list[K8sObjectData],
+        history_seconds: float,
+        step_seconds: float,
+        end_time: Optional[float] = None,
     ) -> dict[ResourceType, list[RaggedHistory]]:
         """Fetch per-pod series for every (object, resource) concurrently.
 
         Objects whose queries fail after retries degrade to empty histories
-        (→ UNKNOWN scans) rather than failing the run.
+        (→ UNKNOWN scans) rather than failing the run. ``end_time`` pins the
+        window's right edge (reproducible scans; defaults to now).
         """
         await self._ensure_connected()
-        end = datetime.datetime.now().timestamp()
+        end = datetime.datetime.now().timestamp() if end_time is None else end_time
         start = end - history_seconds
-        step = step_string(step_seconds)
 
         histories: dict[ResourceType, list[RaggedHistory]] = {
             resource: [{} for _ in objects] for resource in ResourceType
@@ -227,7 +307,7 @@ class PrometheusLoader:
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
             query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
             try:
-                series = await self._query_range(query, start, end, step)
+                series = await self._query_range(query, start, end, step_seconds)
             except Exception as e:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
@@ -246,25 +326,69 @@ class PrometheusLoader:
         return histories
 
     async def _query_range_digest(
-        self, query: str, start: float, end: float, step: str, gamma: float, min_value: float, num_buckets: int
+        self,
+        query: str,
+        start: float,
+        end: float,
+        step_seconds: float,
+        gamma: float,
+        min_value: float,
+        num_buckets: int,
     ) -> "list[tuple[str, np.ndarray, float, float]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
-        sample arrays are never materialized."""
+        sample arrays are never materialized. Split sub-windows merge exactly
+        (bucket counts add, peaks max — the digest's defining property)."""
+        from functools import partial
+
         from krr_tpu.integrations.native import parse_matrix_digest
 
-        body = await self._fetch_range_body(query, start, end, step)
-        return await asyncio.to_thread(parse_matrix_digest, body, gamma, min_value, num_buckets)
+        windows = await self._fetch_parsed_windows(
+            query, start, end, step_seconds,
+            partial(parse_matrix_digest, gamma=gamma, min_value=min_value, num_buckets=num_buckets),
+        )
+        if len(windows) == 1:
+            return windows[0]
+        merged: dict[str, list] = {}
+        for window in windows:
+            seen_in_window: set[str] = set()
+            for pod, counts, total, peak in window:
+                if pod in seen_in_window:
+                    continue
+                seen_in_window.add(pod)
+                if pod in merged:
+                    m = merged[pod]
+                    m[0] += counts
+                    m[1] += total
+                    m[2] = max(m[2], peak)
+                else:
+                    merged[pod] = [counts.copy(), total, peak]
+        return [(pod, m[0], m[1], m[2]) for pod, m in merged.items()]
 
     async def _query_range_stats(
-        self, query: str, start: float, end: float, step: str
+        self, query: str, start: float, end: float, step_seconds: float
     ) -> "list[tuple[str, float, float]]":
         """Range query → per-series (pod, count, max) only — the memory
-        ingest, which needs no histogram and no per-sample log()."""
+        ingest, which needs no histogram and no per-sample log(). Split
+        sub-windows merge exactly (counts add, peaks max)."""
         from krr_tpu.integrations.native import parse_matrix_stats
 
-        body = await self._fetch_range_body(query, start, end, step)
-        return await asyncio.to_thread(parse_matrix_stats, body)
+        windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix_stats)
+        if len(windows) == 1:
+            return windows[0]
+        merged: dict[str, list[float]] = {}
+        for window in windows:
+            seen_in_window: set[str] = set()
+            for pod, total, peak in window:
+                if pod in seen_in_window:
+                    continue
+                seen_in_window.add(pod)
+                if pod in merged:
+                    merged[pod][0] += total
+                    merged[pod][1] = max(merged[pod][1], peak)
+                else:
+                    merged[pod] = [total, peak]
+        return [(pod, m[0], m[1]) for pod, m in merged.items()]
 
     async def gather_fleet_digests(
         self,
@@ -274,6 +398,7 @@ class PrometheusLoader:
         gamma: float,
         min_value: float,
         num_buckets: int,
+        end_time: Optional[float] = None,
     ) -> "DigestedFleet":
         """Digest-ingest fetch: every (object, resource) query's samples are
         bucketized at parse time; per-pod digests merge into per-object
@@ -283,9 +408,8 @@ class PrometheusLoader:
         from krr_tpu.models.series import DigestedFleet
 
         await self._ensure_connected()
-        end = datetime.datetime.now().timestamp()
+        end = datetime.datetime.now().timestamp() if end_time is None else end_time
         start = end - history_seconds
-        step = step_string(step_seconds)
         fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
 
         async def fetch_one(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
@@ -298,7 +422,7 @@ class PrometheusLoader:
             try:
                 if resource is ResourceType.CPU:
                     series = await self._query_range_digest(
-                        query, start, end, step, gamma, min_value, num_buckets
+                        query, start, end, step_seconds, gamma, min_value, num_buckets
                     )
                     for pod, counts, total, peak in series:
                         if pod in wanted and total > 0 and pod not in seen:
@@ -307,7 +431,9 @@ class PrometheusLoader:
                 else:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
-                    for pod, total, peak in await self._query_range_stats(query, start, end, step):
+                    for pod, total, peak in await self._query_range_stats(
+                        query, start, end, step_seconds
+                    ):
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_mem_row(i, total, peak)

@@ -106,6 +106,11 @@ class FakeMetrics:
     fail_queries: bool = False
     fail_next: int = 0  # inject N transient 500s, then succeed (retry tests)
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
+    #: When set, series are anchored at SERIES_ORIGIN with the requested step
+    #: and sliced to the requested [start, end] — the contract the loader's
+    #: sub-11k-point window splitting relies on. Off by default (historical
+    #: behavior: the full series regardless of range).
+    enforce_range: bool = False
     request_count: int = 0
     #: Pre-rendered response fragments per (ns, container, pod): rendering
     #: the values JSON per request dominates fleet-scale benches and would
@@ -181,6 +186,19 @@ class FakeBackend:
     #: 8 KB; enforcing it here pins that the loader POSTs range queries (a
     #: multi-hundred-pod workload's pod regex overflows any GET URL).
     MAX_URL_BYTES = 8192
+    #: Real Prometheus rejects range queries past 11,000 points per series.
+    MAX_RANGE_POINTS = 11_000
+    #: Absolute time of sample 0 when ``enforce_range`` is on (also the
+    #: static timestamp base in the pre-rendered fragments).
+    SERIES_ORIGIN = 1_700_000_000.0
+
+    @staticmethod
+    def _step_seconds(step: str) -> float:
+        if step.endswith("m"):
+            return float(step[:-1]) * 60.0
+        if step.endswith("s"):
+            return float(step[:-1])
+        return float(step)
 
     async def query_range(self, request: web.Request) -> web.Response:
         self.metrics.request_count += 1
@@ -193,6 +211,14 @@ class FakeBackend:
             return web.json_response({"status": "error", "error": "transient failure"}, status=500)
         form = await request.post()  # form-encoded POST, like real Prometheus
         params = {**request.query, **form}
+        step_sec = self._step_seconds(str(params.get("step", "1m")))
+        req_start = float(params.get("start", 0))
+        req_end = float(params.get("end", req_start))
+        if (req_end - req_start) // step_sec + 1 > self.MAX_RANGE_POINTS:
+            return web.json_response(
+                {"status": "error", "error": "exceeded maximum resolution of 11,000 points per timeseries"},
+                status=400,
+            )
         query = params.get("query", "")
         match = _QUERY_RE.search(query)
         if not match:
@@ -202,6 +228,24 @@ class FakeBackend:
         is_cpu = "cpu_usage" in query
         start = float(params.get("start", 0))
         step = 60.0
+        if self.metrics.enforce_range:
+            # Series anchored at SERIES_ORIGIN with the requested step;
+            # return exactly the samples on the requested grid slice.
+            t0 = self.SERIES_ORIGIN
+            result = []
+            for (ns, cont, pod), (cpu, memory) in self.metrics.series.items():
+                if ns == namespace and cont == container and pod_pattern.match(pod):
+                    samples = cpu if is_cpu else memory
+                    i0 = max(0, int(np.ceil((req_start - t0) / step_sec)))
+                    i1 = min(len(samples) - 1, int((req_end - t0) // step_sec))
+                    if i1 >= i0:
+                        values = [
+                            [t0 + i * step_sec, repr(float(samples[i]))] for i in range(i0, i1 + 1)
+                        ]
+                        result.append({"metric": {"pod": pod}, "values": values})
+            return web.json_response(
+                {"status": "success", "data": {"resultType": "matrix", "result": result}}
+            )
         if not self.metrics.duplicate_pods:
             # Fast path: assemble the body from pre-rendered fragments.
             fragments = [

@@ -389,3 +389,98 @@ class TestWidePodFanout:
             assert got_pods == set(pods[:10])
         finally:
             server.stop()
+
+
+class TestRangeQuerySplitting:
+    """Fine-grained long windows exceed Prometheus's 11,000-point-per-query
+    limit (7d @ 5s = 120,960 points); the loader must split the range into
+    grid-aligned sub-queries and merge per-pod results exactly."""
+
+    def test_subwindows_tile_the_grid(self):
+        from krr_tpu.integrations.prometheus import MAX_RANGE_POINTS, subwindows
+
+        start, step = 1_700_000_000.0, 5.0
+        n = 30_000
+        end = start + (n - 1) * step
+        windows = subwindows(start, end, step)
+        assert len(windows) == -(-n // MAX_RANGE_POINTS)
+        # Exact tiling: every grid point appears exactly once.
+        points = []
+        for s, e in windows:
+            assert (s - start) % step == 0 and (e - start) % step == 0
+            points.extend(np.arange(s, e + step / 2, step))
+        np.testing.assert_array_equal(np.asarray(points), start + step * np.arange(n))
+        # Short windows don't split.
+        assert subwindows(start, start + 3600, 60) == [(start, start + 3600)]
+
+    def _wide_window_env(self, tmp_path_factory, n_samples=30_000, step=5.0):
+        from tests.fakes.servers import FakeBackend
+
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        metrics.enforce_range = True
+        rng = np.random.default_rng(21)
+        (pod,) = cluster.add_workload_with_pods("Deployment", "longwin", "default", pod_count=1)
+        cpu = rng.gamma(2.0, 0.05, n_samples)
+        mem = rng.uniform(5e7, 4e8, n_samples)
+        metrics.set_series("default", "main", pod, cpu=cpu, memory=mem)
+        server = ServerThread(FakeBackend(cluster, metrics)).start()
+        kubeconfig_path = tmp_path_factory.mktemp("kube-long") / "config"
+        kubeconfig_path.write_text(yaml.dump({
+            "current-context": "fake",
+            "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+            "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+            "users": [{"name": "fake", "user": {"token": "t"}}],
+        }))
+        end_time = FakeBackend.SERIES_ORIGIN + (n_samples - 1) * step
+        history = (n_samples - 1) * step
+        config = Config(kubeconfig=str(kubeconfig_path), prometheus_url=server.url)
+        return server, config, metrics, pod, cpu, mem, end_time, history
+
+    def test_raw_fetch_splits_and_concatenates(self, tmp_path_factory):
+        server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(tmp_path_factory)
+        try:
+            loader = KubernetesLoader(config)
+            objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+            target = [o for o in objects if o.name == "longwin"]
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet(target, history, 5.0, end_time=end_time)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+            np.testing.assert_allclose(histories[ResourceType.CPU][0][pod], cpu)
+            np.testing.assert_allclose(histories[ResourceType.Memory][0][pod], mem)
+            # 3 sub-windows x 2 resources (+1 connectivity probe not counted here)
+            assert metrics.request_count == 6
+        finally:
+            server.stop()
+
+    def test_digest_ingest_splits_and_merges(self, tmp_path_factory):
+        server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(tmp_path_factory)
+        try:
+            loader = KubernetesLoader(config)
+            objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+            target = [o for o in objects if o.name == "longwin"]
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet_digests(
+                        target, history, 5.0, gamma=1.01, min_value=1e-7, num_buckets=512,
+                        end_time=end_time,
+                    )
+                finally:
+                    await prom.close()
+
+            fleet = asyncio.run(fetch())
+            assert fleet.cpu_total[0] == len(cpu)
+            assert fleet.mem_total[0] == len(mem)
+            np.testing.assert_allclose(fleet.cpu_peak[0], cpu.max())
+            np.testing.assert_allclose(fleet.mem_peak[0], mem.max())
+            assert fleet.cpu_counts[0].sum() == len(cpu)
+        finally:
+            server.stop()
