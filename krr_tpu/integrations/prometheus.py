@@ -17,7 +17,7 @@ Python (`/root/reference/robusta_krr/core/integrations/prometheus.py:108-155`)
 * sub-minute steps and automatic splitting of long fine-grained windows into
   ≤11,000-point sub-queries (Prometheus's per-query resolution cap), fetched
   concurrently and merged exactly — this is what makes the 7 d @ 5 s
-  headline workload (120,960 points/series) actually fetchable; the
+  headline workload (120,961 grid points/series) actually fetchable; the
   reference clamps every step to whole minutes and would be rejected by
   Prometheus long before that resolution.
 
@@ -98,7 +98,7 @@ def subwindows(start: float, end: float, step_seconds: float) -> list[tuple[floa
     the sub-windows tile exactly that grid (window ``j`` starts at point
     ``j · M``), so the union of the split queries returns the same samples
     as the single query would — no duplicates, no gaps. Long fine-grained
-    windows (7 d @ 5 s = 120,960 points) split into ⌈n / 11,000⌉ concurrent
+    windows (7 d @ 5 s = 120,961 grid points) split into ⌈n / 11,000⌉ concurrent
     queries; the per-pod series concatenate in window order (raw path) or
     merge exactly (digest/stats ingest — sketches are mergeable).
     """
@@ -246,7 +246,14 @@ class PrometheusLoader:
     ) -> "list[list]":
         """Fetch every ≤11k-point sub-window of the range concurrently and
         parse each body off the event loop; returns per-window parse results
-        in window (time) order. One window short-circuits to one fetch."""
+        in window (time) order. One window short-circuits to one fetch.
+
+        Failures surface only after every sibling fetch settles
+        (``return_exceptions``): raising early would leave the other windows'
+        multi-MB downloads running orphaned in the semaphore — and their
+        exceptions unretrieved — while the caller has already written the
+        object off.
+        """
         step = step_string(step_seconds)
 
         async def one(w_start: float, w_end: float):
@@ -255,9 +262,34 @@ class PrometheusLoader:
             # event loop so the fetch fan-out stays concurrent.
             return await asyncio.to_thread(parse, body)
 
-        return list(
-            await asyncio.gather(*[one(s, e) for s, e in subwindows(start, end, step_seconds)])
+        results = await asyncio.gather(
+            *[one(s, e) for s, e in subwindows(start, end, step_seconds)],
+            return_exceptions=True,
         )
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return list(results)
+
+    @staticmethod
+    def _merge_window_series(windows: "list[list]", init, fold) -> "list[tuple]":
+        """Shared per-pod fold across split sub-windows.
+
+        Applies the first-series-per-pod rule *per window* (matching the
+        single-query behavior window-wise), then combines each pod's
+        per-window entries: ``init(entry) -> state``,
+        ``fold(state, entry) -> state``. Returns ``[(pod, *state), …]``.
+        """
+        merged: dict = {}
+        for window in windows:
+            seen_in_window: set[str] = set()
+            for entry in window:
+                pod = entry[0]
+                if pod in seen_in_window:
+                    continue
+                seen_in_window.add(pod)
+                merged[pod] = fold(merged[pod], entry) if pod in merged else init(entry)
+        return [(pod, *state) for pod, state in merged.items()]
 
     async def _query_range(
         self, query: str, start: float, end: float, step_seconds: float
@@ -271,14 +303,12 @@ class PrometheusLoader:
         windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix)
         if len(windows) == 1:
             return windows[0]
-        merged: dict[str, list[np.ndarray]] = {}
-        for window in windows:
-            seen_in_window: set[str] = set()
-            for pod, samples in window:
-                if pod not in seen_in_window:  # first series per pod, per window
-                    seen_in_window.add(pod)
-                    merged.setdefault(pod, []).append(samples)
-        return [(pod, np.concatenate(parts)) for pod, parts in merged.items()]
+        merged = self._merge_window_series(
+            windows,
+            init=lambda e: ([e[1]],),
+            fold=lambda state, e: (state[0] + [e[1]],),
+        )
+        return [(pod, np.concatenate(parts)) for pod, parts in merged]
 
     async def gather_fleet(
         self,
@@ -349,21 +379,11 @@ class PrometheusLoader:
         )
         if len(windows) == 1:
             return windows[0]
-        merged: dict[str, list] = {}
-        for window in windows:
-            seen_in_window: set[str] = set()
-            for pod, counts, total, peak in window:
-                if pod in seen_in_window:
-                    continue
-                seen_in_window.add(pod)
-                if pod in merged:
-                    m = merged[pod]
-                    m[0] += counts
-                    m[1] += total
-                    m[2] = max(m[2], peak)
-                else:
-                    merged[pod] = [counts.copy(), total, peak]
-        return [(pod, m[0], m[1], m[2]) for pod, m in merged.items()]
+        return self._merge_window_series(
+            windows,
+            init=lambda e: (e[1].copy(), e[2], e[3]),
+            fold=lambda s, e: (s[0] + e[1], s[1] + e[2], max(s[2], e[3])),
+        )
 
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float
@@ -376,19 +396,11 @@ class PrometheusLoader:
         windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix_stats)
         if len(windows) == 1:
             return windows[0]
-        merged: dict[str, list[float]] = {}
-        for window in windows:
-            seen_in_window: set[str] = set()
-            for pod, total, peak in window:
-                if pod in seen_in_window:
-                    continue
-                seen_in_window.add(pod)
-                if pod in merged:
-                    merged[pod][0] += total
-                    merged[pod][1] = max(merged[pod][1], peak)
-                else:
-                    merged[pod] = [total, peak]
-        return [(pod, m[0], m[1]) for pod, m in merged.items()]
+        return self._merge_window_series(
+            windows,
+            init=lambda e: (e[1], e[2]),
+            fold=lambda s, e: (s[0] + e[1], max(s[1], e[2])),
+        )
 
     async def gather_fleet_digests(
         self,

@@ -393,7 +393,7 @@ class TestWidePodFanout:
 
 class TestRangeQuerySplitting:
     """Fine-grained long windows exceed Prometheus's 11,000-point-per-query
-    limit (7d @ 5s = 120,960 points); the loader must split the range into
+    limit (7d @ 5s = 120,961 grid points); the loader must split the range into
     grid-aligned sub-queries and merge per-pod results exactly."""
 
     def test_subwindows_tile_the_grid(self):
