@@ -54,6 +54,12 @@ class Config(pd.BaseModel):
     strategy: str = "simple"
     log_to_stderr: bool = False
 
+    # Kubernetes discovery
+    #: One pods request per namespace with client-side selector matching
+    #: (O(namespaces) apiserver calls); False = the reference's per-workload
+    #: server-side selector queries.
+    bulk_pod_discovery: bool = True
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

@@ -127,6 +127,17 @@ def _common_options() -> list[click.Option]:
             help="Max concurrent Prometheus range-query connections for the bulk fetch.",
         ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
+        PanelOption(
+            ["--bulk-pod-discovery"],
+            type=bool,
+            default=True,
+            show_default=True,
+            help=(
+                "Resolve workload pods from one pod listing per namespace with "
+                "client-side selector matching (O(namespaces) apiserver requests); "
+                "false = one server-side selector query per workload."
+            ),
+        ),
         PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
         PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
         PanelOption(["--formatter", "-f", "format"], default="table", show_default=True, help="Output formatter"),

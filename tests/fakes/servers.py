@@ -139,6 +139,7 @@ class FakeBackend:
     def __init__(self, cluster: FakeCluster, metrics: FakeMetrics):
         self.cluster = cluster
         self.metrics = metrics
+        self.pod_request_count = 0
 
     # ---------------------------------------------------------- k8s handlers
     async def _list(self, items: list[dict[str, Any]], namespace: Optional[str] = None) -> web.Response:
@@ -153,6 +154,7 @@ class FakeBackend:
         return handler
 
     async def list_pods(self, request: web.Request) -> web.Response:
+        self.pod_request_count += 1
         namespace = request.match_info["namespace"]
         selector = request.query.get("labelSelector")
         pods = [

@@ -484,3 +484,90 @@ class TestRangeQuerySplitting:
             assert fleet.cpu_counts[0].sum() == len(cpu)
         finally:
             server.stop()
+
+
+class TestSelectorMatching:
+    """Client-side LabelSelector evaluation must replicate the apiserver's
+    semantics exactly — in particular NotIn matching label-less pods."""
+
+    def test_match_labels(self):
+        from krr_tpu.integrations.kubernetes import match_selector
+
+        sel = {"matchLabels": {"app": "web", "tier": "frontend"}}
+        assert match_selector(sel, {"app": "web", "tier": "frontend", "extra": "x"})
+        assert not match_selector(sel, {"app": "web"})
+        assert not match_selector(sel, {"app": "web", "tier": "backend"})
+
+    def test_match_expressions_semantics(self):
+        from krr_tpu.integrations.kubernetes import match_selector
+
+        base = {"matchLabels": {}}
+        in_expr = {**base, "matchExpressions": [{"key": "env", "operator": "In", "values": ["prod", "stage"]}]}
+        assert match_selector(in_expr, {"env": "prod"})
+        assert not match_selector(in_expr, {"env": "dev"})
+        assert not match_selector(in_expr, {})  # In requires the key
+
+        notin = {**base, "matchExpressions": [{"key": "env", "operator": "NotIn", "values": ["prod"]}]}
+        assert match_selector(notin, {"env": "dev"})
+        assert match_selector(notin, {})  # missing key satisfies NotIn (k8s rule)
+        assert not match_selector(notin, {"env": "prod"})
+
+        exists = {**base, "matchExpressions": [{"key": "canary", "operator": "Exists"}]}
+        assert match_selector(exists, {"canary": "anything"})
+        assert not match_selector(exists, {})
+
+        dne = {**base, "matchExpressions": [{"key": "canary", "operator": "DoesNotExist"}]}
+        assert match_selector(dne, {})
+        assert not match_selector(dne, {"canary": "x"})
+
+    def test_empty_selector_owns_no_pods(self):
+        from krr_tpu.integrations.kubernetes import match_selector
+
+        assert not match_selector(None, {"a": "b"})
+        assert not match_selector({}, {"a": "b"})
+
+
+class TestBulkPodDiscovery:
+    """Bulk mode resolves the same pods as server-side selector queries with
+    O(namespaces) pod requests instead of O(workloads)."""
+
+    def _env(self, tmp_path_factory, workloads=30):
+        from tests.fakes.servers import FakeBackend
+
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        for i in range(workloads):
+            cluster.add_workload_with_pods("Deployment", f"wl-{i}", "default", pod_count=2)
+        backend = FakeBackend(cluster, metrics)
+        server = ServerThread(backend).start()
+        kubeconfig_path = tmp_path_factory.mktemp("kube-bulk") / "config"
+        kubeconfig_path.write_text(yaml.dump({
+            "current-context": "fake",
+            "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+            "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+            "users": [{"name": "fake", "user": {"token": "t"}}],
+        }))
+        return server, backend, str(kubeconfig_path)
+
+    def test_modes_agree_and_bulk_is_one_request(self, tmp_path_factory):
+        server, backend, kubeconfig = self._env(tmp_path_factory)
+        try:
+            def discover(bulk):
+                config = Config(kubeconfig=kubeconfig, prometheus_url=server.url,
+                                bulk_pod_discovery=bulk)
+                return asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+
+            bulk_objects = discover(True)
+            bulk_requests = backend.pod_request_count
+            backend.pod_request_count = 0
+            selector_objects = discover(False)
+            selector_requests = backend.pod_request_count
+
+            key = lambda o: (o.namespace, o.name, o.container)
+            assert {key(o): tuple(sorted(o.pods)) for o in bulk_objects} == {
+                key(o): tuple(sorted(o.pods)) for o in selector_objects
+            }
+            assert bulk_requests == 1  # one namespace -> one pods listing
+            assert selector_requests == 30  # one per workload
+        finally:
+            server.stop()
