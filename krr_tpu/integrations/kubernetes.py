@@ -120,9 +120,13 @@ class KubeApi:
                     )
         return self._client
 
-    async def get_json(self, path: str, **params: Any) -> dict[str, Any]:
+    async def get_json(
+        self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
+    ) -> dict[str, Any]:
         client = await self.client()
-        response = await client.get(path, params={k: v for k, v in params.items() if v is not None})
+        response = await client.get(
+            path, params={k: v for k, v in params.items() if v is not None}, headers=headers
+        )
         response.raise_for_status()
         return response.json()
 
@@ -157,13 +161,24 @@ class ClusterLoader:
                     self._api = KubeApi(credentials)
         return self._api
 
+    #: Ask the apiserver for metadata-only pod lists: bulk discovery needs
+    #: just (name, labels), and a PartialObjectMetadataList is an order of
+    #: magnitude smaller than full pod objects (spec/status/managedFields)
+    #: for large namespaces. Servers that don't support the transform (and
+    #: the test fakes) simply return the full list — same extraction either way.
+    _METADATA_ONLY = {
+        "Accept": "application/json;as=PartialObjectMetadataList;g=meta.k8s.io;v=v1,application/json"
+    }
+
     async def _namespace_pod_labels(self, namespace: str) -> list[tuple[str, dict[str, str]]]:
         """All (pod name, labels) in a namespace — ONE apiserver request,
         cached; the bulk-discovery backing store."""
         if namespace not in self._namespace_pods:
             async def fetch() -> list[tuple[str, dict[str, str]]]:
                 api = await self.api()
-                body = await api.get_json(f"/api/v1/namespaces/{namespace}/pods")
+                body = await api.get_json(
+                    f"/api/v1/namespaces/{namespace}/pods", headers=self._METADATA_ONLY
+                )
                 return [
                     (item["metadata"]["name"], item["metadata"].get("labels") or {})
                     for item in body.get("items", [])
@@ -234,10 +249,23 @@ class ClusterLoader:
             bodies = await asyncio.gather(
                 *[api.get_json(f"{group}/namespaces/{ns}/{plural}") for ns in self.config.namespaces]
             )
-        items = [item for body in bodies for item in body.get("items", [])]
+        items = [
+            item
+            for body in bodies
+            for item in body.get("items", [])
+            if self._namespace_included(item["metadata"]["namespace"])
+        ]
         self.logger.debug(f"Found {len(items)} {kind}s in {self.cluster or 'default'}")
         nested = await asyncio.gather(*[self._build_objects(kind, item) for item in items])
         return [obj for objs in nested for obj in objs]
+
+    def _namespace_included(self, namespace: str) -> bool:
+        """Filter BEFORE pod resolution: resolving pods for workloads that
+        are dropped afterwards would, in bulk mode, dump entire excluded
+        namespaces (kube-system is typically one of the largest)."""
+        if self.config.namespaces == "*":
+            return namespace != "kube-system"  # never scanned by default (reference behavior)
+        return namespace in self.config.namespaces
 
     async def list_scannable_objects(self) -> list[K8sObjectData]:
         self.logger.debug(f"Listing scannable objects in {self.cluster or 'default'}")
@@ -250,11 +278,9 @@ class ClusterLoader:
             self.logger.debug_exception()
             return []
 
-        objects = [obj for objs in per_kind for obj in objs]
-        if self.config.namespaces == "*":
-            # kube-system is never scanned by default (reference behavior).
-            return [obj for obj in objects if obj.namespace != "kube-system"]
-        return [obj for obj in objects if obj.namespace in self.config.namespaces]
+        # Namespace filtering already happened in _list_workloads (before pod
+        # resolution); this flatten is the whole remaining job.
+        return [obj for objs in per_kind for obj in objs]
 
     async def close(self) -> None:
         if self._api is not None:
