@@ -120,6 +120,10 @@ class KubeApi:
                     )
         return self._client
 
+    #: Page size for list requests — the apiserver streams huge collections
+    #: in chunks instead of one giant response (100k-pod namespaces exist).
+    LIST_PAGE_LIMIT = 5000
+
     async def get_json(
         self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
     ) -> dict[str, Any]:
@@ -129,6 +133,25 @@ class KubeApi:
         )
         response.raise_for_status()
         return response.json()
+
+    async def list_items(
+        self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
+    ) -> list[dict[str, Any]]:
+        """Paginated collection list: follows ``metadata.continue`` tokens with
+        ``limit`` pages so fleet-scale collections never arrive as one
+        unbounded response. Servers (and fakes) that ignore pagination return
+        everything with no continue token — one iteration, same result."""
+        items: list[dict[str, Any]] = []
+        continue_token: Optional[str] = None
+        while True:
+            body = await self.get_json(
+                path, headers=headers, limit=self.LIST_PAGE_LIMIT,
+                **{"continue": continue_token}, **params,
+            )
+            items.extend(body.get("items", []))
+            continue_token = (body.get("metadata") or {}).get("continue")
+            if not continue_token:
+                return items
 
     async def close(self) -> None:
         if self._client is not None:
@@ -176,12 +199,12 @@ class ClusterLoader:
         if namespace not in self._namespace_pods:
             async def fetch() -> list[tuple[str, dict[str, str]]]:
                 api = await self.api()
-                body = await api.get_json(
+                items = await api.list_items(
                     f"/api/v1/namespaces/{namespace}/pods", headers=self._METADATA_ONLY
                 )
                 return [
                     (item["metadata"]["name"], item["metadata"].get("labels") or {})
-                    for item in body.get("items", [])
+                    for item in items
                 ]
 
             self._namespace_pods[namespace] = asyncio.ensure_future(fetch())
@@ -194,10 +217,10 @@ class ClusterLoader:
         if key not in self._pod_cache:
             async def fetch() -> list[str]:
                 api = await self.api()
-                body = await api.get_json(
+                items = await api.list_items(
                     f"/api/v1/namespaces/{namespace}/pods", labelSelector=selector
                 )
-                return [item["metadata"]["name"] for item in body.get("items", [])]
+                return [item["metadata"]["name"] for item in items]
 
             self._pod_cache[key] = asyncio.ensure_future(fetch())
         return await self._pod_cache[key]
@@ -239,20 +262,20 @@ class ClusterLoader:
         self.logger.debug(f"Listing {kind}s in {self.cluster or 'default'}")
         api = await self.api()
         if self.config.namespaces == "*":
-            bodies = [await api.get_json(path)]
+            pages = [await api.list_items(path)]
         else:
             # Explicit namespace list → namespaced endpoints, so a scan scoped
             # to one namespace needs only namespace-level RBAC and doesn't pay
             # for cluster-wide listing (the reference always lists cluster-wide,
             # `kubernetes.py:108`, then filters).
             group, plural = path.rsplit("/", 1)
-            bodies = await asyncio.gather(
-                *[api.get_json(f"{group}/namespaces/{ns}/{plural}") for ns in self.config.namespaces]
+            pages = await asyncio.gather(
+                *[api.list_items(f"{group}/namespaces/{ns}/{plural}") for ns in self.config.namespaces]
             )
         items = [
             item
-            for body in bodies
-            for item in body.get("items", [])
+            for page in pages
+            for item in page
             if self._namespace_included(item["metadata"]["namespace"])
         ]
         self.logger.debug(f"Found {len(items)} {kind}s in {self.cluster or 'default'}")

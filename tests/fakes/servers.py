@@ -142,9 +142,21 @@ class FakeBackend:
         self.pod_request_count = 0
 
     # ---------------------------------------------------------- k8s handlers
-    async def _list(self, items: list[dict[str, Any]], namespace: Optional[str] = None) -> web.Response:
+    async def _list(
+        self,
+        items: list[dict[str, Any]],
+        namespace: Optional[str] = None,
+        request: Optional[web.Request] = None,
+    ) -> web.Response:
         if namespace is not None:
             items = [i for i in items if i["metadata"]["namespace"] == namespace]
+        # Apiserver-style chunked lists: honor limit/continue when sent.
+        if request is not None and request.query.get("limit"):
+            limit = int(request.query["limit"])
+            offset = int(request.query.get("continue") or 0)
+            page = items[offset : offset + limit]
+            metadata = {"continue": str(offset + limit)} if offset + limit < len(items) else {}
+            return web.json_response({"items": page, "metadata": metadata})
         return web.json_response({"items": items})
 
     def _workload_handler(self, attr: str):
@@ -162,7 +174,7 @@ class FakeBackend:
             if p["metadata"]["namespace"] == namespace
             and _matches_selector(p["metadata"].get("labels", {}), selector)
         ]
-        return await self._list(pods)
+        return await self._list(pods, request=request)
 
     async def list_services(self, request: web.Request) -> web.Response:
         selector = request.query.get("labelSelector")

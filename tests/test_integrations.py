@@ -571,3 +571,34 @@ class TestBulkPodDiscovery:
             assert selector_requests == 30  # one per workload
         finally:
             server.stop()
+
+
+class TestListPagination:
+    """Collection lists follow apiserver continue tokens — fleet-scale
+    namespaces never arrive as one unbounded response."""
+
+    def test_pod_listing_pages(self, tmp_path_factory, monkeypatch):
+        from krr_tpu.integrations.kubernetes import KubeApi
+        from tests.fakes.servers import FakeBackend
+
+        monkeypatch.setattr(KubeApi, "LIST_PAGE_LIMIT", 7)
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        cluster.add_workload_with_pods("Deployment", "paged", "default", pod_count=30)
+        backend = FakeBackend(cluster, metrics)
+        server = ServerThread(backend).start()
+        try:
+            kubeconfig_path = tmp_path_factory.mktemp("kube-page") / "config"
+            kubeconfig_path.write_text(yaml.dump({
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+                "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+                "users": [{"name": "fake", "user": {"token": "t"}}],
+            }))
+            config = Config(kubeconfig=str(kubeconfig_path), prometheus_url=server.url)
+            objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+            paged = [o for o in objects if o.name == "paged"]
+            assert paged and len(paged[0].pods) == 30  # all pages stitched
+            assert backend.pod_request_count == -(-30 // 7)  # ceil(30/7) pages
+        finally:
+            server.stop()
