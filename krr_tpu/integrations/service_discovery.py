@@ -39,7 +39,10 @@ class ServiceDiscovery:
         self.logger = logger
 
     async def find_service_url(self, label_selector: str) -> Optional[str]:
-        body: dict[str, Any] = await self.api.get_json("/api/v1/services", labelSelector=label_selector)
+        # Only the first match is used — bound the listing to one object.
+        body: dict[str, Any] = await self.api.get_json(
+            "/api/v1/services", labelSelector=label_selector, limit=1
+        )
         items = body.get("items", [])
         if not items:
             return None
@@ -55,7 +58,9 @@ class ServiceDiscovery:
     async def find_ingress_host(self, label_selector: str) -> Optional[str]:
         if self.inside_cluster:
             return None
-        body = await self.api.get_json("/apis/networking.k8s.io/v1/ingresses", labelSelector=label_selector)
+        body = await self.api.get_json(
+            "/apis/networking.k8s.io/v1/ingresses", labelSelector=label_selector, limit=1
+        )
         items = body.get("items", [])
         if not items:
             return None
