@@ -134,24 +134,47 @@ class KubeApi:
         response.raise_for_status()
         return response.json()
 
-    async def list_items(
-        self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
-    ) -> list[dict[str, Any]]:
-        """Paginated collection list: follows ``metadata.continue`` tokens with
-        ``limit`` pages so fleet-scale collections never arrive as one
-        unbounded response. Servers (and fakes) that ignore pagination return
-        everything with no continue token — one iteration, same result."""
-        items: list[dict[str, Any]] = []
+    async def _pages(self, path: str, headers: Optional[dict[str, str]], params: dict[str, Any]):
+        """Yield each ``limit``-sized page's items, following
+        ``metadata.continue`` tokens. Servers (and fakes) that ignore
+        pagination return everything with no continue token — one page.
+        ``params`` must not contain ``limit``/``continue`` — pagination owns
+        both (callers pass selectors and field filters only)."""
         continue_token: Optional[str] = None
         while True:
             body = await self.get_json(
                 path, headers=headers, limit=self.LIST_PAGE_LIMIT,
                 **{"continue": continue_token}, **params,
             )
-            items.extend(body.get("items", []))
+            # `or []`: the apiserver serializes an empty Go slice as
+            # `"items": null`, and a None page must not reach the consumers.
+            yield body.get("items") or []
             continue_token = (body.get("metadata") or {}).get("continue")
             if not continue_token:
-                return items
+                return
+
+    async def list_items(
+        self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
+    ) -> list[dict[str, Any]]:
+        """Paginated collection list, so fleet-scale collections never arrive
+        as one unbounded response."""
+        return [item async for page in self._pages(path, headers, params) for item in page]
+
+    async def first_item(
+        self, path: str, headers: Optional[dict[str, str]] = None, **params: Any
+    ) -> Optional[dict[str, Any]]:
+        """First object in a (possibly label-selected) collection.
+
+        The apiserver applies ``labelSelector`` AFTER reading the limit-sized
+        chunk from storage, so a selected listing's early pages can be empty
+        yet carry a ``metadata.continue`` token — ``limit=1`` on a selected
+        listing is a correctness bug, not an optimization. This follows the
+        tokens and stops at the first page that yields a match.
+        """
+        async for page in self._pages(path, headers, params):
+            if page:
+                return page[0]
+        return None
 
     async def close(self) -> None:
         if self._client is not None:

@@ -279,6 +279,16 @@ class PrometheusLoader:
         single-query behavior window-wise), then combines each pod's
         per-window entries: ``init(entry) -> state``,
         ``fold(state, entry) -> state``. Returns ``[(pod, *state), …]``.
+
+        Series identity across windows: every query here is
+        ``sum by (pod) (…)``, and a spec-compliant Prometheus cannot return
+        two series with the same ``pod`` value in one response (the output
+        label set IS the grouping set) — the first-series rule is purely
+        defensive. Against a non-compliant backend that does emit duplicates,
+        the per-window rule may combine samples from *different* duplicates
+        across windows, where a single unsplit query would have kept one
+        (round-2 advisor note); the parsers surface only the ``pod`` label,
+        so cross-window identity cannot be pinned any finer.
         """
         merged: dict = {}
         for window in windows:

@@ -10,7 +10,7 @@ SURVEY.md §2.15 — is not reproduced.)
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from krr_tpu.integrations.kubernetes import KubeApi
 from krr_tpu.utils.logging import KrrLogger, NULL_LOGGER
@@ -39,14 +39,12 @@ class ServiceDiscovery:
         self.logger = logger
 
     async def find_service_url(self, label_selector: str) -> Optional[str]:
-        # Only the first match is used — bound the listing to one object.
-        body: dict[str, Any] = await self.api.get_json(
-            "/api/v1/services", labelSelector=label_selector, limit=1
-        )
-        items = body.get("items", [])
-        if not items:
+        # Only the first match is used, but the listing must still page: the
+        # apiserver applies label selectors after chunking, so a small `limit`
+        # on a selected listing returns empty pages with continue tokens.
+        svc = await self.api.first_item("/api/v1/services", labelSelector=label_selector)
+        if svc is None:
             return None
-        svc = items[0]
         name = svc["metadata"]["name"]
         namespace = svc["metadata"]["namespace"]
         port = svc["spec"]["ports"][0]["port"]
@@ -58,13 +56,12 @@ class ServiceDiscovery:
     async def find_ingress_host(self, label_selector: str) -> Optional[str]:
         if self.inside_cluster:
             return None
-        body = await self.api.get_json(
-            "/apis/networking.k8s.io/v1/ingresses", labelSelector=label_selector, limit=1
+        ingress = await self.api.first_item(
+            "/apis/networking.k8s.io/v1/ingresses", labelSelector=label_selector
         )
-        items = body.get("items", [])
-        if not items:
+        if ingress is None:
             return None
-        host = items[0]["spec"]["rules"][0]["host"]
+        host = ingress["spec"]["rules"][0]["host"]
         return f"http://{host}"
 
     async def find_url(self, selectors: list[str]) -> Optional[str]:

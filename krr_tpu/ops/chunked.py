@@ -33,6 +33,31 @@ import numpy as np
 State = TypeVar("State")
 
 
+def dispatch_prefix_kernel(
+    kernel: Callable,
+    generic: Callable,
+    operands,
+    valid: jax.Array,
+    eff: jax.Array,
+    mask_is_prefix: bool,
+):
+    """Shared fold-dispatch for kernels that consume the validity mask as a
+    per-row prefix length (both sketch families' ``add_chunk``).
+
+    ``mask_is_prefix=True`` is the static promise the drivers in this module
+    make by construction — the kernel runs directly and the generic branch
+    stays out of the compiled program. Otherwise the promise is checked at
+    runtime (one fused pass over the mask, sharing the ``eff`` sum's read)
+    and non-prefix masks take ``generic`` — identical results either way.
+    """
+    if mask_is_prefix:
+        return kernel(operands)
+    is_prefix = jnp.all(
+        valid == (jnp.arange(valid.shape[1], dtype=jnp.int32)[None, :] < eff[:, None])
+    )
+    return jax.lax.cond(is_prefix, kernel, generic, operands)
+
+
 def scan_time_chunks(
     values: jax.Array,
     counts: jax.Array,

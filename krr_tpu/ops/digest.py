@@ -123,7 +123,7 @@ def _use_kernel(spec: DigestSpec, t: int, interpret: bool) -> bool:
     )
 
 
-@partial(jax.jit, static_argnames=("spec", "interpret", "use_kernel"))
+@partial(jax.jit, static_argnames=("spec", "interpret", "use_kernel", "mask_is_prefix"))
 def add_chunk(
     spec: DigestSpec,
     digest: Digest,
@@ -131,36 +131,57 @@ def add_chunk(
     valid: jax.Array,
     interpret: bool = False,
     use_kernel: bool = True,
+    mask_is_prefix: bool = False,
 ) -> Digest:
     """Fold one ``[N, Tc]`` time chunk (with validity mask) into the digest.
 
-    On TPU the histogram + chunk peak come from the Pallas matmul-histogram
-    kernel (`krr_tpu.ops.pallas_sketch.digest_hist`) — exact integer counts,
-    no sorts. The kernel consumes the mask as a per-row prefix length, which
-    every driver's mask is (`krr_tpu.ops.chunked`: valid positions are always
-    a leading run); the jnp sort-based histogram remains the generic path for
-    arbitrary masks and non-TPU backends. ``use_kernel=False`` forces the jnp
-    path — required when the operands are mesh-sharded under plain ``jit``
-    (a ``pallas_call`` has no partitioning rule there; inside ``shard_map``,
-    where operands are device-local, the kernel path is fine).
+    ``valid`` may be ANY boolean mask. On TPU the histogram + chunk peak come
+    from the Pallas matmul-histogram kernel
+    (`krr_tpu.ops.pallas_sketch.digest_hist`) — exact integer counts, no
+    sorts — but the kernel consumes the mask as a per-row prefix length, so
+    it is gated on a runtime mask-is-prefix check (which fuses with the
+    mask-sum it needs anyway); non-prefix masks take the generic jnp
+    sort-based histogram with identical results. Internal drivers whose mask
+    is a prefix by construction (`krr_tpu.ops.chunked`: valid positions are a
+    leading run) pass the static ``mask_is_prefix=True`` promise, which skips
+    the runtime check AND keeps the generic branch out of the compiled
+    program — hot scan bodies don't carry a dead two-sort histogram.
+    ``use_kernel=False`` forces the jnp path — required when the operands are
+    mesh-sharded under plain ``jit`` (a ``pallas_call`` has no partitioning
+    rule there; inside ``shard_map``, where operands are device-local, the
+    kernel path is fine).
     """
+
+    def generic(operands: tuple[Digest, jax.Array, jax.Array]) -> Digest:
+        digest, values, valid = operands
+        idx = bucketize(spec, values)
+        counts = digest.counts + _histogram(spec, idx, valid)
+        total = digest.total + jnp.sum(valid, axis=1).astype(jnp.float32)
+        peak = jnp.maximum(digest.peak, jnp.max(jnp.where(valid, values, -jnp.inf), axis=1))
+        return Digest(counts=counts, total=total, peak=peak)
+
     if use_kernel and values.shape[0] and _use_kernel(spec, values.shape[1], interpret):
         from krr_tpu.ops import pallas_sketch
 
         eff = jnp.sum(valid, axis=1, dtype=jnp.int32)
-        hist, chunk_peak = pallas_sketch.digest_hist(
-            values, eff, spec.num_buckets, spec.min_value, spec.log_gamma, interpret=interpret
+
+        def kernel(operands: tuple[Digest, jax.Array, jax.Array]) -> Digest:
+            digest, values, _ = operands
+            hist, chunk_peak = pallas_sketch.digest_hist(
+                values, eff, spec.num_buckets, spec.min_value, spec.log_gamma, interpret=interpret
+            )
+            return Digest(
+                counts=digest.counts + hist,
+                total=digest.total + eff.astype(jnp.float32),
+                peak=jnp.maximum(digest.peak, chunk_peak),
+            )
+
+        from krr_tpu.ops.chunked import dispatch_prefix_kernel
+
+        return dispatch_prefix_kernel(
+            kernel, generic, (digest, values, valid), valid, eff, mask_is_prefix
         )
-        return Digest(
-            counts=digest.counts + hist,
-            total=digest.total + eff.astype(jnp.float32),
-            peak=jnp.maximum(digest.peak, chunk_peak),
-        )
-    idx = bucketize(spec, values)
-    counts = digest.counts + _histogram(spec, idx, valid)
-    total = digest.total + jnp.sum(valid, axis=1).astype(jnp.float32)
-    peak = jnp.maximum(digest.peak, jnp.max(jnp.where(valid, values, -jnp.inf), axis=1))
-    return Digest(counts=counts, total=total, peak=peak)
+    return generic((digest, values, valid))
 
 
 def merge(a: Digest, b: Digest) -> Digest:
@@ -266,7 +287,7 @@ def build_from_packed(
         values,
         counts,
         empty(spec, n),
-        lambda digest, chunk, valid: add_chunk(spec, digest, chunk, valid),
+        lambda digest, chunk, valid: add_chunk(spec, digest, chunk, valid, mask_is_prefix=True),
         chunk_size,
         time_offset,
     )
@@ -294,7 +315,7 @@ def build_from_host(
         counts,
         empty(spec, values.shape[0]),
         lambda digest, chunk, valid: add_chunk(
-            spec, digest, chunk, valid, use_kernel=sharding is None
+            spec, digest, chunk, valid, use_kernel=sharding is None, mask_is_prefix=True
         ),
         chunk_size,
         time_offset,

@@ -87,34 +87,54 @@ def add_chunk(
     valid: jax.Array,
     interpret: bool = False,
     use_kernel: bool = True,
+    mask_is_prefix: bool = False,
 ) -> TopKSketch:
     """Fold one ``[N, Tc]`` time chunk (with validity mask) into the sketch.
 
-    On TPU the fold is the sort-free Pallas kernel (state and chunk are two
-    premasked parts of one bisect+compact pass); it consumes the mask as a
-    per-row prefix length, which every driver's mask is
-    (`krr_tpu.ops.chunked`). The jnp path is one ``top_k(concat)``.
-    ``use_kernel=False`` forces the jnp path — required when operands are
-    mesh-sharded under plain ``jit`` (no partitioning rule for a
-    ``pallas_call`` there; inside ``shard_map`` the kernel path is fine).
+    ``valid`` may be ANY boolean mask. On TPU the fold is the sort-free
+    Pallas kernel (state and chunk are two premasked parts of one
+    bisect+compact pass); the kernel consumes the mask as a per-row prefix
+    length, so it is gated on a runtime mask-is-prefix check (fused with the
+    mask-sum it needs anyway) and non-prefix masks take the jnp
+    ``top_k(concat)`` path — same multiset either way. Internal drivers
+    whose mask is a prefix by construction (`krr_tpu.ops.chunked`) pass the
+    static ``mask_is_prefix=True`` promise, skipping the runtime check and
+    keeping the jnp branch out of the compiled program. ``use_kernel=False``
+    forces the jnp path — required when operands are mesh-sharded under
+    plain ``jit`` (no partitioning rule for a ``pallas_call`` there; inside
+    ``shard_map`` the kernel path is fine).
     """
     n, k = sketch.values.shape
+
+    def generic(operands: "tuple[TopKSketch, jax.Array, jax.Array]") -> TopKSketch:
+        sketch, values, valid = operands
+        masked = jnp.where(valid, values, -jnp.inf)
+        top, _ = jax.lax.top_k(jnp.concatenate([sketch.values, masked], axis=1), k)
+        return TopKSketch(values=top, total=sketch.total + jnp.sum(valid, axis=1).astype(jnp.float32))
+
     if use_kernel and n and _use_kernel(k, values.shape[1], k, interpret):
         from krr_tpu.ops import pallas_sketch
 
         eff = jnp.sum(valid, axis=1, dtype=jnp.int32)
-        new_values = pallas_sketch.topk_select(
-            values,
-            eff,
-            k,
-            state=sketch.values,
-            state_counts=_valid_slots(sketch),
-            interpret=interpret,
+
+        def kernel(operands: "tuple[TopKSketch, jax.Array, jax.Array]") -> TopKSketch:
+            sketch, values, _ = operands
+            new_values = pallas_sketch.topk_select(
+                values,
+                eff,
+                k,
+                state=sketch.values,
+                state_counts=_valid_slots(sketch),
+                interpret=interpret,
+            )
+            return TopKSketch(values=new_values, total=sketch.total + eff.astype(jnp.float32))
+
+        from krr_tpu.ops.chunked import dispatch_prefix_kernel
+
+        return dispatch_prefix_kernel(
+            kernel, generic, (sketch, values, valid), valid, eff, mask_is_prefix
         )
-        return TopKSketch(values=new_values, total=sketch.total + eff.astype(jnp.float32))
-    masked = jnp.where(valid, values, -jnp.inf)
-    top, _ = jax.lax.top_k(jnp.concatenate([sketch.values, masked], axis=1), k)
-    return TopKSketch(values=top, total=sketch.total + jnp.sum(valid, axis=1).astype(jnp.float32))
+    return generic((sketch, values, valid))
 
 
 def merge(a: TopKSketch, b: TopKSketch) -> TopKSketch:
@@ -191,7 +211,11 @@ def build_from_packed(
         eff = jnp.clip(counts.astype(jnp.int32) - jnp.int32(time_offset), 0, t)
         state = pallas_sketch.topk_select(values, eff, k, interpret=interpret)
         return TopKSketch(values=state, total=eff.astype(jnp.float32))
-    return scan_time_chunks(values, counts, empty(n, k), add_chunk, chunk_size, time_offset)
+    return scan_time_chunks(
+        values, counts, empty(n, k),
+        lambda sketch, chunk, valid: add_chunk(sketch, chunk, valid, mask_is_prefix=True),
+        chunk_size, time_offset,
+    )
 
 
 def build_from_host(
@@ -214,7 +238,7 @@ def build_from_host(
         counts,
         empty(values.shape[0], k),
         lambda sketch, chunk, valid: add_chunk(
-            sketch, chunk, valid, use_kernel=sharding is None
+            sketch, chunk, valid, use_kernel=sharding is None, mask_is_prefix=True
         ),
         chunk_size,
         time_offset,

@@ -147,17 +147,26 @@ class FakeBackend:
         items: list[dict[str, Any]],
         namespace: Optional[str] = None,
         request: Optional[web.Request] = None,
+        selector: Optional[str] = None,
     ) -> web.Response:
         if namespace is not None:
             items = [i for i in items if i["metadata"]["namespace"] == namespace]
-        # Apiserver-style chunked lists: honor limit/continue when sent.
+        # Apiserver-style chunked lists: the limit-sized chunk is read from
+        # "storage" FIRST and the label selector is applied to the chunk
+        # AFTER, exactly like the real apiserver — so a selected listing can
+        # return an empty page that still carries a continue token. (Round-2
+        # advisor finding: filtering before paginating here hid a real
+        # `limit=1 + labelSelector` bug in service discovery.)
         if request is not None and request.query.get("limit"):
             limit = int(request.query["limit"])
             offset = int(request.query.get("continue") or 0)
             page = items[offset : offset + limit]
             metadata = {"continue": str(offset + limit)} if offset + limit < len(items) else {}
-            return web.json_response({"items": page, "metadata": metadata})
-        return web.json_response({"items": items})
+        else:  # no limit sent: the whole collection is one page
+            page, metadata = items, {}
+        if selector is not None:
+            page = [p for p in page if _matches_selector(p["metadata"].get("labels", {}), selector)]
+        return web.json_response({"items": page, "metadata": metadata})
 
     def _workload_handler(self, attr: str):
         async def handler(request: web.Request) -> web.Response:
@@ -168,29 +177,18 @@ class FakeBackend:
     async def list_pods(self, request: web.Request) -> web.Response:
         self.pod_request_count += 1
         namespace = request.match_info["namespace"]
-        selector = request.query.get("labelSelector")
-        pods = [
-            p for p in self.cluster.pods
-            if p["metadata"]["namespace"] == namespace
-            and _matches_selector(p["metadata"].get("labels", {}), selector)
-        ]
-        return await self._list(pods, request=request)
+        pods = [p for p in self.cluster.pods if p["metadata"]["namespace"] == namespace]
+        return await self._list(pods, request=request, selector=request.query.get("labelSelector"))
 
     async def list_services(self, request: web.Request) -> web.Response:
-        selector = request.query.get("labelSelector")
-        items = [
-            s for s in self.cluster.services
-            if _matches_selector(s["metadata"].get("labels", {}), selector)
-        ]
-        return await self._list(items)
+        return await self._list(
+            self.cluster.services, request=request, selector=request.query.get("labelSelector")
+        )
 
     async def list_ingresses(self, request: web.Request) -> web.Response:
-        selector = request.query.get("labelSelector")
-        items = [
-            s for s in self.cluster.ingresses
-            if _matches_selector(s["metadata"].get("labels", {}), selector)
-        ]
-        return await self._list(items)
+        return await self._list(
+            self.cluster.ingresses, request=request, selector=request.query.get("labelSelector")
+        )
 
     # --------------------------------------------------------- prom handlers
     async def query(self, request: web.Request) -> web.Response:

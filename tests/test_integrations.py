@@ -321,6 +321,47 @@ class TestIngressFallback:
             fake_env["cluster"].ingresses.clear()
 
 
+class TestSelectedListingPagination:
+    def test_match_beyond_first_chunk(self, fake_env, monkeypatch):
+        """The apiserver applies labelSelector AFTER the limit-sized chunk, so
+        a selected listing's first pages can be empty with a continue token;
+        discovery must follow the tokens (round-2 advisor finding — the old
+        ``limit=1`` listing returned None whenever the match wasn't the very
+        first object in storage)."""
+        from krr_tpu.integrations.kubeconfig import KubeConfig
+        from krr_tpu.integrations.kubernetes import KubeApi
+        from krr_tpu.integrations.service_discovery import ServiceDiscovery
+
+        monkeypatch.setattr(KubeApi, "LIST_PAGE_LIMIT", 2)
+        decoys = [
+            {"metadata": {"name": f"decoy-{i}", "namespace": "default",
+                          "labels": {"app": "unrelated"}},
+             "spec": {"ports": [{"port": 80}]}}
+            for i in range(5)
+        ]
+        target = {"metadata": {"name": "prom", "namespace": "monitoring",
+                               "labels": {"app": "prometheus-server"}},
+                  "spec": {"ports": [{"port": 9090}]}}
+        saved = fake_env["cluster"].services[:]
+        fake_env["cluster"].services[:] = decoys + [target]
+        creds = KubeConfig.load(fake_env["kubeconfig"]).credentials_for("fake")
+
+        async def run():
+            api = KubeApi(creds)
+            try:
+                disco = ServiceDiscovery(api, inside_cluster=True)
+                disco.cache.clear()
+                return await disco.find_url(["app=prometheus-server"])
+            finally:
+                await api.close()
+
+        try:
+            url = asyncio.run(run())
+        finally:
+            fake_env["cluster"].services[:] = saved
+        assert url == "http://prom.monitoring.svc.cluster.local:9090"
+
+
 class TestInClusterCredentials:
     def test_service_account_mount(self, tmp_path, monkeypatch):
         from krr_tpu.integrations import kubeconfig as kc

@@ -485,6 +485,33 @@ class TestPallasSketchKernels:
         np.testing.assert_array_equal(np.asarray(folded.peak), np.asarray(want.peak))
         np.testing.assert_array_equal(np.asarray(folded.total), np.asarray(want.total))
 
+    def test_fold_non_prefix_mask_matches_generic_path(self, rng):
+        """The kernel fold reads the mask as a per-row prefix length; an
+        arbitrary scattered mask (public API) must fall back to the generic
+        path instead of silently mis-counting (round-2 advisor finding)."""
+        import jax.numpy as jnp
+
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        spec = DigestSpec()
+        values, _ = self._fleet(rng, n=16, t=384)
+        scattered = jnp.asarray(rng.random((16, 384)) < 0.5)
+
+        base = digest_ops.empty(spec, 16)
+        got = digest_ops.add_chunk(spec, base, jnp.asarray(values), scattered, interpret=True)
+        want = digest_ops.add_chunk(spec, base, jnp.asarray(values), scattered, use_kernel=False)
+        np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+        np.testing.assert_array_equal(np.asarray(got.total), np.asarray(want.total))
+        np.testing.assert_array_equal(np.asarray(got.peak), np.asarray(want.peak))
+
+        base_t = topk_ops.empty(16, 128)
+        got_t = topk_ops.add_chunk(base_t, jnp.asarray(values), scattered, interpret=True)
+        want_t = topk_ops.add_chunk(base_t, jnp.asarray(values), scattered, use_kernel=False)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(got_t.values), axis=1), np.sort(np.asarray(want_t.values), axis=1)
+        )
+        np.testing.assert_array_equal(np.asarray(got_t.total), np.asarray(want_t.total))
+
     def _topk_reference(self, values, counts, k):
         masked = np.where(np.arange(values.shape[1])[None, :] < counts[:, None], values, -np.inf)
         return -np.sort(-masked, axis=1)[:, :k]
