@@ -60,6 +60,14 @@ class Config(pd.BaseModel):
     #: server-side selector queries.
     bulk_pod_discovery: bool = True
 
+    #: One Prometheus range query per (namespace, resource) with client-side
+    #: (pod, container) routing — O(namespaces) round trips; False = one query
+    #: per (workload, resource). A failed batched query falls back to the
+    #: per-workload path for its namespace automatically, so this flag exists
+    #: for backends where namespace-sized responses are pathological (huge
+    #: mono-namespace fleets behind a slow proxy).
+    batched_fleet_queries: bool = True
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

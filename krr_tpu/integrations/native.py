@@ -4,7 +4,10 @@ Loads ``libfastsamples.so``, building it with g++ on first use if missing
 (cached next to the source; falls back silently to the pure-Python parser when
 no compiler is available — the native path is an optimization, not a
 requirement). ``parse_matrix`` has the same contract as the Python fallback:
-response bytes → list of (pod_name, float64 samples).
+response bytes → list of ((pod, container), float64 samples). The key is the
+series' ``pod``/``container`` label pair — either component is ``""`` when the
+query's grouping omits that label (per-workload queries group by pod only;
+namespace-batched queries group by both).
 """
 
 from __future__ import annotations
@@ -110,7 +113,12 @@ def _load_library() -> Optional[ctypes.CDLL]:
     return _lib
 
 
-def parse_matrix_python(body: bytes) -> list[tuple[str, np.ndarray]]:
+#: Series identity: the (pod, container) label pair. Either component is ""
+#: when the query's grouping omits that label.
+SeriesKey = tuple[str, str]
+
+
+def parse_matrix_python(body: bytes) -> list[tuple[SeriesKey, np.ndarray]]:
     """Reference implementation: json.loads + per-sample float().
 
     Raises on a non-success or shape-less payload (e.g. a proxy answering 200
@@ -125,24 +133,37 @@ def parse_matrix_python(body: bytes) -> list[tuple[str, np.ndarray]]:
     result = payload["data"]["result"]
     series = []
     for entry in result:
-        pod = entry.get("metric", {}).get("pod", "")
+        metric = entry.get("metric", {})
+        key = (metric.get("pod", ""), metric.get("container", ""))
         values = entry.get("values") or []
         samples = np.asarray([float(v) for _, v in values], dtype=np.float64)
         # Stale markers ("NaN") / division artifacts ("+Inf") carry no usage
         # information and would poison max/percentile reductions — drop them
         # (same rule as the native parser).
-        series.append((pod, samples[np.isfinite(samples)]))
+        series.append((key, samples[np.isfinite(samples)]))
     return series
 
 
 def _names_cap(body: bytes, series_count: int) -> int:
-    """Name-buffer size: series × (k8s name limit 253 + '\\n'), never more than
-    the response itself. If an exotic label still overflows, the native parser
-    returns -1 and the caller falls back to Python — never truncation."""
-    return max(4096, min(len(body), series_count * 256))
+    """Name-buffer size: series × (2 × k8s name limit 253 + '\\t' + '\\n'),
+    never more than the response itself. If an exotic label still overflows,
+    the native parser returns -1 and the caller falls back to Python — never
+    truncation."""
+    return max(4096, min(len(body), series_count * 512))
 
 
-def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
+def _split_keys(names_value: bytes, n: int) -> list[SeriesKey]:
+    """Decode the native names buffer: '\\n'-joined "pod\\tcontainer" records."""
+    if not n:
+        return []
+    keys = []
+    for record in names_value.decode("utf-8", errors="replace").split("\n")[:n]:
+        pod, _, container = record.partition("\t")
+        keys.append((pod, container))
+    return keys
+
+
+def parse_matrix_native(body: bytes) -> Optional[list[tuple[SeriesKey, np.ndarray]]]:
     """Native parse; None when the library is unavailable or reports malformed
     input (caller falls back to Python)."""
     lib = _load_library()
@@ -173,17 +194,17 @@ def parse_matrix_native(body: bytes) -> Optional[list[tuple[str, np.ndarray]]]:
     )
     if n < 0:
         return None
-    pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
+    keys = _split_keys(names.value, n)
     series = []
     offset = 0
     for i in range(n):
         length = int(lens[i])
-        series.append((pods[i], values[offset : offset + length].copy()))
+        series.append((keys[i], values[offset : offset + length].copy()))
         offset += length
     return series
 
 
-def parse_matrix(body: bytes) -> list[tuple[str, np.ndarray]]:
+def parse_matrix(body: bytes) -> list[tuple[SeriesKey, np.ndarray]]:
     """Parse a query_range matrix response: native when possible, Python otherwise."""
     # Error payloads route through the Python parser, which raises with the
     # server's error message (the native scanner only understands matrices).
@@ -194,9 +215,9 @@ def parse_matrix(body: bytes) -> list[tuple[str, np.ndarray]]:
     return parse_matrix_python(body)
 
 
-#: Result of a fused parse+digest pass: per-series (pod, bucket counts,
+#: Result of a fused parse+digest pass: per-series (series key, bucket counts,
 #: total sample count, exact max).
-DigestedSeries = list[tuple[str, np.ndarray, float, float]]
+DigestedSeries = list[tuple[SeriesKey, np.ndarray, float, float]]
 
 
 def _digest_python(samples: np.ndarray, gamma: float, min_value: float, num_buckets: int):
@@ -250,16 +271,17 @@ def parse_matrix_digest(
                 names_cap,
             )
             if n >= 0:
-                pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
-                return [(pods[i], counts[i].copy(), float(totals[i]), float(peaks[i])) for i in range(n)]
+                keys = _split_keys(names.value, n)
+                return [(keys[i], counts[i].copy(), float(totals[i]), float(peaks[i])) for i in range(n)]
     return [
-        (pod, *_digest_python(samples, gamma, min_value, num_buckets))
-        for pod, samples in parse_matrix(body)
+        (key, *_digest_python(samples, gamma, min_value, num_buckets))
+        for key, samples in parse_matrix(body)
     ]
 
 
-#: Result of a stats-only parse: per-series (pod, total sample count, exact max).
-SeriesStats = list[tuple[str, float, float]]
+#: Result of a stats-only parse: per-series (series key, total sample count,
+#: exact max).
+SeriesStats = list[tuple[SeriesKey, float, float]]
 
 
 def parse_matrix_stats(body: bytes) -> SeriesStats:
@@ -283,9 +305,9 @@ def parse_matrix_stats(body: bytes) -> SeriesStats:
                 names_cap,
             )
             if n >= 0:
-                pods = names.value.decode("utf-8", errors="replace").split("\n")[:n] if n else []
-                return [(pods[i], float(totals[i]), float(peaks[i])) for i in range(n)]
+                keys = _split_keys(names.value, n)
+                return [(keys[i], float(totals[i]), float(peaks[i])) for i in range(n)]
     return [
-        (pod, float(samples.size), float(samples.max()) if samples.size else float("-inf"))
-        for pod, samples in parse_matrix(body)
+        (key, float(samples.size), float(samples.max()) if samples.size else float("-inf"))
+        for key, samples in parse_matrix(body)
     ]

@@ -5,10 +5,14 @@ per object through a thread pool and converts every sample to Decimal in
 Python (`/root/reference/robusta_krr/core/integrations/prometheus.py:108-155`)
 — the hot loop SURVEY.md §3.2 flags. This loader replaces it with:
 
-* one ``query_range`` per (object, resource), aggregated ``by (pod)`` over a
-  pod-name regex — O(pods) fewer HTTP round-trips with identical per-pod
-  series (the reference's ``sum(...)`` per pod == our ``sum by (pod)(...)``
-  row for that pod);
+* ONE ``query_range`` per (namespace, resource), aggregated
+  ``by (pod, container)``, with series routed back to workloads client-side
+  via the pod→workload mapping discovery already built — O(namespaces) HTTP
+  round-trips instead of O(workloads × pods) (the reference) or O(workloads)
+  (the per-workload fallback path, kept for backends that choke on
+  namespace-sized responses: ``--batched-fleet-queries false``). The
+  reference's ``sum(...)`` per pod == our ``sum by (pod, container)(...)``
+  row for that (pod, container);
 * a bounded async fan-out (``prometheus_max_connections``) with retry +
   exponential backoff (the reference has retries only at the urllib3 adapter
   level, no backoff policy — SURVEY.md §5);
@@ -67,6 +71,30 @@ def memory_query(namespace: str, pod_regex: str, container: str) -> str:
 
 
 QUERY_BUILDERS = {ResourceType.CPU: cpu_query, ResourceType.Memory: memory_query}
+
+
+def cpu_namespace_query(namespace: str) -> str:
+    # The reference's CPU query (`prometheus.py:123`) lifted one aggregation
+    # level: one request covers EVERY workload in the namespace; rows route
+    # back to workloads client-side by their (pod, container) labels.
+    return (
+        "sum by (pod, container) (node_namespace_pod_container:container_cpu_usage_seconds_total:sum_irate"
+        f'{{namespace="{namespace}"}})'
+    )
+
+
+def memory_namespace_query(namespace: str) -> str:
+    # Reference memory query (`prometheus.py:136`), namespace-batched.
+    return (
+        'sum by (pod, container) (container_memory_working_set_bytes{job="kubelet", '
+        f'metrics_path="/metrics/cadvisor", image!="", namespace="{namespace}"}})'
+    )
+
+
+NAMESPACE_QUERY_BUILDERS = {
+    ResourceType.CPU: cpu_namespace_query,
+    ResourceType.Memory: memory_namespace_query,
+}
 
 
 def effective_step_seconds(step_seconds: float) -> int:
@@ -273,41 +301,42 @@ class PrometheusLoader:
 
     @staticmethod
     def _merge_window_series(windows: "list[list]", init, fold) -> "list[tuple]":
-        """Shared per-pod fold across split sub-windows.
+        """Shared per-series fold across split sub-windows.
 
-        Applies the first-series-per-pod rule *per window* (matching the
-        single-query behavior window-wise), then combines each pod's
+        Applies the first-series-per-key rule *per window* (matching the
+        single-query behavior window-wise), then combines each key's
         per-window entries: ``init(entry) -> state``,
-        ``fold(state, entry) -> state``. Returns ``[(pod, *state), …]``.
+        ``fold(state, entry) -> state``. Returns ``[(key, *state), …]``.
 
-        Series identity across windows: every query here is
-        ``sum by (pod) (…)``, and a spec-compliant Prometheus cannot return
-        two series with the same ``pod`` value in one response (the output
-        label set IS the grouping set) — the first-series rule is purely
-        defensive. Against a non-compliant backend that does emit duplicates,
-        the per-window rule may combine samples from *different* duplicates
-        across windows, where a single unsplit query would have kept one
-        (round-2 advisor note); the parsers surface only the ``pod`` label,
-        so cross-window identity cannot be pinned any finer.
+        Series identity across windows: the key is the (pod, container) label
+        pair — exactly the query's grouping set (``sum by (pod, container)``
+        batched, ``sum by (pod)`` per-workload, container ""), and a
+        spec-compliant Prometheus cannot return two series with the same
+        grouping-label values in one response — the first-series rule is
+        purely defensive. Against a non-compliant backend that does emit
+        duplicates, the per-window rule may combine samples from *different*
+        duplicates across windows, where a single unsplit query would have
+        kept one (round-2 advisor note); the parsers surface only the
+        grouping labels, so cross-window identity cannot be pinned any finer.
         """
         merged: dict = {}
         for window in windows:
-            seen_in_window: set[str] = set()
+            seen_in_window: set = set()
             for entry in window:
-                pod = entry[0]
-                if pod in seen_in_window:
+                key = entry[0]
+                if key in seen_in_window:
                     continue
-                seen_in_window.add(pod)
-                merged[pod] = fold(merged[pod], entry) if pod in merged else init(entry)
-        return [(pod, *state) for pod, state in merged.items()]
+                seen_in_window.add(key)
+                merged[key] = fold(merged[key], entry) if key in merged else init(entry)
+        return [(key, *state) for key, state in merged.items()]
 
     async def _query_range(
         self, query: str, start: float, end: float, step_seconds: float
-    ) -> list[tuple[str, np.ndarray]]:
-        """Range query → parsed (pod, samples) series via the native matrix
-        parser (`krr_tpu.integrations.native`, pure-Python fallback); long
-        fine-grained ranges split into sub-queries whose per-pod series
-        concatenate in time order."""
+    ) -> "list[tuple[tuple[str, str], np.ndarray]]":
+        """Range query → parsed ((pod, container), samples) series via the
+        native matrix parser (`krr_tpu.integrations.native`, pure-Python
+        fallback); long fine-grained ranges split into sub-queries whose
+        per-series samples concatenate in time order."""
         from krr_tpu.integrations.native import parse_matrix
 
         windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix)
@@ -318,7 +347,79 @@ class PrometheusLoader:
             init=lambda e: ([e[1]],),
             fold=lambda state, e: (state[0] + [e[1]],),
         )
-        return [(pod, np.concatenate(parts)) for pod, parts in merged]
+        return [(key, np.concatenate(parts)) for key, parts in merged]
+
+    # -------------------------------------------------------- query routing
+    @staticmethod
+    def _series_route(
+        objects: list[K8sObjectData], indices: list[int]
+    ) -> dict[tuple[str, str], list[int]]:
+        """(pod, container) → object indices, for routing a namespace-batched
+        response's rows back to workloads. A pod can route to multiple objects
+        when workload selectors overlap — each gets the series, matching what
+        per-workload queries would have returned. Series whose key routes
+        nowhere (bare pods, unscanned workloads) are dropped."""
+        route: dict[tuple[str, str], list[int]] = {}
+        for i in indices:
+            obj = objects[i]
+            for pod in obj.pods:
+                route.setdefault((pod, obj.container), []).append(i)
+        return route
+
+    @staticmethod
+    def _by_namespace(objects: list[K8sObjectData]) -> dict[str, list[int]]:
+        by_namespace: dict[str, list[int]] = {}
+        for i, obj in enumerate(objects):
+            if obj.pods:
+                by_namespace.setdefault(obj.namespace, []).append(i)
+        return by_namespace
+
+    def _route_series(self, objects, indices: list[int], series, merge) -> None:
+        """Deliver a batched response's rows to their objects. First series
+        per (pod, container) wins (callers pre-filter empty series, so the
+        defensive dedup matches the per-workload "first series with samples"
+        rule); ``merge(object_index, key, *payload)`` folds one row in."""
+        route = self._series_route(objects, indices)
+        seen: set[tuple[str, str]] = set()
+        for key, *payload in series:
+            if key in seen:
+                continue
+            seen.add(key)
+            for i in route.get(key, ()):
+                merge(i, key, *payload)
+
+    async def _fan_out(self, objects: list[K8sObjectData], per_workload, per_namespace) -> None:
+        """Shared fetch orchestration for both ingest forms: one batched query
+        per (namespace, resource) with automatic per-workload fallback when a
+        batched query fails (backends that reject or truncate namespace-sized
+        responses); ``--batched-fleet-queries false`` forces per-workload."""
+
+        async def one_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+            try:
+                await per_namespace(namespace, indices, resource)
+            except Exception as e:
+                self.logger.warning(
+                    f"Batched {resource} query failed for namespace {namespace}: {e} — "
+                    f"falling back to per-workload queries for {len(indices)} objects"
+                )
+                await asyncio.gather(*[per_workload(i, objects[i], resource) for i in indices])
+
+        if self.config.batched_fleet_queries:
+            await asyncio.gather(
+                *[
+                    one_namespace(namespace, indices, resource)
+                    for namespace, indices in self._by_namespace(objects).items()
+                    for resource in ResourceType
+                ]
+            )
+        else:
+            await asyncio.gather(
+                *[
+                    per_workload(i, obj, resource)
+                    for i, obj in enumerate(objects)
+                    for resource in ResourceType
+                ]
+            )
 
     async def gather_fleet(
         self,
@@ -327,11 +428,16 @@ class PrometheusLoader:
         step_seconds: float,
         end_time: Optional[float] = None,
     ) -> dict[ResourceType, list[RaggedHistory]]:
-        """Fetch per-pod series for every (object, resource) concurrently.
+        """Fetch per-pod series for the whole fleet.
 
-        Objects whose queries fail after retries degrade to empty histories
-        (→ UNKNOWN scans) rather than failing the run. ``end_time`` pins the
-        window's right edge (reproducible scans; defaults to now).
+        Default: ONE namespace-batched query per (namespace, resource) with
+        client-side routing — the same O(workloads) → O(namespaces) collapse
+        bulk pod discovery applies on the apiserver side. A failed batched
+        query falls back to per-workload queries for that namespace (backends
+        that reject or truncate namespace-sized responses); objects whose
+        queries still fail degrade to empty histories (→ UNKNOWN scans) rather
+        than failing the run. ``end_time`` pins the window's right edge
+        (reproducible scans; defaults to now).
         """
         await self._ensure_connected()
         end = datetime.datetime.now().timestamp() if end_time is None else end_time
@@ -341,7 +447,7 @@ class PrometheusLoader:
             resource: [{} for _ in objects] for resource in ResourceType
         }
 
-        async def fetch_one(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
+        async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
             if not obj.pods:
                 return
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
@@ -353,16 +459,24 @@ class PrometheusLoader:
                 return
             wanted = set(obj.pods)
             history: RaggedHistory = {}
-            for pod, samples in series:
+            for (pod, _container), samples in series:
                 # Keep only the first series per pod; drop pods without
                 # samples (reference `prometheus.py:152-154`).
                 if pod in wanted and samples.size and pod not in history:
                     history[pod] = samples
             histories[resource][i] = history
 
-        await asyncio.gather(
-            *[fetch_one(i, obj, resource) for i, obj in enumerate(objects) for resource in ResourceType]
-        )
+        async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+            query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
+            series = await self._query_range(query, start, end, step_seconds)
+            self._route_series(
+                objects,
+                indices,
+                [(key, samples) for key, samples in series if samples.size],
+                lambda i, key, samples: histories[resource][i].__setitem__(key[0], samples),
+            )
+
+        await self._fan_out(objects, per_workload, per_namespace)
         return histories
 
     async def _query_range_digest(
@@ -374,7 +488,7 @@ class PrometheusLoader:
         gamma: float,
         min_value: float,
         num_buckets: int,
-    ) -> "list[tuple[str, np.ndarray, float, float]]":
+    ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
         sample arrays are never materialized. Split sub-windows merge exactly
@@ -397,7 +511,7 @@ class PrometheusLoader:
 
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float
-    ) -> "list[tuple[str, float, float]]":
+    ) -> "list[tuple[tuple[str, str], float, float]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
         sub-windows merge exactly (counts add, peaks max)."""
@@ -422,11 +536,12 @@ class PrometheusLoader:
         num_buckets: int,
         end_time: Optional[float] = None,
     ) -> "DigestedFleet":
-        """Digest-ingest fetch: every (object, resource) query's samples are
-        bucketized at parse time; per-pod digests merge into per-object
-        digests by exact count addition / peak max. Ingest memory is
-        O(num_buckets) per object instead of O(window length). Failed queries
-        degrade to empty digests (→ UNKNOWN scans), like ``gather_fleet``."""
+        """Digest-ingest fetch: every response's samples are bucketized at
+        parse time; per-pod digests merge into per-object digests by exact
+        count addition / peak max. Ingest memory is O(num_buckets) per object
+        instead of O(window length). Namespace-batched by default with the
+        same per-workload fallback as ``gather_fleet``; failed queries degrade
+        to empty digests (→ UNKNOWN scans)."""
         from krr_tpu.models.series import DigestedFleet
 
         await self._ensure_connected()
@@ -434,7 +549,12 @@ class PrometheusLoader:
         start = end - history_seconds
         fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
 
-        async def fetch_one(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
+        async def fetch_cpu(query: str) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
+            return await self._query_range_digest(
+                query, start, end, step_seconds, gamma, min_value, num_buckets
+            )
+
+        async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
             if not obj.pods:
                 return
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
@@ -443,17 +563,14 @@ class PrometheusLoader:
             seen: set[str] = set()  # first series per pod, like gather_fleet
             try:
                 if resource is ResourceType.CPU:
-                    series = await self._query_range_digest(
-                        query, start, end, step_seconds, gamma, min_value, num_buckets
-                    )
-                    for pod, counts, total, peak in series:
+                    for (pod, _c), counts, total, peak in await fetch_cpu(query):
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_cpu_row(i, counts, total, peak)
                 else:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
-                    for pod, total, peak in await self._query_range_stats(
+                    for (pod, _c), total, peak in await self._query_range_stats(
                         query, start, end, step_seconds
                     ):
                         if pod in wanted and total > 0 and pod not in seen:
@@ -463,9 +580,23 @@ class PrometheusLoader:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
 
-        await asyncio.gather(
-            *[fetch_one(i, obj, resource) for i, obj in enumerate(objects) for resource in ResourceType]
-        )
+        async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+            query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
+            if resource is ResourceType.CPU:
+                series: list = [row for row in await fetch_cpu(query) if row[2] > 0]
+                merge = fleet.merge_cpu_row
+            else:
+                series = [
+                    row
+                    for row in await self._query_range_stats(query, start, end, step_seconds)
+                    if row[1] > 0
+                ]
+                merge = fleet.merge_mem_row
+            self._route_series(
+                objects, indices, series, lambda i, key, *payload: merge(i, *payload)
+            )
+
+        await self._fan_out(objects, per_workload, per_namespace)
         return fleet
 
     async def close(self) -> None:

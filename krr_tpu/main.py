@@ -128,6 +128,18 @@ def _common_options() -> list[click.Option]:
         ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
         PanelOption(
+            ["--batched-fleet-queries"],
+            type=bool,
+            default=True,
+            show_default=True,
+            help=(
+                "Fetch usage history with one Prometheus range query per "
+                "(namespace, resource), routing series to workloads client-side "
+                "(O(namespaces) round trips); false = one query per workload. "
+                "Failed batched queries fall back to per-workload automatically."
+            ),
+        ),
+        PanelOption(
             ["--bulk-pod-discovery"],
             type=bool,
             default=True,

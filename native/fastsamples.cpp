@@ -6,9 +6,9 @@
 // (Decimal(value) over every element,
 // /root/reference/robusta_krr/core/integrations/prometheus.py:150-155); at
 // fleet scale (1e8+ samples) interpreter-loop parsing dominates the fetch
-// wall-clock. One shared scanner walks every series' pod label and samples in
-// a single pass with strtod (~20x faster than json.loads + float()); three
-// entry points differ only in their per-sample sink:
+// wall-clock. One shared scanner walks every series' pod/container labels and
+// samples in a single pass with strtod (~20x faster than json.loads +
+// float()); three entry points differ only in their per-sample sink:
 //
 //   krr_parse_matrix        — collect raw float64 samples (packed arrays)
 //   krr_parse_matrix_digest — fold each sample into a per-series log-bucket
@@ -48,9 +48,40 @@ struct Cursor {
     }
 };
 
+// Find `quoted_key` (e.g. "\"pod\"") used as a KEY (next non-space char is
+// ':') within the metric object [c.p, limit), not as a label VALUE — e.g.
+// {"container":"pod","pod":"web-1"} must not match the value occurrence.
+// Returns the start of the quoted string value (sets *len_out), or nullptr.
+const char* find_label_value(Cursor c, const char* limit, const char* quoted_key, long* len_out) {
+    // Clamp the search to the metric object: an ABSENT key (e.g. no
+    // "container" label anywhere in a per-workload response) must cost
+    // O(metric object), not an O(body) memmem per series — unclamped, a
+    // 2,000-series response without the key parses ~20x slower than one
+    // with it, and quadratically worse as series grow.
+    c.end = limit;
+    while (c.seek(quoted_key)) {
+        const char* after_key = c.p;
+        while (after_key < c.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
+        if (after_key < c.end && *after_key == ':') {
+            after_key++;
+            while (after_key < c.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
+            if (after_key < c.end && *after_key == '"') {
+                after_key++;
+                const char* start = after_key;
+                while (after_key < c.end && *after_key != '"') after_key++;
+                *len_out = after_key - start;
+                return start;
+            }
+        }
+        // Value occurrence — keep scanning within the metric object.
+    }
+    return nullptr;
+}
+
 // Walk every series in `body`, invoking the sink once per series and once per
 // sample. Sink contract:
-//   bool begin_series(long series_index, const char* pod, long pod_len)
+//   bool begin_series(long series_index, const char* pod, long pod_len,
+//                     const char* container, long container_len)
 //       -> false aborts with -1 (capacity exhausted)
 //   void sample(long series_index, double value)
 // Returns the number of series parsed, or -1 (capacity) / -2 (malformed).
@@ -61,8 +92,9 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
 
     long num_series = 0;
 
-    // Each series: a "metric" object (with optional "pod" label) followed by
-    // a "values" array. Prometheus emits them in this order.
+    // Each series: a "metric" object (with optional "pod"/"container" labels,
+    // depending on the query's grouping) followed by a "values" array.
+    // Prometheus emits them in this order.
     while (true) {
         Cursor probe = c;
         if (!probe.seek("\"metric\"")) break;
@@ -72,33 +104,12 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
         if (!metric_end.seek("\"values\"")) break;
         const char* values_key_at = metric_end.p;
 
-        const char* pod = nullptr;
-        long pod_len = 0;
-        {
-            // Find "pod" used as a KEY (next non-space char is ':'), not as a
-            // label value — e.g. {"container":"pod","pod":"web-1"} must not
-            // match the value occurrence.
-            Cursor m = c;
-            while (m.seek("\"pod\"") && m.p < values_key_at) {
-                const char* after_key = m.p;
-                while (after_key < m.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
-                if (after_key < m.end && *after_key == ':') {
-                    after_key++;
-                    while (after_key < m.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
-                    if (after_key < m.end && *after_key == '"') {
-                        after_key++;
-                        const char* start = after_key;
-                        while (after_key < m.end && *after_key != '"') after_key++;
-                        pod = start;
-                        pod_len = after_key - start;
-                        break;
-                    }
-                }
-                // Value occurrence — keep scanning within the metric object.
-            }
-        }
+        long pod_len = 0, container_len = 0;
+        const char* pod = find_label_value(c, values_key_at, "\"pod\"", &pod_len);
+        const char* container =
+            find_label_value(c, values_key_at, "\"container\"", &container_len);
 
-        if (!sink.begin_series(num_series, pod, pod_len)) return -1;
+        if (!sink.begin_series(num_series, pod, pod_len, container, container_len)) return -1;
 
         // Samples: sequence of [ts, "value"] pairs until the closing ']]'.
         c.p = values_key_at;
@@ -131,16 +142,25 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
     return num_series;
 }
 
-// Shared names-buffer emission: '\n'-joined pod label per series.
+// Shared names-buffer emission: one "pod\tcontainer" record per series,
+// '\n'-joined ('\t' cannot appear inside either label — k8s names are
+// DNS-1123). Either label may be empty when the query's grouping omits it.
 struct NameWriter {
     char* names;
     long names_cap;
     long names_used = 0;
 
-    bool write(const char* pod, long pod_len) {
-        if (names_used + pod_len + 1 > names_cap) return false;
-        std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
-        names_used += pod_len;
+    bool write(const char* pod, long pod_len, const char* container, long container_len) {
+        if (names_used + pod_len + container_len + 2 > names_cap) return false;
+        if (pod_len > 0) {  // absent label: pod may be nullptr
+            std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
+            names_used += pod_len;
+        }
+        names[names_used++] = '\t';
+        if (container_len > 0) {
+            std::memcpy(names + names_used, container, static_cast<size_t>(container_len));
+            names_used += container_len;
+        }
         names[names_used++] = '\n';
         return true;
     }
@@ -163,7 +183,8 @@ long krr_count_series(const char* body, long body_len) {
 // Parse all series in `body`. Outputs:
 //   values      — all samples, series-concatenated (capacity values_cap)
 //   series_lens — sample count per series (capacity series_cap)
-//   names       — '\n'-joined pod label per series (capacity names_cap bytes)
+//   names       — '\n'-joined "pod\tcontainer" record per series
+//                 (capacity names_cap bytes)
 // Returns the number of series parsed, or:
 //   -1  output capacity exceeded (caller should retry with larger buffers)
 //   -2  malformed input (no "result" array)
@@ -179,10 +200,11 @@ long krr_parse_matrix(const char* body, long body_len,
         long series_cap;
         NameWriter namew;
 
-        bool begin_series(long i, const char* pod, long pod_len) {
+        bool begin_series(long i, const char* pod, long pod_len,
+                          const char* container, long container_len) {
             if (i >= series_cap) return false;
             series_lens[i] = 0;
-            return namew.write(pod, pod_len);
+            return namew.write(pod, pod_len, container, container_len);
         }
         bool sample(long i, double v) {
             if (values_used >= values_cap) return false;
@@ -201,7 +223,7 @@ long krr_parse_matrix(const char* body, long body_len,
 //   counts — [series_cap x num_buckets] row-major bucket counts
 //   totals — [series_cap] sample counts
 //   peaks  — [series_cap] exact maxima (-inf when empty)
-//   names  — '\n'-joined pod label per series
+//   names  — '\n'-joined "pod\tcontainer" record per series
 long krr_parse_matrix_digest(const char* body, long body_len,
                              double gamma, double min_value, long num_buckets,
                              double* counts, double* totals, double* peaks,
@@ -219,11 +241,12 @@ long krr_parse_matrix_digest(const char* body, long body_len,
         long series_cap;
         NameWriter namew;
 
-        bool begin_series(long i, const char* pod, long pod_len) {
+        bool begin_series(long i, const char* pod, long pod_len,
+                          const char* container, long container_len) {
             if (i >= series_cap) return false;
             totals[i] = 0.0;
             peaks[i] = -HUGE_VAL;
-            return namew.write(pod, pod_len);
+            return namew.write(pod, pod_len, container, container_len);
         }
         bool sample(long i, double v) {
             // Same bucketize as ops/digest.py: values <= min_value -> bucket 0.
@@ -248,7 +271,7 @@ long krr_parse_matrix_digest(const char* body, long body_len,
 // buffer needs no histogram): O(1) state per series, no log() per sample.
 //   totals — [series_cap] sample counts
 //   peaks  — [series_cap] exact maxima (-inf when empty)
-//   names  — '\n'-joined pod label per series
+//   names  — '\n'-joined "pod\tcontainer" record per series
 long krr_parse_matrix_stats(const char* body, long body_len,
                             double* totals, double* peaks,
                             long series_cap, char* names, long names_cap) {
@@ -258,11 +281,12 @@ long krr_parse_matrix_stats(const char* body, long body_len,
         long series_cap;
         NameWriter namew;
 
-        bool begin_series(long i, const char* pod, long pod_len) {
+        bool begin_series(long i, const char* pod, long pod_len,
+                          const char* container, long container_len) {
             if (i >= series_cap) return false;
             totals[i] = 0.0;
             peaks[i] = -HUGE_VAL;
-            return namew.write(pod, pod_len);
+            return namew.write(pod, pod_len, container, container_len);
         }
         bool sample(long i, double v) {
             totals[i] += 1.0;

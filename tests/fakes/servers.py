@@ -105,6 +105,10 @@ class FakeMetrics:
     series: dict[tuple[str, str, str], tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
     fail_queries: bool = False
     fail_next: int = 0  # inject N transient 500s, then succeed (retry tests)
+    #: Reject namespace-batched queries with a non-retryable 422, like a
+    #: backend that caps response sizes — per-workload queries still succeed
+    #: (exercises the loader's automatic per-namespace fallback).
+    fail_batched: bool = False
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     #: When set, series are anchored at SERIES_ORIGIN with the requested step
     #: and sliced to the requested [start, end] — the contract the loader's
@@ -112,24 +116,31 @@ class FakeMetrics:
     #: behavior: the full series regardless of range).
     enforce_range: bool = False
     request_count: int = 0
-    #: Pre-rendered response fragments per (ns, container, pod): rendering
-    #: the values JSON per request dominates fleet-scale benches and would
-    #: make `bench_e2e.py` measure the fake instead of the scanner. The
-    #: parser discards timestamps, so static ones are served.
-    _fragments: dict[tuple[str, str, str], tuple[str, str]] = field(default_factory=dict)
+    #: Pre-rendered values-array JSON per (ns, container, pod): rendering the
+    #: values JSON per request dominates fleet-scale benches and would make
+    #: `bench_e2e.py` measure the fake instead of the scanner. The metric
+    #: header (whose label set depends on the query's grouping) is prepended
+    #: per request; the parser discards timestamps, so static ones are served.
+    _value_strs: dict[tuple[str, str, str], tuple[str, str]] = field(default_factory=dict)
 
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
         key = (namespace, container, pod)
         self.series[key] = (np.asarray(cpu, float), np.asarray(memory, float))
-        self._fragments[key] = tuple(
-            '{"metric":{"pod":"%s"},"values":[%s]}'
-            % (pod, ",".join(f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples)))
+        self._value_strs[key] = tuple(
+            ",".join(f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples))
             for samples in self.series[key]
         )
 
 
+#: Per-workload query shape (`krr_tpu.integrations.prometheus.cpu_query`).
 _QUERY_RE = re.compile(
     r'namespace="(?P<namespace>[^"]*)", pod=~"(?P<pods>[^"]*)", container="(?P<container>[^"]*)"'
+)
+
+#: Namespace-batched query shape (`cpu_namespace_query`/`memory_namespace_query`):
+#: grouped by (pod, container), namespace is the only identity filter.
+_BATCHED_QUERY_RE = re.compile(
+    r'sum by \(pod, container\) \([^{]*\{[^}]*namespace="(?P<namespace>[^"]*)"'
 )
 
 
@@ -232,12 +243,44 @@ class FakeBackend:
                 status=400,
             )
         query = params.get("query", "")
-        match = _QUERY_RE.search(query)
-        if not match:
-            return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": []}})
-        namespace, container = match["namespace"], match["container"]
-        pod_pattern = re.compile(f"^(?:{match['pods']})$")
         is_cpu = "cpu_usage" in query
+        batched = _BATCHED_QUERY_RE.search(query)
+        if batched and self.metrics.fail_batched:
+            return web.json_response(
+                {"status": "error", "error": "query result too large"}, status=422
+            )
+        if batched:
+            # Namespace-batched query: every series in the namespace, metric
+            # labels = the grouping set (pod AND container), like real
+            # Prometheus, which emits exactly the `by (...)` labels.
+            namespace = batched["namespace"]
+            selected = [k for k in self.metrics.series if k[0] == namespace]
+
+            def metric_json(cont: str, pod: str) -> str:
+                return '{"pod":"%s","container":"%s"}' % (pod, cont)
+
+            def metric_dict(cont: str, pod: str) -> dict:
+                return {"pod": pod, "container": cont}
+        else:
+            match = _QUERY_RE.search(query)
+            if not match:
+                return web.json_response(
+                    {"status": "success", "data": {"resultType": "matrix", "result": []}}
+                )
+            namespace, container = match["namespace"], match["container"]
+            pod_pattern = re.compile(f"^(?:{match['pods']})$")
+            selected = [
+                k
+                for k in self.metrics.series
+                if k[0] == namespace and k[1] == container and pod_pattern.match(k[2])
+            ]
+
+            def metric_json(cont: str, pod: str) -> str:
+                return '{"pod":"%s"}' % pod
+
+            def metric_dict(cont: str, pod: str) -> dict:
+                return {"pod": pod}
+
         start = float(params.get("start", 0))
         step = 60.0
         if self.metrics.enforce_range:
@@ -245,39 +288,38 @@ class FakeBackend:
             # return exactly the samples on the requested grid slice.
             t0 = self.SERIES_ORIGIN
             result = []
-            for (ns, cont, pod), (cpu, memory) in self.metrics.series.items():
-                if ns == namespace and cont == container and pod_pattern.match(pod):
-                    samples = cpu if is_cpu else memory
-                    i0 = max(0, int(np.ceil((req_start - t0) / step_sec)))
-                    i1 = min(len(samples) - 1, int((req_end - t0) // step_sec))
-                    if i1 >= i0:
-                        values = [
-                            [t0 + i * step_sec, repr(float(samples[i]))] for i in range(i0, i1 + 1)
-                        ]
-                        result.append({"metric": {"pod": pod}, "values": values})
+            for ns, cont, pod in selected:
+                cpu, memory = self.metrics.series[(ns, cont, pod)]
+                samples = cpu if is_cpu else memory
+                i0 = max(0, int(np.ceil((req_start - t0) / step_sec)))
+                i1 = min(len(samples) - 1, int((req_end - t0) // step_sec))
+                if i1 >= i0:
+                    values = [
+                        [t0 + i * step_sec, repr(float(samples[i]))] for i in range(i0, i1 + 1)
+                    ]
+                    result.append({"metric": metric_dict(cont, pod), "values": values})
             return web.json_response(
                 {"status": "success", "data": {"resultType": "matrix", "result": result}}
             )
         if not self.metrics.duplicate_pods:
-            # Fast path: assemble the body from pre-rendered fragments.
+            # Fast path: assemble the body from pre-rendered values strings.
             fragments = [
-                frags[0 if is_cpu else 1]
-                for (ns, cont, pod), frags in self.metrics._fragments.items()
-                if ns == namespace and cont == container and pod_pattern.match(pod)
-                and len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
+                '{"metric":%s,"values":[%s]}'
+                % (metric_json(cont, pod), self.metrics._value_strs[(ns, cont, pod)][0 if is_cpu else 1])
+                for ns, cont, pod in selected
+                if len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
             ]
             body = '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
             return web.Response(text=body, content_type="application/json")
         result = []
-        for (ns, cont, pod), (cpu, memory) in self.metrics.series.items():
-            if ns == namespace and cont == container and pod_pattern.match(pod):
-                samples = cpu if is_cpu else memory
-                if len(samples):
-                    values = [[start + i * step, repr(float(v))] for i, v in enumerate(samples)]
-                    result.append({"metric": {"pod": pod}, "values": values})
-                    if self.metrics.duplicate_pods:
-                        dupe = [[t, repr(float(v) + 1000.0)] for t, v in values]
-                        result.append({"metric": {"pod": pod}, "values": dupe})
+        for ns, cont, pod in selected:
+            cpu, memory = self.metrics.series[(ns, cont, pod)]
+            samples = cpu if is_cpu else memory
+            if len(samples):
+                values = [[start + i * step, repr(float(v))] for i, v in enumerate(samples)]
+                result.append({"metric": metric_dict(cont, pod), "values": values})
+                dupe = [[t, repr(float(v) + 1000.0)] for t, v in values]
+                result.append({"metric": metric_dict(cont, pod), "values": dupe})
         return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": result}})
 
     # ----------------------------------------------------------------- app

@@ -199,7 +199,7 @@ class TestRetryBackoff:
         for i in with_metrics:
             assert histories[ResourceType.CPU][i], objects[i]
             assert histories[ResourceType.Memory][i], objects[i]
-        queries = 2 * len(objects)  # one per (object, resource)
+        queries = 2 * len({o.namespace for o in objects if o.pods})  # one per (namespace, resource)
         assert fake_env["metrics"].request_count - base_count == queries + 2
 
 
@@ -255,6 +255,152 @@ class TestFirstSeriesPerPod:
         np.testing.assert_array_equal(baseline.cpu_total, duped.cpu_total)
         np.testing.assert_array_equal(baseline.mem_total, duped.mem_total)
         np.testing.assert_array_equal(baseline.cpu_peak, duped.cpu_peak)
+
+
+class TestBatchedFleetQueries:
+    """The fetch-side fan-out collapse: one range query per (namespace,
+    resource), series routed to workloads client-side by (pod, container) —
+    the same O(workloads) → O(namespaces) move bulk pod discovery makes on
+    the apiserver side."""
+
+    @staticmethod
+    def _gather(config, objects, **kwargs):
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                return await prom.gather_fleet(objects, 3600, 60, **kwargs)
+            finally:
+                await prom.close()
+
+        return asyncio.run(fetch())
+
+    @staticmethod
+    def _gather_digests(config, objects):
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                return await prom.gather_fleet_digests(
+                    objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128
+                )
+            finally:
+                await prom.close()
+
+        return asyncio.run(fetch())
+
+    def test_request_count_is_per_namespace(self, fake_env):
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        base = fake_env["metrics"].request_count
+        histories = self._gather(config, objects)
+        namespaces = {o.namespace for o in objects if o.pods}
+        assert len(objects) > len(namespaces)  # the collapse is real here
+        assert fake_env["metrics"].request_count - base == 2 * len(namespaces)
+        assert any(histories[ResourceType.CPU][i] for i in range(len(objects)))
+
+    def test_batched_equals_per_workload(self, fake_env):
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        batched = self._gather(make_config(fake_env), objects)
+        unbatched = self._gather(
+            make_config(fake_env, batched_fleet_queries=False), objects
+        )
+        for resource in ResourceType:
+            for i in range(len(objects)):
+                assert set(batched[resource][i]) == set(unbatched[resource][i]), objects[i]
+                for pod in batched[resource][i]:
+                    np.testing.assert_array_equal(
+                        batched[resource][i][pod], unbatched[resource][i][pod]
+                    )
+
+    def test_digest_batched_equals_per_workload(self, fake_env):
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        batched = self._gather_digests(make_config(fake_env), objects)
+        unbatched = self._gather_digests(
+            make_config(fake_env, batched_fleet_queries=False), objects
+        )
+        np.testing.assert_array_equal(batched.cpu_counts, unbatched.cpu_counts)
+        np.testing.assert_array_equal(batched.cpu_total, unbatched.cpu_total)
+        np.testing.assert_array_equal(batched.cpu_peak, unbatched.cpu_peak)
+        np.testing.assert_array_equal(batched.mem_total, unbatched.mem_total)
+        np.testing.assert_array_equal(batched.mem_peak, unbatched.mem_peak)
+
+    def test_unowned_series_are_dropped(self, fake_env):
+        """The namespace query returns series for bare pods / unscanned
+        workloads too; rows whose (pod, container) routes to no object must
+        vanish, not leak into someone's history."""
+        rng = np.random.default_rng(3)
+        fake_env["metrics"].set_series(
+            "default", "main", "orphan-0",
+            cpu=rng.gamma(2.0, 0.05, 48), memory=rng.uniform(5e7, 2e8, 48),
+        )
+        try:
+            config = make_config(fake_env)
+            objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+            histories = self._gather(config, objects)
+            for resource in ResourceType:
+                for i in range(len(objects)):
+                    assert "orphan-0" not in histories[resource][i]
+        finally:
+            del fake_env["metrics"].series[("default", "main", "orphan-0")]
+            del fake_env["metrics"]._value_strs[("default", "main", "orphan-0")]
+
+    def test_multi_container_pods_route_to_distinct_objects(self, fake_env):
+        """web's pods run two containers; each (pod, container) series must
+        land on its own object, not bleed across containers."""
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        histories = self._gather(config, objects)
+        by_key = {(o.namespace, o.name, o.container): i for i, o in enumerate(objects)}
+        pod = fake_env["web_pods"][0]
+        main_cpu = histories[ResourceType.CPU][by_key[("default", "web", "main")]][pod]
+        sidecar_cpu = histories[ResourceType.CPU][by_key[("default", "web", "sidecar")]][pod]
+        np.testing.assert_array_equal(
+            main_cpu, fake_env["metrics"].series[("default", "main", pod)][0]
+        )
+        np.testing.assert_array_equal(
+            sidecar_cpu, fake_env["metrics"].series[("default", "sidecar", pod)][0]
+        )
+        assert not np.array_equal(main_cpu, sidecar_cpu)
+
+    def test_failed_batched_query_falls_back_per_workload(self, fake_env):
+        """A backend that rejects namespace-sized responses (non-retryable
+        4xx) must degrade to per-workload queries for that namespace, not to
+        empty histories."""
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        fake_env["metrics"].fail_batched = True
+        base = fake_env["metrics"].request_count
+        try:
+            histories = self._gather(config, objects)
+        finally:
+            fake_env["metrics"].fail_batched = False
+        # Data arrived anyway — via the per-workload path.
+        by_key = {(o.namespace, o.name, o.container): i for i, o in enumerate(objects)}
+        web_i = by_key[("default", "web", "main")]
+        for pod in fake_env["web_pods"]:
+            np.testing.assert_allclose(
+                histories[ResourceType.CPU][web_i][pod],
+                fake_env["metrics"].series[("default", "main", pod)][0],
+            )
+        namespaces = {o.namespace for o in objects if o.pods}
+        with_pods = [o for o in objects if o.pods]
+        # 2 rejected batched queries per namespace + 2 per-workload per object.
+        assert fake_env["metrics"].request_count - base == 2 * len(namespaces) + 2 * len(with_pods)
+
+    def test_digest_failed_batched_query_falls_back(self, fake_env):
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        baseline = self._gather_digests(config, objects)
+        fake_env["metrics"].fail_batched = True
+        try:
+            fallback = self._gather_digests(config, objects)
+        finally:
+            fake_env["metrics"].fail_batched = False
+        np.testing.assert_array_equal(baseline.cpu_counts, fallback.cpu_counts)
+        np.testing.assert_array_equal(baseline.mem_peak, fallback.mem_peak)
 
 
 class TestClusterSelection:
@@ -389,7 +535,9 @@ class TestInClusterCredentials:
 class TestWidePodFanout:
     """A workload with hundreds of pods produces a multi-KB pod regex; the
     fake server rejects over-long GET URLs (like real Prometheus / proxies),
-    so this passes only because the loader POSTs range queries."""
+    so this passes only because the loader POSTs range queries. Pinned to the
+    per-workload path — namespace-batched queries carry no pod regex (their
+    whole point), so only the fallback path ever builds these URLs."""
 
     def test_wide_pod_workload_scan(self, tmp_path_factory):
         cluster = FakeCluster()
@@ -408,7 +556,8 @@ class TestWidePodFanout:
                 "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
                 "users": [{"name": "fake", "user": {"token": "test-token"}}],
             }))
-            config = Config(kubeconfig=str(kubeconfig_path), prometheus_url=server.url)
+            config = Config(kubeconfig=str(kubeconfig_path), prometheus_url=server.url,
+                            batched_fleet_queries=False)
             loader = KubernetesLoader(config)
             objects = asyncio.run(loader.list_scannable_objects(["fake"]))
             wide = [o for o in objects if o.name == "wide"]

@@ -47,7 +47,8 @@ class TestNativeParser:
         expected = native.parse_matrix_python(body)
         got = native.parse_matrix_native(body)
         assert got is not None
-        assert [pod for pod, _ in got] == [pod for pod, _ in expected]
+        assert [key for key, _ in got] == [key for key, _ in expected]
+        assert [key for key, _ in got] == [(pod, "main") for pod, _ in series]
         for (_, g), (_, e) in zip(got, expected):
             np.testing.assert_array_equal(g, e)
 
@@ -90,7 +91,9 @@ class TestNativeParser:
             b'"values":[[1700000000,"0.5"],[1700000060,"0.75"]]}]}}'
         )
         got = native.parse_matrix_native(body)
-        assert got is not None and got[0][0] == "web-1"
+        # The "container" label's VALUE here really is "pod" — the key scan
+        # must bind pod="web-1" (the "pod" KEY) and container="pod".
+        assert got is not None and got[0][0] == ("web-1", "pod")
         np.testing.assert_array_equal(got[0][1], np.asarray([0.5, 0.75]))
 
     def test_error_status_raises_via_python_parser(self, library_available):
@@ -110,7 +113,7 @@ class TestNativeDigestIngest:
         ]
         body = make_response(series)
         got = native.parse_matrix_digest(body, self.GAMMA, self.MIN_VALUE, self.BUCKETS)
-        assert [pod for pod, *_ in got] == ["pod-a", "pod-b", "pod-empty"]
+        assert [key for key, *_ in got] == [("pod-a", "main"), ("pod-b", "main"), ("pod-empty", "main")]
         for (pod, vals), (_, counts, total, peak) in zip(series, got):
             ref_counts, ref_total, ref_peak = native._digest_python(
                 np.asarray(vals, dtype=np.float64), self.GAMMA, self.MIN_VALUE, self.BUCKETS
@@ -161,7 +164,7 @@ class TestNativeStats:
         ]
         body = make_response(series)
         got = native.parse_matrix_stats(body)
-        assert [p for p, *_ in got] == ["pod-a", "pod-empty", "pod-b"]
+        assert [k for k, *_ in got] == [("pod-a", "main"), ("pod-empty", "main"), ("pod-b", "main")]
         for (pod, vals), (_, total, peak) in zip(series, got):
             assert total == len(vals)
             if vals:
@@ -206,14 +209,14 @@ class TestNonFiniteSamples:
         for parser in (native.parse_matrix_native, native.parse_matrix_python):
             series = parser(self.BODY)
             assert series is not None
-            by_pod = dict(series)
-            np.testing.assert_array_equal(by_pod["p0"], [0.5, 1.5])
-            assert by_pod["p1"].size == 0  # all-stale pod -> empty (dropped upstream)
+            by_key = dict(series)
+            np.testing.assert_array_equal(by_key[("p0", "")], [0.5, 1.5])
+            assert by_key[("p1", "")].size == 0  # all-stale pod -> empty (dropped upstream)
 
     def test_digest_and_stats_drop_nonfinite(self):
         digests = native.parse_matrix_digest(self.BODY, 1.01, 1e-7, 64)
-        assert [(p, t, pk) for p, _c, t, pk in digests] == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
-        assert native.parse_matrix_stats(self.BODY) == [("p0", 2.0, 1.5), ("p1", 0.0, -np.inf)]
+        assert [(k, t, pk) for k, _c, t, pk in digests] == [(("p0", ""), 2.0, 1.5), (("p1", ""), 0.0, -np.inf)]
+        assert native.parse_matrix_stats(self.BODY) == [(("p0", ""), 2.0, 1.5), (("p1", ""), 0.0, -np.inf)]
 
 
 class TestParserFuzz:
