@@ -9,10 +9,16 @@ digest-ingest compute path at a synthetic 100k-container fleet (the
 BASELINE.md config-4 fleet size; raw fetch at that scale is bounded by the
 Prometheus side, which a local fake can't represent — see README).
 
-The e2e number is a *lower bound*: the fake Prometheus renders its JSON in
-pure Python in-process, so at fleet scale the measured wall-clock is
-dominated by the fake server's own encoding, not by the scanner. It still
-catches regressions anywhere in the pipeline, which is its job.
+The fakes run in a SEPARATE process (spawned, not forked — forking after JAX
+initializes is unsafe), so the server's GIL never blocks the scanner's, and
+batched response bodies are pre-rendered server-side on the first (cold)
+scan and served from cache on the warm scan that produces the headline
+number. CAVEAT: this image exposes ONE CPU core (`nproc` = 1), so the
+measured wall-clock is the SUM of server serving + client read + parse +
+routing + pack, not their overlap — on any real multi-core host the server
+cost leaves the measurement and concurrent reads/parses overlap. Solo
+component throughputs (the honest per-core numbers): native parse
+~450 MB/s, http.client read ~1.1 GB/s (see BASELINE.md's ingest budget).
 
 Prints ONE JSON line:
     {"e2e_objects_per_sec": N, "e2e_containers": N, "discover_seconds": N,
@@ -36,12 +42,12 @@ import tempfile
 import time
 
 
-def run_e2e(n_containers: int, samples: int) -> dict:
+def _serve_fixture(n_containers: int, samples: int, conn) -> None:
+    """Child-process entry: build the fixture, serve it, report the port,
+    hold until the parent is done. Runs under multiprocessing 'spawn', so
+    this must stay importable without side effects."""
     import numpy as np
-    import yaml
 
-    from krr_tpu.core.config import Config
-    from krr_tpu.core.runner import Runner
     from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
 
     cluster = FakeCluster()
@@ -57,8 +63,29 @@ def run_e2e(n_containers: int, samples: int) -> dict:
             cpu=rng.gamma(2.0, 0.05, samples),
             memory=rng.uniform(5e7, 4e8, samples),
         )
-
     server = ServerThread(FakeBackend(cluster, metrics)).start()
+    conn.send(server.port)
+    conn.recv()  # parent signals completion
+    server.stop()
+
+
+def run_e2e(n_containers: int, samples: int) -> dict:
+    import multiprocessing
+
+    import yaml
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.core.runner import Runner
+
+    ctx = multiprocessing.get_context("spawn")
+    parent_conn, child_conn = ctx.Pipe()
+    proc = ctx.Process(target=_serve_fixture, args=(n_containers, samples, child_conn), daemon=True)
+    proc.start()
+    if not parent_conn.poll(timeout=600):
+        proc.kill()
+        raise RuntimeError("fake-server subprocess failed to start")
+    port = parent_conn.recv()
+    server_url = f"http://127.0.0.1:{port}"
     try:
         with tempfile.TemporaryDirectory() as tmp:
             kubeconfig = os.path.join(tmp, "config")
@@ -67,14 +94,14 @@ def run_e2e(n_containers: int, samples: int) -> dict:
                     {
                         "current-context": "fake",
                         "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "u"}}],
-                        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+                        "clusters": [{"name": "fake", "cluster": {"server": server_url}}],
                         "users": [{"name": "u", "user": {"token": "t"}}],
                     },
                     f,
                 )
             config = Config(
                 kubeconfig=kubeconfig,
-                prometheus_url=server.url,
+                prometheus_url=server_url,
                 quiet=True,
                 format="json",
             )
@@ -83,14 +110,19 @@ def run_e2e(n_containers: int, samples: int) -> dict:
                 start = time.perf_counter()
                 with contextlib.redirect_stdout(io.StringIO()):  # result JSON isn't the metric
                     asyncio.run(runner.run())
+                assert runner.stats["objects"] == n_containers, runner.stats
                 return time.perf_counter() - start, runner.stats
 
-            # Cold scan pays one-time JIT compiles; the warm scan is the
-            # steady-state a continuously-running recommender sees.
+            # Cold scan pays one-time JIT compiles + the fake's body renders;
+            # the warm scan is the steady-state a continuously-running
+            # recommender sees.
             cold_elapsed, _cold = one_scan()
             elapsed, stats = one_scan()
     finally:
-        server.stop()
+        parent_conn.send("done")
+        proc.join(timeout=10)
+        if proc.is_alive():
+            proc.kill()
 
     return {
         "e2e_objects_per_sec": round(stats["objects"] / elapsed, 1),

@@ -182,6 +182,46 @@ class KubeApi:
             self._client = None
 
 
+class NamespacePods:
+    """One namespace's pods plus a label inverted index for bulk discovery.
+
+    ``match_selector`` over every pod for every workload is O(workloads ×
+    pods) — quadratic for the common one-big-namespace fleet (10k workloads ×
+    10k pods = 1e8 Python evaluations ≈ 25 s). The index maps each (label
+    key, value) pair to the pods carrying it, so a ``matchLabels`` selector
+    (the overwhelmingly common case) resolves as a set intersection over
+    exactly the candidate pods; ``matchExpressions`` are evaluated only on
+    those candidates (or on the full list when there are no matchLabels)."""
+
+    def __init__(self, pods: list[tuple[str, dict[str, str]]]):
+        self.pods = pods
+        self.by_label: dict[tuple[str, str], list[int]] = {}
+        for j, (_, labels) in enumerate(pods):
+            for item in labels.items():
+                self.by_label.setdefault(item, []).append(j)
+
+    def select(self, selector: dict[str, Any]) -> list[str]:
+        """Pods matching the selector, in listing order (the order the
+        server-side path returns)."""
+        candidates: Optional[set[int]] = None
+        for item in (selector.get("matchLabels") or {}).items():
+            hits = self.by_label.get(item)
+            if not hits:
+                return []
+            candidates = set(hits) if candidates is None else candidates & set(hits)
+        if candidates is None:  # no matchLabels: expressions scan everything
+            positions: "range | list[int]" = range(len(self.pods))
+        else:
+            positions = sorted(candidates)
+        if selector.get("matchExpressions") or candidates is None:
+            return [
+                self.pods[j][0]
+                for j in positions
+                if match_selector(selector, self.pods[j][1])
+            ]
+        return [self.pods[j][0] for j in positions]
+
+
 class ClusterLoader:
     """Scans one cluster for workloads."""
 
@@ -193,7 +233,7 @@ class ClusterLoader:
         self._api = api
         self._api_lock = asyncio.Lock()
         self._pod_cache: dict[tuple[str, str], asyncio.Task[list[str]]] = {}
-        self._namespace_pods: dict[str, asyncio.Task[list[tuple[str, dict[str, str]]]]] = {}
+        self._namespace_pods: dict[str, asyncio.Task["NamespacePods"]] = {}
 
     async def api(self) -> KubeApi:
         """Credentials resolve lazily off the event loop (kubeconfig file I/O,
@@ -216,19 +256,21 @@ class ClusterLoader:
         "Accept": "application/json;as=PartialObjectMetadataList;g=meta.k8s.io;v=v1,application/json"
     }
 
-    async def _namespace_pod_labels(self, namespace: str) -> list[tuple[str, dict[str, str]]]:
-        """All (pod name, labels) in a namespace — ONE apiserver request,
-        cached; the bulk-discovery backing store."""
+    async def _namespace_pod_labels(self, namespace: str) -> NamespacePods:
+        """All (pod name, labels) in a namespace, label-indexed — ONE
+        apiserver request, cached; the bulk-discovery backing store."""
         if namespace not in self._namespace_pods:
-            async def fetch() -> list[tuple[str, dict[str, str]]]:
+            async def fetch() -> NamespacePods:
                 api = await self.api()
                 items = await api.list_items(
                     f"/api/v1/namespaces/{namespace}/pods", headers=self._METADATA_ONLY
                 )
-                return [
-                    (item["metadata"]["name"], item["metadata"].get("labels") or {})
-                    for item in items
-                ]
+                return NamespacePods(
+                    [
+                        (item["metadata"]["name"], item["metadata"].get("labels") or {})
+                        for item in items
+                    ]
+                )
 
             self._namespace_pods[namespace] = asyncio.ensure_future(fetch())
         return await self._namespace_pods[namespace]
@@ -259,7 +301,7 @@ class ClusterLoader:
             return []
         if self.config.bulk_pod_discovery:
             pods = await self._namespace_pod_labels(namespace)
-            return [name for name, labels in pods if match_selector(selector, labels)]
+            return pods.select(selector)
         return await self._list_pods(namespace, build_selector_query(selector))
 
     async def _build_objects(self, kind: str, item: dict[str, Any]) -> list[K8sObjectData]:

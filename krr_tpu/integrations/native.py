@@ -46,9 +46,16 @@ def _load_library() -> Optional[ctypes.CDLL]:
             source = os.path.join(_NATIVE_DIR, "fastsamples.cpp")
             # Rebuild when missing OR stale: a cached .so from an older source
             # would load but lack newer symbols, and the blanket failure
-            # handling below would then silently disable the whole native path.
+            # handling below would then silently disable the whole native
+            # path. Staleness covers the headers too (pow10_table.h) — a
+            # regenerated table with an untouched .cpp must also rebuild.
+            inputs = [
+                os.path.join(_NATIVE_DIR, f)
+                for f in os.listdir(_NATIVE_DIR)
+                if f.endswith((".cpp", ".h"))
+            ] if os.path.isdir(_NATIVE_DIR) else []
             if not os.path.exists(_SO_PATH) or (
-                os.path.exists(source) and os.path.getmtime(source) > os.path.getmtime(_SO_PATH)
+                inputs and max(map(os.path.getmtime, inputs)) > os.path.getmtime(_SO_PATH)
             ):
                 if not os.path.exists(source):
                     raise FileNotFoundError(source)

@@ -123,6 +123,12 @@ class FakeMetrics:
     #: per request; the parser discards timestamps, so static ones are served.
     _value_strs: dict[tuple[str, str, str], tuple[str, str]] = field(default_factory=dict)
 
+    #: Fully-rendered batched response bodies per (namespace, is_cpu):
+    #: namespace-sized bodies are hundreds of MB at fleet scale and identical
+    #: across requests — rendering per request would make the e2e bench
+    #: measure the fake's string assembly, not the scanner.
+    _batched_bodies: dict[tuple[str, bool], bytes] = field(default_factory=dict)
+
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
         key = (namespace, container, pod)
         self.series[key] = (np.asarray(cpu, float), np.asarray(memory, float))
@@ -130,6 +136,7 @@ class FakeMetrics:
             ",".join(f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples))
             for samples in self.series[key]
         )
+        self._batched_bodies.clear()
 
 
 #: Per-workload query shape (`krr_tpu.integrations.prometheus.cpu_query`).
@@ -302,6 +309,11 @@ class FakeBackend:
                 {"status": "success", "data": {"resultType": "matrix", "result": result}}
             )
         if not self.metrics.duplicate_pods:
+            cache_key = (namespace, is_cpu) if batched else None
+            if cache_key is not None and cache_key in self.metrics._batched_bodies:
+                return web.Response(
+                    body=self.metrics._batched_bodies[cache_key], content_type="application/json"
+                )
             # Fast path: assemble the body from pre-rendered values strings.
             fragments = [
                 '{"metric":%s,"values":[%s]}'
@@ -309,8 +321,12 @@ class FakeBackend:
                 for ns, cont, pod in selected
                 if len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
             ]
-            body = '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
-            return web.Response(text=body, content_type="application/json")
+            body = (
+                '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
+            ).encode()
+            if cache_key is not None:
+                self.metrics._batched_bodies[cache_key] = body
+            return web.Response(body=body, content_type="application/json")
         result = []
         for ns, cont, pod in selected:
             cpu, memory = self.metrics.series[(ns, cont, pod)]

@@ -219,6 +219,42 @@ class TestNonFiniteSamples:
         assert native.parse_matrix_stats(self.BODY) == [(("p0", ""), 2.0, 1.5), (("p1", ""), 0.0, -np.inf)]
 
 
+class TestFastFloat:
+    """The Eisel–Lemire fast path must be bit-identical to Python's float()
+    (== strtod) for every value it accepts; everything else falls back to
+    strtod inside the scanner, so one parity sweep over adversarial shapes
+    covers both routes."""
+
+    def test_bit_exact_vs_float(self, library_available, rng):
+        cases = []
+        for _ in range(5000):  # full exponent range, incl. near-subnormal
+            m = float(rng.uniform(-1, 1))
+            e = int(rng.integers(-320, 309))
+            cases.append(repr(m * 10.0 ** min(e, 308)))
+        cases += [repr(float(x)) for x in rng.gamma(2.0, 0.05, 5000)]  # CPU-like
+        cases += [repr(float(x)) for x in rng.uniform(5e7, 4e8, 5000)]  # memory-like
+        cases += [
+            "0", "0.0", "-0.0", "1", "-1", "1e0", "1E5", "0.1", "0.3",
+            "123456789012345678", "1234567890123456789",  # 18/19 digits
+            "5e-324", "4.9406564584124654e-324", "2.2250738585072014e-308",  # subnormals
+            "1.7976931348623157e308", "1e-322",  # extremes
+            "9007199254740993", "9007199254740992",  # 2^53 boundary
+            "4503599627370495.5", "4503599627370496.5", "2.5e15",  # exact ties
+            "1.0000000000000000555", "0.30000000000000004", "6.02214076e23",
+        ]
+        import json
+
+        body = json.dumps(
+            {"status": "success", "data": {"resultType": "matrix", "result": [
+                {"metric": {"pod": "p"}, "values": [[i, c] for i, c in enumerate(cases)]}
+            ]}}
+        ).encode()
+        [(_, got)] = native.parse_matrix_native(body)
+        want = np.asarray([float(c) for c in cases])
+        want = want[np.isfinite(want)]
+        np.testing.assert_array_equal(got, want)
+
+
 class TestParserFuzz:
     def test_mutated_bodies_never_crash(self, library_available, rng):
         """The C scanner must reject or survive arbitrary corruption —
