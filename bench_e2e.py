@@ -84,7 +84,11 @@ def run_e2e(n_containers: int, samples: int) -> dict:
     if not parent_conn.poll(timeout=600):
         proc.kill()
         raise RuntimeError("fake-server subprocess failed to start")
-    port = parent_conn.recv()
+    try:
+        port = parent_conn.recv()
+    except EOFError:  # child died building the fixture — pipe EOF, not a port
+        proc.kill()
+        raise RuntimeError("fake-server subprocess died during fixture setup") from None
     server_url = f"http://127.0.0.1:{port}"
     try:
         with tempfile.TemporaryDirectory() as tmp:
@@ -119,7 +123,10 @@ def run_e2e(n_containers: int, samples: int) -> dict:
             cold_elapsed, _cold = one_scan()
             elapsed, stats = one_scan()
     finally:
-        parent_conn.send("done")
+        try:
+            parent_conn.send("done")
+        except OSError:  # child already gone — don't mask the real failure
+            pass
         proc.join(timeout=10)
         if proc.is_alive():
             proc.kill()
@@ -131,6 +138,58 @@ def run_e2e(n_containers: int, samples: int) -> dict:
         "discover_seconds": round(stats["discover_seconds"], 3),
         "fetch_seconds": round(stats["fetch_seconds"], 3),
         "compute_seconds": round(stats["compute_seconds"], 3),
+    }
+
+
+def run_ingest_throughput(n_series: int = 1000, samples: int = 2688) -> dict:
+    """Measure the native scanner's ingest legs on a pre-rendered
+    namespace-batched body, no network — the per-core terms of BASELINE.md's
+    config-4 wall-clock budget:
+
+    * ``ingest_digest_bytes_per_sec`` — fused parse+digest (the config-4 CPU
+      sink: every sample straight into its log bucket);
+    * ``ingest_stats_bytes_per_sec`` — parse+count/max (the memory sink);
+    * ``ingest_raw_bytes_per_sec`` — raw float64 collection (config 2/3);
+    * ``ingest_samples_per_sec`` / ``ingest_bytes_per_sample`` — the measured
+      density used in the budget arithmetic.
+    """
+    import numpy as np
+
+    from krr_tpu.integrations import native
+
+    rng = np.random.default_rng(17)
+    fragments = []
+    for i in range(n_series):
+        values = ",".join(
+            f'[{1700000000 + 5 * t},"{float(v)!r}"]'
+            for t, v in enumerate(rng.gamma(2.0, 0.05, samples))
+        )
+        fragments.append(
+            '{"metric":{"pod":"wl-%d-0","container":"main"},"values":[%s]}' % (i, values)
+        )
+    body = (
+        '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
+    ).encode()
+    total_samples = n_series * samples
+
+    def best_of(fn, runs=3) -> float:
+        fn()  # warm (and build the .so on first use)
+        return min(_timed(fn) for _ in range(runs))
+
+    def _timed(fn) -> float:
+        start = time.perf_counter()
+        fn()
+        return time.perf_counter() - start
+
+    digest_s = best_of(lambda: native.parse_matrix_digest(body, 1.01, 1e-7, 2560))
+    stats_s = best_of(lambda: native.parse_matrix_stats(body))
+    raw_s = best_of(lambda: native.parse_matrix(body))
+    return {
+        "ingest_bytes_per_sample": round(len(body) / total_samples, 2),
+        "ingest_samples_per_sec": round(total_samples / digest_s, 1),
+        "ingest_digest_bytes_per_sec": round(len(body) / digest_s, 1),
+        "ingest_stats_bytes_per_sec": round(len(body) / stats_s, 1),
+        "ingest_raw_bytes_per_sec": round(len(body) / raw_s, 1),
     }
 
 
@@ -198,6 +257,14 @@ def main() -> None:
             f"{out['digest_ingest_100k_objects_per_sec']:.0f} objects/s",
             file=sys.stderr,
         )
+    out.update(run_ingest_throughput())
+    print(
+        f"bench_e2e: scanner ingest {out['ingest_digest_bytes_per_sec']/1e6:.0f} MB/s digest-sink, "
+        f"{out['ingest_stats_bytes_per_sec']/1e6:.0f} MB/s stats-sink, "
+        f"{out['ingest_raw_bytes_per_sec']/1e6:.0f} MB/s raw "
+        f"({out['ingest_bytes_per_sample']} B/sample)",
+        file=sys.stderr,
+    )
     print(json.dumps(out))
 
 
