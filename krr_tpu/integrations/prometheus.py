@@ -413,10 +413,14 @@ class PrometheusLoader:
             except (http.client.HTTPException, httpx.TransportError, OSError) as e:
                 last_error = e
             else:
-                if status < 400:
+                if status < 300:
                     return body
                 detail = body[:200].decode("utf-8", errors="replace")
-                if status < 500:  # 4xx: non-retryable, surfaces now
+                # 3xx: the raw transport never follows redirects, and a
+                # redirect (SSO login, trailing slash) won't resolve by
+                # retrying — non-retryable, like 4xx. Feeding a redirect body
+                # to the parser would silently turn the fleet UNKNOWN.
+                if status < 500:
                     raise PrometheusQueryError(status, detail)
                 last_error = PrometheusQueryError(status, detail)
             if attempt + 1 < self.retries:

@@ -109,6 +109,10 @@ class FakeMetrics:
     #: backend that caps response sizes — per-workload queries still succeed
     #: (exercises the loader's automatic per-namespace fallback).
     fail_batched: bool = False
+    #: Answer every range query with a 302 (an SSO/ingress login redirect):
+    #: the loader must surface it as a failed query, never parse the
+    #: redirect body as an empty result.
+    redirect_queries: bool = False
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     #: When set, series are anchored at SERIES_ORIGIN with the requested step
     #: and sliced to the requested [start, end] — the contract the loader's
@@ -234,6 +238,10 @@ class FakeBackend:
         self.metrics.request_count += 1
         if len(str(request.rel_url)) > self.MAX_URL_BYTES:
             return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
+        if self.metrics.redirect_queries:
+            return web.Response(
+                status=302, headers={"Location": "https://sso.example/login"}, text="<html>login</html>"
+            )
         if self.metrics.fail_queries:
             return web.json_response({"status": "error", "error": "injected failure"}, status=500)
         if self.metrics.fail_next > 0:
