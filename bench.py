@@ -86,7 +86,9 @@ def main() -> None:
             file=sys.stderr,
         )
     chunk = int(os.environ.get("BENCH_CHUNK", 8_192))
-    runs = max(1, int(os.environ.get("BENCH_RUNS", 3)))
+    # 5 runs (round-2 verdict: 3 left round-over-round comparisons inside the
+    # recorded 4.8% chip-load spread — best-of-5 tightens the floor).
+    runs = max(1, int(os.environ.get("BENCH_RUNS", 5)))
     py_sample = int(os.environ.get("BENCH_PY_SAMPLE", 3))
     parity_rows = min(n, max(8, int(os.environ.get("BENCH_PARITY_ROWS", 512)) // 8 * 8))
 

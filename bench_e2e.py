@@ -193,6 +193,58 @@ def run_ingest_throughput(n_series: int = 1000, samples: int = 2688) -> dict:
     }
 
 
+def run_digest_store_scale(n_rows: int = 100_000) -> dict:
+    """DigestStore at config-4/5 width: fold a 100k-row window into the
+    persistent store, save, and load — the incremental-streaming legs of the
+    <60 s steady-state path (BASELINE.md config-4 budget). Counts are
+    band-sparse like real fleets (~40 active buckets/row of 2,560)."""
+    import numpy as np
+
+    from krr_tpu.core.streaming import DigestStore
+    from krr_tpu.ops.digest import DigestSpec
+
+    spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+    rng = np.random.default_rng(23)
+    keys = [f"c/ns-{i % 64}/wl-{i}/main/Deployment" for i in range(n_rows)]
+    counts = np.zeros((n_rows, spec.num_buckets), dtype=np.float32)
+    bands = rng.integers(200, 2300, size=n_rows)
+    for offset in range(40):  # 40 active buckets per row (bands stay < 2560)
+        counts[np.arange(n_rows), bands + offset] += rng.integers(1, 60, size=n_rows)
+    totals = counts.sum(axis=1)
+    peaks = rng.gamma(2.0, 0.3, n_rows).astype(np.float32)
+
+    store = DigestStore(spec=spec)
+    start = time.perf_counter()
+    store.merge_window(keys, counts, totals, peaks, totals, peaks * 1e3)
+    merge_s = time.perf_counter() - start
+
+    start = time.perf_counter()
+    rows = np.arange(n_rows)
+    p99 = store.cpu_percentile(rows, 99.0)
+    query_s = time.perf_counter() - start
+    assert np.isfinite(p99).all()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "state.npz")
+        start = time.perf_counter()
+        store.save(path)
+        save_s = time.perf_counter() - start
+        size_mb = os.path.getsize(path) / 1e6
+        start = time.perf_counter()
+        loaded = DigestStore.load(path)
+        load_s = time.perf_counter() - start
+        assert len(loaded.keys) == n_rows
+
+    return {
+        "digest_store_rows": n_rows,
+        "digest_store_merge_seconds": round(merge_s, 3),
+        "digest_store_query_p99_seconds": round(query_s, 3),
+        "digest_store_save_seconds": round(save_s, 3),
+        "digest_store_load_seconds": round(load_s, 3),
+        "digest_store_file_mb": round(size_mb, 1),
+    }
+
+
 def run_digest_ingest(n_rows: int) -> dict:
     """Time the digest-ingest compute path (run_digested: host percentile
     query + Decimal finalize + severity-ready raw results) at config-4 fleet
@@ -255,6 +307,16 @@ def main() -> None:
         print(
             f"bench_e2e: digest_ingest at {ingest_rows} rows -> "
             f"{out['digest_ingest_100k_objects_per_sec']:.0f} objects/s",
+            file=sys.stderr,
+        )
+    store_rows = int(os.environ.get("BENCH_E2E_STORE_ROWS", 100_000))
+    if store_rows:
+        out.update(run_digest_store_scale(store_rows))
+        print(
+            f"bench_e2e: DigestStore at {store_rows} rows x 2560 buckets -> "
+            f"merge {out['digest_store_merge_seconds']}s, p99 query {out['digest_store_query_p99_seconds']}s, "
+            f"save {out['digest_store_save_seconds']}s ({out['digest_store_file_mb']} MB), "
+            f"load {out['digest_store_load_seconds']}s",
             file=sys.stderr,
         )
     out.update(run_ingest_throughput())

@@ -169,10 +169,10 @@ class Runner:
 
         def fold_histories(indices: list[int], fetched: dict[ResourceType, list[RaggedHistory]]) -> None:
             for local_i, global_i in enumerate(indices):
-                for pod, samples in fetched[ResourceType.CPU][local_i].items():
+                for samples in fetched[ResourceType.CPU][local_i].values():
                     counts, total, peak = _digest_python(samples, spec.gamma, spec.min_value, spec.num_buckets)
                     fleet.merge_cpu_row(global_i, counts, total, peak)
-                for pod, samples in fetched[ResourceType.Memory][local_i].items():
+                for samples in fetched[ResourceType.Memory][local_i].values():
                     if samples.size:
                         fleet.merge_mem_row(global_i, float(samples.size), float(samples.max()))
 

@@ -14,9 +14,13 @@ from __future__ import annotations
 import asyncio
 import datetime
 import decimal
+import types
+import typing
+import uuid
 from typing import Any
 
 import click
+import pydantic_core
 
 from krr_tpu.utils.version import get_version
 
@@ -68,30 +72,74 @@ class PanelCommand(click.Command):
 
 
 def _click_type(annotation: Any) -> Any:
-    """Map a settings-field annotation to a click param type."""
+    """Map a settings-field annotation to a click param type (the analogue of
+    the reference's ``__process_type``, `/root/reference/robusta_krr/main.py:29-36`,
+    which unwraps Optional and passes UUID through). ``Optional[T]`` unwraps
+    to T; unknown types round-trip as str and pydantic re-validates."""
+    origin = typing.get_origin(annotation)
+    if origin in (typing.Union, types.UnionType):
+        non_none = [a for a in typing.get_args(annotation) if a is not type(None)]
+        if len(non_none) == 1:  # Optional[T] -> T
+            return _click_type(non_none[0])
+        return str
     if annotation is bool:
         return bool
     if annotation is int:
         return int
     if annotation in (float, decimal.Decimal):
         return float
+    if annotation is uuid.UUID:
+        return click.UUID
     if annotation is datetime.datetime:
         return click.DateTime()
     return str  # unknown types round-trip as str; pydantic re-validates
+
+
+def _element_type(annotation: Any) -> Any:
+    """For a list/set/tuple annotation, the click type of its elements —
+    rendered as a repeatable flag (``--field a --field b``); None for
+    non-sequence annotations."""
+    origin = typing.get_origin(annotation)
+    if origin in (typing.Union, types.UnionType):
+        non_none = [a for a in typing.get_args(annotation) if a is not type(None)]
+        return _element_type(non_none[0]) if len(non_none) == 1 else None
+    if origin in (list, set, frozenset, tuple):
+        args = typing.get_args(annotation)
+        return _click_type(args[0]) if args else str
+    return None
 
 
 def _strategy_options(strategy_type: Any) -> list[click.Option]:
     """Reflect a StrategySettings model's fields into click options."""
     options: list[click.Option] = []
     for field_name, field in strategy_type.get_settings_type().model_fields.items():
-        default = field.default
+        # get_default resolves default_factory fields too; truly required
+        # fields come back as PydanticUndefined -> no CLI default.
+        default = field.get_default(call_default_factory=True)
+        if default is pydantic_core.PydanticUndefined:
+            default = None
         if isinstance(default, decimal.Decimal):
             default = float(default)
+        element = _element_type(field.annotation)
+        if element is not None and isinstance(default, (list, set, frozenset)):
+            default = tuple(default)  # click multiple options take tuples
+        # Optional[list[...]] = None: click resolves an absent repeatable
+        # flag to (), which pydantic would coerce to [] — masking the
+        # model's None default (None may mean "no filtering" while [] means
+        # "filter everything"). () can only mean "flag absent", so map it
+        # back to the model's None.
+        callback = (
+            (lambda ctx, param, value: None if value == () else value)
+            if element is not None and default is None
+            else None
+        )
         options.append(
             PanelOption(
                 [f"--{field_name}"],
-                type=_click_type(field.annotation),
+                type=element if element is not None else _click_type(field.annotation),
+                multiple=element is not None,
                 default=default,
+                callback=callback,
                 show_default=True,
                 help=field.description or "",
                 panel="TPU Backend Settings" if field_name in TPU_BACKEND_FIELDS else "Strategy Settings",
@@ -101,6 +149,11 @@ def _strategy_options(strategy_type: Any) -> list[click.Option]:
 
 
 def _common_options() -> list[click.Option]:
+    from krr_tpu.formatters.base import BaseFormatter
+
+    # Enumerated at command-build time, so plugin formatters defined before
+    # krr_tpu.run() appear in the help (reference `main.py:81`).
+    formatter_names = ", ".join(BaseFormatter.get_all())
     return [
         PanelOption(
             ["--cluster", "-c", "clusters"],
@@ -152,7 +205,12 @@ def _common_options() -> list[click.Option]:
         ),
         PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
         PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
-        PanelOption(["--formatter", "-f", "format"], default="table", show_default=True, help="Output formatter"),
+        PanelOption(
+            ["--formatter", "-f", "format"],
+            default="table",
+            show_default=True,
+            help=f"Output formatter ({formatter_names})",
+        ),
         PanelOption(["--verbose", "-v"], is_flag=True, default=False, panel="Logging Settings", help="Enable verbose mode"),
         PanelOption(["--quiet", "-q"], is_flag=True, default=False, panel="Logging Settings", help="Enable quiet mode"),
         PanelOption(

@@ -2,7 +2,6 @@ from krr_tpu.parallel.fleet import (
     sharded_fleet_digest,
     sharded_fleet_topk,
     sharded_masked_max,
-    sharded_peak,
     sharded_percentile,
     sharded_percentile_bisect,
     transfer_to_mesh,
@@ -22,7 +21,6 @@ __all__ = [
     "transfer_to_mesh",
     "sharded_fleet_digest",
     "sharded_fleet_topk",
-    "sharded_peak",
     "sharded_percentile",
     "DATA_AXIS",
     "TIME_AXIS",

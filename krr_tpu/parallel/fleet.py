@@ -110,10 +110,6 @@ def sharded_percentile(
     return np.asarray(digest_ops.percentile(spec, digest, q))[:real_rows]
 
 
-def sharded_peak(digest: Digest, real_rows: int) -> np.ndarray:
-    return np.asarray(digest_ops.peak(digest))[:real_rows]
-
-
 @partial(jax.jit, static_argnames=("mesh", "k", "chunk_size"))
 def _sharded_topk_build(
     mesh: Mesh, values: jax.Array, counts: jax.Array, k: int, chunk_size: int

@@ -128,6 +128,64 @@ class TestCLI:
         logging_part = out.split("Logging Settings:")[1].split("Strategy Settings:")[0]
         assert "--verbose" in logging_part
 
+    def test_formatter_help_lists_registered_formatters(self):
+        """-f help enumerates the registered formatters (reference
+        `main.py:81` interpolates them into the option help)."""
+        result = runner.invoke(app, ["simple", "--help"])
+        import re
+
+        formatter_help = re.sub(r"\s+", " ", result.output)
+        for name in ("table", "json", "yaml", "pprint"):
+            assert name in formatter_help.split("Output formatter")[1][:120], result.output
+
+    def test_settings_type_reflection(self):
+        """Plugin settings with Optional[...], UUID, and list[str] fields get
+        working typed flags (the reference's __process_type handles the first
+        two; lists fall to str there — here they become repeatable flags)."""
+        import uuid
+        from typing import Optional
+
+        import click
+        import pydantic
+
+        from krr_tpu.main import _click_type, _element_type, _strategy_options
+
+        assert _click_type(Optional[int]) is int
+        assert _click_type(Optional[float]) is float
+        assert isinstance(_click_type(uuid.UUID), type(click.UUID)) or _click_type(uuid.UUID) is click.UUID
+        assert _element_type(list[str]) is str
+        assert _element_type(Optional[list[int]]) is int
+        assert _element_type(int) is None
+
+        class FakeSettings(pydantic.BaseModel):
+            scan_id: Optional[uuid.UUID] = pydantic.Field(None, description="scan id")
+            excluded: list[str] = pydantic.Field(default_factory=lambda: ["a"], description="names")
+            ratio: Optional[float] = pydantic.Field(None, description="ratio")
+            maybe_names: Optional[list[str]] = pydantic.Field(None, description="optional names")
+
+        class FakeStrategy:
+            @staticmethod
+            def get_settings_type():
+                return FakeSettings
+
+        options = {o.name: o for o in _strategy_options(FakeStrategy)}
+        assert options["excluded"].multiple and options["excluded"].default == ("a",)
+        assert options["excluded"].type is click.STRING or options["excluded"].type.name == "text"
+        assert options["ratio"].type is float or options["ratio"].type.name == "float"
+        # Round-trip through click parsing: repeatable flag yields a tuple
+        # pydantic coerces back to list[str].
+        command = click.Command(
+            "fake",
+            params=list(options.values()),
+            callback=lambda **kw: print([kw["excluded"], kw["maybe_names"]]),
+        )
+        result = CliRunner().invoke(command, ["--excluded", "x", "--excluded", "y"])
+        # Optional[list] with default None: an absent repeatable flag maps
+        # back to None (not () -> []), preserving the model's None branch.
+        assert result.exit_code == 0 and "[('x', 'y'), None]" in result.output
+        result = CliRunner().invoke(command, ["--maybe_names", "z"])
+        assert result.exit_code == 0 and "('z',)" in result.output
+
     def test_version(self):
         result = runner.invoke(app, ["version"])
         assert result.exit_code == 0

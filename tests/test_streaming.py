@@ -40,6 +40,29 @@ class TestDigestStore:
         np.testing.assert_array_equal(loaded.cpu_counts, store.cpu_counts)
         np.testing.assert_array_equal(loaded.mem_peak, store.mem_peak)
 
+    def test_shuffled_remerge_equals_ordered(self, rng):
+        """A re-scan that returns the fleet in a different order must land on
+        the same rows (non-contiguous scatter path) — and a window carrying a
+        duplicate unseen key must grow ONE row, not one per occurrence
+        (regression: the dup used to orphan a row and misroute the merge)."""
+        ones = np.ones((2, SPEC.num_buckets), np.float32)
+        store = DigestStore(spec=SPEC)
+        store.merge_window(["x", "y"], ones, np.array([8.0, 8.0]), np.array([1.0, 2.0]),
+                           np.array([8.0, 8.0]), np.array([5.0, 3.0]))
+        store.merge_window(["y", "x"], ones, np.array([8.0, 8.0]), np.array([9.0, 1.0]),
+                           np.array([8.0, 8.0]), np.array([1.0, 9.0]))
+        assert store.keys == ["x", "y"]
+        np.testing.assert_array_equal(store.cpu_total, [16.0, 16.0])
+        np.testing.assert_array_equal(store.cpu_peak, [1.0, 9.0])
+        np.testing.assert_array_equal(store.mem_peak, [9.0, 3.0])
+
+        dup = DigestStore(spec=SPEC)
+        rows = dup.merge_window(["a", "a"], ones, np.array([8.0, 8.0]), np.array([1.0, 2.0]),
+                                np.array([8.0, 8.0]), np.array([5.0, 3.0]))
+        assert list(rows) == [0, 0] and dup.keys == ["a"]
+        assert dup.cpu_counts[0].sum() == 2 * SPEC.num_buckets
+        assert dup.cpu_peak[0] == 2.0 and dup.mem_peak[0] == 5.0
+
     def test_incremental_windows_equal_oneshot(self, rng):
         """4 disjoint windows (4 'Prometheus sources') merged in any order
         must equal one digest over the concatenated history — exactly."""
