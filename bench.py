@@ -35,11 +35,17 @@ the fetch+parse+compute pipeline). NOTE: on the tunneled TPU backend
 ``block_until_ready`` returns early — sync is via small host readbacks.
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "containers/s", "vs_baseline": N,
-     "parity": "ok", "runs": N, "spread_pct": N, "secondary": {...}}
+     "parity": "ok", "runs": N, "spread_pct": N, "dispatch_floor_ms": N,
+     "secondary": {...}}
+``dispatch_floor_ms`` is the measured trivial jit-call + readback round trip:
+on the tunneled chip it is most of the headline measurement, so it is
+reported per run to tell rig-RTT movement apart from code movement.
 
 Env knobs: BENCH_CONTAINERS (default 10000), BENCH_TIMESTEPS (default 120960),
-BENCH_CHUNK (default 8192), BENCH_RUNS (default 3), BENCH_PY_SAMPLE
-(default 3), BENCH_SKIP_DIGEST, BENCH_PARITY_ROWS (default 512).
+BENCH_CHUNK (default 8192), BENCH_RUNS (default 5), BENCH_PY_SAMPLE
+(default 3), BENCH_SKIP_DIGEST, BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default
+512). The e2e leg runs `bench_e2e.py` in a subprocess with
+BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
 """
 
 from __future__ import annotations
@@ -49,6 +55,12 @@ import os
 import sys
 import time
 from decimal import Decimal
+
+
+def _time_once(fn) -> float:
+    start = time.perf_counter()
+    fn()
+    return time.perf_counter() - start
 
 
 def python_reference_seconds_per_container(timesteps: int, sample: int) -> float:
@@ -171,6 +183,19 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # Measured dispatch floor: one trivial jit call + host readback. On the
+    # tunneled chip this RTT is ~90 ms — ~2/3 of the headline measurement —
+    # so the reported containers/s is a LOWER bound set by per-call latency,
+    # not by the kernel: at 4x the rows over the same bytes the same program
+    # measures ~2.4x the throughput (ARCHITECTURE.md records the sweep).
+    tiny = jnp.ones((8, 128), jnp.float32)
+    tiny_step = jax.jit(lambda a: a.sum(axis=1))
+    _ = np.asarray(tiny_step(tiny))
+    floor = min(
+        _time_once(lambda: np.asarray(tiny_step(tiny))) for _ in range(5)
+    )
+    print(f"bench: dispatch+readback floor {floor * 1e3:.1f} ms", file=sys.stderr)
+
     # --- On-hardware parity gate, part 1: fused Pallas vs pure-jnp XLA.
     # Same chip, same subsample, two independent lowerings; the contract is
     # bit-identity (BASELINE.md correctness gate is ±1% vs the reference —
@@ -266,12 +291,17 @@ def main() -> None:
         # in a subprocess so a pipeline failure can't take down the headline.
         import subprocess
 
+        env = {**os.environ}
+        # Record the e2e number at fleet scale (round-2 verdict: >= 10k
+        # containers) unless the caller pinned a size.
+        env.setdefault("BENCH_E2E_CONTAINERS", "10000")
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py")],
                 capture_output=True,
                 text=True,
                 timeout=900,
+                env=env,
             )
             for line in proc.stderr.splitlines():
                 print(line, file=sys.stderr)
@@ -299,6 +329,7 @@ def main() -> None:
                 "parity": "fail" if parity_failures else "ok",
                 "runs": runs,
                 "spread_pct": round(exact_spread, 1),
+                "dispatch_floor_ms": round(floor * 1e3, 1),
                 "secondary": secondary,
             }
         )

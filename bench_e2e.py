@@ -21,13 +21,17 @@ component throughputs (the honest per-core numbers): native parse
 ~450 MB/s, http.client read ~1.1 GB/s (see BASELINE.md's ingest budget).
 
 Prints ONE JSON line:
-    {"e2e_objects_per_sec": N, "e2e_containers": N, "discover_seconds": N,
-     "fetch_seconds": N, "compute_seconds": N,
-     "digest_ingest_100k_objects_per_sec": N}
+    {"e2e_objects_per_sec": N, "e2e_objects_per_sec_cold": N,
+     "e2e_containers": N, "discover_seconds": N, "fetch_seconds": N,
+     "compute_seconds": N, "e2e_digest_objects_per_sec": N,
+     "e2e_digest_fetch_seconds": N, "digest_ingest_100k_objects_per_sec": N,
+     "digest_store_*": ...,  # 100k x 2560 store merge/query/save/load + MB
+     "ingest_*": ...}        # scanner sink throughputs + bytes/sample
 
-Env knobs: BENCH_E2E_CONTAINERS (default 1000), BENCH_E2E_SAMPLES (default
-1344 = 2 weeks @ 15 min, the reference's workload shape),
-BENCH_E2E_INGEST_ROWS (default 100000; 0 skips the ingest measurement).
+Env knobs: BENCH_E2E_CONTAINERS (default 1000; bench.py's subprocess sets
+10000), BENCH_E2E_SAMPLES (default 1344 = 2 weeks @ 15 min, the reference's
+workload shape), BENCH_E2E_INGEST_ROWS (default 100000; 0 skips),
+BENCH_E2E_STORE_ROWS (default 100000; 0 skips the DigestStore leg).
 """
 
 from __future__ import annotations
@@ -109,8 +113,8 @@ def run_e2e(n_containers: int, samples: int) -> dict:
                 quiet=True,
                 format="json",
             )
-            def one_scan() -> tuple[float, dict]:
-                runner = Runner(config)
+            def one_scan(cfg=None) -> tuple[float, dict]:
+                runner = Runner(cfg or config)
                 start = time.perf_counter()
                 with contextlib.redirect_stdout(io.StringIO()):  # result JSON isn't the metric
                     asyncio.run(runner.run())
@@ -122,6 +126,16 @@ def run_e2e(n_containers: int, samples: int) -> dict:
             # recommender sees.
             cold_elapsed, _cold = one_scan()
             elapsed, stats = one_scan()
+
+            # The config-4 headline path end-to-end: tdigest digest-at-ingest
+            # (responses fold into per-object digests inside the native
+            # scanner; raw arrays never materialize). Same server, warm body
+            # cache — directly comparable to the raw-path number above.
+            digest_config = config.model_copy(
+                update={"strategy": "tdigest", "other_args": {"digest_ingest": True}}
+            )
+            one_scan(digest_config)  # cold (digest-path JIT/compile)
+            digest_elapsed, digest_stats = one_scan(digest_config)
     finally:
         try:
             parent_conn.send("done")
@@ -138,6 +152,8 @@ def run_e2e(n_containers: int, samples: int) -> dict:
         "discover_seconds": round(stats["discover_seconds"], 3),
         "fetch_seconds": round(stats["fetch_seconds"], 3),
         "compute_seconds": round(stats["compute_seconds"], 3),
+        "e2e_digest_objects_per_sec": round(digest_stats["objects"] / digest_elapsed, 1),
+        "e2e_digest_fetch_seconds": round(digest_stats["fetch_seconds"], 3),
     }
 
 
@@ -299,7 +315,9 @@ def main() -> None:
         f"bench_e2e: {out['e2e_containers']} containers x {samples} samples -> "
         f"{out['e2e_objects_per_sec']:.0f} objects/s end-to-end "
         f"(discover {out['discover_seconds']}s, fetch {out['fetch_seconds']}s, "
-        f"compute {out['compute_seconds']}s)",
+        f"compute {out['compute_seconds']}s); digest-ingest "
+        f"{out['e2e_digest_objects_per_sec']:.0f} objects/s "
+        f"(fetch {out['e2e_digest_fetch_seconds']}s)",
         file=sys.stderr,
     )
     if ingest_rows:

@@ -66,12 +66,7 @@ class DigestStore:
         repeated within one call (duplicate-object windows) must grow ONE
         row, not one per occurrence — the dedup here keeps the index and the
         row arrays consistent."""
-        seen_new: set[str] = set()
-        new = [
-            key
-            for key in keys
-            if key not in self._index and not (key in seen_new or seen_new.add(key))
-        ]
+        new = list(dict.fromkeys(key for key in keys if key not in self._index))
         if new:
             grow = len(new)
             self.cpu_counts = np.vstack([self.cpu_counts, np.zeros((grow, self.spec.num_buckets), np.float32)])
