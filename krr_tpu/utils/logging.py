@@ -18,6 +18,7 @@ module default used).
 from __future__ import annotations
 
 import inspect
+import sys
 from typing import Any, Literal
 
 from rich.console import Console
@@ -34,8 +35,23 @@ class KrrLogger:
 
     # -- result channel ------------------------------------------------------
     def print_result(self, content: Any) -> None:
-        """The scan result always goes to stdout, regardless of --logtostderr."""
-        Console().print(content)
+        """The scan result always goes to stdout, regardless of --logtostderr.
+
+        Machine output (str — json/yaml/pprint) is written RAW: rich's
+        ``Console.print`` soft-wraps at the console width and runs its
+        highlighter over the payload, which (a) can insert newlines inside a
+        fleet-sized JSON line — corrupting ``-f json > out.json`` — and (b)
+        costs minutes on multi-MB results (measured: a 9.6 MB single-line
+        payload didn't finish in 10 min; a raw write is instant). Rich
+        renderables (the table) still render through a fresh stdout console.
+        """
+        if isinstance(content, str):
+            sys.stdout.write(content)
+            if not content.endswith("\n"):
+                sys.stdout.write("\n")
+            sys.stdout.flush()
+        else:
+            Console().print(content)
 
     # -- log channel ---------------------------------------------------------
     @property

@@ -186,6 +186,34 @@ class TestCLI:
         result = CliRunner().invoke(command, ["--maybe_names", "z"])
         assert result.exit_code == 0 and "('z',)" in result.output
 
+    def test_machine_output_is_raw_and_unwrapped(self, fake_env, monkeypatch):
+        """Machine formats must reach stdout byte-exact: rich's console
+        printing soft-wraps at the terminal width, which inserts newlines
+        into fleet-sized single-line JSON (corrupting `-f json > out.json`)
+        and costs minutes on multi-MB payloads. Narrow COLUMNS simulates the
+        worst case."""
+        monkeypatch.setenv("COLUMNS", "40")
+        result = runner.invoke(
+            app,
+            ["simple", "-q", "-f", "json", "--kubeconfig", fake_env["kubeconfig"],
+             "-p", fake_env["server"].url],
+        )
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)  # would raise if wrapped mid-string
+        assert payload["scans"]
+
+    def test_print_result_is_byte_exact(self, monkeypatch, capsys):
+        """print_result must write machine output verbatim: lines longer than
+        the console width arrive unwrapped and unhighlighted (rich's print
+        would wrap at COLUMNS and markup-process the payload — corrupting
+        piped JSON and costing minutes at fleet-scale sizes)."""
+        from krr_tpu.utils.logging import KrrLogger
+
+        monkeypatch.setenv("COLUMNS", "40")
+        long_line = '{"name": "' + "x" * 300 + '", "style": "[bold red]not markup[/bold red]"}'
+        KrrLogger(quiet=True).print_result(long_line)
+        assert capsys.readouterr().out == long_line + "\n"
+
     def test_version(self):
         result = runner.invoke(app, ["version"])
         assert result.exit_code == 0
