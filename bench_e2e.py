@@ -13,12 +13,15 @@ The fakes run in a SEPARATE process (spawned, not forked — forking after JAX
 initializes is unsafe), so the server's GIL never blocks the scanner's, and
 batched response bodies are pre-rendered server-side on the first (cold)
 scan and served from cache on the warm scan that produces the headline
-number. CAVEAT: this image exposes ONE CPU core (`nproc` = 1), so the
-measured wall-clock is the SUM of server serving + client read + parse +
-routing + pack, not their overlap — on any real multi-core host the server
-cost leaves the measurement and concurrent reads/parses overlap. Solo
-component throughputs (the honest per-core numbers): native parse
-~450 MB/s, http.client read ~1.1 GB/s (see BASELINE.md's ingest budget).
+number. CAVEATS of this rig: (a) ONE CPU core (`nproc` = 1), so the measured
+wall-clock is the SUM of server serving + client read + parse + routing +
+pack, not their overlap; (b) the tunneled TPU transfers host→device at
+~12 MB/s (measured), so the raw path's compute_seconds at fleet scale is
+mostly input transfer — production PCIe moves GB/s. Solo component
+throughputs (the honest per-core numbers): native parse ~450 MB/s,
+http.client read ~1.1 GB/s (see BASELINE.md's ingest budget). The
+digest-ingest path ships no bulk arrays to the device at all, which is why
+its e2e number is several times the raw path's here.
 
 Prints ONE JSON line:
     {"e2e_objects_per_sec": N, "e2e_objects_per_sec_cold": N,
