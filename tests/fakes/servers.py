@@ -377,10 +377,15 @@ class FakeBackend:
 
 
 class ServerThread:
-    """Runs a FakeBackend on localhost in a daemon thread with its own loop."""
+    """Runs a FakeBackend on localhost in a daemon thread with its own loop.
 
-    def __init__(self, backend: FakeBackend):
+    Pass ``ssl_context`` to serve HTTPS (e.g. a self-signed cert — the shape
+    of a typical in-cluster Prometheus, pinning the loader's TLS branches).
+    """
+
+    def __init__(self, backend: FakeBackend, ssl_context: Optional[object] = None):
         self.backend = backend
+        self.ssl_context = ssl_context
         self.port: Optional[int] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -393,7 +398,7 @@ class ServerThread:
         async def start() -> None:
             runner = web.AppRunner(self.backend.build_app())
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0)
+            site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=self.ssl_context)
             await site.start()
             self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
             self._started.set()
@@ -409,7 +414,8 @@ class ServerThread:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.ssl_context is not None else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def stop(self) -> None:
         if self._loop is not None:

@@ -472,6 +472,105 @@ class TestBatchedFleetQueries:
         np.testing.assert_array_equal(baseline.mem_peak, fallback.mem_peak)
 
 
+class TestHTTPSPrometheus:
+    """A self-signed HTTPS Prometheus (the typical in-cluster shape): with
+    verification off (the default), both the probe (httpx) and the raw
+    http.client data plane must connect through their unverified-TLS
+    branches and fetch data."""
+
+    @staticmethod
+    def _self_signed_context(tmp_path):
+        import datetime as dt
+        import ipaddress
+        import ssl
+
+        # Not a declared dependency — only present transitively in this
+        # image; environments without it skip rather than error.
+        pytest.importorskip("cryptography")
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+        now = dt.datetime.now(dt.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + dt.timedelta(days=1))
+            .add_extension(
+                x509.SubjectAlternativeName([x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+                critical=False,
+            )
+            .sign(key, hashes.SHA256())
+        )
+        cert_file = tmp_path / "cert.pem"
+        key_file = tmp_path / "key.pem"
+        cert_file.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+        key_file.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(str(cert_file), str(key_file))
+        return ctx
+
+    def test_self_signed_https_scan(self, tmp_path, monkeypatch):
+        import urllib.request
+
+        import numpy as np
+
+        # Pin a proxy-free environment: a developer's https_proxy would
+        # legitimately make _make_raw_transport decline the raw transport.
+        monkeypatch.setattr(urllib.request, "getproxies", lambda: {})
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        rng = np.random.default_rng(13)
+        (pod,) = cluster.add_workload_with_pods("Deployment", "tls-wl", "default", pod_count=1)
+        metrics.set_series("default", "main", pod,
+                           cpu=rng.gamma(2.0, 0.05, 48), memory=rng.uniform(5e7, 2e8, 48))
+        server = ServerThread(FakeBackend(cluster, metrics), ssl_context=self._self_signed_context(tmp_path)).start()
+        try:
+            assert server.url.startswith("https://")
+            kubeconfig = tmp_path / "config"
+            kubeconfig.write_text(yaml.dump({
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "u"}}],
+                "clusters": [{"name": "fake", "cluster": {"server": server.url,
+                                                          "insecure-skip-tls-verify": True}}],
+                "users": [{"name": "u", "user": {"token": "t"}}],
+            }))
+            config = Config(kubeconfig=str(kubeconfig), prometheus_url=server.url)
+            objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+            assert objects
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    histories = await prom.gather_fleet(objects, 3600, 60)
+                    return prom._raw, histories
+                finally:
+                    await prom.close()
+
+            raw, histories = asyncio.run(fetch())
+            assert raw is not None and raw._https  # the raw TLS branch served
+            target = next(i for i, o in enumerate(objects) if o.name == "tls-wl")
+            np.testing.assert_allclose(
+                histories[ResourceType.CPU][target][pod],
+                metrics.series[("default", "main", pod)][0],
+            )
+        finally:
+            server.stop()
+
+
 class TestClusterSelection:
     def test_star_selects_all_contexts(self, fake_env, tmp_path):
         """clusters='*' scans every kubeconfig context (reference
