@@ -212,26 +212,46 @@ def step_string(step_seconds: float) -> str:
 #: points per series ("exceeded maximum resolution of 11,000 points").
 MAX_RANGE_POINTS = 11_000
 
+#: Cap on TOTAL samples per response (series × points per window): the
+#: loader reads each response fully into memory (~35 B/sample of JSON), so
+#: an unbounded namespace-batched response from a 100k-pod namespace could
+#: be tens of GB. 20M samples ≈ 700 MB of body — bounded loader memory at
+#: any fleet width, paid for with more (concurrent, exactly-merged) windows.
+MAX_RESPONSE_SAMPLES = 20_000_000
 
-def subwindows(start: float, end: float, step_seconds: float) -> list[tuple[float, float]]:
-    """Split ``[start, end]`` into sub-ranges of ≤ ``MAX_RANGE_POINTS`` steps.
+
+def window_points_cap(expected_series: int) -> int:
+    """Points per sub-window for a query expected to return ``expected_series``
+    series: the Prometheus per-series cap, tightened so series × points stays
+    under ``MAX_RESPONSE_SAMPLES``. At least one point per window."""
+    if expected_series <= 0:
+        return MAX_RANGE_POINTS
+    return max(1, min(MAX_RANGE_POINTS, MAX_RESPONSE_SAMPLES // expected_series))
+
+
+def subwindows(
+    start: float, end: float, step_seconds: float, max_points: int = MAX_RANGE_POINTS
+) -> list[tuple[float, float]]:
+    """Split ``[start, end]`` into sub-ranges of ≤ ``max_points`` steps.
 
     Prometheus evaluates a range query at ``start, start + step, … ≤ end``;
     the sub-windows tile exactly that grid (window ``j`` starts at point
     ``j · M``), so the union of the split queries returns the same samples
     as the single query would — no duplicates, no gaps. Long fine-grained
-    windows (7 d @ 5 s = 120,961 grid points) split into ⌈n / 11,000⌉ concurrent
-    queries; the per-pod series concatenate in window order (raw path) or
-    merge exactly (digest/stats ingest — sketches are mergeable).
+    windows (7 d @ 5 s = 120,961 grid points) split into ⌈n / max_points⌉
+    concurrent queries; the per-series samples concatenate in window order
+    (raw path) or merge exactly (digest/stats ingest — sketches are
+    mergeable). ``max_points`` defaults to the server's per-series cap and
+    tightens for wide fan-outs (see :func:`window_points_cap`).
     """
     step = effective_step_seconds(step_seconds)
     n_points = int((end - start) // step) + 1
-    if n_points <= MAX_RANGE_POINTS:
+    if n_points <= max_points:
         return [(start, end)]
     windows = []
     j = 0
     while j < n_points:
-        last = min(j + MAX_RANGE_POINTS, n_points) - 1
+        last = min(j + max_points, n_points) - 1
         windows.append((start + j * step, start + last * step))
         j = last + 1
     return windows
@@ -385,6 +405,34 @@ class PrometheusLoader:
             response = await self._client.post("/api/v1/query_range", data=params)
         return response.status_code, response.content
 
+    async def _count_series(self, range_query: str, at_time: float) -> Optional[int]:
+        """ACTUAL series count of a batched range query, via one cheap
+        instant ``count(...)`` probe evaluated at the window's END (not
+        server now — a backfill scan's window may lie entirely in the past).
+        The routed pod count only covers scanned workloads — a namespace can
+        hold arbitrarily many unscanned/bare-pod series the range query will
+        also return, and the response memory bound (``window_points_cap``)
+        must be sized to what the server will actually send, not to what we
+        will keep (round-3 review finding). Series that churned away before
+        ``at_time`` escape an instant count — a structural limit; the
+        nominal ~700 MB/body that ``MAX_RESPONSE_SAMPLES`` targets carries
+        the headroom for that. None on any failure (callers fall back to
+        the routed estimate)."""
+        if self._client is None:
+            return None
+        try:
+            response = await self._client.get(
+                "/api/v1/query", params={"query": f"count({range_query})", "time": at_time}
+            )
+            if response.status_code != 200:
+                return None
+            result = (response.json().get("data") or {}).get("result") or []
+            if not result:
+                return 0
+            return int(float(result[0]["value"][1]))
+        except Exception:
+            return None
+
     async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
         """Range query with retry + exponential backoff; returns the raw
         response body (callers pick their parser).
@@ -428,12 +476,27 @@ class PrometheusLoader:
         assert last_error is not None
         raise last_error
 
-    async def _fetch_parsed_windows(
-        self, query: str, start: float, end: float, step_seconds: float, parse
-    ) -> "list[list]":
-        """Fetch every ≤11k-point sub-window of the range concurrently and
-        parse each body off the event loop; returns per-window parse results
-        in window (time) order. One window short-circuits to one fetch.
+    @staticmethod
+    def _kept(parse, keep: "Optional[set]"):
+        """Wrap a parser to drop series whose key isn't in ``keep`` INSIDE
+        the worker thread: on batched queries, unrouted (bare-pod/unscanned)
+        series can dwarf the routed ones, and retaining their parsed arrays
+        until routing would unbound loader memory (round-3 review finding)."""
+        if keep is None:
+            return parse
+        return lambda body: [entry for entry in parse(body) if entry[0] in keep]
+
+    async def _window_fan_out(
+        self, query: str, start: float, end: float, step_seconds: float, parse,
+        expected_series: int, consume,
+    ) -> None:
+        """Shared sub-window fan-out: fetch every sub-window concurrently,
+        parse each body off the event loop (CPU-bound, up to ~MBs), and hand
+        each window's entries to ``consume(window_index, entries)`` on the
+        loop as it completes. Windows are sized to the server's 11k-point
+        cap AND to a total-samples cap from ``expected_series`` (probed from
+        the server for batched queries — see ``_expected_series``), keeping
+        every response body bounded no matter how wide the namespace is.
 
         Failures surface only after every sibling fetch settles
         (``return_exceptions``): raising early would leave the other windows'
@@ -443,24 +506,73 @@ class PrometheusLoader:
         """
         step = step_string(step_seconds)
 
-        async def one(w_start: float, w_end: float):
+        async def one(index: int, w_start: float, w_end: float) -> None:
             body = await self._fetch_range_body(query, w_start, w_end, step)
-            # Parsing is CPU-bound (up to ~MBs per response): keep it off the
-            # event loop so the fetch fan-out stays concurrent.
-            return await asyncio.to_thread(parse, body)
+            consume(index, await asyncio.to_thread(parse, body))
 
         results = await asyncio.gather(
-            *[one(s, e) for s, e in subwindows(start, end, step_seconds)],
+            *[
+                one(i, s, e)
+                for i, (s, e) in enumerate(
+                    subwindows(start, end, step_seconds, max_points=window_points_cap(expected_series))
+                )
+            ],
             return_exceptions=True,
         )
         for r in results:
             if isinstance(r, BaseException):
                 raise r
-        return list(results)
+
+    async def _fetch_parsed_windows(
+        self, query: str, start: float, end: float, step_seconds: float, parse,
+        expected_series: int = 0, keep: "Optional[set]" = None,
+    ) -> "list[list]":
+        """Sub-window fan-out returning per-window parse results in window
+        (time) order — the raw path, whose cross-window concatenation is
+        order-dependent."""
+        by_index: dict[int, list] = {}
+        await self._window_fan_out(
+            query, start, end, step_seconds, self._kept(parse, keep), expected_series,
+            by_index.__setitem__,
+        )
+        return [by_index[i] for i in range(len(by_index))]
+
+    async def _fold_windows(
+        self, query: str, start: float, end: float, step_seconds: float, parse,
+        expected_series: int, init, fold, keep: "Optional[set]" = None,
+    ) -> "list[tuple]":
+        """Sub-window fan-out with INCREMENTAL merging for order-independent
+        folds (digest/stats — counts add, peaks max): each window's parse
+        output folds into the shared per-series state as soon as it lands,
+        so only in-flight bodies and one window's parse output are ever
+        live — the gather barrier would retain every window's parsed digests
+        (windows × series state) before merging, which at capped-window
+        fan-outs scales with series² (round-3 review finding).
+        First-series-per-key applies per window, like
+        `_merge_window_series`; ``init`` takes OWNERSHIP of the entry's
+        arrays (each parse call allocates fresh ones), so ``fold`` may
+        mutate in place."""
+        merged: dict = {}
+
+        def consume(index: int, entries: list) -> None:
+            seen: set = set()  # single event loop: consume runs windows-serially
+            for entry in entries:
+                key = entry[0]
+                if key in seen:
+                    continue
+                seen.add(key)
+                merged[key] = fold(merged[key], entry) if key in merged else init(entry)
+
+        await self._window_fan_out(
+            query, start, end, step_seconds, self._kept(parse, keep), expected_series, consume
+        )
+        return [(key, *state) for key, state in merged.items()]
 
     @staticmethod
     def _merge_window_series(windows: "list[list]", init, fold) -> "list[tuple]":
-        """Shared per-series fold across split sub-windows.
+        """Per-series fold across split sub-windows in WINDOW (time) order —
+        the raw path's merge, whose concatenation is order-dependent
+        (digest/stats use the completion-order `_fold_windows` instead).
 
         Applies the first-series-per-key rule *per window* (matching the
         single-query behavior window-wise), then combines each key's
@@ -490,15 +602,19 @@ class PrometheusLoader:
         return [(key, *state) for key, state in merged.items()]
 
     async def _query_range(
-        self, query: str, start: float, end: float, step_seconds: float
+        self, query: str, start: float, end: float, step_seconds: float,
+        expected_series: int = 0, keep: "Optional[set]" = None,
     ) -> "list[tuple[tuple[str, str], np.ndarray]]":
         """Range query → parsed ((pod, container), samples) series via the
         native matrix parser (`krr_tpu.integrations.native`, pure-Python
         fallback); long fine-grained ranges split into sub-queries whose
-        per-series samples concatenate in time order."""
+        per-series samples concatenate in time order. ``keep`` drops
+        non-routed series inside the parse stage (batched queries)."""
         from krr_tpu.integrations.native import parse_matrix
 
-        windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix)
+        windows = await self._fetch_parsed_windows(
+            query, start, end, step_seconds, parse_matrix, expected_series, keep
+        )
         if len(windows) == 1:
             return windows[0]
         merged = self._merge_window_series(
@@ -533,12 +649,13 @@ class PrometheusLoader:
                 by_namespace.setdefault(obj.namespace, []).append(i)
         return by_namespace
 
-    def _route_series(self, objects, indices: list[int], series, merge) -> None:
-        """Deliver a batched response's rows to their objects. First series
-        per (pod, container) wins (callers pre-filter empty series, so the
-        defensive dedup matches the per-workload "first series with samples"
-        rule); ``merge(object_index, key, *payload)`` folds one row in."""
-        route = self._series_route(objects, indices)
+    @staticmethod
+    def _route_series(route: dict[tuple[str, str], list[int]], series, merge) -> None:
+        """Deliver a batched response's rows to their objects via a
+        prebuilt ``_series_route`` map. First series per (pod, container)
+        wins (callers pre-filter empty series, so the defensive dedup matches
+        the per-workload "first series with samples" rule);
+        ``merge(object_index, key, *payload)`` folds one row in."""
         seen: set[tuple[str, str]] = set()
         for key, *payload in series:
             if key in seen:
@@ -546,6 +663,14 @@ class PrometheusLoader:
             seen.add(key)
             for i in route.get(key, ()):
                 merge(i, key, *payload)
+
+    async def _expected_series(self, query: str, route: dict, end: float) -> int:
+        """Series-count estimate for sizing a batched query's sub-windows:
+        the ACTUAL count from a probe at the window end when the server
+        answers, never less than the routed count (the probe races pod
+        churn)."""
+        counted = await self._count_series(query, end)
+        return max(len(route), counted or 0)
 
     async def _fan_out(self, objects: list[K8sObjectData], per_workload, per_namespace) -> None:
         """Shared fetch orchestration for both ingest forms: one batched query
@@ -612,7 +737,9 @@ class PrometheusLoader:
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
             query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
             try:
-                series = await self._query_range(query, start, end, step_seconds)
+                series = await self._query_range(
+                    query, start, end, step_seconds, expected_series=len(obj.pods)
+                )
             except Exception as e:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
@@ -627,10 +754,14 @@ class PrometheusLoader:
 
         async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
-            series = await self._query_range(query, start, end, step_seconds)
+            route = self._series_route(objects, indices)
+            expected = await self._expected_series(query, route, end)
+            series = await self._query_range(
+                query, start, end, step_seconds,
+                expected_series=expected, keep=set(route),
+            )
             self._route_series(
-                objects,
-                indices,
+                route,
                 [(key, samples) for key, samples in series if samples.size],
                 lambda i, key, samples: histories[resource][i].__setitem__(key[0], samples),
             )
@@ -647,6 +778,8 @@ class PrometheusLoader:
         gamma: float,
         min_value: float,
         num_buckets: int,
+        expected_series: int = 0,
+        keep: "Optional[set]" = None,
     ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
@@ -656,33 +789,34 @@ class PrometheusLoader:
 
         from krr_tpu.integrations.native import parse_matrix_digest
 
-        windows = await self._fetch_parsed_windows(
+        def fold(state, entry):
+            counts, total, peak = state
+            counts += entry[1]  # owned array (see _fold_windows) — in place
+            return (counts, total + entry[2], max(peak, entry[3]))
+
+        return await self._fold_windows(
             query, start, end, step_seconds,
             partial(parse_matrix_digest, gamma=gamma, min_value=min_value, num_buckets=num_buckets),
-        )
-        if len(windows) == 1:
-            return windows[0]
-        return self._merge_window_series(
-            windows,
-            init=lambda e: (e[1].copy(), e[2], e[3]),
-            fold=lambda s, e: (s[0] + e[1], s[1] + e[2], max(s[2], e[3])),
+            expected_series,
+            init=lambda e: (e[1], e[2], e[3]),
+            fold=fold,
+            keep=keep,
         )
 
     async def _query_range_stats(
-        self, query: str, start: float, end: float, step_seconds: float
+        self, query: str, start: float, end: float, step_seconds: float,
+        expected_series: int = 0, keep: "Optional[set]" = None,
     ) -> "list[tuple[tuple[str, str], float, float]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
         sub-windows merge exactly (counts add, peaks max)."""
         from krr_tpu.integrations.native import parse_matrix_stats
 
-        windows = await self._fetch_parsed_windows(query, start, end, step_seconds, parse_matrix_stats)
-        if len(windows) == 1:
-            return windows[0]
-        return self._merge_window_series(
-            windows,
+        return await self._fold_windows(
+            query, start, end, step_seconds, parse_matrix_stats, expected_series,
             init=lambda e: (e[1], e[2]),
             fold=lambda s, e: (s[0] + e[1], max(s[1], e[2])),
+            keep=keep,
         )
 
     async def gather_fleet_digests(
@@ -708,9 +842,12 @@ class PrometheusLoader:
         start = end - history_seconds
         fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
 
-        async def fetch_cpu(query: str) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
+        async def fetch_cpu(
+            query: str, expected_series: int, keep: "Optional[set]" = None
+        ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
             return await self._query_range_digest(
-                query, start, end, step_seconds, gamma, min_value, num_buckets
+                query, start, end, step_seconds, gamma, min_value, num_buckets,
+                expected_series=expected_series, keep=keep,
             )
 
         async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
@@ -722,7 +859,7 @@ class PrometheusLoader:
             seen: set[str] = set()  # first series per pod, like gather_fleet
             try:
                 if resource is ResourceType.CPU:
-                    for (pod, _c), counts, total, peak in await fetch_cpu(query):
+                    for (pod, _c), counts, total, peak in await fetch_cpu(query, len(obj.pods)):
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_cpu_row(i, counts, total, peak)
@@ -730,7 +867,7 @@ class PrometheusLoader:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
                     for (pod, _c), total, peak in await self._query_range_stats(
-                        query, start, end, step_seconds
+                        query, start, end, step_seconds, expected_series=len(obj.pods)
                     ):
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
@@ -741,19 +878,24 @@ class PrometheusLoader:
 
         async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
+            route = self._series_route(objects, indices)
+            expected = await self._expected_series(query, route, end)
             if resource is ResourceType.CPU:
-                series: list = [row for row in await fetch_cpu(query) if row[2] > 0]
+                series: list = [
+                    row for row in await fetch_cpu(query, expected, keep=set(route)) if row[2] > 0
+                ]
                 merge = fleet.merge_cpu_row
             else:
                 series = [
                     row
-                    for row in await self._query_range_stats(query, start, end, step_seconds)
+                    for row in await self._query_range_stats(
+                        query, start, end, step_seconds,
+                        expected_series=expected, keep=set(route),
+                    )
                     if row[1] > 0
                 ]
                 merge = fleet.merge_mem_row
-            self._route_series(
-                objects, indices, series, lambda i, key, *payload: merge(i, *payload)
-            )
+            self._route_series(route, series, lambda i, key, *payload: merge(i, *payload))
 
         await self._fan_out(objects, per_workload, per_namespace)
         return fleet

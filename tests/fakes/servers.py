@@ -214,6 +214,23 @@ class FakeBackend:
 
     # --------------------------------------------------------- prom handlers
     async def query(self, request: web.Request) -> web.Response:
+        q = request.query.get("query", "")
+        # `count(<batched range query>)` — the loader's series-count probe
+        # for sizing sub-windows: answer with the TRUE number of series the
+        # wrapped query would return (all series in the namespace).
+        inner = _BATCHED_QUERY_RE.search(q)
+        if q.startswith("count(") and inner:
+            namespace = inner["namespace"]
+            is_cpu = "cpu_usage" in q
+            n = sum(
+                1
+                for k in self.metrics.series
+                if k[0] == namespace and len(self.metrics.series[k][0 if is_cpu else 1])
+            )
+            return web.json_response(
+                {"status": "success", "data": {"resultType": "vector",
+                                               "result": [{"metric": {}, "value": [0, str(n)]}]}}
+            )
         return web.json_response({"status": "success", "data": {"resultType": "vector", "result": []}})
 
     #: Real Prometheus (and most reverse proxies) cap the request line around

@@ -771,6 +771,33 @@ class TestRangeQuerySplitting:
         # Short windows don't split.
         assert subwindows(start, start + 3600, 60) == [(start, start + 3600)]
 
+    def test_response_sample_cap_tightens_windows(self):
+        """Namespace-batched fan-outs bound TOTAL samples per response, not
+        just points per series: a wide fleet splits into more windows so the
+        loader never materializes a multi-GB body."""
+        from krr_tpu.integrations.prometheus import (
+            MAX_RANGE_POINTS,
+            MAX_RESPONSE_SAMPLES,
+            subwindows,
+            window_points_cap,
+        )
+
+        assert window_points_cap(0) == MAX_RANGE_POINTS
+        assert window_points_cap(10) == MAX_RANGE_POINTS  # narrow: server cap rules
+        wide = 100_000
+        cap = window_points_cap(wide)
+        assert 1 <= cap < MAX_RANGE_POINTS
+        assert wide * cap <= MAX_RESPONSE_SAMPLES
+        # Degenerate width never collapses below one point per window.
+        assert window_points_cap(10 * MAX_RESPONSE_SAMPLES) == 1
+
+        start, step, n = 1_700_000_000.0, 5.0, 2_000
+        end = start + (n - 1) * step
+        windows = subwindows(start, end, step, max_points=cap)
+        assert len(windows) == -(-n // cap)
+        points = [p for s, e in windows for p in np.arange(s, e + step / 2, step)]
+        np.testing.assert_array_equal(np.asarray(points), start + step * np.arange(n))
+
     def _wide_window_env(self, tmp_path_factory, n_samples=30_000, step=5.0):
         from tests.fakes.servers import FakeBackend
 
@@ -814,6 +841,76 @@ class TestRangeQuerySplitting:
             np.testing.assert_allclose(histories[ResourceType.Memory][0][pod], mem)
             # 3 sub-windows x 2 resources (+1 connectivity probe not counted here)
             assert metrics.request_count == 6
+        finally:
+            server.stop()
+
+    def test_sample_cap_splits_batched_fetch_exactly(self, tmp_path_factory, monkeypatch):
+        """With the total-samples cap forced tiny, the namespace-batched
+        fetch splits into many sub-windows and still merges exactly."""
+        import krr_tpu.integrations.prometheus as prom_mod
+
+        monkeypatch.setattr(prom_mod, "MAX_RESPONSE_SAMPLES", 96)
+        server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(
+            tmp_path_factory, n_samples=1000, step=60.0
+        )
+        try:
+            loader = KubernetesLoader(config)
+            objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+            target = [o for o in objects if o.name == "longwin"]
+            base = metrics.request_count
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet(target, history, 60.0, end_time=end_time)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+            np.testing.assert_allclose(histories[ResourceType.CPU][0][pod], cpu)
+            np.testing.assert_allclose(histories[ResourceType.Memory][0][pod], mem)
+            # 1 routed series -> 96 points/window -> ceil(1000/96) windows x 2 resources.
+            assert metrics.request_count - base == 2 * (-(-1000 // 96))
+        finally:
+            server.stop()
+
+    def test_unrouted_series_tighten_windows_via_count_probe(self, tmp_path_factory, monkeypatch):
+        """The response bound must size to what the server will SEND, not
+        what we keep: unscanned series in the namespace (found by the
+        count() probe) shrink the windows even though none of them route."""
+        import krr_tpu.integrations.prometheus as prom_mod
+
+        monkeypatch.setattr(prom_mod, "MAX_RESPONSE_SAMPLES", 600)
+        n_samples = 1000
+        server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(
+            tmp_path_factory, n_samples=n_samples, step=60.0
+        )
+        try:
+            rng = np.random.default_rng(31)
+            for i in range(5):  # bare pods: served by the namespace query, never routed
+                metrics.set_series("default", "main", f"orphan-{i}",
+                                   cpu=rng.gamma(2.0, 0.05, n_samples),
+                                   memory=rng.uniform(5e7, 2e8, n_samples))
+            loader = KubernetesLoader(config)
+            objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+            target = [o for o in objects if o.name == "longwin"]
+            base = metrics.request_count
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    return await prom.gather_fleet(target, history, 60.0, end_time=end_time)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+            np.testing.assert_allclose(histories[ResourceType.CPU][0][pod], cpu)
+            np.testing.assert_allclose(histories[ResourceType.Memory][0][pod], mem)
+            assert all("orphan" not in p for p in histories[ResourceType.CPU][0])
+            # 6 actual series -> cap 100 points/window -> 10 windows per
+            # resource; the routed count alone (1 -> cap 600 -> 2 windows)
+            # would undersplit.
+            assert metrics.request_count - base == 2 * (-(-n_samples // (600 // 6)))
         finally:
             server.stop()
 
