@@ -70,6 +70,16 @@ class ClusterCredentials:
             return {"Authorization": f"Basic {basic}"}
         return {}
 
+    def refresh_auth_headers(self) -> dict[str, str]:
+        """Auth headers with any exec-plugin-derived token RE-RESOLVED:
+        ``resolve_token`` caches its result, so after a 401 mid-scan the
+        cached (expired) token must be dropped and the plugin re-run. A
+        static kubeconfig token has nothing to refresh and is returned
+        as-is — a repeat 401 with it is a real authz failure."""
+        if self.exec_spec:
+            self.token = None  # drop the cached (expired) plugin token
+        return self.auth_headers()
+
     def ssl_verify(self) -> ssl.SSLContext | bool:
         if self.insecure_skip_tls_verify:
             return False

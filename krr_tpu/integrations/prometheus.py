@@ -140,6 +140,12 @@ class _RawTransport:
                 self._idle.append(conn)
             return status, data
 
+    def update_headers(self, headers: dict[str, str]) -> None:
+        """Merge refreshed headers (e.g. a re-resolved bearer token) into the
+        base header set used by subsequent requests."""
+        with self._lock:
+            self._headers = {**self._headers, **headers}
+
     def close(self) -> None:
         with self._lock:
             idle, self._idle = self._idle, []
@@ -267,6 +273,11 @@ class PrometheusLoader:
         self.url: Optional[str] = config.prometheus_url
         self._client: Optional[httpx.AsyncClient] = None
         self._raw: Optional[_RawTransport] = None
+        #: Re-resolves auth headers (sync callable, may run an exec plugin) —
+        #: set when riding kubeconfig credentials, whose tokens expire.
+        self._auth_refresh = None
+        self._auth_generation = 0
+        self._refresh_lock = asyncio.Lock()
         self._connect_lock = asyncio.Lock()
         self._semaphore = asyncio.Semaphore(config.prometheus_max_connections)
         self.retries = 3
@@ -307,6 +318,12 @@ class PrometheusLoader:
                     # (auth_headers may run an exec plugin — off the loop.)
                     headers.update(await asyncio.to_thread(kube_api.credentials.auth_headers))
                     verify = kube_api.credentials.ssl_verify()
+                    # Exec-plugin/bearer tokens expire (EKS: ~15 min); an
+                    # hour-long backfill must re-resolve mid-scan instead of
+                    # degrading the whole fleet to UNKNOWN on the first 401.
+                    # refresh_auth_headers drops the cached plugin token
+                    # (plain auth_headers would hand the expired one back).
+                    self._auth_refresh = kube_api.credentials.refresh_auth_headers
 
                 client = httpx.AsyncClient(
                     base_url=self.url.rstrip("/"),
@@ -420,18 +437,29 @@ class PrometheusLoader:
         the routed estimate)."""
         if self._client is None:
             return None
-        try:
-            response = await self._client.get(
-                "/api/v1/query", params={"query": f"count({range_query})", "time": at_time}
-            )
-            if response.status_code != 200:
-                return None
-            result = (response.json().get("data") or {}).get("result") or []
-            if not result:
-                return 0
-            return int(float(result[0]["value"][1]))
-        except Exception:
-            return None
+        for attempt in range(2):
+            generation = self._auth_generation
+            try:
+                response = await self._client.get(
+                    "/api/v1/query", params={"query": f"count({range_query})", "time": at_time}
+                )
+                if response.status_code == 200:
+                    result = (response.json().get("data") or {}).get("result") or []
+                    if not result:
+                        return 0
+                    return int(float(result[0]["value"][1]))
+                # Expired token: refresh like the range path before the
+                # retry — a silently failed probe would undersize the
+                # windows and lose the memory bound for this namespace.
+                if response.status_code in (401, 403) and self._auth_refresh is not None and attempt == 0:
+                    await self._refresh_auth(generation)
+            except Exception:
+                pass  # transport hiccup: the loop grants one retry
+        self.logger.warning(
+            "series-count probe failed; sizing response windows from the routed "
+            "pod count only — unscanned series in the namespace may enlarge responses"
+        )
+        return None
 
     async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
         """Range query with retry + exponential backoff; returns the raw
@@ -449,7 +477,10 @@ class PrometheusLoader:
         """
         await self._ensure_connected()
         last_error: Optional[Exception] = None
-        for attempt in range(self.retries):
+        auth_refreshed = False
+        attempt = 0
+        while attempt < self.retries:
+            generation = self._auth_generation
             try:
                 async with self._semaphore:
                     if self._raw is not None:
@@ -464,6 +495,17 @@ class PrometheusLoader:
                 if status < 300:
                     return body
                 detail = body[:200].decode("utf-8", errors="replace")
+                if status in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
+                    # Expired kubeconfig token mid-scan: re-resolve (single-
+                    # flight across the fan-out) and retry with fresh
+                    # credentials. The retry is FREE — it doesn't consume a
+                    # transient-failure attempt, so a 401 on the last attempt
+                    # still gets its refreshed retry. A second 401 is a real
+                    # authz failure (non-retryable below).
+                    auth_refreshed = True
+                    await self._refresh_auth(generation)
+                    last_error = PrometheusQueryError(status, detail)
+                    continue  # no backoff: the failure was auth, not load
                 # 3xx: the raw transport never follows redirects, and a
                 # redirect (SSO login, trailing slash) won't resolve by
                 # retrying — non-retryable, like 4xx. Feeding a redirect body
@@ -471,10 +513,28 @@ class PrometheusLoader:
                 if status < 500:
                     raise PrometheusQueryError(status, detail)
                 last_error = PrometheusQueryError(status, detail)
-            if attempt + 1 < self.retries:
-                await asyncio.sleep(0.25 * 2**attempt)
+            attempt += 1
+            if attempt < self.retries:
+                await asyncio.sleep(0.25 * 2 ** (attempt - 1))
         assert last_error is not None
         raise last_error
+
+    async def _refresh_auth(self, seen_generation: int) -> None:
+        """Single-flight credential refresh: with dozens of windows in
+        flight, every one sees the 401 at once, and each would otherwise
+        spawn its own exec-plugin subprocess (up to 60 s each, racing the
+        plugin's on-disk cache). The generation check makes late arrivals
+        reuse a sibling's refresh instead of re-running the plugin."""
+        async with self._refresh_lock:
+            if self._auth_generation != seen_generation:
+                return  # a sibling refreshed while we waited
+            assert self._auth_refresh is not None
+            fresh = await asyncio.to_thread(self._auth_refresh)
+            if self._raw is not None:
+                self._raw.update_headers(fresh)
+            if self._client is not None:
+                self._client.headers.update(fresh)
+            self._auth_generation += 1
 
     @staticmethod
     def _kept(parse, keep: "Optional[set]"):

@@ -113,6 +113,9 @@ class FakeMetrics:
     #: the loader must surface it as a failed query, never parse the
     #: redirect body as an empty result.
     redirect_queries: bool = False
+    #: When set, range queries require `Authorization: Bearer <this>` and
+    #: 401 otherwise — exercising the loader's mid-scan credential refresh.
+    require_bearer: Optional[str] = None
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     #: When set, series are anchored at SERIES_ORIGIN with the requested step
     #: and sliced to the requested [start, end] — the contract the loader's
@@ -259,6 +262,9 @@ class FakeBackend:
             return web.Response(
                 status=302, headers={"Location": "https://sso.example/login"}, text="<html>login</html>"
             )
+        if self.metrics.require_bearer is not None:
+            if request.headers.get("Authorization") != f"Bearer {self.metrics.require_bearer}":
+                return web.json_response({"status": "error", "error": "Unauthorized"}, status=401)
         if self.metrics.fail_queries:
             return web.json_response({"status": "error", "error": "injected failure"}, status=500)
         if self.metrics.fail_next > 0:

@@ -459,6 +459,64 @@ class TestBatchedFleetQueries:
         # per-workload query — no retry storm on a 3xx.
         assert fake_env["metrics"].request_count - base == 2 * len(namespaces) + 2 * len(with_pods)
 
+    def test_expired_token_refreshes_mid_scan(self, fake_env, tmp_path):
+        """A 401 on a range query re-resolves credentials and retries — an
+        hour-long backfill behind the apiserver proxy must survive token
+        expiry (EKS exec-plugin tokens live ~15 min), not degrade the whole
+        fleet to UNKNOWN. Wired through the REAL credentials path: a cached
+        expired exec-plugin token that refresh_auth_headers must drop and
+        re-resolve by re-running the plugin."""
+        from krr_tpu.integrations.kubeconfig import ClusterCredentials
+
+        plugin = tmp_path / "token-plugin.sh"
+        plugin.write_text('#!/bin/sh\necho \'{"status": {"token": "fresh"}}\'\n')
+        plugin.chmod(0o755)
+        credentials = ClusterCredentials(
+            server=fake_env["server"].url, exec_spec={"command": str(plugin)}
+        )
+        credentials.token = "stale"  # as resolved at connect time, now expired
+
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        fake_env["metrics"].require_bearer = "fresh"
+        try:
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    await prom._ensure_connected()  # probe is auth-free in the fake
+                    prom._auth_refresh = credentials.refresh_auth_headers
+                    return await prom.gather_fleet(objects, 3600, 60)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+        finally:
+            fake_env["metrics"].require_bearer = None
+        assert credentials.token == "fresh"  # the plugin really re-ran
+        by_key = {(o.namespace, o.name, o.container): i for i, o in enumerate(objects)}
+        web_i = by_key[("default", "web", "main")]
+        for pod in fake_env["web_pods"]:
+            np.testing.assert_allclose(
+                histories[ResourceType.CPU][web_i][pod],
+                fake_env["metrics"].series[("default", "main", pod)][0],
+            )
+
+    def test_refresh_auth_headers_rerun_vs_static(self, monkeypatch):
+        """refresh_auth_headers re-runs the exec plugin (dropping the cached
+        token); a static kubeconfig token is returned as-is."""
+        from krr_tpu.integrations import kubeconfig as kc
+
+        tokens = iter(["t1", "t2"])
+        monkeypatch.setattr(kc, "_run_exec_plugin", lambda spec: next(tokens))
+        creds = kc.ClusterCredentials(server="https://x", exec_spec={"command": "x"})
+        assert creds.auth_headers() == {"Authorization": "Bearer t1"}
+        assert creds.auth_headers() == {"Authorization": "Bearer t1"}  # cached
+        assert creds.refresh_auth_headers() == {"Authorization": "Bearer t2"}
+
+        static = kc.ClusterCredentials(server="https://x", token="fixed")
+        assert static.refresh_auth_headers() == {"Authorization": "Bearer fixed"}
+
     def test_digest_failed_batched_query_falls_back(self, fake_env):
         config = make_config(fake_env)
         objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
