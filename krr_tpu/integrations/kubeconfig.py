@@ -45,6 +45,9 @@ class ClusterCredentials:
     ca_pem: Optional[str] = None
     insecure_skip_tls_verify: bool = False
     token: Optional[str] = None
+    #: Path of a rotating on-disk token (kubeconfig ``tokenFile`` /
+    #: service-account projected token) — re-read on refresh.
+    token_file: Optional[str] = None
     username: Optional[str] = None
     password: Optional[str] = None
     client_cert_file: Optional[str] = None
@@ -53,8 +56,13 @@ class ClusterCredentials:
     _tempfiles: list[str] = field(default_factory=list, repr=False)
 
     def resolve_token(self) -> Optional[str]:
-        """Return a bearer token, running the exec credential plugin if configured."""
+        """Return a bearer token, reading the token file / running the exec
+        credential plugin if configured (cached until refreshed)."""
         if self.token:
+            return self.token
+        if self.token_file:
+            with open(self.token_file) as f:
+                self.token = f.read().strip()
             return self.token
         if self.exec_spec:
             self.token = _run_exec_plugin(self.exec_spec)
@@ -71,13 +79,15 @@ class ClusterCredentials:
         return {}
 
     def refresh_auth_headers(self) -> dict[str, str]:
-        """Auth headers with any exec-plugin-derived token RE-RESOLVED:
+        """Auth headers with any REFRESHABLE token re-resolved:
         ``resolve_token`` caches its result, so after a 401 mid-scan the
-        cached (expired) token must be dropped and the plugin re-run. A
-        static kubeconfig token has nothing to refresh and is returned
-        as-is — a repeat 401 with it is a real authz failure."""
-        if self.exec_spec:
-            self.token = None  # drop the cached (expired) plugin token
+        cached (expired) token must be dropped and re-derived — by re-running
+        the exec plugin or re-reading a rotating ``tokenFile`` (kubelet
+        projects fresh tokens onto disk). A static inline kubeconfig token
+        has nothing to refresh and is returned as-is — a repeat 401 with it
+        is a real authz failure."""
+        if self.exec_spec or self.token_file:
+            self.token = None  # drop the cached (expired) token
         return self.auth_headers()
 
     def ssl_verify(self) -> ssl.SSLContext | bool:
@@ -169,17 +179,16 @@ class KubeConfig:
             with open(cluster["certificate-authority"]) as f:
                 ca_pem = f.read()
 
-        token = user.get("token")
-        if not token and user.get("tokenFile"):
-            with open(user["tokenFile"]) as f:
-                token = f.read().strip()
-
+        # Inline tokens are static; a tokenFile is retained as a PATH so a
+        # mid-scan refresh can re-read the rotated token (resolve_token
+        # reads it lazily on first use).
         return ClusterCredentials(
             server=cluster["server"],
             context_name=name,
             ca_pem=ca_pem,
             insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
-            token=token,
+            token=user.get("token"),
+            token_file=None if user.get("token") else user.get("tokenFile"),
             username=user.get("username"),
             password=user.get("password"),
             client_cert_file=_materialize(user.get("client-certificate-data"), user.get("client-certificate"), holder),
@@ -197,13 +206,13 @@ def in_cluster_credentials() -> ClusterCredentials:
     ca_path = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
     if not host or not os.path.exists(token_path):
         raise KubeConfigError("not running inside a cluster (no service account mounted)")
-    with open(token_path) as f:
-        token = f.read().strip()
     ca_pem = None
     if os.path.exists(ca_path):
         with open(ca_path) as f:
             ca_pem = f.read()
-    return ClusterCredentials(server=f"https://{host}:{port}", token=token, ca_pem=ca_pem)
+    # Kept as a PATH: bound service-account tokens rotate on disk, and a
+    # mid-scan refresh must re-read the projected file.
+    return ClusterCredentials(server=f"https://{host}:{port}", token_file=token_path, ca_pem=ca_pem)
 
 
 def resolve_credentials(

@@ -437,7 +437,9 @@ class PrometheusLoader:
         the routed estimate)."""
         if self._client is None:
             return None
-        for attempt in range(2):
+        attempt = 0
+        auth_refreshed = False
+        while attempt < 2:
             generation = self._auth_generation
             try:
                 response = await self._client.get(
@@ -448,13 +450,18 @@ class PrometheusLoader:
                     if not result:
                         return 0
                     return int(float(result[0]["value"][1]))
-                # Expired token: refresh like the range path before the
-                # retry — a silently failed probe would undersize the
-                # windows and lose the memory bound for this namespace.
-                if response.status_code in (401, 403) and self._auth_refresh is not None and attempt == 0:
+                # Expired token: refresh like the range path and retry for
+                # FREE (not gated on the attempt number — a transport hiccup
+                # must not consume the refresh opportunity). A silently
+                # failed probe would undersize the windows and lose the
+                # memory bound for this namespace.
+                if response.status_code in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
+                    auth_refreshed = True
                     await self._refresh_auth(generation)
+                    continue
             except Exception:
                 pass  # transport hiccup: the loop grants one retry
+            attempt += 1
         self.logger.warning(
             "series-count probe failed; sizing response windows from the routed "
             "pod count only — unscanned series in the namespace may enlarge responses"
@@ -524,17 +531,29 @@ class PrometheusLoader:
         flight, every one sees the 401 at once, and each would otherwise
         spawn its own exec-plugin subprocess (up to 60 s each, racing the
         plugin's on-disk cache). The generation check makes late arrivals
-        reuse a sibling's refresh instead of re-running the plugin."""
+        reuse a sibling's refresh instead of re-running the plugin — and the
+        generation advances on FAILURE too, with refreshing disabled, so a
+        broken plugin runs once and every queued/fallback query fails fast
+        with its 401 instead of serially re-running a 60 s timeout per
+        window (round-3 review finding)."""
         async with self._refresh_lock:
-            if self._auth_generation != seen_generation:
-                return  # a sibling refreshed while we waited
-            assert self._auth_refresh is not None
-            fresh = await asyncio.to_thread(self._auth_refresh)
+            if self._auth_generation != seen_generation or self._auth_refresh is None:
+                return  # a sibling already refreshed (or refresh is disabled)
+            refresh = self._auth_refresh
+            self._auth_generation += 1
+            try:
+                fresh = await asyncio.to_thread(refresh)
+            except Exception as e:
+                self._auth_refresh = None  # one shot — don't retry a broken plugin per window
+                self.logger.warning(
+                    f"Credential refresh failed ({e}); not retrying — "
+                    f"subsequent auth failures will surface directly"
+                )
+                return
             if self._raw is not None:
                 self._raw.update_headers(fresh)
             if self._client is not None:
                 self._client.headers.update(fresh)
-            self._auth_generation += 1
 
     @staticmethod
     def _kept(parse, keep: "Optional[set]"):

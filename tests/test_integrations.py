@@ -502,9 +502,10 @@ class TestBatchedFleetQueries:
                 fake_env["metrics"].series[("default", "main", pod)][0],
             )
 
-    def test_refresh_auth_headers_rerun_vs_static(self, monkeypatch):
-        """refresh_auth_headers re-runs the exec plugin (dropping the cached
-        token); a static kubeconfig token is returned as-is."""
+    def test_refresh_auth_headers_rerun_vs_static(self, monkeypatch, tmp_path):
+        """refresh_auth_headers re-derives refreshable tokens — re-running
+        the exec plugin or re-reading a rotated tokenFile — while a static
+        inline kubeconfig token is returned as-is."""
         from krr_tpu.integrations import kubeconfig as kc
 
         tokens = iter(["t1", "t2"])
@@ -516,6 +517,44 @@ class TestBatchedFleetQueries:
 
         static = kc.ClusterCredentials(server="https://x", token="fixed")
         assert static.refresh_auth_headers() == {"Authorization": "Bearer fixed"}
+
+        rotating = tmp_path / "token"
+        rotating.write_text("projected-1\n")
+        filed = kc.ClusterCredentials(server="https://x", token_file=str(rotating))
+        assert filed.auth_headers() == {"Authorization": "Bearer projected-1"}
+        rotating.write_text("projected-2\n")  # kubelet rotates the file
+        assert filed.auth_headers() == {"Authorization": "Bearer projected-1"}  # cached
+        assert filed.refresh_auth_headers() == {"Authorization": "Bearer projected-2"}
+
+    def test_broken_refresh_runs_once_and_fails_fast(self, fake_env):
+        """A broken exec plugin must run ONCE per loader, not once per
+        in-flight window/fallback query (each run can block 60 s)."""
+        config = make_config(fake_env)
+        objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+        fake_env["metrics"].require_bearer = "unobtainable"
+        calls = []
+
+        def broken_refresh():
+            calls.append(1)
+            raise RuntimeError("plugin exploded")
+
+        try:
+
+            async def fetch():
+                prom = PrometheusLoader(config, cluster="fake")
+                try:
+                    await prom._ensure_connected()
+                    prom._auth_refresh = broken_refresh
+                    return await prom.gather_fleet(objects, 3600, 60)
+                finally:
+                    await prom.close()
+
+            histories = asyncio.run(fetch())
+        finally:
+            fake_env["metrics"].require_bearer = None
+        assert len(calls) == 1  # single-flight, memoized failure
+        for resource in ResourceType:
+            assert all(h == {} for h in histories[resource])  # degraded, not hung
 
     def test_digest_failed_batched_query_falls_back(self, fake_env):
         config = make_config(fake_env)
