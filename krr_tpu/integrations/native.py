@@ -43,7 +43,11 @@ def _load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            source = os.path.join(_NATIVE_DIR, "fastsamples.cpp")
+            sources = [
+                os.path.join(_NATIVE_DIR, name)
+                for name in ("fastsamples.cpp", "faststream.cpp")
+            ]
+            source = sources[0]
             # Rebuild when missing OR stale: a cached .so from an older source
             # would load but lack newer symbols, and the blanket failure
             # handling below would then silently disable the whole native
@@ -60,7 +64,7 @@ def _load_library() -> Optional[ctypes.CDLL]:
                 if not os.path.exists(source):
                     raise FileNotFoundError(source)
                 subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, source],
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, *sources],
                     check=True,
                     capture_output=True,
                     timeout=120,
@@ -103,6 +107,26 @@ def _load_library() -> Optional[ctypes.CDLL]:
             ]
             lib.krr_count_series.restype = ctypes.c_long
             lib.krr_count_series.argtypes = [ctypes.c_char_p, ctypes.c_long]
+            lib.krr_stream_new.restype = ctypes.c_void_p
+            lib.krr_stream_new.argtypes = [ctypes.c_double, ctypes.c_double, ctypes.c_long]
+            lib.krr_stream_feed.restype = ctypes.c_long
+            lib.krr_stream_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+            lib.krr_stream_finish.restype = ctypes.c_long
+            lib.krr_stream_finish.argtypes = [ctypes.c_void_p]
+            lib.krr_stream_names_len.restype = ctypes.c_long
+            lib.krr_stream_names_len.argtypes = [ctypes.c_void_p]
+            lib.krr_stream_read.restype = ctypes.c_long
+            lib.krr_stream_read.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long,
+            ]
+            lib.krr_stream_free.restype = None
+            lib.krr_stream_free.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception as e:
             _build_failed = True
@@ -284,6 +308,97 @@ def parse_matrix_digest(
         (key, *_digest_python(samples, gamma, min_value, num_buckets))
         for key, samples in parse_matrix(body)
     ]
+
+
+class StreamIngest:
+    """Streaming fused parse+fold over arbitrary chunk boundaries
+    (`native/faststream.cpp`): feed response bytes as they arrive from the
+    socket; per-series digests/stats accumulate in native memory, so neither
+    the body nor raw samples are ever materialized. ``num_buckets=0`` selects
+    the stats-only sink (memory resource). None from :func:`open_stream` when
+    the native library is unavailable — callers fall back to buffered parsing.
+
+    Usage::
+
+        stream = open_stream(gamma, min_value, num_buckets)
+        while chunk := read(...):
+            stream.feed(chunk)
+        series = stream.finish()   # DigestedSeries or SeriesStats
+    """
+
+    def __init__(self, lib, handle: int, num_buckets: int):
+        self._lib = lib
+        self._handle = handle
+        self._num_buckets = num_buckets
+
+    def feed(self, chunk: bytes) -> None:
+        if self._handle is None:
+            raise ValueError("stream already finished")
+        if self._lib.krr_stream_feed(self._handle, chunk, len(chunk)) != 0:
+            raise ValueError("malformed Prometheus stream")
+
+    def finish(self):
+        """Close the stream and return the folded series: DigestedSeries
+        (digest mode) or SeriesStats (stats mode)."""
+        handle, self._handle = self._handle, None
+        try:
+            n = self._lib.krr_stream_finish(handle)
+            if n < 0:
+                raise ValueError("malformed Prometheus stream (no result array)")
+            if n == 0:
+                return []
+            names_cap = self._lib.krr_stream_names_len(handle)
+            names = ctypes.create_string_buffer(names_cap)
+            totals = np.zeros(n, dtype=np.float64)
+            peaks = np.zeros(n, dtype=np.float64)
+            counts = (
+                np.zeros((n, self._num_buckets), dtype=np.float64)
+                if self._num_buckets
+                else None
+            )
+            rc = self._lib.krr_stream_read(
+                handle,
+                names,
+                names_cap,
+                totals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                peaks.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) if counts is not None else None,
+                n,
+            )
+            if rc != 0:
+                raise ValueError("stream readout capacity mismatch")
+            keys = _split_keys(names.raw[:names_cap], n)
+            if counts is not None:
+                return [
+                    (keys[i], counts[i].copy(), float(totals[i]), float(peaks[i]))
+                    for i in range(n)
+                ]
+            return [(keys[i], float(totals[i]), float(peaks[i])) for i in range(n)]
+        finally:
+            self._lib.krr_stream_free(handle)
+
+    def abort(self) -> None:
+        """Release native memory without reading results (fetch failed)."""
+        handle, self._handle = self._handle, None
+        if handle is not None:
+            self._lib.krr_stream_free(handle)
+
+
+def stream_available() -> bool:
+    """Whether streaming ingest exists here (native library loaded)."""
+    return _load_library() is not None
+
+
+def open_stream(gamma: float, min_value: float, num_buckets: int) -> Optional[StreamIngest]:
+    """A streaming ingest handle, or None when the native library (the only
+    implementation) is unavailable. ``num_buckets=0`` = stats-only sink."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    handle = lib.krr_stream_new(gamma, min_value, num_buckets)
+    if not handle:
+        return None
+    return StreamIngest(lib, handle, num_buckets)
 
 
 #: Result of a stats-only parse: per-series (series key, total sample count,

@@ -119,17 +119,37 @@ class _RawTransport:
         idle keep-alive (RemoteDisconnected/BadStatusLine), and burning one
         of the caller's real retry attempts (with backoff) on a stale socket
         would let a pool full of dead sockets fail a query outright."""
+        return self.request_streaming(method, path, body, headers, sink=None)
+
+    def request_streaming(
+        self, method: str, path: str, body: Optional[str], headers: dict[str, str], sink
+    ) -> tuple[int, bytes]:
+        """Like :meth:`request`, but on a 2xx the response body is fed to
+        ``sink(chunk)`` in ~1 MB pieces as it arrives — never materialized —
+        and the returned bytes are empty. Non-2xx bodies (small error
+        payloads) are returned for diagnostics either way. ``sink=None``
+        degrades to the buffered behavior."""
         with self._lock:
             conn, fresh = (self._idle.pop(), False) if self._idle else (self._connect(), True)
         while True:
+            fed = False  # once the sink has bytes, a transparent retry would duplicate them
             try:
                 conn.request(method, self._prefix + path, body=body, headers={**self._headers, **headers})
                 response = conn.getresponse()
-                data = response.read()
                 status = response.status
+                if sink is None or status >= 300:
+                    data = response.read()
+                else:
+                    data = b""
+                    while True:
+                        chunk = response.read(1 << 20)
+                        if not chunk:
+                            break
+                        fed = True
+                        sink(chunk)
             except (http.client.HTTPException, ConnectionError):
                 conn.close()
-                if not fresh:
+                if not fresh and not fed:
                     conn, fresh = self._connect(), True
                     continue
                 raise
@@ -393,23 +413,48 @@ class PrometheusLoader:
             }
         return _RawTransport(url, headers, verify)
 
-    def _raw_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
-        """One range request on the raw transport (sync — run in a worker
-        thread). GET below the URL-cap threshold (safe past read-only RBAC on
-        the apiserver service proxy, where POST maps to the `create` verb),
-        form-encoded POST above it."""
-        assert self._raw is not None
+    def _range_request_parts(self, query: str, start: float, end: float, step: str):
+        """(method, path, body, headers) for a range request: GET below the
+        URL-cap threshold (safe past read-only RBAC on the apiserver service
+        proxy, where POST maps to the `create` verb), form-encoded POST
+        above it."""
         encoded = urllib.parse.urlencode(
             {"query": query, "start": start, "end": end, "step": step}
         )
         if len(query) <= self.GET_QUERY_LIMIT:
-            return self._raw.request("GET", "/api/v1/query_range?" + encoded, None, {})
-        return self._raw.request(
+            return "GET", "/api/v1/query_range?" + encoded, None, {}
+        return (
             "POST",
             "/api/v1/query_range",
             encoded,
             {"Content-Type": "application/x-www-form-urlencoded"},
         )
+
+    def _raw_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
+        """One buffered range request on the raw transport (sync — run in a
+        worker thread)."""
+        assert self._raw is not None
+        return self._raw.request(*self._range_request_parts(query, start, end, step))
+
+    def _stream_attempt(self, query: str, start: float, end: float, step: str, make_stream):
+        """One STREAMED range request (sync — worker thread): response bytes
+        feed a fresh native ingest stream as they arrive; returns
+        (status, folded series or None, error body). The stream is aborted on
+        any failure — a partially-fed stream can never be resumed (retrying
+        would duplicate samples), so each attempt starts a fresh one."""
+        assert self._raw is not None
+        stream = make_stream()
+        try:
+            status, err = self._raw.request_streaming(
+                *self._range_request_parts(query, start, end, step), sink=stream.feed
+            )
+            if status >= 300:
+                stream.abort()
+                return status, None, err
+            return status, stream.finish(), b""
+        except BaseException:
+            stream.abort()
+            raise
 
     async def _httpx_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
         """Range request via the httpx client — the fallback data plane for
@@ -468,21 +513,19 @@ class PrometheusLoader:
         )
         return None
 
-    async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
-        """Range query with retry + exponential backoff; returns the raw
-        response body (callers pick their parser).
+    async def _retrying(self, attempt_fn):
+        """Shared retry/auth policy around one range-request attempt.
 
-        Our per-workload fallback queries carry a pod-name regex that grows
-        with the pod count: short queries go as GET (works under read-only
-        RBAC on apiserver-proxied URLs), multi-KB ones as form-encoded POST
-        (the only transport that survives URL caps — a proxy user at that pod
-        scale needs the extra `create services/proxy` RBAC verb either way).
-
-        Only transient failures (transport errors, 5xx) are retried; a 4xx
-        (bad query) fails immediately — retrying those only adds fleet-sized
-        futile sleeps.
+        ``attempt_fn() -> (status, result, detail_bytes)``; transport errors
+        raise. Returns ``result`` on 2xx. Only transient failures (transport
+        errors, 5xx) are retried, with exponential backoff; 3xx (the raw
+        transport never follows redirects — feeding a redirect body to the
+        parser would silently turn the fleet UNKNOWN) and 4xx fail
+        immediately — except one FREE auth-refreshed retry on 401/403 (an
+        expired kubeconfig token mid-scan; single-flight across the
+        fan-out, and free so a 401 on the last transient attempt still gets
+        its refreshed retry; a second 401 is a real authz failure).
         """
-        await self._ensure_connected()
         last_error: Optional[Exception] = None
         auth_refreshed = False
         attempt = 0
@@ -490,33 +533,18 @@ class PrometheusLoader:
             generation = self._auth_generation
             try:
                 async with self._semaphore:
-                    if self._raw is not None:
-                        status, body = await asyncio.to_thread(
-                            self._raw_range_query, query, start, end, step
-                        )
-                    else:  # proxied environment: ride the httpx client
-                        status, body = await self._httpx_range_query(query, start, end, step)
+                    status, result, detail_bytes = await attempt_fn()
             except (http.client.HTTPException, httpx.TransportError, OSError) as e:
                 last_error = e
             else:
                 if status < 300:
-                    return body
-                detail = body[:200].decode("utf-8", errors="replace")
+                    return result
+                detail = detail_bytes[:200].decode("utf-8", errors="replace")
                 if status in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
-                    # Expired kubeconfig token mid-scan: re-resolve (single-
-                    # flight across the fan-out) and retry with fresh
-                    # credentials. The retry is FREE — it doesn't consume a
-                    # transient-failure attempt, so a 401 on the last attempt
-                    # still gets its refreshed retry. A second 401 is a real
-                    # authz failure (non-retryable below).
                     auth_refreshed = True
                     await self._refresh_auth(generation)
                     last_error = PrometheusQueryError(status, detail)
                     continue  # no backoff: the failure was auth, not load
-                # 3xx: the raw transport never follows redirects, and a
-                # redirect (SSO login, trailing slash) won't resolve by
-                # retrying — non-retryable, like 4xx. Feeding a redirect body
-                # to the parser would silently turn the fleet UNKNOWN.
                 if status < 500:
                     raise PrometheusQueryError(status, detail)
                 last_error = PrometheusQueryError(status, detail)
@@ -525,6 +553,45 @@ class PrometheusLoader:
                 await asyncio.sleep(0.25 * 2 ** (attempt - 1))
         assert last_error is not None
         raise last_error
+
+    async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
+        """Range query with the shared retry policy; returns the raw response
+        body (callers pick their parser).
+
+        Our per-workload fallback queries carry a pod-name regex that grows
+        with the pod count: short queries go as GET (works under read-only
+        RBAC on apiserver-proxied URLs), multi-KB ones as form-encoded POST
+        (the only transport that survives URL caps — a proxy user at that pod
+        scale needs the extra `create services/proxy` RBAC verb either way).
+        """
+        await self._ensure_connected()
+
+        async def attempt():
+            if self._raw is not None:
+                status, body = await asyncio.to_thread(
+                    self._raw_range_query, query, start, end, step
+                )
+            else:  # proxied environment: ride the httpx client
+                status, body = await self._httpx_range_query(query, start, end, step)
+            return status, body, body
+
+        return await self._retrying(attempt)
+
+    async def _fetch_streamed_series(
+        self, query: str, start: float, end: float, step: str, make_stream
+    ) -> list:
+        """Range query whose response bytes feed a native ingest stream as
+        they arrive (no body materialization); returns the folded per-series
+        entries. Same retry policy as the buffered path — each attempt runs
+        on a FRESH stream (a partially-fed one cannot be resumed)."""
+        await self._ensure_connected()
+
+        async def attempt():
+            return await asyncio.to_thread(
+                self._stream_attempt, query, start, end, step, make_stream
+            )
+
+        return await self._retrying(attempt)
 
     async def _refresh_auth(self, seen_generation: int) -> None:
         """Single-flight credential refresh: with dozens of windows in
@@ -566,16 +633,16 @@ class PrometheusLoader:
         return lambda body: [entry for entry in parse(body) if entry[0] in keep]
 
     async def _window_fan_out(
-        self, query: str, start: float, end: float, step_seconds: float, parse,
-        expected_series: int, consume,
+        self, start: float, end: float, step_seconds: float,
+        expected_series: int, fetch_entries, consume,
     ) -> None:
-        """Shared sub-window fan-out: fetch every sub-window concurrently,
-        parse each body off the event loop (CPU-bound, up to ~MBs), and hand
-        each window's entries to ``consume(window_index, entries)`` on the
-        loop as it completes. Windows are sized to the server's 11k-point
-        cap AND to a total-samples cap from ``expected_series`` (probed from
-        the server for batched queries — see ``_expected_series``), keeping
-        every response body bounded no matter how wide the namespace is.
+        """Shared sub-window fan-out: run ``fetch_entries(w_start, w_end)``
+        for every sub-window concurrently and hand each window's entries to
+        ``consume(window_index, entries)`` on the loop as it completes.
+        Windows are sized to the server's 11k-point cap AND to a
+        total-samples cap from ``expected_series`` (probed from the server
+        for batched queries — see ``_expected_series``), keeping every
+        response bounded no matter how wide the namespace is.
 
         Failures surface only after every sibling fetch settles
         (``return_exceptions``): raising early would leave the other windows'
@@ -583,11 +650,9 @@ class PrometheusLoader:
         exceptions unretrieved — while the caller has already written the
         object off.
         """
-        step = step_string(step_seconds)
 
         async def one(index: int, w_start: float, w_end: float) -> None:
-            body = await self._fetch_range_body(query, w_start, w_end, step)
-            consume(index, await asyncio.to_thread(parse, body))
+            consume(index, await fetch_entries(w_start, w_end))
 
         results = await asyncio.gather(
             *[
@@ -602,6 +667,17 @@ class PrometheusLoader:
             if isinstance(r, BaseException):
                 raise r
 
+    def _buffered_fetch_entries(self, query: str, step_seconds: float, parse):
+        """fetch_entries for the buffered route: fetch the whole window body,
+        then parse it off the event loop (CPU-bound, up to ~MBs)."""
+        step = step_string(step_seconds)
+
+        async def fetch_entries(w_start: float, w_end: float) -> list:
+            body = await self._fetch_range_body(query, w_start, w_end, step)
+            return await asyncio.to_thread(parse, body)
+
+        return fetch_entries
+
     async def _fetch_parsed_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int = 0, keep: "Optional[set]" = None,
@@ -611,7 +687,8 @@ class PrometheusLoader:
         order-dependent."""
         by_index: dict[int, list] = {}
         await self._window_fan_out(
-            query, start, end, step_seconds, self._kept(parse, keep), expected_series,
+            start, end, step_seconds, expected_series,
+            self._buffered_fetch_entries(query, step_seconds, self._kept(parse, keep)),
             by_index.__setitem__,
         )
         return [by_index[i] for i in range(len(by_index))]
@@ -619,6 +696,7 @@ class PrometheusLoader:
     async def _fold_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int, init, fold, keep: "Optional[set]" = None,
+        stream_factory=None,
     ) -> "list[tuple]":
         """Sub-window fan-out with INCREMENTAL merging for order-independent
         folds (digest/stats — counts add, peaks max): each window's parse
@@ -630,20 +708,44 @@ class PrometheusLoader:
         First-series-per-key applies per window, like
         `_merge_window_series`; ``init`` takes OWNERSHIP of the entry's
         arrays (each parse call allocates fresh ones), so ``fold`` may
-        mutate in place."""
+        mutate in place.
+
+        With ``stream_factory`` (a thunk returning a fresh
+        `native.StreamIngest`) and the raw transport available, each
+        window's response bytes feed the native stream AS THEY ARRIVE — the
+        body is never materialized at all; ``parse`` serves only the
+        buffered fallback (httpx/proxied environments, native lib absent).
+        """
         merged: dict = {}
 
         def consume(index: int, entries: list) -> None:
             seen: set = set()  # single event loop: consume runs windows-serially
             for entry in entries:
                 key = entry[0]
-                if key in seen:
+                if (keep is not None and key not in keep) or key in seen:
                     continue
                 seen.add(key)
                 merged[key] = fold(merged[key], entry) if key in merged else init(entry)
 
+        use_stream = stream_factory is not None and self._raw is not None
+        if use_stream:
+            # The availability probe may BUILD the native library (a g++
+            # subprocess, tens of seconds on first use) — keep it off the
+            # event loop.
+            from krr_tpu.integrations.native import stream_available
+
+            use_stream = await asyncio.to_thread(stream_available)
+        if use_stream:
+            step = step_string(step_seconds)
+
+            async def fetch_entries(w_start: float, w_end: float) -> list:
+                return await self._fetch_streamed_series(query, w_start, w_end, step, stream_factory)
+
+        else:
+            fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
+
         await self._window_fan_out(
-            query, start, end, step_seconds, self._kept(parse, keep), expected_series, consume
+            start, end, step_seconds, expected_series, fetch_entries, consume
         )
         return [(key, *state) for key, state in merged.items()]
 
@@ -866,7 +968,7 @@ class PrometheusLoader:
         (bucket counts add, peaks max — the digest's defining property)."""
         from functools import partial
 
-        from krr_tpu.integrations.native import parse_matrix_digest
+        from krr_tpu.integrations.native import open_stream, parse_matrix_digest
 
         def fold(state, entry):
             counts, total, peak = state
@@ -880,6 +982,7 @@ class PrometheusLoader:
             init=lambda e: (e[1], e[2], e[3]),
             fold=fold,
             keep=keep,
+            stream_factory=partial(open_stream, gamma, min_value, num_buckets),
         )
 
     async def _query_range_stats(
@@ -889,13 +992,17 @@ class PrometheusLoader:
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
         sub-windows merge exactly (counts add, peaks max)."""
-        from krr_tpu.integrations.native import parse_matrix_stats
+        from functools import partial
+
+        from krr_tpu.integrations.native import open_stream, parse_matrix_stats
 
         return await self._fold_windows(
             query, start, end, step_seconds, parse_matrix_stats, expected_series,
             init=lambda e: (e[1], e[2]),
             fold=lambda s, e: (s[0] + e[1], max(s[1], e[2])),
             keep=keep,
+            # num_buckets=0 selects the stats-only native sink.
+            stream_factory=partial(open_stream, 0.0, 0.0, 0),
         )
 
     async def gather_fleet_digests(

@@ -30,119 +30,10 @@
 #include <cstdlib>
 #include <cstring>
 
-#include "pow10_table.h"
+#include "fastfloat.h"
 
 namespace {
 
-// ---------------------------------------------------------------------------
-// Fast float parsing (Eisel–Lemire): the per-sample strtod is the scanner's
-// bottleneck (~60% of parse time at fleet scale — strtod is locale-aware and
-// re-derives everything per call). This is the standard "number parsing at a
-// gigabyte per second" construction: collect up to 19 significant digits into
-// a u64, multiply by a precomputed 128-bit normalized significand of 10^q
-// (pow10_table.h, generated by gen_pow10_table.py), and assemble the IEEE
-// bits directly. EVERY ambiguous case — >19 digits, subnormal/overflow
-// range, truncated-table rounding ambiguity, exact round-to-even ties —
-// falls back to strtod, so the result is bit-identical to strtod by
-// construction on the fast path and by delegation otherwise (fuzzed against
-// strtod in tests/test_native.py).
-//
-// Parses [+-]?digits[.digits][(e|E)[+-]?digits]; returns the char past the
-// number and sets *out, or returns nullptr for anything it won't certify
-// (including NaN/Inf markers) — caller falls back to strtod.
-const char* parse_number_fast(const char* p, const char* end, double* out) {
-    bool neg = false;
-    if (p < end && (*p == '+' || *p == '-')) {
-        neg = (*p == '-');
-        p++;
-    }
-    uint64_t w = 0;
-    int digits = 0;  // significant digits in w (leading zeros excluded)
-    int64_t exp10 = 0;
-    bool any = false;
-    while (p < end && *p >= '0' && *p <= '9') {
-        any = true;
-        if (digits >= 19) return nullptr;  // would truncate — strtod
-        w = w * 10 + static_cast<uint64_t>(*p - '0');
-        if (w != 0) digits++;
-        p++;
-    }
-    if (p < end && *p == '.') {
-        p++;
-        while (p < end && *p >= '0' && *p <= '9') {
-            any = true;
-            if (digits >= 19) return nullptr;
-            w = w * 10 + static_cast<uint64_t>(*p - '0');
-            if (w != 0) digits++;
-            exp10--;  // every fraction digit shifts the decimal point
-            p++;
-        }
-    }
-    if (!any) return nullptr;
-    if (p < end && (*p == 'e' || *p == 'E')) {
-        p++;
-        bool eneg = false;
-        if (p < end && (*p == '+' || *p == '-')) {
-            eneg = (*p == '-');
-            p++;
-        }
-        if (p >= end || *p < '0' || *p > '9') return nullptr;  // dangling 'e'
-        int64_t e = 0;
-        while (p < end && *p >= '0' && *p <= '9') {
-            if (e < 1000000) e = e * 10 + (*p - '0');
-            p++;
-        }
-        exp10 += eneg ? -e : e;
-    }
-
-    if (w == 0) {
-        *out = neg ? -0.0 : 0.0;
-        return p;
-    }
-    if (exp10 < pow10_table::kMinExp10 || exp10 > pow10_table::kMaxExp10) return nullptr;
-
-    // value = ±w · 10^exp10, w in [1, 10^19). Normalize and multiply by the
-    // 128-bit significand of 10^exp10.
-    int lz = __builtin_clzll(w);
-    uint64_t wn = w << lz;
-    int idx = static_cast<int>(exp10) - pow10_table::kMinExp10;
-    __uint128_t prod = static_cast<__uint128_t>(wn) * pow10_table::kHi[idx];
-    uint64_t phi = static_cast<uint64_t>(prod >> 64);
-    uint64_t plo = static_cast<uint64_t>(prod);
-    if ((phi & 0x1FF) == 0x1FF) {
-        // The truncated table may hide a carry: extend with the low word.
-        __uint128_t prod2 = static_cast<__uint128_t>(wn) * pow10_table::kLo[idx];
-        uint64_t old = plo;
-        plo += static_cast<uint64_t>(prod2 >> 64);
-        if (plo < old) phi++;
-        if ((phi & 0x1FF) == 0x1FF) return nullptr;  // still ambiguous — strtod
-    }
-
-    uint64_t upperbit = phi >> 63;
-    uint64_t mantissa = phi >> (upperbit + 9);  // 53 bits + round bit
-    lz += static_cast<int>(1 ^ upperbit);
-
-    // floor(exp10 · log2(10)) via the fixed-point constant 217706/2^16.
-    int64_t power = (((152170 + 65536) * exp10) >> 16) + 1024 + 63;
-    int64_t exp2 = power - lz;
-    if (exp2 <= 0 || exp2 >= 0x7FF) return nullptr;  // subnormal/overflow — strtod
-
-    // Exact round-to-even tie with a truncated product: can't certify.
-    if (plo == 0 && (phi & 0x1FF) == 0 && (mantissa & 3) == 1) return nullptr;
-
-    mantissa += mantissa & 1;  // round to nearest
-    mantissa >>= 1;
-    if (mantissa >= (1ULL << 53)) {  // rounding overflowed into the next binade
-        mantissa >>= 1;
-        exp2++;
-        if (exp2 >= 0x7FF) return nullptr;
-    }
-
-    uint64_t bits = (static_cast<uint64_t>(exp2) << 52) | (mantissa & ((1ULL << 52) - 1));
-    if (neg) bits |= 1ULL << 63;
-    std::memcpy(out, &bits, sizeof(*out));
-    return p;
-}
 
 struct Cursor {
     const char* p;
@@ -237,7 +128,7 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
             c.p++;
             while (c.p < c.end && (*c.p == ' ' || *c.p == '"')) c.p++;
             double v;
-            const char* after = parse_number_fast(c.p, c.end, &v);
+            const char* after = fastfloat::parse_number_fast(c.p, c.end, &v);
             if (!after) {  // exotic shape (NaN/Inf, ties, subnormals) — strtod
                 char* slow_end = nullptr;
                 v = std::strtod(c.p, &slow_end);

@@ -1,0 +1,479 @@
+// Streaming Prometheus matrix ingest: feed response bytes in ARBITRARY
+// chunks as they arrive from the socket; samples fold into per-series
+// digest/stats sinks on the fly, so neither the response body nor raw sample
+// arrays are ever materialized. This is the streaming form of the buffered
+// scanners in fastsamples.cpp (same bucket layout, same label semantics,
+// same NaN/Inf dropping) — the buffered one-shot parsers are the oracle its
+// tests compare against byte-for-byte.
+//
+// Design: a resumable state machine with a small carry buffer. The carry
+// holds only the bytes the machine cannot yet act on — a partial anchor
+// token, an unfinished metric-object label section, or an unfinished
+// [ts,"value"] sample — never the body. The metric label section is capped
+// (k8s names are <=253 chars; a metric object past 64 KB is rejected as
+// malformed rather than buffered unboundedly).
+//
+// Series state (labels, bucket counts, totals, peaks) lives in arrays OWNED
+// by the stream (grown on demand), read out by the Python side after
+// finish(). Exposed via a plain C ABI for ctypes.
+//
+// Build: part of libfastsamples.so (see Makefile).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+#include "fastfloat.h"
+
+namespace {
+
+constexpr long kMaxCarry = 64 * 1024;  // metric-object cap; beyond = malformed
+constexpr long kMaxNumber = 512;       // longest sample literal we accept (Prometheus
+                                       // emits <=25 chars; longer = malformed, BOTH
+                                       // number paths below enforce it identically)
+
+enum class State {
+  kSeekResult,   // before the "result" array
+  kSeekMetric,   // between series: looking for "metric"
+  kInMetric,     // inside the metric object: collecting until "values"
+  kInValues,     // inside the values section: between samples (depth-tracked)
+  kInSample,     // inside [ts,"value"]: skipping the timestamp
+  kInNumber,     // collecting the value literal
+  kAfterNumber,  // skipping to the sample's closing ']'
+  kError,
+};
+
+// One series' accumulators. Digest counts live in a single [cap x buckets]
+// matrix owned by the stream (indexed by series).
+struct SeriesMeta {
+  long name_off;  // offset into the names arena ("pod\tcontainer")
+  long name_len;
+  double total;
+  double peak;
+};
+
+struct Stream {
+  // Sink configuration: num_buckets == 0 -> stats-only (no histogram).
+  double gamma;
+  double min_value;
+  double inv_log_gamma;
+  double inv_min;
+  long num_buckets;
+
+  State state = State::kSeekResult;
+  //: Bracket depth within the values section: the array opener takes it to
+  //: 1, each sample's '[' to 2; back to 0 == this series' values are done.
+  //: Disambiguates the array close from a sample close — without it an
+  //: empty "values":[] would swallow the next series' metric object.
+  long depth = 0;
+
+  // Carry: bytes not yet consumed (partial anchor / metric object / number).
+  char* carry = nullptr;
+  long carry_len = 0;
+  long carry_cap = 0;
+
+  // Series storage, grown on demand.
+  SeriesMeta* series = nullptr;
+  long series_count = 0;
+  long series_cap = 0;
+  double* counts = nullptr;  // [series_cap x num_buckets], digest mode only
+
+  // Names arena ("pod\tcontainer" records, not NUL-joined — lengths in meta).
+  char* names = nullptr;
+  long names_len = 0;
+  long names_cap = 0;
+
+  // Current sample literal (kInNumber).
+  char number[kMaxNumber + 1];
+  long number_len = 0;
+
+  ~Stream() {
+    std::free(carry);
+    std::free(series);
+    std::free(counts);
+    std::free(names);
+  }
+
+  bool reserve_carry(long need) {
+    if (need > kMaxCarry) return false;
+    if (need <= carry_cap) return true;
+    long cap = carry_cap ? carry_cap : 1024;
+    while (cap < need) cap *= 2;
+    char* grown = static_cast<char*>(std::realloc(carry, static_cast<size_t>(cap)));
+    if (!grown) return false;
+    carry = grown;
+    carry_cap = cap;
+    return true;
+  }
+
+  bool grow_series() {
+    long cap = series_cap ? series_cap * 2 : 64;
+    SeriesMeta* grown =
+        static_cast<SeriesMeta*>(std::realloc(series, sizeof(SeriesMeta) * static_cast<size_t>(cap)));
+    if (!grown) return false;
+    series = grown;
+    if (num_buckets > 0) {
+      double* grown_counts = static_cast<double*>(
+          std::realloc(counts, sizeof(double) * static_cast<size_t>(cap) * static_cast<size_t>(num_buckets)));
+      if (!grown_counts) return false;
+      counts = grown_counts;
+      std::memset(counts + series_cap * num_buckets, 0,
+                  sizeof(double) * static_cast<size_t>(cap - series_cap) * static_cast<size_t>(num_buckets));
+    }
+    series_cap = cap;
+    return true;
+  }
+
+  bool append_name(const char* data, long len) {
+    if (names_len + len > names_cap) {
+      long cap = names_cap ? names_cap : 4096;
+      while (cap < names_len + len) cap *= 2;
+      char* grown = static_cast<char*>(std::realloc(names, static_cast<size_t>(cap)));
+      if (!grown) return false;
+      names = grown;
+      names_cap = cap;
+    }
+    std::memcpy(names + names_len, data, static_cast<size_t>(len));
+    names_len += len;
+    return true;
+  }
+
+  void fold_sample(double v) {
+    SeriesMeta& m = series[series_count - 1];
+    if (num_buckets > 0) {
+      long idx = 0;
+      if (v > min_value) {
+        long raw = static_cast<long>(std::floor(std::log(v * inv_min) * inv_log_gamma));
+        if (raw < 0) raw = 0;
+        if (raw > num_buckets - 2) raw = num_buckets - 2;
+        idx = 1 + raw;
+      }
+      counts[(series_count - 1) * num_buckets + idx] += 1.0;
+    }
+    m.total += 1.0;
+    if (v > m.peak) m.peak = v;
+  }
+};
+
+// Find `needle` in [p, end); returns position or nullptr.
+const char* find(const char* p, const char* end, const char* needle, size_t n) {
+  if (end - p < static_cast<long>(n)) return nullptr;
+  return static_cast<const char*>(memmem(p, static_cast<size_t>(end - p), needle, n));
+}
+
+// Label-key scan within a complete metric object [p, limit): identical
+// semantics to fastsamples.cpp's find_label_value.
+const char* find_label(const char* p, const char* limit, const char* key, size_t key_len,
+                       long* len_out) {
+  const char* cur = p;
+  while (true) {
+    const char* hit = find(cur, limit, key, key_len);
+    if (!hit) return nullptr;
+    const char* after = hit + key_len;
+    while (after < limit && (*after == ' ' || *after == '\t')) after++;
+    if (after < limit && *after == ':') {
+      after++;
+      while (after < limit && (*after == ' ' || *after == '\t')) after++;
+      if (after < limit && *after == '"') {
+        after++;
+        const char* start = after;
+        while (after < limit && *after != '"') after++;
+        *len_out = after - start;
+        return start;
+      }
+    }
+    cur = hit + key_len;
+  }
+}
+
+// The resumable scanner core: consume as much of [p, end) as possible.
+// Returns the first UNCONSUMED position (the caller carries the rest), or
+// nullptr on malformed input / allocation failure (state set to kError).
+//
+// Anchors ("result", "metric", "values") may straddle a chunk boundary: when
+// an anchor isn't found, all but the last (anchor_len - 1) bytes are
+// consumed, so the partial token survives in the carry.
+const char* step(Stream& s, const char* p, const char* end) {
+  while (p < end) {
+    switch (s.state) {
+      case State::kSeekResult: {
+        const char* hit = find(p, end, "\"result\"", 8);
+        if (!hit) {
+          long keep = end - p < 7 ? end - p : 7;
+          return end - keep;
+        }
+        p = hit + 8;
+        s.state = State::kSeekMetric;
+        break;
+      }
+      case State::kSeekMetric: {
+        const char* hit = find(p, end, "\"metric\"", 8);
+        if (!hit) {
+          long keep = end - p < 7 ? end - p : 7;
+          return end - keep;
+        }
+        p = hit + 8;
+        s.state = State::kInMetric;
+        break;
+      }
+      case State::kInMetric: {
+        // Need the WHOLE metric object (through the "values" key) before
+        // extracting labels; until then keep everything in the carry.
+        const char* hit = find(p, end, "\"values\"", 8);
+        if (!hit) return p;  // keep all — bounded by kMaxCarry
+        long pod_len = 0, container_len = 0;
+        const char* pod = find_label(p, hit, "\"pod\"", 5, &pod_len);
+        const char* container = find_label(p, hit, "\"container\"", 11, &container_len);
+        if (s.series_count == s.series_cap && !s.grow_series()) {
+          s.state = State::kError;
+          return nullptr;
+        }
+        SeriesMeta& m = s.series[s.series_count];
+        m.name_off = s.names_len;
+        bool ok = (pod_len == 0 || s.append_name(pod, pod_len)) && s.append_name("\t", 1) &&
+                  (container_len == 0 || s.append_name(container, container_len));
+        if (!ok) {
+          s.state = State::kError;
+          return nullptr;
+        }
+        m.name_len = s.names_len - m.name_off;
+        m.total = 0.0;
+        m.peak = -HUGE_VAL;
+        s.series_count++;
+        p = hit + 8;
+        s.depth = 0;
+        s.state = State::kInValues;
+        break;
+      }
+      case State::kInValues: {
+        // Tight scan to the next bracket (the switch dispatch per byte
+        // halves throughput vs the buffered scanner; these inner loops
+        // close most of the gap).
+        while (p < end && *p != '[' && *p != ']') p++;
+        if (p >= end) break;
+        if (*p == '[') {
+          p++;
+          s.depth++;
+          if (s.depth >= 2) s.state = State::kInSample;  // a sample's opener
+        } else {
+          p++;
+          s.depth--;
+          if (s.depth <= 0) s.state = State::kSeekMetric;  // values array closed
+        }
+        break;
+      }
+      case State::kInSample: {
+        while (p < end && *p != ',' && *p != ']') p++;  // timestamp bytes
+        if (p >= end) break;
+        if (*p == ',') {
+          p++;
+          s.number_len = 0;
+          s.state = State::kInNumber;
+        } else {
+          p++;  // degenerate [ts] pair — treat as sample-less
+          s.depth--;
+          s.state = State::kInValues;
+        }
+        break;
+      }
+      case State::kInNumber: {
+        if (s.number_len == 0) {
+          while (p < end && (*p == ' ' || *p == '"')) p++;
+          if (p >= end) break;
+          const char* t = p;
+          while (t < end && *t != ']' && *t != ',' && *t != '"') t++;
+          if (t < end) {
+            // Whole literal in view (the overwhelmingly common case):
+            // parse IN PLACE — no per-character copy.
+            if (t - p > kMaxNumber) {  // same limit as the copy path below
+              s.state = State::kError;
+              return nullptr;
+            }
+            double v;
+            const char* after = fastfloat::parse_number_fast(p, t, &v);
+            if (!after && t > p) {
+              // strtod fallback needs NUL termination: bounce via the buffer.
+              long n = t - p;
+              std::memcpy(s.number, p, static_cast<size_t>(n));
+              s.number[n] = '\0';
+              char* slow_end = nullptr;
+              v = std::strtod(s.number, &slow_end);
+              after = slow_end == s.number ? nullptr : slow_end;
+            }
+            if (after && std::isfinite(v)) s.fold_sample(v);
+            p = t;
+            s.state = State::kAfterNumber;
+            break;
+          }
+          // Literal straddles the chunk: fall through to the copy path.
+        }
+        while (p < end) {
+          char c = *p;
+          if (c == ' ' || c == '"') {
+            p++;
+          } else if (c == ']' || c == ',') {
+            break;
+          } else {
+            if (s.number_len >= kMaxNumber) {
+              s.state = State::kError;
+              return nullptr;
+            }
+            s.number[s.number_len++] = c;
+            p++;
+          }
+        }
+        if (p >= end) break;  // literal continues in the next chunk
+        // Literal complete: parse and fold (same fast-float + strtod
+        // fallback and finite-only rule as the buffered scanner).
+        s.number[s.number_len] = '\0';
+        double v;
+        const char* after =
+            fastfloat::parse_number_fast(s.number, s.number + s.number_len, &v);
+        if (!after) {
+          char* slow_end = nullptr;
+          v = std::strtod(s.number, &slow_end);
+          after = slow_end == s.number ? nullptr : slow_end;
+        }
+        if (after && std::isfinite(v)) s.fold_sample(v);
+        s.number_len = 0;
+        s.state = State::kAfterNumber;
+        break;
+      }
+      case State::kAfterNumber: {
+        while (p < end && *p != ']') p++;
+        if (p >= end) break;
+        p++;
+        s.depth--;
+        s.state = State::kInValues;
+        break;
+      }
+      case State::kError:
+        return nullptr;
+    }
+  }
+  return end;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* krr_stream_new(double gamma, double min_value, long num_buckets) {
+  // num_buckets == 0 selects the stats-only sink (count + max, no histogram);
+  // otherwise parameters follow krr_parse_matrix_digest.
+  if (num_buckets != 0 && (num_buckets < 2 || gamma <= 1.0 || min_value <= 0.0)) return nullptr;
+  Stream* s = new (std::nothrow) Stream();
+  if (!s) return nullptr;
+  s->gamma = gamma;
+  s->min_value = min_value;
+  s->num_buckets = num_buckets;
+  if (num_buckets > 0) {
+    s->inv_log_gamma = 1.0 / std::log(gamma);
+    s->inv_min = 1.0 / min_value;
+  }
+  return s;
+}
+
+// Feed one chunk. Returns 0, or -2 on malformed input/allocation failure
+// (the stream is then unusable).
+//
+// The carry never exceeds kMaxCarry regardless of chunk size: while a carry
+// exists, new bytes top it up in kMaxCarry-bounded blocks and the machine
+// steps over the carry buffer; once it drains, the rest of the chunk is
+// scanned in place. The machine makes progress in any full carry unless a
+// single metric object exceeds kMaxCarry — which is rejected as malformed,
+// never buffered unboundedly.
+long krr_stream_feed(void* handle, const char* chunk, long len) {
+  Stream& s = *static_cast<Stream*>(handle);
+  if (s.state == State::kError) return -2;
+
+  const char* p = chunk;
+  const char* end = chunk + len;
+  while (p < end) {
+    if (s.carry_len > 0) {
+      long room = kMaxCarry - s.carry_len;
+      long take = end - p < room ? end - p : room;
+      if (take <= 0) {  // carry at cap with no progress possible
+        s.state = State::kError;
+        return -2;
+      }
+      if (!s.reserve_carry(s.carry_len + take)) {
+        s.state = State::kError;
+        return -2;
+      }
+      std::memcpy(s.carry + s.carry_len, p, static_cast<size_t>(take));
+      s.carry_len += take;
+      p += take;
+      const char* consumed_to = step(s, s.carry, s.carry + s.carry_len);
+      if (!consumed_to) return -2;
+      long remaining = (s.carry + s.carry_len) - consumed_to;
+      if (remaining == s.carry_len && remaining >= kMaxCarry) {
+        s.state = State::kError;  // a metric object larger than the cap
+        return -2;
+      }
+      std::memmove(s.carry, consumed_to, static_cast<size_t>(remaining));
+      s.carry_len = remaining;
+      continue;
+    }
+    const char* consumed_to = step(s, p, end);
+    if (!consumed_to) return -2;
+    long remaining = end - consumed_to;
+    if (remaining > 0) {
+      if (remaining > kMaxCarry || !s.reserve_carry(remaining)) {
+        s.state = State::kError;  // a metric object larger than the cap
+        return -2;
+      }
+      std::memcpy(s.carry, consumed_to, static_cast<size_t>(remaining));
+      s.carry_len = remaining;
+    }
+    return 0;  // chunk fully handed off (scanned or carried)
+  }
+  return 0;
+}
+
+// End of body: returns the series count, or -2 if the stream errored or
+// never saw a "result" array (e.g. an error payload).
+long krr_stream_finish(void* handle) {
+  Stream& s = *static_cast<Stream*>(handle);
+  if (s.state == State::kError || s.state == State::kSeekResult) return -2;
+  // A trailing carry is fine: it can only hold a partial anchor between
+  // series (never part of an accepted sample).
+  return s.series_count;
+}
+
+//   names      — '\n'-joined "pod\tcontainer" records (as fastsamples.cpp)
+//   totals/peaks — per-series count / exact max
+//   counts     — [series x num_buckets] row-major (digest mode only)
+// Buffers are caller-allocated; returns 0 or -1 if a capacity is too small.
+long krr_stream_read(void* handle, char* names, long names_cap, double* totals, double* peaks,
+                     double* counts, long series_cap) {
+  Stream& s = *static_cast<Stream*>(handle);
+  if (s.series_count > series_cap) return -1;
+  long need = s.names_len + s.series_count;  // + '\n' per record
+  if (need > names_cap) return -1;
+  long off = 0;
+  for (long i = 0; i < s.series_count; i++) {
+    std::memcpy(names + off, s.names + s.series[i].name_off,
+                static_cast<size_t>(s.series[i].name_len));
+    off += s.series[i].name_len;
+    names[off++] = '\n';
+    totals[i] = s.series[i].total;
+    peaks[i] = s.series[i].peak;
+  }
+  if (s.num_buckets > 0 && counts) {
+    std::memcpy(counts, s.counts,
+                sizeof(double) * static_cast<size_t>(s.series_count) * static_cast<size_t>(s.num_buckets));
+  }
+  return 0;
+}
+
+long krr_stream_names_len(void* handle) {
+  Stream& s = *static_cast<Stream*>(handle);
+  return s.names_len + s.series_count;
+}
+
+void krr_stream_free(void* handle) { delete static_cast<Stream*>(handle); }
+
+}  // extern "C"

@@ -313,6 +313,25 @@ class TestBatchedFleetQueries:
                         batched[resource][i][pod], unbatched[resource][i][pod]
                     )
 
+    def test_streamed_digests_equal_buffered(self, fake_env, monkeypatch):
+        """The streamed ingest route (response bytes → native stream, no
+        body materialization) must produce exactly the buffered route's
+        digests."""
+        from krr_tpu.integrations import native
+
+        assert native.stream_available()  # this image has the toolchain
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        streamed = self._gather_digests(make_config(fake_env), objects)
+        monkeypatch.setattr(native, "stream_available", lambda: False)
+        buffered = self._gather_digests(make_config(fake_env), objects)
+        np.testing.assert_array_equal(streamed.cpu_counts, buffered.cpu_counts)
+        np.testing.assert_array_equal(streamed.cpu_total, buffered.cpu_total)
+        np.testing.assert_array_equal(streamed.cpu_peak, buffered.cpu_peak)
+        np.testing.assert_array_equal(streamed.mem_total, buffered.mem_total)
+        np.testing.assert_array_equal(streamed.mem_peak, buffered.mem_peak)
+
     def test_digest_batched_equals_per_workload(self, fake_env):
         objects = asyncio.run(
             KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])

@@ -255,6 +255,124 @@ class TestFastFloat:
         np.testing.assert_array_equal(got, want)
 
 
+class TestStreamingIngest:
+    """The streaming scanner (faststream.cpp) must match the buffered
+    one-shot parsers exactly at EVERY chunk boundary — including 1-byte
+    feeds, empty series, empty values arrays, and absent labels."""
+
+    GAMMA, MINV, BUCKETS = 1.01, 1e-7, 256
+
+    def _body(self, rng) -> bytes:
+        series = [
+            (f"pod-{i}", "main", list(rng.gamma(2.0, 0.05, int(rng.integers(0, 40)))))
+            for i in range(12)
+        ]
+        series.insert(3, ("empty-values", "main", []))
+        result = [
+            {"metric": {"pod": p, "container": c, "namespace": "ns"},
+             "values": [[1700000000 + 5 * t, repr(float(v))] for t, v in enumerate(vals)]}
+            for p, c, vals in series
+        ]
+        result.append({"metric": {"namespace": "ns"}, "values": [[1, "0.5"], [2, "NaN"]]})  # no pod label
+        import json
+
+        return json.dumps({"status": "success", "data": {"resultType": "matrix", "result": result}}).encode()
+
+    def test_stream_matches_buffered_at_every_chunk_size(self, library_available, rng):
+        body = self._body(rng)
+        digest_oracle = native.parse_matrix_digest(body, self.GAMMA, self.MINV, self.BUCKETS)
+        stats_oracle = native.parse_matrix_stats(body)
+        for chunk_size in (1, 2, 3, 7, 17, 64, 1000, len(body)):
+            stream = native.open_stream(self.GAMMA, self.MINV, self.BUCKETS)
+            assert stream is not None
+            for i in range(0, len(body), chunk_size):
+                stream.feed(body[i:i + chunk_size])
+            got = stream.finish()
+            assert [e[0] for e in got] == [e[0] for e in digest_oracle], chunk_size
+            for (k, c, t, p), (_, oc, ot, op) in zip(got, digest_oracle):
+                assert t == ot and (p == op or (np.isneginf(p) and np.isneginf(op))), (chunk_size, k)
+                np.testing.assert_array_equal(c, oc)
+
+            stats_stream = native.open_stream(0.0, 0.0, 0)
+            for i in range(0, len(body), chunk_size):
+                stats_stream.feed(body[i:i + chunk_size])
+            assert stats_stream.finish() == stats_oracle, chunk_size
+
+    def test_large_chunk_after_carry(self, library_available, rng):
+        """A chunk boundary mid-anchor followed by a multi-hundred-KB chunk
+        must work: the carry tops up in bounded blocks, it doesn't try to
+        swallow the whole next chunk (regression — the first cut errored on
+        any >64 KB chunk that followed a carry)."""
+        import json
+
+        big = json.dumps({"status": "success", "data": {"resultType": "matrix", "result": [
+            {"metric": {"pod": f"p{i}", "container": "c"},
+             "values": [[t, repr(float(v))] for t, v in enumerate(rng.uniform(0, 1, 120))]}
+            for i in range(300)
+        ]}}).encode()
+        assert len(big) > 3 * 64 * 1024
+        oracle = native.parse_matrix_stats(big)
+        # Split 3 bytes into a '"metric"' anchor so a carry exists, then feed
+        # everything else as ONE giant chunk.
+        cut = big.index(b'"metric"', 200) + 3
+        stream = native.open_stream(0.0, 0.0, 0)
+        stream.feed(big[:cut])
+        stream.feed(big[cut:])
+        assert stream.finish() == oracle
+
+    def test_long_literal_across_chunks(self, library_available):
+        """Literals up to the 512-char cap parse identically streamed (any
+        boundary) and buffered; beyond the cap both streamed paths reject."""
+        import json
+
+        long_lit = "0." + "1234567890" * 7  # 72 chars — valid, > old 64 cap
+        body = json.dumps({"status": "success", "data": {"resultType": "matrix", "result": [
+            {"metric": {"pod": "p"}, "values": [[1, long_lit], [2, "0.5"]]}
+        ]}}).encode()
+        [(key, total, peak)] = native.parse_matrix_stats(body)
+        for chunk in (1, 5, 30, len(body)):
+            stream = native.open_stream(0.0, 0.0, 0)
+            for i in range(0, len(body), chunk):
+                stream.feed(body[i:i + chunk])
+            assert stream.finish() == [(key, total, peak)], chunk
+
+    def test_error_payload_rejected(self, library_available):
+        stream = native.open_stream(self.GAMMA, self.MINV, self.BUCKETS)
+        stream.feed(b'{"status":"error","error":"boom"}')
+        with pytest.raises(ValueError):
+            stream.finish()
+
+    def test_mutated_streams_never_crash(self, library_available, rng):
+        """Corrupted bodies fed at adversarial chunk sizes must surface as
+        clean Python exceptions or empty/partial results — never memory
+        errors (a segfault would kill the process)."""
+        good = self._body(rng)
+        for trial in range(120):
+            body = bytearray(good)
+            r = np.random.default_rng(trial)
+            op = trial % 4
+            if op == 0:
+                body = body[: r.integers(0, len(body))]
+            elif op == 1:
+                for _ in range(int(r.integers(1, 8))):
+                    body[int(r.integers(0, len(body)))] = int(r.integers(0, 256))
+            elif op == 2:
+                a = int(r.integers(0, len(body)))
+                del body[a: min(len(body), a + int(r.integers(1, 200)))]
+            else:
+                a = int(r.integers(0, len(body)))
+                b = min(len(body), a + int(r.integers(1, 200)))
+                body = body[:a] + body[a:b] + body[a:]
+            stream = native.open_stream(self.GAMMA, self.MINV, self.BUCKETS)
+            try:
+                chunk = max(1, int(r.integers(1, 97)))
+                for i in range(0, len(body), chunk):
+                    stream.feed(bytes(body[i:i + chunk]))
+                stream.finish()
+            except Exception:
+                stream.abort()  # clean Python exceptions are acceptable
+
+
 class TestParserFuzz:
     def test_mutated_bodies_never_crash(self, library_available, rng):
         """The C scanner must reject or survive arbitrary corruption —
