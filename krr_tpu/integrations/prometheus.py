@@ -238,21 +238,31 @@ def step_string(step_seconds: float) -> str:
 #: points per series ("exceeded maximum resolution of 11,000 points").
 MAX_RANGE_POINTS = 11_000
 
-#: Cap on TOTAL samples per response (series × points per window): the
-#: loader reads each response fully into memory (~35 B/sample of JSON), so
-#: an unbounded namespace-batched response from a 100k-pod namespace could
-#: be tens of GB. 20M samples ≈ 700 MB of body — bounded loader memory at
-#: any fleet width, paid for with more (concurrent, exactly-merged) windows.
+#: Cap on TOTAL samples per response (series × points per window): an
+#: unbounded namespace-batched response from a 100k-pod namespace could be
+#: tens of GB (~35 B/sample of JSON). The digest/stats routes STREAM bodies
+#: into the native sinks (never materialized), so their cap only bounds the
+#: per-request transfer unit: 20M samples ≈ 700 MB.
 MAX_RESPONSE_SAMPLES = 20_000_000
 
+#: The raw sample route BUFFERS each window's body and parse output, and up
+#: to the connection-semaphore width of windows are in flight concurrently —
+#: so its per-response cap must be small enough that width × body stays a
+#: couple of GB: 2M samples ≈ 70 MB/body ⇒ ≤ ~2.2 GB in flight at the
+#: default 32-way fan-out, paid for with more (exactly-merged) windows.
+RAW_MAX_RESPONSE_SAMPLES = 2_000_000
 
-def window_points_cap(expected_series: int) -> int:
+
+def window_points_cap(expected_series: int, max_samples: Optional[int] = None) -> int:
     """Points per sub-window for a query expected to return ``expected_series``
     series: the Prometheus per-series cap, tightened so series × points stays
-    under ``MAX_RESPONSE_SAMPLES``. At least one point per window."""
+    under ``max_samples`` (default ``MAX_RESPONSE_SAMPLES``, read at call time
+    so tests can tune it). At least one point per window."""
+    if max_samples is None:
+        max_samples = MAX_RESPONSE_SAMPLES
     if expected_series <= 0:
         return MAX_RANGE_POINTS
-    return max(1, min(MAX_RANGE_POINTS, MAX_RESPONSE_SAMPLES // expected_series))
+    return max(1, min(MAX_RANGE_POINTS, max_samples // expected_series))
 
 
 def subwindows(
@@ -635,6 +645,7 @@ class PrometheusLoader:
     async def _window_fan_out(
         self, start: float, end: float, step_seconds: float,
         expected_series: int, fetch_entries, consume,
+        max_samples: Optional[int] = None,
     ) -> None:
         """Shared sub-window fan-out: run ``fetch_entries(w_start, w_end)``
         for every sub-window concurrently and hand each window's entries to
@@ -658,7 +669,10 @@ class PrometheusLoader:
             *[
                 one(i, s, e)
                 for i, (s, e) in enumerate(
-                    subwindows(start, end, step_seconds, max_points=window_points_cap(expected_series))
+                    subwindows(
+                        start, end, step_seconds,
+                        max_points=window_points_cap(expected_series, max_samples),
+                    )
                 )
             ],
             return_exceptions=True,
@@ -684,12 +698,15 @@ class PrometheusLoader:
     ) -> "list[list]":
         """Sub-window fan-out returning per-window parse results in window
         (time) order — the raw path, whose cross-window concatenation is
-        order-dependent."""
+        order-dependent. Uses the raw route's tighter response cap: these
+        bodies buffer, and the connection-semaphore width of them are in
+        flight at once (see RAW_MAX_RESPONSE_SAMPLES)."""
         by_index: dict[int, list] = {}
         await self._window_fan_out(
             start, end, step_seconds, expected_series,
             self._buffered_fetch_entries(query, step_seconds, self._kept(parse, keep)),
             by_index.__setitem__,
+            max_samples=RAW_MAX_RESPONSE_SAMPLES,  # read at call time
         )
         return [by_index[i] for i in range(len(by_index))]
 
@@ -745,7 +762,10 @@ class PrometheusLoader:
             fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
 
         await self._window_fan_out(
-            start, end, step_seconds, expected_series, fetch_entries, consume
+            start, end, step_seconds, expected_series, fetch_entries, consume,
+            # The buffered fallback (no native lib / proxied httpx) holds
+            # whole bodies like the raw route — give it the same tight cap.
+            max_samples=None if use_stream else RAW_MAX_RESPONSE_SAMPLES,
         )
         return [(key, *state) for key, state in merged.items()]
 

@@ -965,7 +965,7 @@ class TestRangeQuerySplitting:
         fetch splits into many sub-windows and still merges exactly."""
         import krr_tpu.integrations.prometheus as prom_mod
 
-        monkeypatch.setattr(prom_mod, "MAX_RESPONSE_SAMPLES", 96)
+        monkeypatch.setattr(prom_mod, "RAW_MAX_RESPONSE_SAMPLES", 96)
         server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(
             tmp_path_factory, n_samples=1000, step=60.0
         )
@@ -996,7 +996,7 @@ class TestRangeQuerySplitting:
         count() probe) shrink the windows even though none of them route."""
         import krr_tpu.integrations.prometheus as prom_mod
 
-        monkeypatch.setattr(prom_mod, "MAX_RESPONSE_SAMPLES", 600)
+        monkeypatch.setattr(prom_mod, "RAW_MAX_RESPONSE_SAMPLES", 600)  # raw-route cap
         n_samples = 1000
         server, config, metrics, pod, cpu, mem, end_time, history = self._wide_window_env(
             tmp_path_factory, n_samples=n_samples, step=60.0
