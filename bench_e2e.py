@@ -59,6 +59,11 @@ def _serve_fixture(n_containers: int, samples: int, conn) -> None:
 
     cluster = FakeCluster()
     metrics = FakeMetrics()
+    # Range-accurate serving: split-window fetches (the raw route's bounded
+    # response windows) must receive exactly their slice — serving the full
+    # series per window would multiply the measured transfer by the window
+    # count. The scan pins its end (scan_end, below) onto this grid.
+    metrics.enforce_range = True
     rng = np.random.default_rng(5)
     for i in range(n_containers):
         name = f"wl-{i}"
@@ -110,11 +115,20 @@ def run_e2e(n_containers: int, samples: int) -> dict:
                     },
                     f,
                 )
+            from krr_tpu.strategies.simple import SimpleStrategySettings
+            from tests.fakes.servers import FakeBackend
+
+            # Pin the window's right edge so the fake's range-anchored series
+            # line up with the scan exactly, deriving the grid step from the
+            # strategy the scan actually runs (15 min by default).
+            step_seconds = SimpleStrategySettings().timeframe_timedelta.total_seconds()
+            scan_end = FakeBackend.SERIES_ORIGIN + (samples - 1) * step_seconds
             config = Config(
                 kubeconfig=kubeconfig,
                 prometheus_url=server_url,
                 quiet=True,
                 format="json",
+                scan_end_timestamp=scan_end,
             )
             def one_scan(cfg=None) -> tuple[float, dict]:
                 runner = Runner(cfg or config)

@@ -68,6 +68,11 @@ class Config(pd.BaseModel):
     #: mono-namespace fleets behind a slow proxy).
     batched_fleet_queries: bool = True
 
+    #: Pin the scan window's right edge to an absolute unix timestamp —
+    #: reproducible scans (two runs see identical samples) and offline
+    #: benchmarking against recorded history. Default: now.
+    scan_end_timestamp: Optional[float] = None
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

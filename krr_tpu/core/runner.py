@@ -32,10 +32,20 @@ from krr_tpu.utils.version import get_version
 
 
 class HistorySource(Protocol):
-    """What the runner needs from a metrics backend (real or fake)."""
+    """What the runner needs from a metrics backend (real or fake).
+
+    ``end_time`` pins the scan window's right edge (``--scan-end-timestamp``);
+    the runner OMITS the argument entirely when unpinned, so sources written
+    without the parameter keep working for ordinary scans — but a source
+    must accept it to support pinned scans.
+    """
 
     async def gather_fleet(
-        self, objects: list[K8sObjectData], history_seconds: float, step_seconds: float
+        self,
+        objects: list[K8sObjectData],
+        history_seconds: float,
+        step_seconds: float,
+        end_time: Optional[float] = None,
     ) -> dict[ResourceType, list[RaggedHistory]]:
         ...
 
@@ -104,6 +114,14 @@ class Runner:
             raise source
         return source
 
+    def _end_time_kwargs(self) -> dict:
+        """``{"end_time": ...}`` when the scan window's right edge is pinned
+        (`--scan-end-timestamp`), else {} — so sources without the parameter
+        (simple fakes, third-party backends) keep working unpinned."""
+        if self.config.scan_end_timestamp is None:
+            return {}
+        return {"end_time": self.config.scan_end_timestamp}
+
     def _greet(self) -> None:
         self.logger.echo(ASCII_LOGO, no_prefix=True, markup=True)
         self.logger.echo(f"Running krr-tpu (TPU-native Kubernetes Resource Recommender) {get_version()}", no_prefix=True)
@@ -132,7 +150,9 @@ class Runner:
             subset = [objects[i] for i in indices]
             try:
                 source = self._get_history_source(cluster)
-                fetched = await source.gather_fleet(subset, history_seconds, step_seconds)
+                fetched = await source.gather_fleet(
+                    subset, history_seconds, step_seconds, **self._end_time_kwargs()
+                )
             except Exception as e:
                 self.logger.warning(
                     f"Failed to gather history for cluster {cluster or 'default'}: {e} — "
@@ -182,11 +202,15 @@ class Runner:
                 source = self._get_history_source(cluster)
                 if hasattr(source, "gather_fleet_digests"):
                     sub_fleet = await source.gather_fleet_digests(
-                        subset, history_seconds, step_seconds, spec.gamma, spec.min_value, spec.num_buckets
+                        subset, history_seconds, step_seconds,
+                        spec.gamma, spec.min_value, spec.num_buckets,
+                        **self._end_time_kwargs(),
                     )
                     fleet.merge_from(sub_fleet, indices)
                 else:
-                    fetched = await source.gather_fleet(subset, history_seconds, step_seconds)
+                    fetched = await source.gather_fleet(
+                        subset, history_seconds, step_seconds, **self._end_time_kwargs()
+                    )
                     fold_histories(indices, fetched)
             except Exception as e:
                 self.logger.warning(

@@ -487,9 +487,11 @@ class PrometheusLoader:
         must be sized to what the server will actually send, not to what we
         will keep (round-3 review finding). Series that churned away before
         ``at_time`` escape an instant count — a structural limit; the
-        nominal ~700 MB/body that ``MAX_RESPONSE_SAMPLES`` targets carries
-        the headroom for that. None on any failure (callers fall back to
-        the routed estimate)."""
+        response caps are transfer/memory targets with real slack (streamed
+        routes never hold the body at all; buffered routes cap at ~70 MB,
+        RAW_MAX_RESPONSE_SAMPLES), so moderate undercounts cost memory
+        headroom, not correctness. None on any failure (callers fall back
+        to the routed estimate)."""
         if self._client is None:
             return None
         attempt = 0

@@ -203,6 +203,15 @@ def _common_options() -> list[click.Option]:
                 "false = one server-side selector query per workload."
             ),
         ),
+        PanelOption(
+            ["--scan-end-timestamp"],
+            type=float,
+            default=None,
+            help=(
+                "Pin the scan window's right edge to an absolute unix timestamp "
+                "(reproducible scans / offline benchmarks). Default: now."
+            ),
+        ),
         PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
         PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
         PanelOption(

@@ -130,20 +130,39 @@ class FakeMetrics:
     #: per request; the parser discards timestamps, so static ones are served.
     _value_strs: dict[tuple[str, str, str], tuple[str, str]] = field(default_factory=dict)
 
-    #: Fully-rendered batched response bodies per (namespace, is_cpu):
-    #: namespace-sized bodies are hundreds of MB at fleet scale and identical
-    #: across requests — rendering per request would make the e2e bench
-    #: measure the fake's string assembly, not the scanner.
-    _batched_bodies: dict[tuple[str, bool], bytes] = field(default_factory=dict)
+    #: Fully-rendered batched response bodies: namespace-sized bodies are
+    #: hundreds of MB at fleet scale and identical across requests —
+    #: rendering per request would make the e2e bench measure the fake's
+    #: string assembly, not the scanner. Keys: (namespace, is_cpu) for
+    #: whole-range serving, (namespace, is_cpu, start, end, step) for
+    #: enforce_range window slices.
+    _batched_bodies: dict[tuple, bytes] = field(default_factory=dict)
+
+    #: Per-(key, resource) cumulative character offsets of each sample
+    #: fragment within the joined values string — O(1) range slicing for
+    #: enforce_range serving (fragment i spans [offs[i], offs[i+1]-1)).
+    _value_offsets: dict[tuple[str, str, str], tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
 
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
         key = (namespace, container, pod)
         self.series[key] = (np.asarray(cpu, float), np.asarray(memory, float))
-        self._value_strs[key] = tuple(
-            ",".join(f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples))
-            for samples in self.series[key]
-        )
+        strs, offsets = [], []
+        for samples in self.series[key]:
+            fragments = [f"[{1700000000 + 60 * i},\"{float(v)!r}\"]" for i, v in enumerate(samples)]
+            strs.append(",".join(fragments))
+            # offs[i] = start of fragment i in the joined string (as if every
+            # fragment had a trailing comma); offs[n] closes the last one.
+            offsets.append(np.concatenate([[0], np.cumsum([len(f) + 1 for f in fragments])]).astype(np.int64))
+        self._value_strs[key] = tuple(strs)
+        self._value_offsets[key] = tuple(offsets)
         self._batched_bodies.clear()
+
+    def sliced_values(self, key: tuple[str, str, str], is_cpu: bool, i0: int, i1: int) -> str:
+        """The values-array JSON for samples [i0, i1] — an O(1) substring of
+        the pre-rendered joined string."""
+        joined = self._value_strs[key][0 if is_cpu else 1]
+        offs = self._value_offsets[key][0 if is_cpu else 1]
+        return joined[offs[i0]: offs[i1 + 1] - 1]
 
 
 #: Per-workload query shape (`krr_tpu.integrations.prometheus.cpu_query`).
@@ -323,22 +342,34 @@ class FakeBackend:
         step = 60.0
         if self.metrics.enforce_range:
             # Series anchored at SERIES_ORIGIN with the requested step;
-            # return exactly the samples on the requested grid slice.
+            # return exactly the samples on the requested grid slice (O(1)
+            # substring slicing of the pre-rendered values — split-window
+            # fetches must not be served the full series per window, which
+            # would multiply the measured transfer by the window count).
+            # Timestamps inside the pre-rendered fragments are static; every
+            # consumer discards them.
             t0 = self.SERIES_ORIGIN
-            result = []
+            cache_key = (namespace, is_cpu, req_start, req_end, step_sec) if batched else None
+            if cache_key is not None and cache_key in self.metrics._batched_bodies:
+                return web.Response(
+                    body=self.metrics._batched_bodies[cache_key], content_type="application/json"
+                )
+            fragments = []
             for ns, cont, pod in selected:
-                cpu, memory = self.metrics.series[(ns, cont, pod)]
-                samples = cpu if is_cpu else memory
+                n = len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
                 i0 = max(0, int(np.ceil((req_start - t0) / step_sec)))
-                i1 = min(len(samples) - 1, int((req_end - t0) // step_sec))
+                i1 = min(n - 1, int((req_end - t0) // step_sec))
                 if i1 >= i0:
-                    values = [
-                        [t0 + i * step_sec, repr(float(samples[i]))] for i in range(i0, i1 + 1)
-                    ]
-                    result.append({"metric": metric_dict(cont, pod), "values": values})
-            return web.json_response(
-                {"status": "success", "data": {"resultType": "matrix", "result": result}}
-            )
+                    fragments.append(
+                        '{"metric":%s,"values":[%s]}'
+                        % (metric_json(cont, pod), self.metrics.sliced_values((ns, cont, pod), is_cpu, i0, i1))
+                    )
+            body = (
+                '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
+            ).encode()
+            if cache_key is not None:
+                self.metrics._batched_bodies[cache_key] = body
+            return web.Response(body=body, content_type="application/json")
         if not self.metrics.duplicate_pods:
             cache_key = (namespace, is_cpu) if batched else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:

@@ -214,6 +214,59 @@ class TestCLI:
         KrrLogger(quiet=True).print_result(long_line)
         assert capsys.readouterr().out == long_line + "\n"
 
+    def test_scan_end_timestamp_pins_the_window(self):
+        """--scan-end-timestamp flows to the history source as end_time;
+        without it, sources are called unpinned (so simple fakes without the
+        parameter keep working)."""
+        import asyncio
+
+        from krr_tpu.models.allocations import ResourceType
+
+        calls = []
+
+        class RecordingSource:
+            async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+                calls.append(kwargs)
+                return {r: [{} for _ in objects] for r in ResourceType}
+
+        from krr_tpu.models.allocations import ResourceAllocations
+        from krr_tpu.models.objects import K8sObjectData
+
+        one_object = [
+            K8sObjectData(
+                cluster="c", namespace="d", name="w", kind="Deployment", container="m",
+                pods=["w-0"],
+                allocations=ResourceAllocations(
+                    requests={ResourceType.CPU: None, ResourceType.Memory: None},
+                    limits={ResourceType.CPU: None, ResourceType.Memory: None},
+                ),
+            )
+        ]
+
+        class OneObjectInventory:
+            async def list_clusters(self):
+                return ["c"]
+
+            async def list_scannable_objects(self, clusters):
+                return one_object
+
+        from krr_tpu.core.config import Config as Cfg
+        from krr_tpu.core.runner import Runner
+
+        for scan_end, expected in [(1_700_000_000.0, {"end_time": 1_700_000_000.0}), (None, {})]:
+            calls.clear()
+            runner_obj = Runner(
+                Cfg(quiet=True, format="json", scan_end_timestamp=scan_end),
+                inventory=OneObjectInventory(),
+                history_factory=lambda cluster: RecordingSource(),
+            )
+            import contextlib
+            import io
+
+            with contextlib.redirect_stdout(io.StringIO()):
+                asyncio.run(runner_obj.run())
+            assert calls == [expected], (scan_end, calls)
+
     def test_version(self):
         result = runner.invoke(app, ["version"])
         assert result.exit_code == 0
