@@ -98,9 +98,16 @@ class _RawTransport:
             elif verify:
                 self._context = ssl.create_default_context()
             else:
-                self._context = ssl._create_unverified_context()
+                # Explicitly-built no-verify context (the private
+                # ssl._create_unverified_context has shifted behavior across
+                # Python releases).
+                context = ssl.create_default_context()
+                context.check_hostname = False
+                context.verify_mode = ssl.CERT_NONE
+                self._context = context
         self._idle: list[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
+        self._closed = False
 
     def _connect(self) -> http.client.HTTPConnection:
         if self._https:
@@ -157,7 +164,12 @@ class _RawTransport:
                 conn.close()
                 raise
             with self._lock:
-                self._idle.append(conn)
+                if self._closed:
+                    # close() ran while this request was in flight: pooling
+                    # the connection now would leak its fd forever.
+                    conn.close()
+                else:
+                    self._idle.append(conn)
             return status, data
 
     def update_headers(self, headers: dict[str, str]) -> None:
@@ -168,6 +180,7 @@ class _RawTransport:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             idle, self._idle = self._idle, []
         for conn in idle:
             conn.close()
@@ -841,7 +854,13 @@ class PrometheusLoader:
         for i in indices:
             obj = objects[i]
             for pod in obj.pods:
-                route.setdefault((pod, obj.container), []).append(i)
+                targets = route.setdefault((pod, obj.container), [])
+                # Dedup per key: a duplicate pod name in obj.pods must not
+                # merge the series twice into the same object (the
+                # per-workload path dedups via its `seen` set — keep the two
+                # routes' defensive behavior symmetric).
+                if not targets or targets[-1] != i:
+                    targets.append(i)
         return route
 
     @staticmethod

@@ -31,6 +31,7 @@
 #include <cstring>
 
 #include "fastfloat.h"
+#include "jsonkey.h"
 
 namespace {
 
@@ -64,19 +65,8 @@ const char* find_label_value(Cursor c, const char* limit, const char* quoted_key
     // with it, and quadratically worse as series grow.
     c.end = limit;
     while (c.seek(quoted_key)) {
-        const char* after_key = c.p;
-        while (after_key < c.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
-        if (after_key < c.end && *after_key == ':') {
-            after_key++;
-            while (after_key < c.end && (*after_key == ' ' || *after_key == '\t')) after_key++;
-            if (after_key < c.end && *after_key == '"') {
-                after_key++;
-                const char* start = after_key;
-                while (after_key < c.end && *after_key != '"') after_key++;
-                *len_out = after_key - start;
-                return start;
-            }
-        }
+        const char* start = jsonkey::string_value(c.p, c.end, len_out);
+        if (start) return start;
         // Value occurrence — keep scanning within the metric object.
     }
     return nullptr;
@@ -104,9 +94,20 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
         if (!probe.seek("\"metric\"")) break;
         c = probe;
 
+        // The "values" anchor must be the KEY (next non-space char ':'), not
+        // a label VALUE equal to "values" — e.g. a container named "values",
+        // which namespace-batched queries would place inside the metric
+        // object ahead of the real key (same key-vs-value rule as
+        // find_label_value).
         Cursor metric_end = c;
-        if (!metric_end.seek("\"values\"")) break;
-        const char* values_key_at = metric_end.p;
+        const char* values_key_at = nullptr;
+        while (metric_end.seek("\"values\"")) {
+            if (jsonkey::classify(metric_end.p, metric_end.end, nullptr) == 1) {
+                values_key_at = metric_end.p;
+                break;
+            }
+        }
+        if (!values_key_at) break;
 
         long pod_len = 0, container_len = 0;
         const char* pod = find_label_value(c, values_key_at, "\"pod\"", &pod_len);

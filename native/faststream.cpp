@@ -26,6 +26,7 @@
 #include <new>
 
 #include "fastfloat.h"
+#include "jsonkey.h"
 
 namespace {
 
@@ -171,20 +172,9 @@ const char* find_label(const char* p, const char* limit, const char* key, size_t
   while (true) {
     const char* hit = find(cur, limit, key, key_len);
     if (!hit) return nullptr;
-    const char* after = hit + key_len;
-    while (after < limit && (*after == ' ' || *after == '\t')) after++;
-    if (after < limit && *after == ':') {
-      after++;
-      while (after < limit && (*after == ' ' || *after == '\t')) after++;
-      if (after < limit && *after == '"') {
-        after++;
-        const char* start = after;
-        while (after < limit && *after != '"') after++;
-        *len_out = after - start;
-        return start;
-      }
-    }
-    cur = hit + key_len;
+    const char* start = jsonkey::string_value(hit + key_len, limit, len_out);
+    if (start) return start;
+    cur = hit + key_len;  // value occurrence — keep scanning
   }
 }
 
@@ -220,9 +210,21 @@ const char* step(Stream& s, const char* p, const char* end) {
       }
       case State::kInMetric: {
         // Need the WHOLE metric object (through the "values" key) before
-        // extracting labels; until then keep everything in the carry.
-        const char* hit = find(p, end, "\"values\"", 8);
-        if (!hit) return p;  // keep all — bounded by kMaxCarry
+        // extracting labels; until then keep everything in the carry. The
+        // anchor must be the KEY (next non-space char ':'): a label VALUE
+        // equal to "values" — a container legally named "values", reachable
+        // since namespace-batched queries put container labels here — would
+        // otherwise false-match and mis-extract this series' labels.
+        const char* scan = p;
+        const char* hit;
+        while (true) {
+          hit = find(scan, end, "\"values\"", 8);
+          if (!hit) return p;  // keep all — bounded by kMaxCarry
+          int kind = jsonkey::classify(hit + 8, end, nullptr);
+          if (kind < 0) return p;  // can't classify yet — wait for more bytes
+          if (kind == 1) break;    // genuine key
+          scan = hit + 8;          // value occurrence — keep scanning
+        }
         long pod_len = 0, container_len = 0;
         const char* pod = find_label(p, hit, "\"pod\"", 5, &pod_len);
         const char* container = find_label(p, hit, "\"container\"", 11, &container_len);

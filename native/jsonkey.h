@@ -1,0 +1,48 @@
+// Shared JSON key-vs-value classification for the native scanners
+// (fastsamples.cpp buffered, faststream.cpp streaming). A quoted token like
+// "pod" or "values" is a KEY only when the next non-whitespace char is ':' —
+// a label VALUE equal to the token (a container legally named "values") must
+// not match. One helper so the rule (including its whitespace set) cannot
+// drift between the four scan sites.
+#pragma once
+
+namespace jsonkey {
+
+inline bool is_ws(char c) { return c == ' ' || c == '\t' || c == '\n' || c == '\r'; }
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && is_ws(*p)) p++;
+  return p;
+}
+
+// Classify the bytes following a quoted token at [after, end):
+//    1 — a key (next non-ws char is ':'); *rest_out = the char past the colon
+//    0 — a value occurrence (next non-ws char is something else)
+//   -1 — indeterminate: whitespace runs to `end` (streaming callers wait for
+//        more bytes; complete-buffer callers treat it as not-a-key)
+inline int classify(const char* after, const char* end, const char** rest_out) {
+  after = skip_ws(after, end);
+  if (after >= end) return -1;
+  if (*after != ':') return 0;
+  if (rest_out) *rest_out = after + 1;
+  return 1;
+}
+
+// Scan a key's quoted string VALUE at [after_key, end): skips the colon's
+// surrounding whitespace and the opening quote, returns the string start and
+// sets *len_out (clamped at `end`), or nullptr when the key's value is not a
+// string or lies beyond `end`. `after_key` must point just past the key
+// token's closing quote.
+inline const char* string_value(const char* after_key, const char* end, long* len_out) {
+  const char* rest = nullptr;
+  if (classify(after_key, end, &rest) != 1) return nullptr;
+  rest = skip_ws(rest, end);
+  if (rest >= end || *rest != '"') return nullptr;
+  rest++;
+  const char* start = rest;
+  while (rest < end && *rest != '"') rest++;
+  *len_out = rest - start;
+  return start;
+}
+
+}  // namespace jsonkey

@@ -397,6 +397,62 @@ class TestBatchedFleetQueries:
         assert raw is None  # raw transport declined; httpx path served
         assert any(histories[ResourceType.CPU][i] for i in range(len(objects)))
 
+    def test_series_route_dedups_duplicate_pods(self):
+        """A duplicate pod name in obj.pods must not route the same series
+        twice into one object (the per-workload path dedups via its `seen`
+        set — the batched route must match)."""
+        from krr_tpu.models.allocations import ResourceAllocations
+        from krr_tpu.models.objects import K8sObjectData
+
+        obj = K8sObjectData(
+            name="web", container="main", namespace="default",
+            pods=["web-1", "web-1", "web-2"],
+            allocations=ResourceAllocations(requests={}, limits={}),
+        )
+        route = PrometheusLoader._series_route([obj], [0])
+        assert route[("web-1", "main")] == [0]
+        assert route[("web-2", "main")] == [0]
+
+    def test_raw_transport_close_drops_in_flight_connections(self):
+        """A connection in flight when close() runs must be closed on
+        completion, not re-pooled (fd leak until GC otherwise)."""
+        from krr_tpu.integrations.prometheus import _RawTransport
+
+        class FakeResponse:
+            status = 200
+
+            def read(self, n=None):
+                return b""
+
+        class FakeConn:
+            def __init__(self):
+                self.closed = False
+
+            def request(self, *a, **k):
+                pass
+
+            def getresponse(self):
+                return FakeResponse()
+
+            def close(self):
+                self.closed = True
+
+        transport = _RawTransport("http://prom.example:9090", {}, True)
+        pooled = FakeConn()
+        transport._connect = lambda: pooled  # type: ignore[method-assign]
+        transport.request("GET", "/api/v1/query", None, {})
+        assert transport._idle == [pooled] and not pooled.closed
+
+        transport.close()
+        assert pooled.closed  # idle pool drained
+
+        # A request completing AFTER close() (it was in flight when close
+        # ran) must close its connection instead of re-pooling it.
+        in_flight = FakeConn()
+        transport._connect = lambda: in_flight  # type: ignore[method-assign]
+        transport.request("GET", "/api/v1/query", None, {})
+        assert in_flight.closed and transport._idle == []
+
     def test_url_userinfo_becomes_basic_auth(self, fake_env, monkeypatch):
         import urllib.request
 

@@ -101,6 +101,34 @@ class TestNativeParser:
         with pytest.raises(ValueError, match="query too long"):
             native.parse_matrix(body)
 
+    def test_values_as_label_value_does_not_confuse_anchor(self, library_available):
+        # A container legally named "values" — its label VALUE renders as
+        # ':"values"' ahead of the real "values" KEY, and must not be taken
+        # as the metric object's end (that would mis-extract the labels and
+        # silently drop the series from routing).
+        body = (
+            b'{"status":"success","data":{"resultType":"matrix","result":['
+            b'{"metric":{"container":"values","namespace":"ns","pod":"web-1"},'
+            b'"values":[[1700000000,"0.5"],[1700000060,"0.75"]]},'
+            b'{"metric":{"container":"main","namespace":"ns","pod":"web-2"},'
+            b'"values":[[1700000000,"1.5"]]}]}}'
+        )
+        got = native.parse_matrix_native(body)
+        assert got is not None and [key for key, _ in got] == [("web-1", "values"), ("web-2", "main")]
+        np.testing.assert_array_equal(got[0][1], np.asarray([0.5, 0.75]))
+        np.testing.assert_array_equal(got[1][1], np.asarray([1.5]))
+        # Same body through the fused digest/stats sinks and the streaming
+        # scanner (every chunk size, so the key-vs-value check also exercises
+        # the carry/wait path when the colon is beyond the chunk edge).
+        stats = native.parse_matrix_stats(body)
+        assert [e[0] for e in stats] == [("web-1", "values"), ("web-2", "main")]
+        assert stats[0][1:] == (2.0, 0.75) and stats[1][1:] == (1.0, 1.5)
+        for chunk in (1, 3, 7, len(body)):
+            stream = native.open_stream(0.0, 0.0, 0)
+            for i in range(0, len(body), chunk):
+                stream.feed(body[i:i + chunk])
+            assert stream.finish() == stats, chunk
+
 
 class TestNativeDigestIngest:
     GAMMA, MIN_VALUE, BUCKETS = 1.01, 1e-7, 2560
