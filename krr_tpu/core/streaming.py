@@ -69,7 +69,15 @@ class DigestStore:
         new = list(dict.fromkeys(key for key in keys if key not in self._index))
         if new:
             grow = len(new)
-            self.cpu_counts = np.vstack([self.cpu_counts, np.zeros((grow, self.spec.num_buckets), np.float32)])
+            if self.cpu_counts.shape[0] == 0:
+                # Fresh store (every first scan at fleet scale): plain zeros —
+                # vstack against the empty matrix would pay a full extra copy
+                # of the [N x B] state (~0.7 s at 100k x 2560).
+                self.cpu_counts = np.zeros((grow, self.spec.num_buckets), np.float32)
+            else:
+                self.cpu_counts = np.vstack(
+                    [self.cpu_counts, np.zeros((grow, self.spec.num_buckets), np.float32)]
+                )
             self.cpu_total = np.concatenate([self.cpu_total, np.zeros(grow, np.float32)])
             self.cpu_peak = np.concatenate([self.cpu_peak, np.full(grow, -np.inf, np.float32)])
             self.mem_total = np.concatenate([self.mem_total, np.zeros(grow, np.float32)])
@@ -95,13 +103,12 @@ class DigestStore:
         def f32(a: np.ndarray) -> np.ndarray:
             return np.asarray(a).astype(np.float32, copy=False)  # no copy when already f32
 
-        start = int(rows[0]) if rows.size else 0
-        if rows.size and np.array_equal(rows, np.arange(start, start + rows.size)):
+        window = self._contiguous_slice(rows, len(self.keys))
+        if window is not None:
             # The common case — a fleet scanned in a stable order lands on a
             # contiguous row range (fresh stores exactly so): slice ops run
             # at memory bandwidth, ~2.5x faster than the buffered scatter on
             # a [100k x 2560] fold (and ~9x faster than fancy-index +=).
-            window = slice(start, start + rows.size)
             self.cpu_counts[window] += f32(cpu_counts)
             self.cpu_total[window] += f32(cpu_total)
             np.maximum(self.cpu_peak[window], f32(cpu_peak), out=self.cpu_peak[window])
@@ -116,20 +123,53 @@ class DigestStore:
         return rows
 
     # -------------------------------------------------------------- quantiles
+    @staticmethod
+    def _contiguous_slice(rows: np.ndarray, n: int) -> Optional[slice]:
+        """The equivalent ``slice`` when ``rows`` is a contiguous ascending
+        IN-BOUNDS range over an ``n``-row axis, else None. The bounds check
+        matters: out-of-range fancy indices raise IndexError, and the slice
+        path must not silently truncate instead. One helper for both the
+        merge fast path and the query view so the two cannot drift."""
+        if rows.size == 0 or rows[0] < 0 or rows[-1] >= n:
+            return None
+        if np.array_equal(rows, np.arange(rows[0], rows[0] + rows.size)):
+            return slice(int(rows[0]), int(rows[0]) + rows.size)
+        return None
+
+    def _take(self, rows: np.ndarray, *arrays: np.ndarray) -> list[np.ndarray]:
+        """``[a[rows] for a in arrays]``, but zero-copy VIEWS when ``rows`` is
+        a contiguous ascending range — the overwhelmingly common whole-fleet
+        query, where the fancy-index copy of the [N x B] count matrix costs
+        4.5 s at 100k x 2560 (measured) and the view costs nothing. One
+        contiguity check covers every array."""
+        rows = np.asarray(rows)
+        window = self._contiguous_slice(rows, len(self.keys))
+        if window is not None:
+            return [a[window] for a in arrays]
+        return [a[rows] for a in arrays]
+
     def cpu_percentile(self, rows: np.ndarray, q: float) -> np.ndarray:
         """Quantile estimate from merged counts — the shared host-numpy query
         (`krr_tpu.ops.digest.percentile_host`; that docstring records why the
         host, not the device, serves host-resident digests). NaN where no data."""
         from krr_tpu.ops.digest import percentile_host
 
-        return percentile_host(
-            self.spec, self.cpu_counts[rows], self.cpu_total[rows], self.cpu_peak[rows], q
-        )
+        counts, total, peak = self._take(rows, self.cpu_counts, self.cpu_total, self.cpu_peak)
+        return percentile_host(self.spec, counts, total, peak, q)
 
     def memory_peak(self, rows: np.ndarray) -> np.ndarray:
-        return np.where(self.mem_total[rows] > 0, self.mem_peak[rows], np.nan).astype(np.float32)
+        total, peak = self._take(rows, self.mem_total, self.mem_peak)
+        return np.where(total > 0, peak, np.nan).astype(np.float32)
 
     # ------------------------------------------------------------ persistence
+    #
+    # On-disk format: the count matrix is stored SPARSELY (CSR — concatenated
+    # per-row occupied buckets) and UNCOMPRESSED. The dense state is mostly
+    # zeros (a series' samples occupy tens of its 2,560 buckets), and pushing
+    # the dense 1 GB through zlib cost ~5 s each way at 100k rows (measured
+    # round 3); the sparse extraction is one pass over the matrix (~1.5 s)
+    # and the write/read run at disk speed. Dense legacy files still load.
+
     def save(self, path: str) -> None:
         """Atomic write (tmp + rename): a crash mid-save keeps the old state."""
         meta = {
@@ -137,15 +177,26 @@ class DigestStore:
             "min_value": self.spec.min_value,
             "num_buckets": self.spec.num_buckets,
         }
+        flat = np.flatnonzero(self.cpu_counts)
+        vals = self.cpu_counts.ravel()[flat]
+        buckets = self.spec.num_buckets
+        col_dtype = np.uint16 if buckets <= np.iinfo(np.uint16).max else np.int32
+        cols = (flat % buckets).astype(col_dtype)
+        per_row = np.bincount(flat // buckets, minlength=len(self.keys))
+        indptr = np.zeros(len(self.keys) + 1, dtype=np.int64)
+        np.cumsum(per_row, out=indptr[1:])
+
         directory = os.path.dirname(os.path.abspath(path)) or "."
         fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as f:
-                np.savez_compressed(
+                np.savez(
                     f,
                     meta=json.dumps(meta),
                     keys=np.asarray(self.keys),
-                    cpu_counts=self.cpu_counts,
+                    csr_vals=vals,
+                    csr_cols=cols,
+                    csr_indptr=indptr,
                     cpu_total=self.cpu_total,
                     cpu_peak=self.cpu_peak,
                     mem_total=self.mem_total,
@@ -162,10 +213,20 @@ class DigestStore:
         with np.load(path, allow_pickle=False) as data:
             meta = json.loads(str(data["meta"]))
             spec = DigestSpec(gamma=meta["gamma"], min_value=meta["min_value"], num_buckets=meta["num_buckets"])
+            keys = [str(k) for k in data["keys"]]
+            if "cpu_counts" in data:  # legacy dense (zlib) format
+                counts = data["cpu_counts"]
+            else:
+                vals = data["csr_vals"]
+                cols = data["csr_cols"].astype(np.int64, copy=False)
+                indptr = data["csr_indptr"]
+                counts = np.zeros((len(keys), spec.num_buckets), dtype=np.float32)
+                row_of = np.repeat(np.arange(len(keys), dtype=np.int64), np.diff(indptr))
+                counts.ravel()[row_of * spec.num_buckets + cols] = vals
             return cls(
                 spec=spec,
-                keys=[str(k) for k in data["keys"]],
-                cpu_counts=data["cpu_counts"],
+                keys=keys,
+                cpu_counts=counts,
                 cpu_total=data["cpu_total"],
                 cpu_peak=data["cpu_peak"],
                 mem_total=data["mem_total"],

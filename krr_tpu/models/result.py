@@ -41,6 +41,9 @@ class Severity(str, enum.Enum):
 
     @property
     def color(self) -> str:
+        # "gray" is not a parseable rich color, so OK cells render UNSTYLED
+        # on every output path — a deliberate parity quirk: the reference
+        # ships the same string (`result.py:28`) with the same effect.
         return {
             Severity.UNKNOWN: "dim",
             Severity.GOOD: "green",

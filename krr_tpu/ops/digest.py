@@ -238,8 +238,18 @@ def percentile_host(
         e = min(s + 4096, n)
         t_blk = total[s:e].astype(np.float64)
         rank = np.maximum(np.floor((t_blk - 1.0) * q / 100.0), 0.0)
-        cum = np.cumsum(counts[s:e], axis=1)
-        k = np.argmax(cum > rank[:, None], axis=1).astype(np.float64)
+        # float32 cumsum: counts are exact integers, so the running sum stays
+        # exact while a row's total is < 2^24 — it halves the memory traffic
+        # of the float64 cumsum, which dominates this query (measured ~30%
+        # faster at 100k x 2560). A store row aggregates ALL pods of an
+        # object across every merged window, so the 16.7 M bound is reachable
+        # (a 100-pod deployment @ 1 s folds ~8.6 M samples/day); blocks
+        # holding any such row take the float64 path instead of silently
+        # saturating. rank is cast alongside so the comparison doesn't
+        # promote the block.
+        cum_dtype = np.float64 if t_blk.size and t_blk.max() >= 2**24 else np.float32
+        cum = np.cumsum(counts[s:e], axis=1, dtype=cum_dtype)
+        k = np.argmax(cum > rank.astype(cum_dtype)[:, None], axis=1).astype(np.float64)
         estimate = np.where(k == 0, 0.0, spec.min_value * np.exp((k - 0.5) * spec.log_gamma))
         estimate = np.minimum(estimate, peaks[s:e])
         out[s:e] = np.where(t_blk > 0, estimate, np.nan).astype(np.float32)

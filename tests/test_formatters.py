@@ -16,6 +16,16 @@ from rich.console import Console
 
 from tests.test_integrations import fake_env  # noqa: F401  (fixture re-export)
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def plain_output(monkeypatch):
+    """Pin the fast path's color decision off for content-comparison tests —
+    a developer shell's FORCE_COLOR would otherwise pollute the cell text
+    with ANSI escapes. Tests of the color decision itself re-patch it."""
+    monkeypatch.setattr(TableFormatter, "_use_color", staticmethod(lambda: False))
+
 from krr_tpu.formatters.table import TableFormatter
 from krr_tpu.models.allocations import ResourceAllocations, ResourceType
 from krr_tpu.models.objects import K8sObjectData

@@ -597,6 +597,21 @@ class TestPercentileHost:
             # digest's 0.5% value-error contract.
             np.testing.assert_allclose(got, want, rtol=5e-5, equal_nan=True)
 
+    def test_exact_beyond_float32_cumsum_range(self):
+        """A row whose total exceeds 2^24 (multi-pod object, long horizon)
+        must take the float64 cumsum path: in float32 the running sum
+        saturates — +1 increments past 2^24 round away — and a high-q query
+        would silently report bucket 0."""
+        spec = DigestSpec()
+        counts = np.zeros((1, spec.num_buckets), np.float32)
+        counts[0, 500] = 2**24  # exactly representable in f32
+        counts[0, 1000:1201] = 1.0  # 201 increments a f32 cumsum would drop
+        total = np.array([2**24 + 201], np.float64)
+        peaks = np.array([np.inf], np.float32)  # don't clamp the estimate
+        out = digest_ops.percentile_host(spec, counts, total, peaks, 100.0)
+        expected = spec.min_value * np.exp((1200 - 0.5) * spec.log_gamma)
+        np.testing.assert_allclose(out[0], expected, rtol=1e-6)
+
 
 class TestPallasSketchFuzz:
     """Shape-space fuzz of the sketch kernels (interpret mode): random row

@@ -40,6 +40,81 @@ class TestDigestStore:
         np.testing.assert_array_equal(loaded.cpu_counts, store.cpu_counts)
         np.testing.assert_array_equal(loaded.mem_peak, store.mem_peak)
 
+    def test_legacy_dense_state_still_loads(self, tmp_path, rng):
+        """Round-3 state files stored the count matrix dense under zlib; the
+        sparse CSR format must keep loading them."""
+        import json
+
+        keys = ["a", "b", "c"]
+        counts = rng.integers(0, 5, size=(3, SPEC.num_buckets)).astype(np.float32)
+        path = str(tmp_path / "legacy.npz")
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                meta=json.dumps({"gamma": SPEC.gamma, "min_value": SPEC.min_value,
+                                 "num_buckets": SPEC.num_buckets}),
+                keys=np.asarray(keys),
+                cpu_counts=counts,
+                cpu_total=counts.sum(axis=1),
+                cpu_peak=np.array([0.5, 1.5, -np.inf], np.float32),
+                mem_total=np.array([10, 0, 3], np.float32),
+                mem_peak=np.array([100.0, -np.inf, 7.0], np.float32),
+            )
+        loaded = DigestStore.load(path)
+        assert loaded.keys == keys
+        np.testing.assert_array_equal(loaded.cpu_counts, counts)
+        np.testing.assert_array_equal(loaded.mem_peak, [100.0, -np.inf, 7.0])
+        # And a save in the new format round-trips the same state.
+        new_path = str(tmp_path / "new.npz")
+        loaded.save(new_path)
+        reloaded = DigestStore.load(new_path)
+        np.testing.assert_array_equal(reloaded.cpu_counts, counts)
+        np.testing.assert_array_equal(reloaded.cpu_total, loaded.cpu_total)
+
+    def test_sparse_format_is_uncompressed_csr(self, tmp_path, rng):
+        """The state file stores occupied buckets only (CSR), uncompressed —
+        the round-4 fix for the ~10 s zlib save+load cycle at 100k rows."""
+        store = DigestStore(spec=SPEC, keys=["a", "b"])
+        store.cpu_counts[0, 7] = 3.0
+        store.cpu_counts[1, 2559] = 1.0
+        path = str(tmp_path / "state.npz")
+        store.save(path)
+        with np.load(path, allow_pickle=False) as data:
+            assert "cpu_counts" not in data.files
+            np.testing.assert_array_equal(data["csr_vals"], [3.0, 1.0])
+            np.testing.assert_array_equal(data["csr_cols"], [7, 2559])
+            np.testing.assert_array_equal(data["csr_indptr"], [0, 1, 2])
+        # Uncompressed for real: zlib over the (mostly-small) arrays cost
+        # ~10 s per save+load cycle at 100k rows — a savez_compressed
+        # regression must fail here, not just re-shrink the file.
+        import zipfile
+
+        with zipfile.ZipFile(path) as zf:
+            assert all(info.compress_type == zipfile.ZIP_STORED for info in zf.infolist())
+
+    def test_noncontiguous_query_matches_contiguous(self, rng):
+        """_take's contiguous fast path and the fancy-index fallback must
+        agree (including a single-row and an out-of-order subset)."""
+        n = 50
+        store = DigestStore(spec=SPEC, keys=[f"k{i}" for i in range(n)])
+        store.cpu_counts[:] = rng.integers(0, 9, size=store.cpu_counts.shape)
+        store.cpu_total[:] = store.cpu_counts.sum(axis=1)
+        store.cpu_peak[:] = rng.gamma(2.0, 0.3, n)
+        full = store.cpu_percentile(np.arange(n), 99.0)
+        scattered = np.array([41, 3, 17, 3, 0, n - 1])
+        np.testing.assert_array_equal(store.cpu_percentile(scattered, 99.0), full[scattered])
+        np.testing.assert_array_equal(store.cpu_percentile(np.array([7]), 99.0), full[[7]])
+        np.testing.assert_array_equal(
+            store.cpu_percentile(np.arange(10, 20), 99.0), full[10:20]
+        )
+
+    def test_out_of_range_rows_still_raise(self):
+        """The contiguous fast path must not let slice semantics silently
+        truncate out-of-range rows where fancy indexing raises."""
+        store = DigestStore(spec=SPEC, keys=["a", "b"])
+        with pytest.raises(IndexError):
+            store.cpu_percentile(np.array([1, 2]), 99.0)
+
     def test_shuffled_remerge_equals_ordered(self, rng):
         """A re-scan that returns the fleet in a different order must land on
         the same rows (non-contiguous scatter path) — and a window carrying a
