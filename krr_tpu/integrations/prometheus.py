@@ -479,16 +479,50 @@ class PrometheusLoader:
             stream.abort()
             raise
 
+    def _httpx_range_request_args(self, query: str, start: float, end: float, step: str):
+        """(method, kwargs) for an httpx range request — the one place the
+        GET/POST dispatch rule lives for the httpx data plane (mirroring
+        `_range_request_parts` for the raw transport)."""
+        params = {"query": query, "start": start, "end": end, "step": step}
+        if len(query) <= self.GET_QUERY_LIMIT:
+            return "GET", {"params": params}
+        return "POST", {"data": params}
+
     async def _httpx_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
         """Range request via the httpx client — the fallback data plane for
         environments the raw transport can't honor (see _make_raw_transport)."""
         assert self._client is not None
-        params = {"query": query, "start": start, "end": end, "step": step}
-        if len(query) <= self.GET_QUERY_LIMIT:
-            response = await self._client.get("/api/v1/query_range", params=params)
-        else:
-            response = await self._client.post("/api/v1/query_range", data=params)
+        method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        response = await self._client.request(method, "/api/v1/query_range", **kwargs)
         return response.status_code, response.content
+
+    async def _httpx_stream_attempt(
+        self, query: str, start: float, end: float, step: str, make_stream
+    ):
+        """One STREAMED range request on the httpx client (proxied
+        environments): response bytes feed a fresh native ingest stream as
+        they arrive via ``aiter_bytes`` — no body materialization, matching
+        `_stream_attempt`'s contract ((status, folded series or None, error
+        body); fresh stream per attempt, aborted on any failure). The ctypes
+        feed releases the GIL, but it does run on the event loop — the
+        throughput trade the proxied environment already made by losing the
+        raw transport."""
+        assert self._client is not None
+        method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        request = self._client.stream(method, "/api/v1/query_range", **kwargs)
+        stream = make_stream()
+        try:
+            async with request as response:
+                if response.status_code >= 300:
+                    err = await response.aread()
+                    stream.abort()
+                    return response.status_code, None, err
+                async for chunk in response.aiter_bytes(1 << 20):
+                    stream.feed(chunk)
+            return response.status_code, stream.finish(), b""
+        except BaseException:
+            stream.abort()
+            raise
 
     async def _count_series(self, range_query: str, at_time: float) -> Optional[int]:
         """ACTUAL series count of a batched range query, via one cheap
@@ -607,14 +641,20 @@ class PrometheusLoader:
     ) -> list:
         """Range query whose response bytes feed a native ingest stream as
         they arrive (no body materialization); returns the folded per-series
-        entries. Same retry policy as the buffered path — each attempt runs
-        on a FRESH stream (a partially-fed one cannot be resumed)."""
+        entries. Rides the raw transport when available, else httpx
+        ``aiter_bytes`` (proxied/userinfo environments keep zero-copy ingest
+        too). Same retry policy as the buffered path — each attempt runs on
+        a FRESH stream (a partially-fed one cannot be resumed)."""
         await self._ensure_connected()
 
-        async def attempt():
-            return await asyncio.to_thread(
-                self._stream_attempt, query, start, end, step, make_stream
-            )
+        if self._raw is not None:
+            async def attempt():
+                return await asyncio.to_thread(
+                    self._stream_attempt, query, start, end, step, make_stream
+                )
+        else:
+            async def attempt():
+                return await self._httpx_stream_attempt(query, start, end, step, make_stream)
 
         return await self._retrying(attempt)
 
@@ -743,10 +783,11 @@ class PrometheusLoader:
         mutate in place.
 
         With ``stream_factory`` (a thunk returning a fresh
-        `native.StreamIngest`) and the raw transport available, each
-        window's response bytes feed the native stream AS THEY ARRIVE — the
-        body is never materialized at all; ``parse`` serves only the
-        buffered fallback (httpx/proxied environments, native lib absent).
+        `native.StreamIngest`), each window's response bytes feed the native
+        stream AS THEY ARRIVE — the body is never materialized at all — on
+        the raw transport when available, else through httpx ``aiter_bytes``
+        (proxied environments); ``parse`` serves only the buffered fallback
+        (native lib absent / no compiler).
         """
         merged: dict = {}
 
@@ -759,7 +800,7 @@ class PrometheusLoader:
                 seen.add(key)
                 merged[key] = fold(merged[key], entry) if key in merged else init(entry)
 
-        use_stream = stream_factory is not None and self._raw is not None
+        use_stream = stream_factory is not None
         if use_stream:
             # The availability probe may BUILD the native library (a g++
             # subprocess, tens of seconds on first use) — keep it off the

@@ -12,11 +12,14 @@ import io
 import time
 from decimal import Decimal
 
+import pytest
 from rich.console import Console
 
+from krr_tpu.formatters.table import TableFormatter
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import ResourceScan, Result
 from tests.test_integrations import fake_env  # noqa: F401  (fixture re-export)
-
-import pytest
 
 
 @pytest.fixture(autouse=True)
@@ -25,11 +28,6 @@ def plain_output(monkeypatch):
     a developer shell's FORCE_COLOR would otherwise pollute the cell text
     with ANSI escapes. Tests of the color decision itself re-patch it."""
     monkeypatch.setattr(TableFormatter, "_use_color", staticmethod(lambda: False))
-
-from krr_tpu.formatters.table import TableFormatter
-from krr_tpu.models.allocations import ResourceAllocations, ResourceType
-from krr_tpu.models.objects import K8sObjectData
-from krr_tpu.models.result import ResourceScan, Result
 
 
 def make_result(n: int, pods_per_group: int = 2) -> Result:

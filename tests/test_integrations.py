@@ -332,6 +332,61 @@ class TestBatchedFleetQueries:
         np.testing.assert_array_equal(streamed.mem_total, buffered.mem_total)
         np.testing.assert_array_equal(streamed.mem_peak, buffered.mem_peak)
 
+    def test_proxied_digest_ingest_streams_without_body(self, fake_env, monkeypatch):
+        """Proxied environments (raw transport declined) must still get the
+        zero-materialization ingest: response bytes feed the native stream
+        through httpx aiter_bytes, the buffered range route never runs, and
+        the digests equal the raw-transport run's exactly."""
+        import urllib.request
+
+        from krr_tpu.integrations import native
+
+        assert native.stream_available()
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        reference = self._gather_digests(make_config(fake_env), objects)
+
+        monkeypatch.setattr(
+            urllib.request, "getproxies", lambda: {"http": "http://proxy.corp:3128"}
+        )
+        monkeypatch.setattr(urllib.request, "proxy_bypass", lambda host: False)
+
+        fed = []
+        real_open_stream = native.open_stream
+
+        def spying_open_stream(*args, **kwargs):
+            stream = real_open_stream(*args, **kwargs)
+            real_feed = stream.feed
+            stream.feed = lambda chunk: (fed.append(len(chunk)), real_feed(chunk))[1]
+            return stream
+
+        monkeypatch.setattr(native, "open_stream", spying_open_stream)
+
+        async def no_buffered_range(self, *args, **kwargs):
+            raise AssertionError("buffered httpx range route ran on the digest path")
+
+        monkeypatch.setattr(PrometheusLoader, "_httpx_range_query", no_buffered_range)
+
+        async def fetch():
+            prom = PrometheusLoader(make_config(fake_env), cluster="fake")
+            try:
+                fleet = await prom.gather_fleet_digests(
+                    objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128
+                )
+                return prom._raw, fleet
+            finally:
+                await prom.close()
+
+        raw, proxied = asyncio.run(fetch())
+        assert raw is None  # the raw transport really did decline
+        assert fed and sum(fed) > 0  # bytes flowed through the native sink
+        np.testing.assert_array_equal(proxied.cpu_counts, reference.cpu_counts)
+        np.testing.assert_array_equal(proxied.cpu_total, reference.cpu_total)
+        np.testing.assert_array_equal(proxied.cpu_peak, reference.cpu_peak)
+        np.testing.assert_array_equal(proxied.mem_total, reference.mem_total)
+        np.testing.assert_array_equal(proxied.mem_peak, reference.mem_peak)
+
     def test_digest_batched_equals_per_workload(self, fake_env):
         objects = asyncio.run(
             KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
