@@ -13,12 +13,19 @@ The digest state is mergeable (counts add), which is also what powers
 multi-device psum merges (`krr_tpu.parallel`), incremental multi-source
 re-merge, and checkpoint/resume (BASELINE.md configs 3-5).
 
-When the configured percentile is high enough that its rank-from-the-top fits
-in ``exact_sketch_budget`` (always true for the default p99 at reference
-sample rates), the one-shot streaming build upgrades itself to the exact
-top-K sketch (`krr_tpu.ops.topk_sketch`) — same chunked scan, zero error,
-about half the cost. The persistent ``state_path`` store stays on the
-histogram digest, whose merged state answers any percentile later.
+With ``--exact_upgrade``, one-shot builds swap the histogram for the exact
+top-K sketch (`krr_tpu.ops.topk_sketch`) when the configured percentile's
+rank-from-the-top fits in ``exact_sketch_budget`` (always true for the
+default p99 at reference sample rates) — same chunked scan, zero error. The
+trade is throughput, not a win: on the chip the top-K build runs ~25-30 %
+SLOWER than the digest at the headline 7 d @ 5 s shape (BENCH_r03/r04
+``topk_containers_per_sec`` vs ``digest_containers_per_sec``: 18.3 k vs
+25.0 k containers/s in r03), so the upgrade is OFF by default — the digest's
+0.5 % bound already sits inside the ±1 % parity gate, and users who want
+exact one-shot results opt in. (Exactness with no opt-in is what the
+``simple`` strategy is for.) The persistent ``state_path`` store always
+stays on the histogram digest, whose merged state answers any percentile
+later.
 """
 
 from __future__ import annotations
@@ -64,6 +71,16 @@ class TDigestStrategySettings(SimpleStrategySettings):
             "top-K path; memory stays exact."
         ),
     )
+    exact_upgrade: bool = pd.Field(
+        False,
+        description=(
+            "Swap the one-shot digest build for the EXACT top-K sketch when "
+            "the percentile's rank fits exact_sketch_budget: zero CPU error "
+            "instead of the digest's 0.5% bound, at ~25-30% lower measured "
+            "throughput (see BENCH topk vs digest containers/s). Off by "
+            "default; state_path scans always use the mergeable digest."
+        ),
+    )
     # exact_sketch_budget is inherited from SimpleStrategySettings — one
     # tunable cut-over shared by the simple and tdigest streamed paths.
     state_path: Optional[str] = pd.Field(
@@ -84,8 +101,14 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
 
     def _exact_topk_k(self, capacity: int, q: float) -> Optional[int]:
         """K for the exact top-K sketch, or None when the histogram digest
-        must serve — delegates to the shared cut-over decision site
+        serves. The digest is the tdigest strategy's DEFAULT one-shot path —
+        it measures ~1.35x the top-K build's throughput at the headline
+        shape (BENCH r03: 25.0k vs 18.3k containers/s) and its 0.5% bound is
+        inside the parity gate; ``--exact_upgrade`` opts into the slower
+        exact sketch via the shared cut-over decision site
         (`krr_tpu.strategies.simple.exact_topk_k`)."""
+        if not self.settings.exact_upgrade:
+            return None
         return exact_topk_k(capacity, q, self.settings.exact_sketch_budget)
 
     def _use_host_stream(self, batch: FleetBatch, mesh) -> bool:

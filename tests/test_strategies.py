@@ -149,6 +149,37 @@ class TestTDigestStrategy:
             assert t[ResourceType.Memory].request == s[ResourceType.Memory].request
 
 
+    def test_default_one_shot_uses_digest_not_topk(self, rng, monkeypatch):
+        """The default tdigest one-shot path must run the histogram digest —
+        measured ~1.35x the top-K build's throughput at the headline shape —
+        and touch the top-K sketch only under --exact_upgrade."""
+        from krr_tpu.ops import topk_sketch as topk_ops
+
+        batch = make_batch(rng)
+
+        def forbidden(*args, **kwargs):
+            raise AssertionError("top-K sketch ran without exact_upgrade")
+
+        monkeypatch.setattr(topk_ops, "build_from_packed", forbidden)
+        monkeypatch.setattr(topk_ops, "build_from_host", forbidden)
+        TDigestStrategy(TDigestStrategySettings(chunk_size=128)).run_batch(batch)
+
+    def test_exact_upgrade_matches_simple_exactly(self, rng):
+        """--exact_upgrade buys zero CPU error: recommendations equal the
+        simple strategy's bit-for-bit (not just within the digest bound)."""
+        batch = make_batch(rng)
+        simple = SimpleStrategy(SimpleStrategySettings()).run_batch(batch)
+        exact = TDigestStrategy(
+            TDigestStrategySettings(chunk_size=128, exact_upgrade=True)
+        ).run_batch(batch)
+        for s, t in zip(simple, exact):
+            for resource in (ResourceType.CPU, ResourceType.Memory):
+                want, got = s[resource].request, t[resource].request
+                if want.is_nan():
+                    assert got.is_nan()
+                else:
+                    assert got == want
+
     def test_host_streamed_equals_resident(self, rng, monkeypatch):
         """A tiny threshold forces the host→device chunk pipeline (mesh path
         under the 8-device conftest); results must match the resident build
