@@ -36,15 +36,24 @@ the fetch+parse+compute pipeline). NOTE: on the tunneled TPU backend
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "containers/s", "vs_baseline": N,
      "parity": "ok", "runs": N, "spread_pct": N, "dispatch_floor_ms": N,
+     "pipelined_containers_per_sec": N, "pipelined_depth": N,
+     "pipelined_spread_pct": N, "floor_corrected_containers_per_sec": N|null,
      "secondary": {...}}
+(``floor_corrected_containers_per_sec`` is null when the measured floor meets
+or exceeds the measurement itself — the subtraction is meaningless there.)
 ``dispatch_floor_ms`` is the measured trivial jit-call + readback round trip:
-on the tunneled chip it is most of the headline measurement, so it is
-reported per run to tell rig-RTT movement apart from code movement.
+on the tunneled chip it is most of the headline measurement, so the raw
+``value`` is a lower bound set by per-call latency. Two latency-honest
+companions are reported: ``pipelined_containers_per_sec`` (R dispatches, ONE
+sync — the RTT amortizes and the rate converges to the kernel's own; the
+stable number to compare round-over-round) and
+``floor_corrected_containers_per_sec`` (the raw measurement with the floor
+subtracted — noisier, kept as a cross-check on the pipelined rate).
 
 Env knobs: BENCH_CONTAINERS (default 10000), BENCH_TIMESTEPS (default 120960),
-BENCH_CHUNK (default 8192), BENCH_RUNS (default 5), BENCH_PY_SAMPLE
-(default 3), BENCH_SKIP_DIGEST, BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default
-512). The e2e leg runs `bench_e2e.py` in a subprocess with
+BENCH_CHUNK (default 8192), BENCH_RUNS (default 5), BENCH_PIPELINE_DEPTH
+(default 16), BENCH_PY_SAMPLE (default 3), BENCH_SKIP_DIGEST,
+BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default 512). The e2e leg runs `bench_e2e.py` in a subprocess with
 BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
 """
 
@@ -196,6 +205,47 @@ def main() -> None:
     )
     print(f"bench: dispatch+readback floor {floor * 1e3:.1f} ms", file=sys.stderr)
 
+    # --- Amortized (pipelined) headline: the single-dispatch number above is
+    # ~2/3 tunnel RTT at this speed, so it tracks rig latency, not kernel
+    # work (round-3 verdict). Dispatch R independent copies of the SAME
+    # program and sync ONCE on the last result: dispatches are async, the
+    # device executes them back-to-back, and the RTT is paid once per R
+    # programs instead of once per measurement. Throughput over n*R rows of
+    # work then converges to the kernel's own rate (measured: 63k c/s raw →
+    # 218k c/s at depth 16 on the tunneled v5e; per-call time approaches the
+    # floor-corrected estimate, which cross-checks the subtraction). Also
+    # report the floor-SUBTRACTED single-dispatch rate; the pipelined number
+    # is the more stable of the two (no difference of noisy ~100 ms
+    # quantities).
+    pipeline_depth = max(2, int(os.environ.get("BENCH_PIPELINE_DEPTH", 16)))
+
+    def dispatch_pipeline() -> None:
+        results = [exact_step(values, counts) for _ in range(pipeline_depth)]
+        _ = np.asarray(results[-1])  # one sync: all earlier programs precede it
+
+    pipe_times = [_time_once(dispatch_pipeline) for _ in range(runs)]
+    pipe_best = min(pipe_times)
+    pipe_spread = 100.0 * (max(pipe_times) - pipe_best) / pipe_best
+    pipelined_throughput = n * pipeline_depth / pipe_best
+    # The subtraction is only meaningful when the floor is clearly below the
+    # measurement (on a fast local backend, or under rig-RTT wobble, it can
+    # meet or exceed it — a clamped divide would report ~1e13 containers/s
+    # as a "cross-check"); report null instead and lean on the pipelined
+    # number, which needs no subtraction.
+    corrected_seconds = exact_elapsed - floor
+    floor_corrected = n / corrected_seconds if corrected_seconds > 1e-3 else None
+    vs_corrected = (
+        f" vs floor-corrected {corrected_seconds * 1e3:.1f} ms"
+        if floor_corrected is not None
+        else " (floor >= measurement: floor-corrected rate not meaningful)"
+    )
+    print(
+        f"bench: pipelined x{pipeline_depth} {pipe_best:.3f}s (spread {pipe_spread:.0f}%) "
+        f"-> {pipelined_throughput:.0f} containers/s amortized "
+        f"({pipe_best / pipeline_depth * 1e3:.1f} ms/call{vs_corrected})",
+        file=sys.stderr,
+    )
+
     # --- On-hardware parity gate, part 1: fused Pallas vs pure-jnp XLA.
     # Same chip, same subsample, two independent lowerings; the contract is
     # bit-identity (BASELINE.md correctness gate is ±1% vs the reference —
@@ -330,6 +380,12 @@ def main() -> None:
                 "runs": runs,
                 "spread_pct": round(exact_spread, 1),
                 "dispatch_floor_ms": round(floor * 1e3, 1),
+                "pipelined_containers_per_sec": round(pipelined_throughput, 1),
+                "pipelined_depth": pipeline_depth,
+                "pipelined_spread_pct": round(pipe_spread, 1),
+                "floor_corrected_containers_per_sec": (
+                    round(floor_corrected, 1) if floor_corrected is not None else None
+                ),
                 "secondary": secondary,
             }
         )

@@ -162,7 +162,12 @@ class TestTDigestStrategy:
 
         monkeypatch.setattr(topk_ops, "build_from_packed", forbidden)
         monkeypatch.setattr(topk_ops, "build_from_host", forbidden)
-        TDigestStrategy(TDigestStrategySettings(chunk_size=128)).run_batch(batch)
+        strategy = TDigestStrategy(TDigestStrategySettings(chunk_size=128))
+        # Order-proof assertion (jit trace caching could let a warm compiled
+        # top-K program bypass the monkeypatch): the cut-over decision itself
+        # must decline the sketch for the default settings.
+        assert strategy._exact_topk_k(1344, 99.0) is None
+        strategy.run_batch(batch)
 
     def test_exact_upgrade_matches_simple_exactly(self, rng):
         """--exact_upgrade buys zero CPU error: recommendations equal the
