@@ -39,8 +39,8 @@ Prints ONE JSON line:
      "pipelined_containers_per_sec": N, "pipelined_depth": N,
      "pipelined_spread_pct": N, "floor_corrected_containers_per_sec": N|null,
      "secondary": {...}}
-(``floor_corrected_containers_per_sec`` is null when the measured floor meets
-or exceeds the measurement itself — the subtraction is meaningless there.)
+(``floor_corrected_containers_per_sec`` is null when the measured floor comes
+within 1 ms of the measurement itself — the subtraction is meaningless there.)
 ``dispatch_floor_ms`` is the measured trivial jit-call + readback round trip:
 on the tunneled chip it is most of the headline measurement, so the raw
 ``value`` is a lower bound set by per-call latency. Two latency-honest
@@ -237,7 +237,7 @@ def main() -> None:
     vs_corrected = (
         f" vs floor-corrected {corrected_seconds * 1e3:.1f} ms"
         if floor_corrected is not None
-        else " (floor >= measurement: floor-corrected rate not meaningful)"
+        else " (floor within 1 ms of the measurement: floor-corrected rate not meaningful)"
     )
     print(
         f"bench: pipelined x{pipeline_depth} {pipe_best:.3f}s (spread {pipe_spread:.0f}%) "
