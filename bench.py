@@ -345,22 +345,38 @@ def main() -> None:
         # Record the e2e number at fleet scale (round-2 verdict: >= 10k
         # containers) unless the caller pinned a size.
         env.setdefault("BENCH_E2E_CONTAINERS", "10000")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py")],
-                capture_output=True,
-                text=True,
-                timeout=900,
-                env=env,
-            )
-            for line in proc.stderr.splitlines():
-                print(line, file=sys.stderr)
-            if proc.returncode == 0 and proc.stdout.strip():
-                secondary.update(json.loads(proc.stdout.strip().splitlines()[-1]))
-            else:
-                secondary["e2e"] = f"failed rc={proc.returncode}"
-        except Exception as e:  # never let the e2e leg sink the headline
-            secondary["e2e"] = f"failed: {e.__class__.__name__}"
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py")
+
+        def e2e_subprocess(tag: str, extra_env: dict, timeout: int) -> None:
+            """One bench_e2e.py subprocess; a failure or timeout records a
+            note under `tag` instead of sinking the headline or each other."""
+            try:
+                proc = subprocess.run(
+                    [sys.executable, script],
+                    capture_output=True,
+                    text=True,
+                    timeout=timeout,
+                    env={**env, **extra_env},
+                )
+                for line in proc.stderr.splitlines():
+                    print(line, file=sys.stderr)
+                if proc.returncode == 0 and proc.stdout.strip():
+                    secondary.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+                else:
+                    secondary[tag] = f"failed rc={proc.returncode}"
+            except Exception as e:
+                secondary[tag] = f"failed: {e.__class__.__name__}"
+
+        # Main legs (10k scans, 100k ingest/store, scanner throughputs) and
+        # the ~15-minute FULL 100k-container scan run in SEPARATE
+        # subprocesses: a timeout on the long fleet scan must not lose the
+        # rest of the e2e numbers (or vice versa).
+        # FLEET_ONLY is explicitly cleared on the main-legs call so an
+        # operator's exported debug value can't silently hollow it out.
+        e2e_subprocess(
+            "e2e", {"BENCH_E2E_FLEET_ROWS": "0", "BENCH_E2E_FLEET_ONLY": "0"}, timeout=900
+        )
+        e2e_subprocess("fleet_e2e", {"BENCH_E2E_FLEET_ONLY": "1"}, timeout=1800)
 
     py_per_container = python_reference_seconds_per_container(t, py_sample)
     baseline_throughput = 1.0 / py_per_container

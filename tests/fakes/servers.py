@@ -157,6 +157,22 @@ class FakeMetrics:
         self._value_offsets[key] = tuple(offsets)
         self._batched_bodies.clear()
 
+    def alias_series(
+        self, namespace: str, container: str, pod: str, source_pod: str
+    ) -> None:
+        """Serve ``pod``'s samples by REFERENCE to ``source_pod``'s (same
+        namespace/container): the arrays, rendered value strings, and offset
+        tables are shared, not copied. Fleet-scale benches need 100k pods
+        without 100k independently-rendered series (~13 GB of strings and
+        minutes of formatting); distinct pods sharing identical histories is
+        fine for throughput measurement."""
+        src = (namespace, container, source_pod)
+        key = (namespace, container, pod)
+        self.series[key] = self.series[src]
+        self._value_strs[key] = self._value_strs[src]
+        self._value_offsets[key] = self._value_offsets[src]
+        self._batched_bodies.clear()
+
     def sliced_values(self, key: tuple[str, str, str], is_cpu: bool, i0: int, i1: int) -> str:
         """The values-array JSON for samples [i0, i1] — an O(1) substring of
         the pre-rendered joined string."""

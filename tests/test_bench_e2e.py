@@ -19,3 +19,12 @@ def test_run_e2e_small():
 def test_run_digest_ingest_small():
     out = bench_e2e.run_digest_ingest(64)
     assert out["digest_ingest_100k_objects_per_sec"] > 0
+
+
+def test_run_fleet_e2e_small():
+    """The full-fleet scan leg at tiny scale, shared-series fixture included
+    (pods beyond `shared` serve aliased histories)."""
+    out = bench_e2e.run_fleet_e2e(n_containers=24, samples=48, shared=8)
+    assert out["fleet_e2e_containers"] == 24
+    assert out["fleet_e2e_objects_per_sec"] > 0
+    assert out["fleet_e2e_fetch_seconds"] > 0
