@@ -361,7 +361,13 @@ def main() -> None:
                 for line in proc.stderr.splitlines():
                     print(line, file=sys.stderr)
                 if proc.returncode == 0 and proc.stdout.strip():
-                    secondary.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+                    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+                    if payload:
+                        secondary.update(payload)
+                    else:
+                        # e.g. a stale exported BENCH_E2E_FLEET_ROWS=0 — record
+                        # the skip instead of silently dropping the leg.
+                        secondary[tag] = "skipped (env disabled this leg)"
                 else:
                     secondary[tag] = f"failed rc={proc.returncode}"
             except Exception as e:

@@ -338,14 +338,24 @@ class StreamIngest:
             raise ValueError("malformed Prometheus stream")
 
     def finish(self):
-        """Close the stream and return the folded series: DigestedSeries
-        (digest mode) or SeriesStats (stats mode)."""
+        """Close the stream and return the folded series.
+
+        Digest mode returns the MATRIX form ``(keys, counts [n × buckets]
+        float64, totals [n], peaks [n])`` — the arrays are exclusively owned
+        by the caller. The earlier per-row tuple readout (one ``.copy()`` +
+        tuple per series) cost ~3.7 s per 100k-series window, several times
+        the native parse itself; consumers fold the matrix with vectorized
+        ops instead (`krr_tpu.integrations.prometheus`). Stats mode returns
+        ``[(key, total, peak), …]`` — scalars, nothing to vectorize."""
         handle, self._handle = self._handle, None
         try:
             n = self._lib.krr_stream_finish(handle)
             if n < 0:
                 raise ValueError("malformed Prometheus stream (no result array)")
             if n == 0:
+                if self._num_buckets:
+                    empty = np.zeros((0, self._num_buckets), dtype=np.float64)
+                    return [], empty, np.zeros(0, np.float64), np.zeros(0, np.float64)
                 return []
             names_cap = self._lib.krr_stream_names_len(handle)
             names = ctypes.create_string_buffer(names_cap)
@@ -369,10 +379,7 @@ class StreamIngest:
                 raise ValueError("stream readout capacity mismatch")
             keys = _split_keys(names.raw[:names_cap], n)
             if counts is not None:
-                return [
-                    (keys[i], counts[i].copy(), float(totals[i]), float(peaks[i]))
-                    for i in range(n)
-                ]
+                return keys, counts, totals, peaks
             return [(keys[i], float(totals[i]), float(peaks[i])) for i in range(n)]
         finally:
             self._lib.krr_stream_free(handle)

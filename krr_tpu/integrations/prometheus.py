@@ -687,6 +687,88 @@ class PrometheusLoader:
             if self._client is not None:
                 self._client.headers.update(fresh)
 
+    class _StreamedDigestWindows:
+        """Matrix-form fold for streamed digest windows.
+
+        `StreamIngest.finish` (digest mode) returns ``(keys, counts matrix,
+        totals, peaks)``; folding that per-row into a dict cost more than the
+        native parse at fleet width (measured ~3.7 s/window at 100k series).
+        This accumulator merges whole windows with vectorized ops instead:
+        one gather-copy when unrouted rows are dropped (so the window matrix
+        is never pinned by kept views), a single in-place add when the key
+        order repeats across windows (the overwhelmingly common case — the
+        backend evaluates the same query each window), and fancy-index
+        add/max otherwise. First-series-per-key applies per window, like the
+        per-entry path."""
+
+        def __init__(self, keep: "Optional[set]"):
+            self._keep = keep
+            self._keys: Optional[list] = None
+            self._rows: dict = {}
+            self._counts = None
+            self._totals = None
+            self._peaks = None
+
+        def consume(self, index: int, window) -> None:
+            keys, counts, totals, peaks = window
+            kept_idx: list[int] = []
+            kept_keys: list = []
+            seen: set = set()
+            for i, key in enumerate(keys):
+                if (self._keep is not None and key not in self._keep) or key in seen:
+                    continue
+                seen.add(key)
+                kept_idx.append(i)
+                kept_keys.append(key)
+            if not kept_keys:
+                return
+            if len(kept_keys) != len(keys):
+                rows = np.asarray(kept_idx)
+                counts, totals, peaks = counts[rows], totals[rows], peaks[rows]
+            if self._counts is None:
+                self._keys = kept_keys
+                self._rows = {key: i for i, key in enumerate(kept_keys)}
+                self._counts, self._totals, self._peaks = counts, totals, peaks
+                return
+            if kept_keys == self._keys:
+                # Same series, same order (typical): three whole-matrix ops.
+                self._counts += counts
+                self._totals += totals
+                np.maximum(self._peaks, peaks, out=self._peaks)
+                return
+            known_sub, known_rows, new_sub = [], [], []
+            for j, key in enumerate(kept_keys):
+                row = self._rows.get(key)
+                if row is None:
+                    new_sub.append(j)
+                else:
+                    known_sub.append(j)
+                    known_rows.append(row)
+            if known_sub:
+                # Keys are unique per window, so the target rows are unique
+                # and plain fancy-index accumulation is exact.
+                rows = np.asarray(known_rows)
+                sub = np.asarray(known_sub)
+                self._counts[rows] += counts[sub]
+                self._totals[rows] += totals[sub]
+                self._peaks[rows] = np.maximum(self._peaks[rows], peaks[sub])
+            if new_sub:
+                sub = np.asarray(new_sub)
+                for j in new_sub:
+                    self._rows[kept_keys[j]] = len(self._keys)
+                    self._keys.append(kept_keys[j])
+                self._counts = np.vstack([self._counts, counts[sub]])
+                self._totals = np.concatenate([self._totals, totals[sub]])
+                self._peaks = np.concatenate([self._peaks, peaks[sub]])
+
+        def entries(self) -> "list[tuple]":
+            if self._keys is None:
+                return []
+            return [
+                (key, self._counts[i], float(self._totals[i]), float(self._peaks[i]))
+                for i, key in enumerate(self._keys)
+            ]
+
     @staticmethod
     def _kept(parse, keep: "Optional[set]"):
         """Wrap a parser to drop series whose key isn't in ``keep`` INSIDE
@@ -768,7 +850,7 @@ class PrometheusLoader:
     async def _fold_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int, init, fold, keep: "Optional[set]" = None,
-        stream_factory=None,
+        stream_factory=None, matrix_mode: bool = False,
     ) -> "list[tuple]":
         """Sub-window fan-out with INCREMENTAL merging for order-independent
         folds (digest/stats — counts add, peaks max): each window's parse
@@ -787,7 +869,10 @@ class PrometheusLoader:
         stream AS THEY ARRIVE — the body is never materialized at all — on
         the raw transport when available, else through httpx ``aiter_bytes``
         (proxied environments); ``parse`` serves only the buffered fallback
-        (native lib absent / no compiler).
+        (native lib absent / no compiler). ``matrix_mode`` marks streams
+        whose finish() returns the matrix form (digest mode): their windows
+        fold through the vectorized `_StreamedDigestWindows` accumulator
+        instead of the per-entry dict.
         """
         merged: dict = {}
 
@@ -808,6 +893,7 @@ class PrometheusLoader:
             from krr_tpu.integrations.native import stream_available
 
             use_stream = await asyncio.to_thread(stream_available)
+        accumulator = self._StreamedDigestWindows(keep) if use_stream and matrix_mode else None
         if use_stream:
             step = step_string(step_seconds)
 
@@ -818,11 +904,14 @@ class PrometheusLoader:
             fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
 
         await self._window_fan_out(
-            start, end, step_seconds, expected_series, fetch_entries, consume,
+            start, end, step_seconds, expected_series, fetch_entries,
+            accumulator.consume if accumulator is not None else consume,
             # The buffered fallback (no native lib / proxied httpx) holds
             # whole bodies like the raw route — give it the same tight cap.
             max_samples=None if use_stream else RAW_MAX_RESPONSE_SAMPLES,
         )
+        if accumulator is not None:
+            return accumulator.entries()
         return [(key, *state) for key, state in merged.items()]
 
     @staticmethod
@@ -1065,6 +1154,7 @@ class PrometheusLoader:
             fold=fold,
             keep=keep,
             stream_factory=partial(open_stream, gamma, min_value, num_buckets),
+            matrix_mode=True,  # digest streams finish() in matrix form
         )
 
     async def _query_range_stats(

@@ -315,11 +315,13 @@ class TestStreamingIngest:
             assert stream is not None
             for i in range(0, len(body), chunk_size):
                 stream.feed(body[i:i + chunk_size])
-            got = stream.finish()
-            assert [e[0] for e in got] == [e[0] for e in digest_oracle], chunk_size
-            for (k, c, t, p), (_, oc, ot, op) in zip(got, digest_oracle):
-                assert t == ot and (p == op or (np.isneginf(p) and np.isneginf(op))), (chunk_size, k)
-                np.testing.assert_array_equal(c, oc)
+            # Digest mode finishes in MATRIX form: (keys, counts, totals, peaks).
+            keys, counts, totals, peaks = stream.finish()
+            assert keys == [e[0] for e in digest_oracle], chunk_size
+            for i, (k, oc, ot, op) in enumerate(digest_oracle):
+                assert totals[i] == ot, (chunk_size, k)
+                assert peaks[i] == op or (np.isneginf(peaks[i]) and np.isneginf(op)), (chunk_size, k)
+                np.testing.assert_array_equal(counts[i], oc)
 
             stats_stream = native.open_stream(0.0, 0.0, 0)
             for i in range(0, len(body), chunk_size):
