@@ -29,6 +29,13 @@ def detect_inside_cluster() -> bool:
     )
 
 
+#: Default per-window sample budget for streamed range queries — THE single
+#: source of truth (the Config field default and the CLI flag default both
+#: reference it; the fetch layer reads the Config field). Sits under
+#: Prometheus's default --query.max-samples=50e6.
+DEFAULT_MAX_STREAMED_SAMPLES = 40_000_000
+
+
 class Config(pd.BaseModel):
     quiet: bool = False
     verbose: bool = False
@@ -45,6 +52,12 @@ class Config(pd.BaseModel):
     prometheus_auth_header: Optional[str] = None
     prometheus_ssl_enabled: bool = False
     prometheus_max_connections: int = pd.Field(32, ge=1)  # bulk-fetch fan-out width
+    #: Per-window total-sample budget for STREAMED range queries (digest/stats
+    #: native ingest — bodies never materialize, so this bounds the retry
+    #: unit and the server-side load, not client memory). Default sits under
+    #: Prometheus's default --query.max-samples=50e6; raise it alongside a
+    #: raised server limit to fetch wide fleets in fewer windows.
+    prometheus_max_streamed_samples: int = pd.Field(DEFAULT_MAX_STREAMED_SAMPLES, ge=1)
 
     # Kubernetes settings
     kubeconfig: Optional[str] = None  # path override; default resolution in integrations

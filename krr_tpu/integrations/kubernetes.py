@@ -291,17 +291,14 @@ class ClusterLoader:
         return await self._pod_cache[key]
 
     async def _resolve_pods(self, namespace: str, selector: Optional[dict[str, Any]]) -> list[str]:
-        """Workload → pod names. Bulk mode (default) lists each namespace's
-        pods ONCE and evaluates selectors client-side (`match_selector`) —
-        O(namespaces) apiserver requests instead of O(workloads), the
-        difference between ~3 s and ~0.1 s of discovery at 1k workloads.
-        ``--bulk-pod-discovery false`` restores the reference's server-side
-        per-workload selector queries."""
+        """Workload → pod names via a server-side selector query — the
+        PER-WORKLOAD discovery path (``--bulk-pod-discovery false``, the
+        reference's behavior). Bulk mode never reaches here: `_list_workloads`
+        resolves each namespace's pod index once and selects client-side
+        inline (the per-workload coroutine fan-out cost more in event-loop
+        scheduling than the build itself at fleet scale)."""
         if not selector:
             return []
-        if self.config.bulk_pod_discovery:
-            pods = await self._namespace_pod_labels(namespace)
-            return pods.select(selector)
         return await self._list_pods(namespace, build_selector_query(selector))
 
     def _make_objects(self, kind: str, item: dict[str, Any], pods: list[str]) -> list[K8sObjectData]:

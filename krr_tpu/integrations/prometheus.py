@@ -251,12 +251,27 @@ def step_string(step_seconds: float) -> str:
 #: points per series ("exceeded maximum resolution of 11,000 points").
 MAX_RANGE_POINTS = 11_000
 
-#: Cap on TOTAL samples per response (series × points per window): an
-#: unbounded namespace-batched response from a 100k-pod namespace could be
-#: tens of GB (~35 B/sample of JSON). The digest/stats routes STREAM bodies
-#: into the native sinks (never materialized), so their cap only bounds the
-#: per-request transfer unit: 20M samples ≈ 700 MB.
-MAX_RESPONSE_SAMPLES = 20_000_000
+#: Every fan-out bounds TOTAL samples per response (series × points per
+#: window): an unbounded namespace-batched response from a 100k-pod
+#: namespace could be tens of GB (~35 B/sample of JSON). Each route passes
+#: its own budget — RAW_MAX_RESPONSE_SAMPLES for buffered bodies,
+#: ``Config.prometheus_max_streamed_samples`` for streamed ingest.
+#:
+#: STREAMED windows (digest/stats native sinks) run at the looser
+#: ``Config.prometheus_max_streamed_samples`` budget (default
+#: `krr_tpu.core.config.DEFAULT_MAX_STREAMED_SAMPLES` — the single source of
+#: truth): the body is never materialized, so the cap trades retry
+#: granularity (a mid-stream failure refetches the whole window — 40M
+#: samples ≈ 1.4 GB ≈ seconds at the native ingest rate) against per-window
+#: overhead, which at fleet width is substantial: every window holds its own
+#: dense [series × buckets] native digest state (~2 GB at 100k × 2,560)
+#: while in flight, plus a fixed ~3 s of readout+fold per window — so FEWER
+#: windows mean both less concurrent memory and less fixed cost. The default
+#: sits UNDER Prometheus's default --query.max-samples=50e6 (a bigger window
+#: would be rejected outright by a default-configured server); if the
+#: series-count probe undercounts (pod churn) and the server still rejects,
+#: `_fan_out` retries the batched query once with halved windows before
+#: falling back per-workload.
 
 #: The raw sample route BUFFERS each window's body and parse output, and up
 #: to the connection-semaphore width of windows are in flight concurrently —
@@ -266,13 +281,11 @@ MAX_RESPONSE_SAMPLES = 20_000_000
 RAW_MAX_RESPONSE_SAMPLES = 2_000_000
 
 
-def window_points_cap(expected_series: int, max_samples: Optional[int] = None) -> int:
+def window_points_cap(expected_series: int, max_samples: int) -> int:
     """Points per sub-window for a query expected to return ``expected_series``
     series: the Prometheus per-series cap, tightened so series × points stays
-    under ``max_samples`` (default ``MAX_RESPONSE_SAMPLES``, read at call time
-    so tests can tune it). At least one point per window."""
-    if max_samples is None:
-        max_samples = MAX_RESPONSE_SAMPLES
+    under ``max_samples`` (the calling route's sample budget). At least one
+    point per window."""
     if expected_series <= 0:
         return MAX_RANGE_POINTS
     return max(1, min(MAX_RANGE_POINTS, max_samples // expected_series))
@@ -782,7 +795,7 @@ class PrometheusLoader:
     async def _window_fan_out(
         self, start: float, end: float, step_seconds: float,
         expected_series: int, fetch_entries, consume,
-        max_samples: Optional[int] = None,
+        max_samples: int, points_divisor: int = 1,
     ) -> None:
         """Shared sub-window fan-out: run ``fetch_entries(w_start, w_end)``
         for every sub-window concurrently and hand each window's entries to
@@ -802,14 +815,20 @@ class PrometheusLoader:
         async def one(index: int, w_start: float, w_end: float) -> None:
             consume(index, await fetch_entries(w_start, w_end))
 
+        max_points = window_points_cap(expected_series, max_samples)
+        if points_divisor > 1:
+            # The halved-window retry after a server max-samples rejection:
+            # shrink relative to the ACTUAL range, not just the cap —
+            # dividing an 11k cap that the 61-point range never reached
+            # would change nothing. Clamping to the range's own point count
+            # first guarantees the retry really issues divisor x the windows.
+            n_points = int((end - start) // effective_step_seconds(step_seconds)) + 1
+            max_points = max(1, min(max_points, n_points) // points_divisor)
         results = await asyncio.gather(
             *[
                 one(i, s, e)
                 for i, (s, e) in enumerate(
-                    subwindows(
-                        start, end, step_seconds,
-                        max_points=window_points_cap(expected_series, max_samples),
-                    )
+                    subwindows(start, end, step_seconds, max_points=max_points)
                 )
             ],
             return_exceptions=True,
@@ -831,7 +850,7 @@ class PrometheusLoader:
 
     async def _fetch_parsed_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
-        expected_series: int = 0, keep: "Optional[set]" = None,
+        expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
     ) -> "list[list]":
         """Sub-window fan-out returning per-window parse results in window
         (time) order — the raw path, whose cross-window concatenation is
@@ -844,13 +863,14 @@ class PrometheusLoader:
             self._buffered_fetch_entries(query, step_seconds, self._kept(parse, keep)),
             by_index.__setitem__,
             max_samples=RAW_MAX_RESPONSE_SAMPLES,  # read at call time
+            points_divisor=points_divisor,
         )
         return [by_index[i] for i in range(len(by_index))]
 
     async def _fold_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int, init, fold, keep: "Optional[set]" = None,
-        stream_factory=None, matrix_mode: bool = False,
+        stream_factory=None, matrix_mode: bool = False, points_divisor: int = 1,
     ) -> "list[tuple]":
         """Sub-window fan-out with INCREMENTAL merging for order-independent
         folds (digest/stats — counts add, peaks max): each window's parse
@@ -906,9 +926,17 @@ class PrometheusLoader:
         await self._window_fan_out(
             start, end, step_seconds, expected_series, fetch_entries,
             accumulator.consume if accumulator is not None else consume,
-            # The buffered fallback (no native lib / proxied httpx) holds
-            # whole bodies like the raw route — give it the same tight cap.
-            max_samples=None if use_stream else RAW_MAX_RESPONSE_SAMPLES,
+            # Streamed windows never hold the body — their looser cap trades
+            # retry granularity for fewer windows (less fixed per-window cost
+            # AND less concurrent native state). The buffered fallback (no
+            # native lib) holds whole bodies like the raw route — same tight
+            # cap.
+            max_samples=(
+                self.config.prometheus_max_streamed_samples
+                if use_stream
+                else RAW_MAX_RESPONSE_SAMPLES
+            ),
+            points_divisor=points_divisor,
         )
         if accumulator is not None:
             return accumulator.entries()
@@ -949,7 +977,7 @@ class PrometheusLoader:
 
     async def _query_range(
         self, query: str, start: float, end: float, step_seconds: float,
-        expected_series: int = 0, keep: "Optional[set]" = None,
+        expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
     ) -> "list[tuple[tuple[str, str], np.ndarray]]":
         """Range query → parsed ((pod, container), samples) series via the
         native matrix parser (`krr_tpu.integrations.native`, pure-Python
@@ -959,7 +987,8 @@ class PrometheusLoader:
         from krr_tpu.integrations.native import parse_matrix
 
         windows = await self._fetch_parsed_windows(
-            query, start, end, step_seconds, parse_matrix, expected_series, keep
+            query, start, end, step_seconds, parse_matrix, expected_series, keep,
+            points_divisor=points_divisor,
         )
         if len(windows) == 1:
             return windows[0]
@@ -1024,21 +1053,49 @@ class PrometheusLoader:
         counted = await self._count_series(query, end)
         return max(len(route), counted or 0)
 
+    #: 4xx statuses worth one halved-window batched retry before the
+    #: per-workload fallback: Prometheus signals its --query.max-samples
+    #: limit as 422 (400/413 from proxies and older servers). Auth statuses
+    #: are excluded — `_retrying` already owns the refresh-and-retry there.
+    _RETRY_HALVED_STATUSES = frozenset({400, 413, 422})
+
     async def _fan_out(self, objects: list[K8sObjectData], per_workload, per_namespace) -> None:
         """Shared fetch orchestration for both ingest forms: one batched query
         per (namespace, resource) with automatic per-workload fallback when a
         batched query fails (backends that reject or truncate namespace-sized
-        responses); ``--batched-fleet-queries false`` forces per-workload."""
+        responses); ``--batched-fleet-queries false`` forces per-workload.
+
+        A 4xx that can mean the server's sample limit (422/400/413) earns ONE
+        batched retry with HALVED windows first: the window sizing trusts a
+        series-count probe taken at the window's end, and pods that churned
+        away mid-window escape it — with the streamed sample budget sitting
+        ~1.25x under Prometheus's default --query.max-samples, a >25%
+        undercount would otherwise trip the limit and push a fleet-wide
+        namespace onto the slow per-workload road."""
 
         async def one_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
             try:
                 await per_namespace(namespace, indices, resource)
+                return
+            except PrometheusQueryError as e:
+                error: Exception = e
+                if e.status in self._RETRY_HALVED_STATUSES:
+                    self.logger.warning(
+                        f"Batched {resource} query for namespace {namespace} rejected "
+                        f"({e}); retrying once with halved windows"
+                    )
+                    try:
+                        await per_namespace(namespace, indices, resource, points_divisor=2)
+                        return
+                    except Exception as retry_error:
+                        error = retry_error
             except Exception as e:
-                self.logger.warning(
-                    f"Batched {resource} query failed for namespace {namespace}: {e} — "
-                    f"falling back to per-workload queries for {len(indices)} objects"
-                )
-                await asyncio.gather(*[per_workload(i, objects[i], resource) for i in indices])
+                error = e
+            self.logger.warning(
+                f"Batched {resource} query failed for namespace {namespace}: {error} — "
+                f"falling back to per-workload queries for {len(indices)} objects"
+            )
+            await asyncio.gather(*[per_workload(i, objects[i], resource) for i in indices])
 
         if self.config.batched_fleet_queries:
             await asyncio.gather(
@@ -1104,13 +1161,15 @@ class PrometheusLoader:
                     history[pod] = samples
             histories[resource][i] = history
 
-        async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+        async def per_namespace(
+            namespace: str, indices: list[int], resource: ResourceType, points_divisor: int = 1
+        ) -> None:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
             route = self._series_route(objects, indices)
             expected = await self._expected_series(query, route, end)
             series = await self._query_range(
                 query, start, end, step_seconds,
-                expected_series=expected, keep=set(route),
+                expected_series=expected, keep=set(route), points_divisor=points_divisor,
             )
             self._route_series(
                 route,
@@ -1132,6 +1191,7 @@ class PrometheusLoader:
         num_buckets: int,
         expected_series: int = 0,
         keep: "Optional[set]" = None,
+        points_divisor: int = 1,
     ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
@@ -1155,11 +1215,12 @@ class PrometheusLoader:
             keep=keep,
             stream_factory=partial(open_stream, gamma, min_value, num_buckets),
             matrix_mode=True,  # digest streams finish() in matrix form
+            points_divisor=points_divisor,
         )
 
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float,
-        expected_series: int = 0, keep: "Optional[set]" = None,
+        expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
     ) -> "list[tuple[tuple[str, str], float, float]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
@@ -1175,6 +1236,7 @@ class PrometheusLoader:
             keep=keep,
             # num_buckets=0 selects the stats-only native sink.
             stream_factory=partial(open_stream, 0.0, 0.0, 0),
+            points_divisor=points_divisor,
         )
 
     async def gather_fleet_digests(
@@ -1201,11 +1263,12 @@ class PrometheusLoader:
         fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
 
         async def fetch_cpu(
-            query: str, expected_series: int, keep: "Optional[set]" = None
+            query: str, expected_series: int, keep: "Optional[set]" = None,
+            points_divisor: int = 1,
         ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
             return await self._query_range_digest(
                 query, start, end, step_seconds, gamma, min_value, num_buckets,
-                expected_series=expected_series, keep=keep,
+                expected_series=expected_series, keep=keep, points_divisor=points_divisor,
             )
 
         async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
@@ -1234,13 +1297,19 @@ class PrometheusLoader:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
 
-        async def per_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+        async def per_namespace(
+            namespace: str, indices: list[int], resource: ResourceType, points_divisor: int = 1
+        ) -> None:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
             route = self._series_route(objects, indices)
             expected = await self._expected_series(query, route, end)
             if resource is ResourceType.CPU:
                 series: list = [
-                    row for row in await fetch_cpu(query, expected, keep=set(route)) if row[2] > 0
+                    row
+                    for row in await fetch_cpu(
+                        query, expected, keep=set(route), points_divisor=points_divisor
+                    )
+                    if row[2] > 0
                 ]
                 merge = fleet.merge_cpu_row
             else:
@@ -1249,6 +1318,7 @@ class PrometheusLoader:
                     for row in await self._query_range_stats(
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route),
+                        points_divisor=points_divisor,
                     )
                     if row[1] > 0
                 ]

@@ -22,6 +22,7 @@ from typing import Any
 import click
 import pydantic_core
 
+from krr_tpu.core.config import DEFAULT_MAX_STREAMED_SAMPLES
 from krr_tpu.utils.version import get_version
 
 
@@ -178,6 +179,18 @@ def _common_options() -> list[click.Option]:
             default=32,
             show_default=True,
             help="Max concurrent Prometheus range-query connections for the bulk fetch.",
+        ),
+        PanelOption(
+            ["--prometheus-max-streamed-samples"],
+            type=int,
+            default=DEFAULT_MAX_STREAMED_SAMPLES,
+            show_default=True,
+            help=(
+                "Per-window total-sample budget for streamed (digest-ingest) "
+                "range queries. Default sits under Prometheus's default "
+                "--query.max-samples=50000000; raise it alongside a raised "
+                "server limit to fetch wide fleets in fewer windows."
+            ),
         ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
         PanelOption(

@@ -109,6 +109,10 @@ class FakeMetrics:
     #: backend that caps response sizes — per-workload queries still succeed
     #: (exercises the loader's automatic per-namespace fallback).
     fail_batched: bool = False
+    #: When set, batched range queries whose series × points exceed this
+    #: limit get Prometheus's --query.max-samples rejection (422) — the
+    #: loader should retry with halved windows before falling back.
+    max_batch_samples: Optional[int] = None
     #: Answer every range query with a 302 (an SSO/ingress login redirect):
     #: the loader must surface it as a failed query, never parse the
     #: redirect body as an empty result.
@@ -322,6 +326,15 @@ class FakeBackend:
             return web.json_response(
                 {"status": "error", "error": "query result too large"}, status=422
             )
+        if batched and self.metrics.max_batch_samples is not None:
+            n_series = sum(1 for k in self.metrics.series if k[0] == batched["namespace"])
+            n_points = int((req_end - req_start) // step_sec) + 1
+            if n_series * n_points > self.metrics.max_batch_samples:
+                return web.json_response(
+                    {"status": "error",
+                     "error": "query processing would load too many samples into memory"},
+                    status=422,
+                )
         if batched:
             # Namespace-batched query: every series in the namespace, metric
             # labels = the grouping set (pod AND container), like real

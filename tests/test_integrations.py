@@ -275,12 +275,12 @@ class TestBatchedFleetQueries:
         return asyncio.run(fetch())
 
     @staticmethod
-    def _gather_digests(config, objects):
+    def _gather_digests(config, objects, **kwargs):
         async def fetch():
             prom = PrometheusLoader(config, cluster="fake")
             try:
                 return await prom.gather_fleet_digests(
-                    objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128
+                    objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128, **kwargs
                 )
             finally:
                 await prom.close()
@@ -324,6 +324,29 @@ class TestBatchedFleetQueries:
             KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
         )
         streamed = self._gather_digests(make_config(fake_env), objects)
+        # Force MULTI-WINDOW streaming through the full path too (a tiny
+        # streamed sample budget splits the range), exercising the matrix
+        # accumulator's cross-window fold end-to-end. Window splitting only
+        # sums correctly against range-accurate serving (each window gets
+        # exactly its slice), so pin the scan onto the fake's series grid.
+        from tests.fakes.servers import FakeBackend
+
+        scan_end = FakeBackend.SERIES_ORIGIN + 47 * 60  # the 48-sample grid
+        fake_env["metrics"].enforce_range = True
+        try:
+            one_window = self._gather_digests(
+                make_config(fake_env), objects, end_time=scan_end
+            )
+            split = self._gather_digests(
+                make_config(fake_env, prometheus_max_streamed_samples=64),
+                objects, end_time=scan_end,
+            )
+        finally:
+            fake_env["metrics"].enforce_range = False
+            fake_env["metrics"]._batched_bodies.clear()
+        np.testing.assert_array_equal(split.cpu_counts, one_window.cpu_counts)
+        np.testing.assert_array_equal(split.cpu_total, one_window.cpu_total)
+        np.testing.assert_array_equal(split.mem_peak, one_window.mem_peak)
         monkeypatch.setattr(native, "stream_available", lambda: False)
         buffered = self._gather_digests(make_config(fake_env), objects)
         np.testing.assert_array_equal(streamed.cpu_counts, buffered.cpu_counts)
@@ -386,6 +409,93 @@ class TestBatchedFleetQueries:
         np.testing.assert_array_equal(proxied.cpu_peak, reference.cpu_peak)
         np.testing.assert_array_equal(proxied.mem_total, reference.mem_total)
         np.testing.assert_array_equal(proxied.mem_peak, reference.mem_peak)
+
+    def test_max_samples_rejection_retries_halved_windows(self, fake_env):
+        """A server 422 (--query.max-samples tripping on a series-count
+        undercount) must earn ONE batched retry with halved windows — and
+        succeed batched, never touching the slow per-workload road."""
+        from tests.fakes.servers import FakeBackend
+
+        metrics = fake_env["metrics"]
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        # Window splitting only sums correctly against range-accurate
+        # serving — pin the scan onto the fake's series grid.
+        scan_end = FakeBackend.SERIES_ORIGIN + 47 * 60
+        metrics.enforce_range = True
+        try:
+            reference = self._gather(make_config(fake_env), objects, end_time=scan_end)
+
+            # The scan window is 3600s @ 60s = 61 points; "default" namespace
+            # holds 4 series. Cap at 3 x 61: the full-range window (4 x 61)
+            # trips 422, halved windows (<=30 points, 4 x 30 = 120) pass.
+            metrics.max_batch_samples = 3 * 61
+            metrics.request_count = 0
+            histories = self._gather(make_config(fake_env), objects, end_time=scan_end)
+            requests_used = metrics.request_count
+        finally:
+            metrics.max_batch_samples = None
+            metrics.enforce_range = False
+            metrics._batched_bodies.clear()
+        # Batched throughout: per-workload fallback for "default"'s 3 objects
+        # x 2 resources would add 6+ queries; the halved retry costs only the
+        # rejected attempts plus ~2 windows per (namespace, resource).
+        assert requests_used <= 16, requests_used
+        for resource in ResourceType:
+            for i in range(len(objects)):
+                assert histories[resource][i].keys() == reference[resource][i].keys()
+                for pod in reference[resource][i]:
+                    np.testing.assert_array_equal(
+                        histories[resource][i][pod], reference[resource][i][pod]
+                    )
+
+    def test_streamed_digest_window_accumulator(self, rng):
+        """The matrix-form window fold (`_StreamedDigestWindows`) must equal a
+        naive per-entry dict fold on every branch: same key order (fast
+        path), permuted order, new keys appearing mid-stream (series churn),
+        keep-filtering, and within-window duplicate keys."""
+        buckets = 32
+
+        def window(keys, seed):
+            r = np.random.default_rng(seed)
+            counts = r.integers(0, 9, size=(len(keys), buckets)).astype(np.float64)
+            totals = counts.sum(axis=1)
+            peaks = r.gamma(2.0, 0.3, len(keys))
+            return keys, counts, totals, peaks
+
+        key = lambda i: (f"pod-{i}", "main")
+        windows = [
+            window([key(0), key(1), key(2)], 1),             # establishes order
+            window([key(0), key(1), key(2)], 2),             # same order: fast path
+            window([key(2), key(0), key(1)], 3),             # permuted
+            window([key(1), key(3), key(0)], 4),             # churn: new key(3)
+            window([key(3), key(3), key(2)], 5),             # duplicate in-window
+            window([key(9), key(0)], 6),                     # unrouted key(9) + known
+        ]
+        keep = {key(0), key(1), key(2), key(3)}
+
+        naive: dict = {}
+        for keys, counts, totals, peaks in windows:
+            seen: set = set()
+            for i, k in enumerate(keys):
+                if k not in keep or k in seen:
+                    continue
+                seen.add(k)
+                if k in naive:
+                    c, t, p = naive[k]
+                    naive[k] = (c + counts[i], t + totals[i], max(p, peaks[i]))
+                else:
+                    naive[k] = (counts[i].copy(), totals[i], peaks[i])
+
+        acc = PrometheusLoader._StreamedDigestWindows(keep)
+        for w, win in enumerate(windows):
+            acc.consume(w, win)
+        got = {k: (c, t, p) for k, c, t, p in acc.entries()}
+        assert got.keys() == naive.keys()
+        for k in naive:
+            np.testing.assert_array_equal(got[k][0], naive[k][0])
+            assert got[k][1] == naive[k][1] and got[k][2] == naive[k][2], k
 
     def test_digest_batched_equals_per_workload(self, fake_env):
         objects = asyncio.run(
@@ -566,8 +676,10 @@ class TestBatchedFleetQueries:
             )
         namespaces = {o.namespace for o in objects if o.pods}
         with_pods = [o for o in objects if o.pods]
-        # 2 rejected batched queries per namespace + 2 per-workload per object.
-        assert fake_env["metrics"].request_count - base == 2 * len(namespaces) + 2 * len(with_pods)
+        # Per (namespace, resource): 1 rejected batched query + a rejected
+        # halved-window retry (the 61-point scan splits into 3 sub-windows =
+        # 3 queries) = 4; then 1 per-workload query per (object, resource).
+        assert fake_env["metrics"].request_count - base == 2 * 4 * len(namespaces) + 2 * len(with_pods)
 
     def test_redirect_responses_are_failures_not_empty_results(self, fake_env):
         """A 302 from an auth proxy must degrade the scan to UNKNOWN (failed
@@ -1004,19 +1116,20 @@ class TestRangeQuerySplitting:
         loader never materializes a multi-GB body."""
         from krr_tpu.integrations.prometheus import (
             MAX_RANGE_POINTS,
-            MAX_RESPONSE_SAMPLES,
+            RAW_MAX_RESPONSE_SAMPLES,
             subwindows,
             window_points_cap,
         )
 
-        assert window_points_cap(0) == MAX_RANGE_POINTS
-        assert window_points_cap(10) == MAX_RANGE_POINTS  # narrow: server cap rules
+        budget = RAW_MAX_RESPONSE_SAMPLES
+        assert window_points_cap(0, budget) == MAX_RANGE_POINTS
+        assert window_points_cap(10, budget) == MAX_RANGE_POINTS  # narrow: server cap rules
         wide = 100_000
-        cap = window_points_cap(wide)
+        cap = window_points_cap(wide, budget)
         assert 1 <= cap < MAX_RANGE_POINTS
-        assert wide * cap <= MAX_RESPONSE_SAMPLES
+        assert wide * cap <= budget
         # Degenerate width never collapses below one point per window.
-        assert window_points_cap(10 * MAX_RESPONSE_SAMPLES) == 1
+        assert window_points_cap(10 * budget, budget) == 1
 
         start, step, n = 1_700_000_000.0, 5.0, 2_000
         end = start + (n - 1) * step
