@@ -366,6 +366,23 @@ class TestStreamingIngest:
                 stream.feed(body[i:i + chunk])
             assert stream.finish() == [(key, total, peak)], chunk
 
+    def test_overcap_literal_rejected_at_every_chunk_size(self, library_available):
+        """An over-cap literal with a parseable prefix ("1.5" + 600 junk
+        chars) must fail the stream at EVERY chunk size — the fast lane's
+        cap measures the full terminator-bounded run, like the stepwise
+        states (regression: the fast lane once capped only the parsed
+        prefix, so acceptance flipped with recv chunking)."""
+        body = (
+            b'{"status":"success","data":{"result":[{"metric":{"pod":"p"},'
+            b'"values":[[1,"1.5' + b"x" * 600 + b'"],[2,"0.5"]]}]}}'
+        )
+        for chunk in (len(body), 729, 64, 7, 1):
+            stream = native.open_stream(0.0, 0.0, 0)
+            with pytest.raises(ValueError):
+                for i in range(0, len(body), chunk):
+                    stream.feed(body[i:i + chunk])
+                stream.finish()
+
     def test_error_payload_rejected(self, library_available):
         stream = native.open_stream(self.GAMMA, self.MINV, self.BUCKETS)
         stream.feed(b'{"status":"error","error":"boom"}')
