@@ -82,6 +82,12 @@ class Runner:
     ) -> None:
         self.config = config
         self.logger = logger or config.create_logger()
+        # Before any strategy can trace/compile: point XLA's persistent
+        # compilation cache at the configured directory so fresh processes
+        # skip the cold-start compile (utils/compile_cache.py).
+        from krr_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache(config.jax_compilation_cache_dir)
         self._strategy = config.create_strategy()
         self._inventory = inventory
         self._history_factory = history_factory
@@ -246,11 +252,11 @@ class Runner:
 
     async def _collect_result(self) -> Result:
         inventory = self._get_inventory()
-        t0 = time.perf_counter()
+        t0, c0 = time.perf_counter(), time.process_time()
         clusters = await inventory.list_clusters()
         self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
         objects = await inventory.list_scannable_objects(clusters)
-        t1 = time.perf_counter()
+        t1, c1 = time.perf_counter(), time.process_time()
         self.logger.info(f"Found {len(objects)} scannable objects")
 
         digest_ingest = bool(getattr(self._strategy.settings, "digest_ingest", False)) and hasattr(
@@ -258,11 +264,11 @@ class Runner:
         )
         if digest_ingest:
             fleet = await self._gather_fleet_digests(objects)
-            t2 = time.perf_counter()
+            t2, c2 = time.perf_counter(), time.process_time()
             raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
         else:
             batch = await self._gather_fleet_history(objects)
-            t2 = time.perf_counter()
+            t2, c2 = time.perf_counter(), time.process_time()
             # The batched strategy call is CPU/TPU bound; keep the loop
             # responsive. Row-chunked so the packed copy never exceeds
             # max_fleet_rows_per_device rows at a time (fleet-axis host
@@ -272,7 +278,7 @@ class Runner:
             raw_results = await asyncio.to_thread(
                 run_batch_row_chunks, self._strategy, batch, self.config.max_fleet_rows_per_device
             )
-        t3 = time.perf_counter()
+        t3, c3 = time.perf_counter(), time.process_time()
 
         scans = [
             ResourceScan.calculate(obj, self._round_result(raw))
@@ -282,6 +288,12 @@ class Runner:
             "discover_seconds": t1 - t0,
             "fetch_seconds": t2 - t1,
             "compute_seconds": t3 - t2,
+            # process_time spans every thread of this process, so the CPU
+            # legs attribute each phase's wall between our own work and
+            # waiting on the outside world (server, device, disk).
+            "discover_cpu_seconds": c1 - c0,
+            "fetch_cpu_seconds": c2 - c1,
+            "compute_cpu_seconds": c3 - c2,
             "objects": float(len(objects)),
             "objects_per_second": len(objects) / (t3 - t2) if t3 > t2 and objects else 0.0,
         }

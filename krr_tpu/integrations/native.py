@@ -127,6 +127,16 @@ def _load_library() -> Optional[ctypes.CDLL]:
             ]
             lib.krr_stream_free.restype = None
             lib.krr_stream_free.argtypes = [ctypes.c_void_p]
+            lib.krr_stream_reserve.restype = ctypes.c_long
+            lib.krr_stream_reserve.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.krr_stream_fold_into.restype = ctypes.c_long
+            lib.krr_stream_fold_into.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long,
+            ]
             _lib = lib
         except Exception as e:
             _build_failed = True
@@ -330,12 +340,89 @@ class StreamIngest:
         self._lib = lib
         self._handle = handle
         self._num_buckets = num_buckets
+        self._count: Optional[int] = None
+        #: Serializes every native call against abort(): on the httpx route
+        #: feed/finalize run in executor threads, and a cancelled awaiter's
+        #: cleanup could otherwise free the handle WHILE a worker is still
+        #: parsing into it (use-after-free). With the lock, abort blocks
+        #: until the in-flight call returns; the late worker then sees the
+        #: cleared handle and raises instead of touching freed memory.
+        self._op_lock = threading.Lock()
 
     def feed(self, chunk: bytes) -> None:
-        if self._handle is None:
-            raise ValueError("stream already finished")
-        if self._lib.krr_stream_feed(self._handle, chunk, len(chunk)) != 0:
-            raise ValueError("malformed Prometheus stream")
+        with self._op_lock:
+            if self._handle is None:
+                raise ValueError("stream already finished")
+            if self._lib.krr_stream_feed(self._handle, chunk, len(chunk)) != 0:
+                raise ValueError("malformed Prometheus stream")
+
+    def finish_parse(self) -> "StreamIngest":
+        """End-of-body validation WITHOUT reading anything out: the handle
+        stays alive for :meth:`read_meta` / :meth:`fold_counts_into`, and the
+        caller owns releasing it (:meth:`free`). This is the fleet fast path —
+        the folded state crosses into Python as one band-sparse native add
+        into the final arrays instead of a dense matrix readout."""
+        with self._op_lock:
+            handle = self._handle
+            if handle is None:
+                raise ValueError("stream already finished")
+            n = self._lib.krr_stream_finish(handle)
+            if n < 0:
+                self._handle = None
+                self._lib.krr_stream_free(handle)
+                raise ValueError("malformed Prometheus stream (no result array)")
+            self._count = int(n)
+            return self
+
+    def read_meta(self) -> tuple[bytes, np.ndarray, np.ndarray]:
+        """(names bytes, totals, peaks) — the cheap per-series readout (no
+        counts matrix) that lets the caller build a row mapping before the
+        native counts fold. Requires :meth:`finish_parse`. The names bytes
+        are '\\n'-joined "pod\\tcontainer" records (:func:`_split_keys`);
+        identical bytes across windows mean an identical series list, so
+        callers can reuse a cached mapping without decoding."""
+        with self._op_lock:
+            assert self._handle is not None and self._count is not None
+            n = self._count
+            totals = np.empty(n, dtype=np.float64)
+            peaks = np.empty(n, dtype=np.float64)
+            if not n:
+                return b"", totals, peaks
+            names_cap = self._lib.krr_stream_names_len(self._handle)
+            names = ctypes.create_string_buffer(names_cap)
+            rc = self._lib.krr_stream_read(
+                self._handle,
+                names,
+                names_cap,
+                totals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                peaks.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                None,
+                n,
+            )
+            if rc != 0:
+                raise ValueError("stream readout capacity mismatch")
+            return names.raw[:names_cap], totals, peaks
+
+    def fold_counts_into(self, rows: np.ndarray, dst: np.ndarray) -> None:
+        """Add every series' touched bucket span into ``dst[rows[i]]``
+        (``rows[i] < 0`` skips) — one GIL-released native pass straight into
+        the caller's [n_rows × num_buckets] float64 accumulator (digest mode
+        only). Requires :meth:`finish_parse`."""
+        with self._op_lock:
+            assert self._handle is not None and self._count is not None
+            assert dst.dtype == np.float64 and dst.flags["C_CONTIGUOUS"]
+            assert dst.ndim == 2 and dst.shape[1] == self._num_buckets
+            rows = np.ascontiguousarray(rows, dtype=np.int64)
+            assert rows.shape == (self._count,)
+            rc = self._lib.krr_stream_fold_into(
+                self._handle,
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+                self._count,
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                dst.shape[0],
+            )
+            if rc != 0:
+                raise ValueError("stream fold shape/mode mismatch")
 
     def finish(self):
         """Close the stream and return the folded series.
@@ -347,7 +434,13 @@ class StreamIngest:
         the native parse itself; consumers fold the matrix with vectorized
         ops instead (`krr_tpu.integrations.prometheus`). Stats mode returns
         ``[(key, total, peak), …]`` — scalars, nothing to vectorize."""
+        with self._op_lock:
+            return self._finish_locked()
+
+    def _finish_locked(self):
         handle, self._handle = self._handle, None
+        if handle is None:
+            raise ValueError("stream already finished")
         try:
             n = self._lib.krr_stream_finish(handle)
             if n < 0:
@@ -385,9 +478,26 @@ class StreamIngest:
             self._lib.krr_stream_free(handle)
 
     def abort(self) -> None:
-        """Release native memory without reading results (fetch failed)."""
-        handle, self._handle = self._handle, None
+        """Release native memory without reading results (fetch failed).
+        Blocks until any in-flight native call on another thread returns —
+        never frees under a live parser (see ``_op_lock``)."""
+        with self._op_lock:
+            handle, self._handle = self._handle, None
+            if handle is not None:
+                self._lib.krr_stream_free(handle)
+
+    #: Terminal call of the finish_parse path (same release as a failed
+    #: fetch's abort — the name marks intent at call sites).
+    free = abort
+
+    def __del__(self):
+        # Safety net for ownership gaps (e.g. a consumer cancelled between
+        # fetch and fold): a still-live handle pins up to GB-scale native
+        # state, far too big to leave to process exit. No lock: reachable
+        # refcount zero means no concurrent op can hold the stream.
+        handle = getattr(self, "_handle", None)
         if handle is not None:
+            self._handle = None
             self._lib.krr_stream_free(handle)
 
 
@@ -396,15 +506,23 @@ def stream_available() -> bool:
     return _load_library() is not None
 
 
-def open_stream(gamma: float, min_value: float, num_buckets: int) -> Optional[StreamIngest]:
+def open_stream(
+    gamma: float, min_value: float, num_buckets: int, reserve_series: int = 0
+) -> Optional[StreamIngest]:
     """A streaming ingest handle, or None when the native library (the only
-    implementation) is unavailable. ``num_buckets=0`` = stats-only sink."""
+    implementation) is unavailable. ``num_buckets=0`` = stats-only sink.
+    ``reserve_series`` pre-sizes the native state for the expected series
+    count (the probed estimate, padded for churn): no realloc-doubling
+    copies, and the counts matrix's untouched pages stay lazily zero-mapped
+    (a reserve failure silently falls back to growth-on-demand)."""
     lib = _load_library()
     if lib is None:
         return None
     handle = lib.krr_stream_new(gamma, min_value, num_buckets)
     if not handle:
         return None
+    if reserve_series > 0:
+        lib.krr_stream_reserve(handle, reserve_series + reserve_series // 8 + 64)
     return StreamIngest(lib, handle, num_buckets)
 
 

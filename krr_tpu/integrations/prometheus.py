@@ -59,11 +59,14 @@ class PrometheusNotFound(Exception):
 
 
 class PrometheusQueryError(Exception):
-    """Non-2xx response to a range query; carries the HTTP status."""
+    """Non-2xx response to a range query; carries the HTTP status and the
+    (truncated) error body for policy decisions like the halved-window
+    retry."""
 
     def __init__(self, status: int, detail: str):
         super().__init__(f"HTTP {status}: {detail}")
         self.status = status
+        self.detail = detail
 
 
 class _RawTransport:
@@ -472,12 +475,14 @@ class PrometheusLoader:
         assert self._raw is not None
         return self._raw.request(*self._range_request_parts(query, start, end, step))
 
-    def _stream_attempt(self, query: str, start: float, end: float, step: str, make_stream):
+    def _stream_attempt(self, query: str, start: float, end: float, step: str, make_stream, finalize):
         """One STREAMED range request (sync — worker thread): response bytes
         feed a fresh native ingest stream as they arrive; returns
-        (status, folded series or None, error body). The stream is aborted on
-        any failure — a partially-fed stream can never be resumed (retrying
-        would duplicate samples), so each attempt starts a fresh one."""
+        (status, ``finalize(stream)`` or None, error body). The stream is
+        aborted on any failure — a partially-fed stream can never be resumed
+        (retrying would duplicate samples), so each attempt starts a fresh
+        one. ``finalize`` is either ``StreamIngest.finish`` (full readout) or
+        ``finish_parse`` (hand the live stream back for a native fold)."""
         assert self._raw is not None
         stream = make_stream()
         try:
@@ -487,7 +492,7 @@ class PrometheusLoader:
             if status >= 300:
                 stream.abort()
                 return status, None, err
-            return status, stream.finish(), b""
+            return status, finalize(stream), b""
         except BaseException:
             stream.abort()
             raise
@@ -510,16 +515,17 @@ class PrometheusLoader:
         return response.status_code, response.content
 
     async def _httpx_stream_attempt(
-        self, query: str, start: float, end: float, step: str, make_stream
+        self, query: str, start: float, end: float, step: str, make_stream, finalize
     ):
         """One STREAMED range request on the httpx client (proxied
         environments): response bytes feed a fresh native ingest stream as
         they arrive via ``aiter_bytes`` — no body materialization, matching
-        `_stream_attempt`'s contract ((status, folded series or None, error
-        body); fresh stream per attempt, aborted on any failure). The ctypes
-        feed releases the GIL, but it does run on the event loop — the
-        throughput trade the proxied environment already made by losing the
-        raw transport."""
+        `_stream_attempt`'s contract ((status, ``finalize(stream)`` or None,
+        error body); fresh stream per attempt, aborted on any failure).
+        ``feed`` and ``finalize`` run off the loop: both are CPU-bound at
+        fleet width (feed at MB-chunk scale, finalize up to a GB-scale
+        readout), and on the loop they would stall every concurrent fetch
+        (round-4 advisor finding)."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
         request = self._client.stream(method, "/api/v1/query_range", **kwargs)
@@ -531,10 +537,15 @@ class PrometheusLoader:
                     stream.abort()
                     return response.status_code, None, err
                 async for chunk in response.aiter_bytes(1 << 20):
-                    stream.feed(chunk)
-            return response.status_code, stream.finish(), b""
+                    await asyncio.to_thread(stream.feed, chunk)
+            return response.status_code, await asyncio.to_thread(finalize, stream), b""
         except BaseException:
-            stream.abort()
+            # Off the loop: abort blocks on the stream's op lock until any
+            # in-flight feed/finalize thread returns — inline it would stall
+            # every concurrent fetch for the remainder of a GB-scale readout.
+            # (A repeat cancellation mid-cleanup falls back to the GC
+            # finalizer — StreamIngest.__del__ frees a still-live handle.)
+            await asyncio.to_thread(stream.abort)
             raise
 
     async def _count_series(self, range_query: str, at_time: float) -> Optional[int]:
@@ -650,24 +661,26 @@ class PrometheusLoader:
         return await self._retrying(attempt)
 
     async def _fetch_streamed_series(
-        self, query: str, start: float, end: float, step: str, make_stream
-    ) -> list:
+        self, query: str, start: float, end: float, step: str, make_stream, finalize
+    ):
         """Range query whose response bytes feed a native ingest stream as
-        they arrive (no body materialization); returns the folded per-series
-        entries. Rides the raw transport when available, else httpx
-        ``aiter_bytes`` (proxied/userinfo environments keep zero-copy ingest
-        too). Same retry policy as the buffered path — each attempt runs on
-        a FRESH stream (a partially-fed one cannot be resumed)."""
+        they arrive (no body materialization); returns ``finalize(stream)``
+        — the folded entries (``StreamIngest.finish``) or the live
+        parse-finished stream (``finish_parse``, the fleet fold path). Rides
+        the raw transport when available, else httpx ``aiter_bytes``
+        (proxied/userinfo environments keep zero-copy ingest too). Same
+        retry policy as the buffered path — each attempt runs on a FRESH
+        stream (a partially-fed one cannot be resumed)."""
         await self._ensure_connected()
 
         if self._raw is not None:
             async def attempt():
                 return await asyncio.to_thread(
-                    self._stream_attempt, query, start, end, step, make_stream
+                    self._stream_attempt, query, start, end, step, make_stream, finalize
                 )
         else:
             async def attempt():
-                return await self._httpx_stream_attempt(query, start, end, step, make_stream)
+                return await self._httpx_stream_attempt(query, start, end, step, make_stream, finalize)
 
         return await self._retrying(attempt)
 
@@ -700,87 +713,91 @@ class PrometheusLoader:
             if self._client is not None:
                 self._client.headers.update(fresh)
 
-    class _StreamedDigestWindows:
-        """Matrix-form fold for streamed digest windows.
+    class _FleetFoldSink:
+        """Folds parse-finished native ingest streams STRAIGHT into
+        `DigestedFleet` rows — the streamed routes' terminal stage.
 
-        `StreamIngest.finish` (digest mode) returns ``(keys, counts matrix,
-        totals, peaks)``; folding that per-row into a dict cost more than the
-        native parse at fleet width (measured ~3.7 s/window at 100k series).
-        This accumulator merges whole windows with vectorized ops instead:
-        one gather-copy when unrouted rows are dropped (so the window matrix
-        is never pinned by kept views), a single in-place add when the key
-        order repeats across windows (the overwhelmingly common case — the
-        backend evaluates the same query each window), and fancy-index
-        add/max otherwise. First-series-per-key applies per window, like the
-        per-entry path."""
+        Per window: one cheap meta readout (names/totals/peaks — no counts
+        matrix), a row mapping from series keys to fleet rows via the
+        prebuilt route, two vectorized total/peak accumulations, and (digest
+        mode) ONE band-sparse native fold into the final ``cpu_counts``
+        array (`StreamIngest.fold_counts_into`). This replaces the former
+        chain — dense matrix readout → window accumulator → entries → route
+        → per-row merges — whose four-plus full-matrix passes per window
+        were the dominant measured client cost of the 100k fetch wall.
 
-        def __init__(self, keep: "Optional[set]"):
-            self._keep = keep
-            self._keys: Optional[list] = None
-            self._rows: dict = {}
-            self._counts = None
-            self._totals = None
-            self._peaks = None
+        Routing semantics match `_route_series` + the per-entry fold:
+        first series per key per window (empty series are harmless no-ops —
+        zero totals, -inf peaks, empty spans), unrouted keys dropped,
+        multi-target keys (overlapping selectors) folded once per target
+        via extra passes. Windows whose names bytes repeat (the typical
+        same-query-every-window case) reuse the cached row mapping without
+        decoding a single key."""
 
-        def consume(self, index: int, window) -> None:
-            keys, counts, totals, peaks = window
-            kept_idx: list[int] = []
-            kept_keys: list = []
+        def __init__(self, fleet, route: dict, resource: ResourceType):
+            self._fleet = fleet
+            self._route = route
+            self._cpu = resource is ResourceType.CPU
+            self._cached_names: Optional[bytes] = None
+            self._cached_passes: Optional[list[np.ndarray]] = None
+
+        def _row_passes(self, keys: list) -> "list[np.ndarray]":
+            """Row maps covering every (series, target) pair: the main pass
+            routes each kept series to its first target; rare extra targets
+            (overlapping selectors) get follow-up passes, one target per
+            series per pass."""
+            rows = np.full(len(keys), -1, dtype=np.int64)
+            extra: list[tuple[int, int]] = []
             seen: set = set()
             for i, key in enumerate(keys):
-                if (self._keep is not None and key not in self._keep) or key in seen:
+                if key in seen:
                     continue
                 seen.add(key)
-                kept_idx.append(i)
-                kept_keys.append(key)
-            if not kept_keys:
-                return
-            if len(kept_keys) != len(keys):
-                rows = np.asarray(kept_idx)
-                counts, totals, peaks = counts[rows], totals[rows], peaks[rows]
-            if self._counts is None:
-                self._keys = kept_keys
-                self._rows = {key: i for i, key in enumerate(kept_keys)}
-                self._counts, self._totals, self._peaks = counts, totals, peaks
-                return
-            if kept_keys == self._keys:
-                # Same series, same order (typical): three whole-matrix ops.
-                self._counts += counts
-                self._totals += totals
-                np.maximum(self._peaks, peaks, out=self._peaks)
-                return
-            known_sub, known_rows, new_sub = [], [], []
-            for j, key in enumerate(kept_keys):
-                row = self._rows.get(key)
-                if row is None:
-                    new_sub.append(j)
-                else:
-                    known_sub.append(j)
-                    known_rows.append(row)
-            if known_sub:
-                # Keys are unique per window, so the target rows are unique
-                # and plain fancy-index accumulation is exact.
-                rows = np.asarray(known_rows)
-                sub = np.asarray(known_sub)
-                self._counts[rows] += counts[sub]
-                self._totals[rows] += totals[sub]
-                self._peaks[rows] = np.maximum(self._peaks[rows], peaks[sub])
-            if new_sub:
-                sub = np.asarray(new_sub)
-                for j in new_sub:
-                    self._rows[kept_keys[j]] = len(self._keys)
-                    self._keys.append(kept_keys[j])
-                self._counts = np.vstack([self._counts, counts[sub]])
-                self._totals = np.concatenate([self._totals, totals[sub]])
-                self._peaks = np.concatenate([self._peaks, peaks[sub]])
+                targets = self._route.get(key)
+                if not targets:
+                    continue
+                rows[i] = targets[0]
+                extra.extend((i, t) for t in targets[1:])
+            passes = [rows]
+            while extra:
+                next_rows = np.full(len(keys), -1, dtype=np.int64)
+                rest: list[tuple[int, int]] = []
+                used: set[int] = set()
+                for i, t in extra:
+                    if i in used:
+                        rest.append((i, t))
+                    else:
+                        used.add(i)
+                        next_rows[i] = t
+                passes.append(next_rows)
+                extra = rest
+            return passes
 
-        def entries(self) -> "list[tuple]":
-            if self._keys is None:
-                return []
-            return [
-                (key, self._counts[i], float(self._totals[i]), float(self._peaks[i]))
-                for i, key in enumerate(self._keys)
-            ]
+        def consume(self, index: int, stream) -> None:
+            from krr_tpu.integrations.native import _split_keys
+
+            try:
+                names, totals, peaks = stream.read_meta()
+                if self._cached_names is not None and names == self._cached_names:
+                    passes = self._cached_passes
+                else:
+                    passes = self._row_passes(_split_keys(names, len(totals)))
+                    self._cached_names, self._cached_passes = names, passes
+                fleet = self._fleet
+                for rows in passes:
+                    valid = rows >= 0
+                    if not valid.any():
+                        continue
+                    targets = rows[valid]
+                    if self._cpu:
+                        np.add.at(fleet.cpu_total, targets, totals[valid])
+                        np.maximum.at(fleet.cpu_peak, targets, peaks[valid])
+                        stream.fold_counts_into(rows, fleet.cpu_counts)
+                    else:
+                        np.add.at(fleet.mem_total, targets, totals[valid])
+                        np.maximum.at(fleet.mem_peak, targets, peaks[valid])
+            finally:
+                stream.free()
 
     @staticmethod
     def _kept(parse, keep: "Optional[set]"):
@@ -870,8 +887,9 @@ class PrometheusLoader:
     async def _fold_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int, init, fold, keep: "Optional[set]" = None,
-        stream_factory=None, matrix_mode: bool = False, points_divisor: int = 1,
-    ) -> "list[tuple]":
+        stream_factory=None, stream_sink=None, stream_entries=None,
+        points_divisor: int = 1,
+    ) -> "Optional[list[tuple]]":
         """Sub-window fan-out with INCREMENTAL merging for order-independent
         folds (digest/stats — counts add, peaks max): each window's parse
         output folds into the shared per-series state as soon as it lands,
@@ -889,10 +907,14 @@ class PrometheusLoader:
         stream AS THEY ARRIVE — the body is never materialized at all — on
         the raw transport when available, else through httpx ``aiter_bytes``
         (proxied environments); ``parse`` serves only the buffered fallback
-        (native lib absent / no compiler). ``matrix_mode`` marks streams
-        whose finish() returns the matrix form (digest mode): their windows
-        fold through the vectorized `_StreamedDigestWindows` accumulator
-        instead of the per-entry dict.
+        (native lib absent / no compiler). With ``stream_sink`` (a
+        `_FleetFoldSink`), streamed windows skip the readout entirely: each
+        parse-finished stream is handed to ``stream_sink.consume``, which
+        folds it natively into the fleet's final arrays — the return value
+        is then None (nothing left to route). The buffered fallback ignores
+        the sink and returns entries as usual. ``stream_entries`` adapts a
+        matrix-form ``finish()`` result (digest streams) back to per-entry
+        tuples for sink-less streamed calls.
         """
         merged: dict = {}
 
@@ -910,22 +932,35 @@ class PrometheusLoader:
             # The availability probe may BUILD the native library (a g++
             # subprocess, tens of seconds on first use) — keep it off the
             # event loop.
-            from krr_tpu.integrations.native import stream_available
+            from krr_tpu.integrations.native import StreamIngest, stream_available
 
             use_stream = await asyncio.to_thread(stream_available)
-        accumulator = self._StreamedDigestWindows(keep) if use_stream and matrix_mode else None
+        use_sink = use_stream and stream_sink is not None
         if use_stream:
             step = step_string(step_seconds)
+            if use_sink:
+                finalize = StreamIngest.finish_parse
+            elif stream_entries is not None:
+                # No sink on a matrix-form (digest) stream: adapt finish()'s
+                # matrix back to per-entry tuples so the dict consume gets
+                # what it expects — the API path for sink-less callers.
+                def finalize(stream):
+                    return stream_entries(stream.finish())
 
-            async def fetch_entries(w_start: float, w_end: float) -> list:
-                return await self._fetch_streamed_series(query, w_start, w_end, step, stream_factory)
+            else:
+                finalize = StreamIngest.finish
+
+            async def fetch_entries(w_start: float, w_end: float):
+                return await self._fetch_streamed_series(
+                    query, w_start, w_end, step, stream_factory, finalize
+                )
 
         else:
             fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
 
         await self._window_fan_out(
             start, end, step_seconds, expected_series, fetch_entries,
-            accumulator.consume if accumulator is not None else consume,
+            stream_sink.consume if use_sink else consume,
             # Streamed windows never hold the body — their looser cap trades
             # retry granularity for fewer windows (less fixed per-window cost
             # AND less concurrent native state). The buffered fallback (no
@@ -938,8 +973,8 @@ class PrometheusLoader:
             ),
             points_divisor=points_divisor,
         )
-        if accumulator is not None:
-            return accumulator.entries()
+        if use_sink:
+            return None
         return [(key, *state) for key, state in merged.items()]
 
     @staticmethod
@@ -1055,9 +1090,20 @@ class PrometheusLoader:
 
     #: 4xx statuses worth one halved-window batched retry before the
     #: per-workload fallback: Prometheus signals its --query.max-samples
-    #: limit as 422 (400/413 from proxies and older servers). Auth statuses
-    #: are excluded — `_retrying` already owns the refresh-and-retry there.
-    _RETRY_HALVED_STATUSES = frozenset({400, 413, 422})
+    #: limit as 422, proxies and older servers as 413. Auth statuses are
+    #: excluded — `_retrying` already owns the refresh-and-retry there.
+    #: 400 also covers permanently malformed queries, so it qualifies only
+    #: when the error body names the sample limit (see
+    #: `_halved_retry_worthwhile`) — a blanket 400 retry would double the
+    #: failure latency of every truly-bad query for a retry that cannot
+    #: succeed (round-4 advisor finding).
+    _RETRY_HALVED_STATUSES = frozenset({413, 422})
+
+    @classmethod
+    def _halved_retry_worthwhile(cls, error: PrometheusQueryError) -> bool:
+        return error.status in cls._RETRY_HALVED_STATUSES or (
+            error.status == 400 and "too many samples" in error.detail
+        )
 
     async def _fan_out(self, objects: list[K8sObjectData], per_workload, per_namespace) -> None:
         """Shared fetch orchestration for both ingest forms: one batched query
@@ -1079,7 +1125,7 @@ class PrometheusLoader:
                 return
             except PrometheusQueryError as e:
                 error: Exception = e
-                if e.status in self._RETRY_HALVED_STATUSES:
+                if self._halved_retry_worthwhile(e):
                     self.logger.warning(
                         f"Batched {resource} query for namespace {namespace} rejected "
                         f"({e}); retrying once with halved windows"
@@ -1191,12 +1237,16 @@ class PrometheusLoader:
         num_buckets: int,
         expected_series: int = 0,
         keep: "Optional[set]" = None,
+        sink=None,
         points_divisor: int = 1,
-    ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
+    ) -> "Optional[list[tuple[tuple[str, str], np.ndarray, float, float]]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
         sample arrays are never materialized. Split sub-windows merge exactly
-        (bucket counts add, peaks max — the digest's defining property)."""
+        (bucket counts add, peaks max — the digest's defining property).
+        With ``sink`` (a `_FleetFoldSink`) the streamed route folds each
+        window natively into the fleet arrays and returns None; entries come
+        back only on the buffered fallback."""
         from functools import partial
 
         from krr_tpu.integrations.native import open_stream, parse_matrix_digest
@@ -1206,6 +1256,13 @@ class PrometheusLoader:
             counts += entry[1]  # owned array (see _fold_windows) — in place
             return (counts, total + entry[2], max(peak, entry[3]))
 
+        def matrix_entries(result):
+            keys, counts, totals, peaks = result
+            return [
+                (keys[i], counts[i].copy(), float(totals[i]), float(peaks[i]))
+                for i in range(len(keys))
+            ]
+
         return await self._fold_windows(
             query, start, end, step_seconds,
             partial(parse_matrix_digest, gamma=gamma, min_value=min_value, num_buckets=num_buckets),
@@ -1213,18 +1270,23 @@ class PrometheusLoader:
             init=lambda e: (e[1], e[2], e[3]),
             fold=fold,
             keep=keep,
-            stream_factory=partial(open_stream, gamma, min_value, num_buckets),
-            matrix_mode=True,  # digest streams finish() in matrix form
+            stream_factory=partial(
+                open_stream, gamma, min_value, num_buckets, reserve_series=expected_series
+            ),
+            stream_sink=sink,
+            stream_entries=matrix_entries,  # sink-less callers get entries back
             points_divisor=points_divisor,
         )
 
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float,
-        expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
-    ) -> "list[tuple[tuple[str, str], float, float]]":
+        expected_series: int = 0, keep: "Optional[set]" = None, sink=None,
+        points_divisor: int = 1,
+    ) -> "Optional[list[tuple[tuple[str, str], float, float]]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
-        sub-windows merge exactly (counts add, peaks max)."""
+        sub-windows merge exactly (counts add, peaks max). ``sink`` as in
+        `_query_range_digest` (returns None when it consumed the windows)."""
         from functools import partial
 
         from krr_tpu.integrations.native import open_stream, parse_matrix_stats
@@ -1235,7 +1297,8 @@ class PrometheusLoader:
             fold=lambda s, e: (s[0] + e[1], max(s[1], e[2])),
             keep=keep,
             # num_buckets=0 selects the stats-only native sink.
-            stream_factory=partial(open_stream, 0.0, 0.0, 0),
+            stream_factory=partial(open_stream, 0.0, 0.0, 0, reserve_series=expected_series),
+            stream_sink=sink,
             points_divisor=points_divisor,
         )
 
@@ -1264,11 +1327,12 @@ class PrometheusLoader:
 
         async def fetch_cpu(
             query: str, expected_series: int, keep: "Optional[set]" = None,
-            points_divisor: int = 1,
-        ) -> "list[tuple[tuple[str, str], np.ndarray, float, float]]":
+            sink=None, points_divisor: int = 1,
+        ) -> "Optional[list[tuple[tuple[str, str], np.ndarray, float, float]]]":
             return await self._query_range_digest(
                 query, start, end, step_seconds, gamma, min_value, num_buckets,
-                expected_series=expected_series, keep=keep, points_divisor=points_divisor,
+                expected_series=expected_series, keep=keep, sink=sink,
+                points_divisor=points_divisor,
             )
 
         async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
@@ -1276,24 +1340,41 @@ class PrometheusLoader:
                 return
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
             query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
+            # Per-workload queries group by pod only → series key (pod, "").
+            route = {(pod, ""): [i] for pod in obj.pods}
+            sink = self._FleetFoldSink(fleet, route, resource)
             wanted = set(obj.pods)
             seen: set[str] = set()  # first series per pod, like gather_fleet
             try:
                 if resource is ResourceType.CPU:
-                    for (pod, _c), counts, total, peak in await fetch_cpu(query, len(obj.pods)):
+                    series = await fetch_cpu(query, len(obj.pods), sink=sink)
+                    if series is None:  # streamed: folded straight into row i
+                        return
+                    for (pod, _c), counts, total, peak in series:
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_cpu_row(i, counts, total, peak)
                 else:
                     # Memory needs only count+max (max × buffer): the cheaper
                     # stats pass, no histogram.
-                    for (pod, _c), total, peak in await self._query_range_stats(
-                        query, start, end, step_seconds, expected_series=len(obj.pods)
-                    ):
+                    series = await self._query_range_stats(
+                        query, start, end, step_seconds,
+                        expected_series=len(obj.pods), sink=sink,
+                    )
+                    if series is None:
+                        return
+                    for (pod, _c), total, peak in series:
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_mem_row(i, total, peak)
             except Exception as e:
+                # The sink folds windows in as they land — unwind any partial
+                # folds so this object degrades to the empty (UNKNOWN) state
+                # the pre-streamed path guaranteed.
+                if resource is ResourceType.CPU:
+                    fleet.clear_cpu_rows([i])
+                else:
+                    fleet.clear_mem_rows([i])
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
 
@@ -1303,26 +1384,37 @@ class PrometheusLoader:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
             route = self._series_route(objects, indices)
             expected = await self._expected_series(query, route, end)
-            if resource is ResourceType.CPU:
-                series: list = [
-                    row
-                    for row in await fetch_cpu(
-                        query, expected, keep=set(route), points_divisor=points_divisor
-                    )
-                    if row[2] > 0
-                ]
-                merge = fleet.merge_cpu_row
-            else:
-                series = [
-                    row
-                    for row in await self._query_range_stats(
-                        query, start, end, step_seconds,
-                        expected_series=expected, keep=set(route),
+            sink = self._FleetFoldSink(fleet, route, resource)
+            try:
+                if resource is ResourceType.CPU:
+                    fetched = await fetch_cpu(
+                        query, expected, keep=set(route), sink=sink,
                         points_divisor=points_divisor,
                     )
-                    if row[1] > 0
-                ]
-                merge = fleet.merge_mem_row
+                    if fetched is None:  # streamed: folded straight into fleet rows
+                        return
+                    series: list = [row for row in fetched if row[2] > 0]
+                    merge = fleet.merge_cpu_row
+                else:
+                    fetched = await self._query_range_stats(
+                        query, start, end, step_seconds,
+                        expected_series=expected, keep=set(route), sink=sink,
+                        points_divisor=points_divisor,
+                    )
+                    if fetched is None:
+                        return
+                    series = [row for row in fetched if row[1] > 0]
+                    merge = fleet.merge_mem_row
+            except BaseException:
+                # Partial windows may already sit in the fleet rows (the sink
+                # folds incrementally); clear them so the halved-window retry
+                # or per-workload fallback starts from zero — anything else
+                # double-counts every sample the failed attempt delivered.
+                if resource is ResourceType.CPU:
+                    fleet.clear_cpu_rows(indices)
+                else:
+                    fleet.clear_mem_rows(indices)
+                raise
             self._route_series(route, series, lambda i, key, *payload: merge(i, *payload))
 
         await self._fan_out(objects, per_workload, per_namespace)

@@ -254,6 +254,17 @@ def _common_options() -> list[click.Option]:
                 "give identical results chunked or not)."
             ),
         ),
+        PanelOption(
+            ["--jax-compilation-cache-dir"],
+            default="~/.cache/krr_tpu/jax-cache",
+            show_default=True,
+            panel="TPU Backend Settings",
+            help=(
+                "Persistent XLA compilation cache: fresh processes reuse "
+                "compiled device programs instead of paying cold-start "
+                "trace+compile. Pass an empty string to disable."
+            ),
+        ),
     ]
 
 

@@ -81,6 +81,24 @@ class DigestedFleet:
         self.mem_total[i] += total
         self.mem_peak[i] = max(self.mem_peak[i], peak)
 
+    def clear_cpu_rows(self, indices: "list[int]") -> None:
+        """Reset CPU state for ``indices`` to the empty-digest state — the
+        failed-query unwind: streamed fetches fold windows into these rows
+        incrementally, so a mid-query failure must clear its partial folds
+        before any retry or per-workload fallback refetches (else samples
+        double-count). Sound because each (namespace, resource) query owns
+        a disjoint row set."""
+        rows = np.asarray(indices, dtype=np.int64)
+        self.cpu_counts[rows] = 0.0
+        self.cpu_total[rows] = 0.0
+        self.cpu_peak[rows] = -np.inf
+
+    def clear_mem_rows(self, indices: "list[int]") -> None:
+        """Memory-resource counterpart of :meth:`clear_cpu_rows`."""
+        rows = np.asarray(indices, dtype=np.int64)
+        self.mem_total[rows] = 0.0
+        self.mem_peak[rows] = -np.inf
+
     def merge_from(self, sub: "DigestedFleet", indices: "list[int]") -> None:
         """Fold a sub-fleet (same spec, ``sub``'s row ``j`` → our row
         ``indices[j]``) into this fleet — the cross-cluster merge."""

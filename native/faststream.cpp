@@ -47,12 +47,17 @@ enum class State {
 };
 
 // One series' accumulators. Digest counts live in a single [cap x buckets]
-// matrix owned by the stream (indexed by series).
+// matrix owned by the stream (indexed by series). [lo, hi] is the touched
+// bucket span (hi < lo == no samples folded): real series are band-sparse
+// (~tens of active buckets out of thousands), so readout/fold passes that
+// honor the span touch ~2% of the dense matrix instead of all of it.
 struct SeriesMeta {
   long name_off;  // offset into the names arena ("pod\tcontainer")
   long name_len;
   double total;
   double peak;
+  long lo;  // lowest touched bucket (digest mode)
+  long hi;  // highest touched bucket, -1 when none
 };
 
 struct Stream {
@@ -127,6 +132,30 @@ struct Stream {
     return true;
   }
 
+  // Pre-size for an expected series count BEFORE any series arrive.
+  // The counts matrix comes from calloc, not realloc+memset: untouched rows
+  // stay lazily-mapped zero pages, so a band-sparse fleet window faults in
+  // only the pages its samples actually hit — pre-faulting the full dense
+  // [series x buckets] state (2 GB at 100k x 2,560) per window was a
+  // measured multi-second cost, paid again at every realloc doubling.
+  bool reserve_series(long n) {
+    if (n <= series_cap) return true;
+    if (series_count > 0 || n > (1L << 24)) return false;
+    SeriesMeta* grown =
+        static_cast<SeriesMeta*>(std::realloc(series, sizeof(SeriesMeta) * static_cast<size_t>(n)));
+    if (!grown) return false;
+    series = grown;
+    if (num_buckets > 0) {
+      double* fresh = static_cast<double*>(
+          std::calloc(static_cast<size_t>(n) * static_cast<size_t>(num_buckets), sizeof(double)));
+      if (!fresh) return false;
+      std::free(counts);
+      counts = fresh;
+    }
+    series_cap = n;
+    return true;
+  }
+
   bool append_name(const char* data, long len) {
     if (names_len + len > names_cap) {
       long cap = names_cap ? names_cap : 4096;
@@ -152,6 +181,8 @@ struct Stream {
         idx = 1 + raw;
       }
       counts[(series_count - 1) * num_buckets + idx] += 1.0;
+      if (idx < m.lo) m.lo = idx;
+      if (idx > m.hi) m.hi = idx;
     }
     m.total += 1.0;
     if (v > m.peak) m.peak = v;
@@ -243,6 +274,8 @@ const char* step(Stream& s, const char* p, const char* end) {
         m.name_len = s.names_len - m.name_off;
         m.total = 0.0;
         m.peak = -HUGE_VAL;
+        m.lo = s.num_buckets;
+        m.hi = -1;
         s.series_count++;
         p = hit + 8;
         s.depth = 0;
@@ -271,6 +304,7 @@ const char* step(Stream& s, const char* p, const char* end) {
           const double inv_min = s.inv_min;
           const double min_value = s.min_value;
           const long top = s.num_buckets - 2;
+          long lo = m.lo, hi = m.hi;  // span hoisted like the row pointer
           while (true) {
             while (p < end && *p != '[' && *p != ']') p++;
             if (p >= end || *p == ']') break;  // array close / chunk edge: stepwise
@@ -327,6 +361,8 @@ const char* step(Stream& s, const char* p, const char* end) {
                     idx = 1 + raw;
                   }
                   row[idx] += 1.0;
+                  if (idx < lo) lo = idx;
+                  if (idx > hi) hi = idx;
                 }
                 m.total += 1.0;
                 if (v > m.peak) m.peak = v;
@@ -335,6 +371,8 @@ const char* step(Stream& s, const char* p, const char* end) {
             // Degenerate [ts] pair (no comma): sample-less, like kInSample.
             p = close + 1;
           }
+          m.lo = lo;
+          m.hi = hi;
         }
         while (p < end && *p != '[' && *p != ']') p++;
         if (p >= end) break;
@@ -557,6 +595,43 @@ long krr_stream_read(void* handle, char* names, long names_cap, double* totals, 
 long krr_stream_names_len(void* handle) {
   Stream& s = *static_cast<Stream*>(handle);
   return s.names_len + s.series_count;
+}
+
+// Pre-size the stream for an expected series count (call right after
+// krr_stream_new, before any bytes). Returns 0; -1 when the hint can't be
+// honored (already holding series, absurd count, OOM) — growth-on-demand
+// still works then. The win is twofold: no realloc-doubling copies, and a
+// calloc'd counts matrix whose untouched pages are never faulted (see
+// Stream::reserve_series).
+long krr_stream_reserve(void* handle, long n_series) {
+  Stream& s = *static_cast<Stream*>(handle);
+  if (s.state == State::kError) return -1;
+  if (n_series <= 0) return 0;
+  return s.reserve_series(n_series) ? 0 : -1;
+}
+
+// Fold the per-series bucket counts straight into caller-owned accumulator
+// rows: series i adds its touched bucket span into row rows[i] of
+// dst_counts ([n_rows x num_buckets] float64, row-major); rows[i] < 0 skips
+// the series. This replaces the dense readout-copy + Python-side add with
+// ONE band-sparse pass — the only full-matrix traversal left in the
+// streamed ingest. Digest mode only; rows must cover every series. Returns
+// 0, or -1 on a shape/mode mismatch.
+long krr_stream_fold_into(void* handle, const long* rows, long n_series, double* dst_counts,
+                          long n_rows) {
+  Stream& s = *static_cast<Stream*>(handle);
+  if (s.num_buckets <= 0 || n_series != s.series_count) return -1;
+  for (long i = 0; i < n_series; i++) {
+    long r = rows[i];
+    if (r < 0) continue;
+    if (r >= n_rows) return -1;
+    const SeriesMeta& m = s.series[i];
+    if (m.hi < m.lo) continue;  // no samples folded into this series
+    const double* src = s.counts + i * s.num_buckets;
+    double* dst = dst_counts + r * s.num_buckets;
+    for (long b = m.lo; b <= m.hi; b++) dst[b] += src[b];
+  }
+  return 0;
 }
 
 void krr_stream_free(void* handle) { delete static_cast<Stream*>(handle); }

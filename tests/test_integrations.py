@@ -450,52 +450,111 @@ class TestBatchedFleetQueries:
                         histories[resource][i][pod], reference[resource][i][pod]
                     )
 
-    def test_streamed_digest_window_accumulator(self, rng):
-        """The matrix-form window fold (`_StreamedDigestWindows`) must equal a
-        naive per-entry dict fold on every branch: same key order (fast
-        path), permuted order, new keys appearing mid-stream (series churn),
-        keep-filtering, and within-window duplicate keys."""
-        buckets = 32
+    def test_fleet_fold_sink_matches_naive_routing(self, rng):
+        """The direct-into-fleet streamed fold (`_FleetFoldSink` over real
+        native streams) must equal a naive parse+route+merge on every
+        branch: repeated windows (cached row mapping), permuted order,
+        series churn, unrouted keys, within-window duplicates, empty
+        series, and multi-target routes (overlapping selectors)."""
+        from krr_tpu.integrations.native import (
+            open_stream,
+            parse_matrix_digest,
+            stream_available,
+        )
+        from krr_tpu.models.allocations import ResourceAllocations
+        from krr_tpu.models.objects import K8sObjectData
+        from krr_tpu.models.series import DigestedFleet
 
-        def window(keys, seed):
+        if not stream_available():
+            pytest.skip("native streaming unavailable")
+        gamma, min_value, buckets = 1.05, 1e-7, 64
+
+        def body(series: "list[tuple[str, list[float]]]") -> bytes:
+            fragments = []
+            for pod, values in series:
+                samples = ",".join(f'[{1700000000 + 15 * t},"{v!r}"]' for t, v in enumerate(values))
+                fragments.append(
+                    '{"metric":{"pod":"%s","container":"main"},"values":[%s]}' % (pod, samples)
+                )
+            return (
+                '{"status":"success","data":{"resultType":"matrix","result":[%s]}}'
+                % ",".join(fragments)
+            ).encode()
+
+        def series_for(pods: "list[str]", seed: int, empties: "set[str]" = frozenset()):
             r = np.random.default_rng(seed)
-            counts = r.integers(0, 9, size=(len(keys), buckets)).astype(np.float64)
-            totals = counts.sum(axis=1)
-            peaks = r.gamma(2.0, 0.3, len(keys))
-            return keys, counts, totals, peaks
+            return [
+                (pod, [] if pod in empties else list(r.gamma(2.0, 0.3, 17)))
+                for pod in pods
+            ]
 
-        key = lambda i: (f"pod-{i}", "main")
         windows = [
-            window([key(0), key(1), key(2)], 1),             # establishes order
-            window([key(0), key(1), key(2)], 2),             # same order: fast path
-            window([key(2), key(0), key(1)], 3),             # permuted
-            window([key(1), key(3), key(0)], 4),             # churn: new key(3)
-            window([key(3), key(3), key(2)], 5),             # duplicate in-window
-            window([key(9), key(0)], 6),                     # unrouted key(9) + known
+            series_for(["p0", "p1", "p2"], 1),
+            series_for(["p0", "p1", "p2"], 2),                       # same order: cached mapping
+            series_for(["p2", "p0", "p1"], 3),                       # permuted
+            series_for(["p1", "p3", "p0"], 4, empties={"p1"}),       # churn + empty series
+            series_for(["p3", "p3", "p2"], 5),                       # duplicate in-window
+            series_for(["p9", "p0"], 6),                             # unrouted + known
         ]
-        keep = {key(0), key(1), key(2), key(3)}
+        # p0 routes to TWO objects (overlapping selectors); p9 routes nowhere.
+        route = {("p0", "main"): [0, 3], ("p1", "main"): [1], ("p2", "main"): [2], ("p3", "main"): [1]}
 
-        naive: dict = {}
-        for keys, counts, totals, peaks in windows:
+        def fleet_of():
+            allocations = ResourceAllocations(requests={}, limits={})
+            objects = [
+                K8sObjectData(cluster="c", namespace="ns", name=f"wl-{i}", kind="Deployment",
+                              container="main", pods=[], allocations=allocations)
+                for i in range(4)
+            ]
+            return DigestedFleet.empty(objects, gamma, min_value, buckets)
+
+        expected = fleet_of()
+        for window in windows:
             seen: set = set()
-            for i, k in enumerate(keys):
-                if k not in keep or k in seen:
+            for key, counts, total, peak in parse_matrix_digest(body(window), gamma, min_value, buckets):
+                if key in seen:
                     continue
-                seen.add(k)
-                if k in naive:
-                    c, t, p = naive[k]
-                    naive[k] = (c + counts[i], t + totals[i], max(p, peaks[i]))
-                else:
-                    naive[k] = (counts[i].copy(), totals[i], peaks[i])
+                seen.add(key)
+                for target in route.get(key, ()):  # empty series fold as no-ops
+                    expected.merge_cpu_row(target, counts, total, peak)
 
-        acc = PrometheusLoader._StreamedDigestWindows(keep)
-        for w, win in enumerate(windows):
-            acc.consume(w, win)
-        got = {k: (c, t, p) for k, c, t, p in acc.entries()}
-        assert got.keys() == naive.keys()
-        for k in naive:
-            np.testing.assert_array_equal(got[k][0], naive[k][0])
-            assert got[k][1] == naive[k][1] and got[k][2] == naive[k][2], k
+        got = fleet_of()
+        sink = PrometheusLoader._FleetFoldSink(got, route, ResourceType.CPU)
+        for w, window in enumerate(windows):
+            stream = open_stream(gamma, min_value, buckets, reserve_series=3)
+            stream.feed(body(window))
+            sink.consume(w, stream.finish_parse())
+        np.testing.assert_array_equal(got.cpu_counts, expected.cpu_counts)
+        np.testing.assert_array_equal(got.cpu_total, expected.cpu_total)
+        np.testing.assert_array_equal(got.cpu_peak, expected.cpu_peak)
+
+    def test_sinkless_streamed_digest_returns_entries(self, fake_env):
+        """`_query_range_digest` WITHOUT a sink (the API path for callers
+        outside `gather_fleet_digests`) must return per-entry tuples on the
+        streamed route too — it once leaked the raw matrix form into the
+        dict fold (review finding)."""
+        from krr_tpu.integrations.prometheus import cpu_namespace_query
+
+        config = make_config(fake_env)
+        scan_end = FakeBackend.SERIES_ORIGIN + 47 * 60
+
+        async def fetch():
+            prom = PrometheusLoader(config, cluster="fake")
+            try:
+                await prom._ensure_connected()
+                return await prom._query_range_digest(
+                    cpu_namespace_query("default"),
+                    scan_end - 47 * 60, scan_end, 60.0, 1.05, 1e-7, 64,
+                )
+            finally:
+                await prom.close()
+
+        entries = asyncio.run(fetch())
+        assert entries, "expected the default namespace's series"
+        for key, counts, total, peak in entries:
+            assert isinstance(key, tuple) and len(key) == 2
+            assert counts.shape == (64,) and counts.sum() == total > 0
+            assert np.isfinite(peak)
 
     def test_digest_batched_equals_per_workload(self, fake_env):
         objects = asyncio.run(
