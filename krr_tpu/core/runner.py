@@ -251,6 +251,23 @@ class Runner:
         )
 
     async def _collect_result(self) -> Result:
+        # Cyclic GC off for the scan: a fleet build keeps 100k+ tracked
+        # objects (models, routed series, JSON items) live at once, and each
+        # threshold-triggered full collection scans that whole heap — a
+        # measured ~2x on bulk object construction. Scans create no cyclic
+        # garbage worth collecting mid-flight; refcounting frees the bulk,
+        # and the deferred collection runs after re-enable.
+        import gc
+
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            return await self._collect_result_inner()
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+
+    async def _collect_result_inner(self) -> Result:
         inventory = self._get_inventory()
         t0, c0 = time.perf_counter(), time.process_time()
         clusters = await inventory.list_clusters()

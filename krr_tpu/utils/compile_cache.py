@@ -40,16 +40,24 @@ def enable_compilation_cache(cache_dir: Optional[str]) -> Optional[str]:
         os.makedirs(path, exist_ok=True)
         import jax
 
+        previously_enabled = _enabled_dir is not None
         jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        return None
+    # The cache is ON from here: record and report it even if the tuning
+    # knobs below are missing on some JAX version — a half-tuned cache is
+    # still an enabled cache, and pretending otherwise would make every
+    # later call re-run (and re-fail) the whole setup.
+    _enabled_dir = path
+    try:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        if _enabled_dir is not None:
+        if previously_enabled:
             # JAX pins its cache object on first use; a later directory
             # change (tests, long-lived embedders) needs an explicit reset.
             from jax._src import compilation_cache
 
             compilation_cache.reset_cache()
-        _enabled_dir = path
-        return path
     except Exception:
-        return None
+        pass
+    return path

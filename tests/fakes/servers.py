@@ -471,6 +471,7 @@ class ServerThread:
         self.ssl_context = ssl_context
         self.port: Optional[int] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
 
@@ -479,9 +480,13 @@ class ServerThread:
         asyncio.set_event_loop(self._loop)
 
         async def start() -> None:
-            runner = web.AppRunner(self.backend.build_app())
-            await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=self.ssl_context)
+            self._runner = web.AppRunner(self.backend.build_app())
+            await self._runner.setup()
+            # Short shutdown grace: lingering keep-alive connections from
+            # already-finished clients shouldn't stretch teardown.
+            site = web.TCPSite(
+                self._runner, "127.0.0.1", 0, ssl_context=self.ssl_context, shutdown_timeout=2.0
+            )
             await site.start()
             self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
             self._started.set()
@@ -502,5 +507,16 @@ class ServerThread:
 
     def stop(self) -> None:
         if self._loop is not None:
+            if self._runner is not None:
+                # Graceful aiohttp teardown BEFORE stopping the loop: closes
+                # the site and drains/cancels handler tasks, so benchmark
+                # tails stop recording "Task was destroyed but it is
+                # pending!" tracebacks from keep-alive handlers (round-4
+                # verdict item 6).
+                future = asyncio.run_coroutine_threadsafe(self._runner.cleanup(), self._loop)
+                try:
+                    future.result(timeout=10)
+                except Exception:
+                    pass  # teardown stays best-effort
             self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)

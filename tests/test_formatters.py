@@ -176,3 +176,138 @@ def test_cli_table_fast_path_e2e(fake_env, monkeypatch):  # noqa: F811
     rows = table_cells(result.output)
     assert rows[0][0] == "Number"
     assert len(rows) >= 5  # header + 4 scans (web×2, db, migrate)
+
+
+class TestMachineFastPaths:
+    """yaml/pprint fleet fast paths: byte-identity with the library paths
+    (the contract — unlike the table's documented shape switch) plus the
+    speed bound that motivated them."""
+
+    @staticmethod
+    def adversarial_result() -> Result:
+        """Names and values chosen to provoke every quoting/layout branch:
+        numeric names, YAML 1.1 bool/null words, dates, dots, colons in
+        cluster names, '?' recommendations, None cluster, 63-char names."""
+        def one(i, name=None, cluster="c", rec_cpu="0.105"):
+            allocations = ResourceAllocations(
+                requests={ResourceType.CPU: "100m", ResourceType.Memory: "128Mi"},
+                limits={ResourceType.CPU: None, ResourceType.Memory: "256Mi"},
+            )
+            rec = ResourceAllocations(
+                requests={
+                    ResourceType.CPU: Decimal(rec_cpu) if rec_cpu != "?" else "?",
+                    ResourceType.Memory: Decimal("178000000"),
+                },
+                limits={ResourceType.CPU: None, ResourceType.Memory: Decimal("178000000")},
+            )
+            workload = name or f"wl-{i}"
+            return ResourceScan.calculate(
+                K8sObjectData(
+                    cluster=cluster, namespace="default", name=workload,
+                    kind="Deployment", container="main",
+                    pods=[f"{workload}-{j}" for j in range(2)], allocations=allocations,
+                ),
+                rec,
+            )
+
+        scans = [one(i) for i in range(20)]
+        scans += [
+            one(100, name="123", cluster="arn:aws:eks:us-east-1:12345:cluster/prod"),
+            one(101, name="1.5"),
+            one(102, name="yes"),
+            one(103, name="off"),
+            one(104, name="y"),
+            one(105, name="a" * 63),
+            one(106, name="x-" + "9" * 40),
+            one(107, rec_cpu="?"),
+            one(108, name="null"),
+            one(109, name="2024-01-15"),
+            one(110, name="wl.dotted.name"),
+            one(111, cluster=None),
+        ]
+        return Result(scans=scans)
+
+    def test_yaml_fast_path_byte_equal(self):
+        import json
+
+        import yaml as _yaml
+
+        from krr_tpu.formatters.machine import _YAML_DUMPER, fast_yaml
+
+        data = json.loads(self.adversarial_result().model_dump_json())
+        fast = fast_yaml(data)
+        assert fast is not None
+        assert fast == _yaml.dump(data, sort_keys=False, Dumper=_YAML_DUMPER)
+
+    def test_pprint_fast_path_byte_equal(self):
+        from pprint import pformat
+
+        from krr_tpu.formatters.machine import fast_pformat
+
+        data = self.adversarial_result().model_dump()
+        fast = fast_pformat(data)
+        assert fast is not None
+        assert fast == pformat(data)
+
+    def test_unsafe_scalars_fall_back_never_diverge(self):
+        """Inputs the emitters can't reproduce (foldable/unicode scalars)
+        must yield None — the formatter then uses the library wholesale."""
+        import json
+
+        from krr_tpu.formatters.machine import fast_pformat, fast_yaml
+
+        result = self.adversarial_result()
+        result.scans[0].object.cluster = "a cluster name with spaces " + "x" * 40
+        data = json.loads(result.model_dump_json())
+        assert fast_yaml(data) is None
+        assert fast_pformat(result.model_dump()) is None
+
+        # SHORT unicode renders double-quoted on one line — reproduced
+        # exactly; LONG double-quoted scalars can split mid-word in context,
+        # so they bail.
+        import yaml as _yaml
+
+        from krr_tpu.formatters.machine import _YAML_DUMPER
+
+        result.scans[0].object.cluster = "プロダクション"
+        data = json.loads(result.model_dump_json())
+        short_unicode = fast_yaml(data)
+        assert short_unicode == _yaml.dump(data, sort_keys=False, Dumper=_YAML_DUMPER)
+
+        result.scans[0].object.cluster = "プロダクション" * 12
+        assert fast_yaml(json.loads(result.model_dump_json())) is None
+
+    def test_formatters_engage_fast_path_above_threshold(self, monkeypatch):
+        """End-to-end through the registry: outputs above the threshold equal
+        the library paths exactly (threshold lowered so the slow comparison
+        stays cheap)."""
+        import json
+        from pprint import pformat
+
+        import yaml as _yaml
+
+        import krr_tpu.formatters.machine as machine
+
+        monkeypatch.setattr(machine, "FAST_PATH_THRESHOLD", 10)
+        result = self.adversarial_result()
+        data = json.loads(result.model_dump_json())
+        assert machine.YAMLFormatter().format(result) == _yaml.dump(
+            data, sort_keys=False, Dumper=machine._YAML_DUMPER
+        )
+        assert machine.PPrintFormatter().format(result) == pformat(result.model_dump())
+
+    def test_fast_paths_are_fast_at_fleet_scale(self):
+        from krr_tpu.formatters.machine import PPrintFormatter, YAMLFormatter
+
+        result = make_result(10_000)
+        start = time.perf_counter()
+        out = YAMLFormatter().format(result)
+        yaml_seconds = time.perf_counter() - start
+        assert out.startswith("scans:")
+        start = time.perf_counter()
+        out = PPrintFormatter().format(result)
+        pprint_seconds = time.perf_counter() - start
+        assert out.startswith("{'resources'")
+        # ~0.6 s / ~1.1 s measured; generous bound for rig noise.
+        assert yaml_seconds < 3.0, yaml_seconds
+        assert pprint_seconds < 3.0, pprint_seconds
