@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import sys
 from typing import Iterator, Optional, Union
 
 from rich.cells import cell_len
@@ -112,6 +113,16 @@ class TableFormatter(BaseFormatter):
 
     def format(self, result: Result) -> Union[Table, str]:
         if len(result.scans) > self.FAST_PATH_THRESHOLD:
+            # The switch changes the output's exact shape (plain aligned text
+            # vs rich's console-fitted table, documented in PARITY.md) —
+            # surface it once for anyone parsing table output at fleet scale
+            # (round-4 advisor note). stderr, so piped stdout stays clean.
+            print(
+                f"krr-tpu: {len(result.scans)} scans > {self.FAST_PATH_THRESHOLD}: "
+                "rendering the fleet-scale plain table (fixed-width, not "
+                "console-fitted); use -f json/yaml for machine parsing",
+                file=sys.stderr,
+            )
             return self._format_plain(result)
         table = Table(show_header=True, header_style="bold magenta", title=f"Scan result ({result.score} points)")
         table.add_column("Number", justify="right", no_wrap=True)
