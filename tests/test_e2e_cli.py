@@ -33,6 +33,29 @@ def run_scan(config: Config):
 
 
 class TestRunnerE2E:
+    def test_scan_pauses_cyclic_gc(self, fake_env):  # noqa: F811
+        """Cyclic GC must be OFF while the scan runs (fleet-scale heaps make
+        threshold collections a measured ~2x tax) and restored afterwards."""
+        import gc
+
+        observed: list[bool] = []
+
+        class Inventory:
+            async def list_clusters(self):
+                return ["fake"]
+
+            async def list_scannable_objects(self, clusters):
+                observed.append(gc.isenabled())
+                return []
+
+        assert gc.isenabled()
+        r = Runner(make_config(fake_env, quiet=True), inventory=Inventory())
+        import asyncio
+
+        asyncio.run(r.run())
+        assert observed == [False]
+        assert gc.isenabled()
+
     def test_scan_matches_oracle(self, fake_env):  # noqa: F811
         config = make_config(fake_env, quiet=True)
         result, _ = run_scan(config)

@@ -528,6 +528,22 @@ class TestBatchedFleetQueries:
         np.testing.assert_array_equal(got.cpu_total, expected.cpu_total)
         np.testing.assert_array_equal(got.cpu_peak, expected.cpu_peak)
 
+    def test_halved_retry_status_policy(self):
+        """422/413 always earn the halved-window retry; 400 only when the
+        body names the sample limit — a blanket 400 retry would double the
+        failure latency of permanently malformed queries (round-4 advisor)."""
+        from krr_tpu.integrations.prometheus import PrometheusQueryError
+
+        worthwhile = PrometheusLoader._halved_retry_worthwhile
+        assert worthwhile(PrometheusQueryError(422, "query would load too many samples"))
+        assert worthwhile(PrometheusQueryError(413, ""))
+        assert worthwhile(
+            PrometheusQueryError(400, "query processing would load too many samples into memory")
+        )
+        assert not worthwhile(PrometheusQueryError(400, 'parse error: unexpected "{"'))
+        assert not worthwhile(PrometheusQueryError(403, "forbidden"))
+        assert not worthwhile(PrometheusQueryError(500, "boom"))
+
     def test_sinkless_streamed_digest_returns_entries(self, fake_env):
         """`_query_range_digest` WITHOUT a sink (the API path for callers
         outside `gather_fleet_digests`) must return per-entry tuples on the

@@ -420,6 +420,70 @@ class TestStreamingIngest:
                 stream.abort()  # clean Python exceptions are acceptable
 
 
+class TestStreamFoldInto:
+    """The fleet-fold readout path: finish_parse + read_meta +
+    fold_counts_into against the buffered digest oracle, plus the error
+    contract (row skips, shape mismatches, stats-mode rejection) and the
+    reserve hint's transparency."""
+
+    GAMMA, MINV, BUCKETS = 1.05, 1e-7, 64
+
+    def _stream(self, body: bytes, reserve: int = 0):
+        stream = native.open_stream(self.GAMMA, self.MINV, self.BUCKETS, reserve_series=reserve)
+        assert stream is not None
+        stream.feed(body)
+        return stream.finish_parse()
+
+    def test_fold_matches_oracle_with_skips_and_reserve(self, library_available, rng):
+        body = make_response(
+            [(f"pod-{i}", list(rng.gamma(2.0, 0.3, 23))) for i in range(7)]
+        )
+        oracle = native.parse_matrix_digest(body, self.GAMMA, self.MINV, self.BUCKETS)
+        for reserve in (0, 3, 64):  # under-, exact-ish, over-reservation
+            stream = self._stream(body, reserve=reserve)
+            names, totals, peaks = stream.read_meta()
+            keys = native._split_keys(names, len(totals))
+            assert keys == [e[0] for e in oracle]
+            np.testing.assert_array_equal(totals, [e[2] for e in oracle])
+            np.testing.assert_array_equal(peaks, [e[3] for e in oracle])
+            # Rows 0/2/4/6 fold into accumulator rows 3/2/1/0; odd series skip.
+            dst = np.zeros((4, self.BUCKETS), dtype=np.float64)
+            rows = np.array([3, -1, 2, -1, 1, -1, 0], dtype=np.int64)
+            stream.fold_counts_into(rows, dst)
+            stream.free()
+            for series_index, dst_row in ((0, 3), (2, 2), (4, 1), (6, 0)):
+                np.testing.assert_array_equal(dst[dst_row], oracle[series_index][1])
+
+    def test_fold_accumulates_on_repeat(self, library_available, rng):
+        body = make_response([("p", list(rng.gamma(2.0, 0.3, 11)))])
+        oracle = native.parse_matrix_digest(body, self.GAMMA, self.MINV, self.BUCKETS)
+        dst = np.zeros((1, self.BUCKETS), dtype=np.float64)
+        for _ in range(3):
+            stream = self._stream(body)
+            stream.fold_counts_into(np.array([0], dtype=np.int64), dst)
+            stream.free()
+        np.testing.assert_array_equal(dst[0], oracle[0][1] * 3)
+
+    def test_shape_and_mode_errors(self, library_available, rng):
+        body = make_response([("p", [0.5, 1.5]), ("q", [2.5])])
+        stream = self._stream(body)
+        dst = np.zeros((2, self.BUCKETS), dtype=np.float64)
+        with pytest.raises(AssertionError):  # rows length must equal series count
+            stream.fold_counts_into(np.array([0], dtype=np.int64), dst)
+        with pytest.raises(ValueError):  # row index out of range
+            stream.fold_counts_into(np.array([0, 5], dtype=np.int64), dst)
+        stream.free()
+
+        stats = native.open_stream(0.0, 0.0, 0)
+        stats.feed(body)
+        stats.finish_parse()
+        names, totals, peaks = stats.read_meta()  # meta readout works in stats mode
+        assert len(totals) == 2
+        with pytest.raises((ValueError, AssertionError)):  # counts fold does not
+            stats.fold_counts_into(np.zeros(2, dtype=np.int64), dst)
+        stats.free()
+
+
 class TestParserFuzz:
     def test_mutated_bodies_never_crash(self, library_available, rng):
         """The C scanner must reject or survive arbitrary corruption —
