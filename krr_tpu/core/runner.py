@@ -145,6 +145,7 @@ class Runner:
         settings = self._strategy.settings
         history_seconds = settings.history_timedelta.total_seconds()
         step_seconds = settings.timeframe_timedelta.total_seconds()
+        stats_resources = frozenset(getattr(self._strategy, "stats_only_resources", ()) or ())
 
         by_cluster: dict[Optional[str], list[int]] = {}
         for i, obj in enumerate(objects):
@@ -152,12 +153,32 @@ class Runner:
 
         histories = _empty_histories(objects)
 
+        def source_kwargs(source) -> dict:
+            """end_time plus, for sources that support it, the strategy's
+            stats-only resources (fetched as per-pod (count, max) and
+            represented as one synthetic max-sample per pod — identical
+            results for max-only consumers; true sample counts are NOT
+            preserved; see ``BaseStrategy.stats_only_resources``). Sources
+            without the parameter (simple fakes, third-party backends) are
+            handed the plain call and keep returning full series."""
+            kwargs = self._end_time_kwargs()
+            if stats_resources:
+                import inspect
+
+                try:
+                    parameters = inspect.signature(source.gather_fleet).parameters
+                except (TypeError, ValueError):
+                    parameters = {}
+                if "stats_resources" in parameters:
+                    kwargs["stats_resources"] = stats_resources
+            return kwargs
+
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
             try:
                 source = self._get_history_source(cluster)
                 fetched = await source.gather_fleet(
-                    subset, history_seconds, step_seconds, **self._end_time_kwargs()
+                    subset, history_seconds, step_seconds, **source_kwargs(source)
                 )
             except Exception as e:
                 self.logger.warning(

@@ -1166,6 +1166,7 @@ class PrometheusLoader:
         history_seconds: float,
         step_seconds: float,
         end_time: Optional[float] = None,
+        stats_resources: "frozenset[ResourceType]" = frozenset(),
     ) -> dict[ResourceType, list[RaggedHistory]]:
         """Fetch per-pod series for the whole fleet.
 
@@ -1177,6 +1178,20 @@ class PrometheusLoader:
         queries still fail degrade to empty histories (→ UNKNOWN scans) rather
         than failing the run. ``end_time`` pins the window's right edge
         (reproducible scans; defaults to now).
+
+        ``stats_resources`` (see ``BaseStrategy.stats_only_resources``):
+        resources the strategy consumes only through each pod's exact MAX —
+        the reference's memory recommendation, max × 1.05
+        (`/root/reference/robusta_krr/strategies/simple.py:24-29`). Those
+        fetch through the streamed STATS route (no histogram, no raw sample
+        arrays, faster native sink) and each pod's history is ONE synthetic
+        sample: its exact max. max-of-maxes equals max-of-all-samples and
+        empty pods stay absent, so results are identical for max-only
+        consumers (true per-pod sample counts are NOT preserved — every
+        present pod reads as one sample) — while the packed device batch
+        for that resource shrinks from [rows × T] to [rows × pods],
+        removing what is at fleet scale the LARGER of the two host→device
+        transfers (memory histories are float64; CPU packs float32).
         """
         await self._ensure_connected()
         end = datetime.datetime.now().timestamp() if end_time is None else end_time
@@ -1191,20 +1206,28 @@ class PrometheusLoader:
                 return
             pod_regex = "|".join(re.escape(pod) for pod in obj.pods)
             query = QUERY_BUILDERS[resource](obj.namespace, pod_regex, obj.container)
+            wanted = set(obj.pods)
+            history: RaggedHistory = {}
             try:
-                series = await self._query_range(
-                    query, start, end, step_seconds, expected_series=len(obj.pods)
-                )
+                if resource in stats_resources:
+                    for (pod, _c), total, peak in await self._query_range_stats(
+                        query, start, end, step_seconds, expected_series=len(obj.pods)
+                    ):
+                        # First series per pod; drop sample-less pods — the
+                        # same rules as the full-series branch below.
+                        if pod in wanted and total > 0 and pod not in history:
+                            history[pod] = np.asarray([peak], dtype=np.float64)
+                else:
+                    for (pod, _container), samples in await self._query_range(
+                        query, start, end, step_seconds, expected_series=len(obj.pods)
+                    ):
+                        # Keep only the first series per pod; drop pods without
+                        # samples (reference `prometheus.py:152-154`).
+                        if pod in wanted and samples.size and pod not in history:
+                            history[pod] = samples
             except Exception as e:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
-            wanted = set(obj.pods)
-            history: RaggedHistory = {}
-            for (pod, _container), samples in series:
-                # Keep only the first series per pod; drop pods without
-                # samples (reference `prometheus.py:152-154`).
-                if pod in wanted and samples.size and pod not in history:
-                    history[pod] = samples
             histories[resource][i] = history
 
         async def per_namespace(
@@ -1213,13 +1236,29 @@ class PrometheusLoader:
             query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
             route = self._series_route(objects, indices)
             expected = await self._expected_series(query, route, end)
-            series = await self._query_range(
-                query, start, end, step_seconds,
-                expected_series=expected, keep=set(route), points_divisor=points_divisor,
-            )
+            if resource in stats_resources:
+                series: list = [
+                    (key, np.asarray([peak], dtype=np.float64))
+                    for key, total, peak in await self._query_range_stats(
+                        query, start, end, step_seconds,
+                        expected_series=expected, keep=set(route),
+                        points_divisor=points_divisor,
+                    )
+                    if total > 0
+                ]
+            else:
+                series = [
+                    (key, samples)
+                    for key, samples in await self._query_range(
+                        query, start, end, step_seconds,
+                        expected_series=expected, keep=set(route),
+                        points_divisor=points_divisor,
+                    )
+                    if samples.size
+                ]
             self._route_series(
                 route,
-                [(key, samples) for key, samples in series if samples.size],
+                series,
                 lambda i, key, samples: histories[resource][i].__setitem__(key[0], samples),
             )
 

@@ -79,10 +79,27 @@ class BaseStrategy(abc.ABC, Generic[_S]):
             (`run_batch_row_chunks`). True for row-local strategies (every
             built-in; also the per-object compat path by construction). Set
             False on a plugin whose ``run_batch`` looks across objects.
+        stats_only_resources: resources this strategy consumes only through
+            each pod's exact MAX (plus sample presence) — e.g. the
+            reference's memory recommendation, max × 1.05. Sources that
+            support it (the Prometheus loader) then ingest those resources
+            through the cheaper stats route (no per-sample histogram work,
+            no raw sample arrays) and the ragged history carries ONE
+            synthetic sample per pod: its exact max. Results are identical
+            for max-only consumers (max of per-pod maxes == max of all
+            samples; pods without samples stay absent) while the packed
+            device batch shrinks from [rows × T] to [rows × pods] — at
+            fleet scale that removes the larger of the two host→device
+            transfers entirely. True per-pod sample COUNTS are NOT
+            preserved (every present pod reads as one sample), and
+            per-sample values other than the max are gone — a plugin that
+            consumes either for such a resource MUST override this back to
+            ``frozenset()``.
     """
 
     __display_name__: str
     row_chunkable: bool = True
+    stats_only_resources: "frozenset[ResourceType]" = frozenset()
 
     settings: _S
 

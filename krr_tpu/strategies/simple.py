@@ -200,6 +200,11 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
     exact on the mesh too (integer psum per bisection step)."""
 
     __display_name__ = "simple"
+    #: Memory is max × 1.05 (reference `strategies/simple.py:24-29`): only
+    #: each pod's exact max matters, so sources may ingest memory through
+    #: the stats route — identical output, no raw memory arrays, and the
+    #: fleet batch ships [rows × pods] to the device instead of [rows × T].
+    stats_only_resources = frozenset({ResourceType.Memory})
 
     def _streamed_exact(self, batch: FleetBatch, q: float, mesh):
         """Exact recommendations with the window streamed from host (window

@@ -56,6 +56,18 @@ class TestRunnerE2E:
         assert observed == [False]
         assert gc.isenabled()
 
+    def test_stats_ingest_equals_full_series(self, fake_env, monkeypatch):  # noqa: F811
+        """`simple` declares memory stats-only (one synthetic max-sample per
+        pod instead of full series): the scan output must be byte-identical
+        to the full-series route — max-of-maxes IS max-of-samples."""
+        from krr_tpu.strategies.simple import SimpleStrategy
+
+        config = make_config(fake_env, quiet=True)
+        stats_result, _ = run_scan(config)
+        monkeypatch.setattr(SimpleStrategy, "stats_only_resources", frozenset())
+        full_result, _ = run_scan(config)
+        assert stats_result.model_dump_json() == full_result.model_dump_json()
+
     def test_scan_matches_oracle(self, fake_env):  # noqa: F811
         config = make_config(fake_env, quiet=True)
         result, _ = run_scan(config)
