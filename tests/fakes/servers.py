@@ -117,6 +117,15 @@ class FakeMetrics:
     #: the loader must surface it as a failed query, never parse the
     #: redirect body as an empty result.
     redirect_queries: bool = False
+    #: Targeted window failure: while ``fail_range_times > 0``, range queries
+    #: whose [start, end] covers ``fail_range_at`` AND whose resource matches
+    #: ``fail_range_resource`` ("cpu"/"mem") get a transient 500. Lets a test
+    #: fail ONE sub-window of a split fetch until the loader's retries
+    #: exhaust, while sibling windows succeed — the partial-ingest unwind
+    #: scenario (streamed digests fold into fleet rows as windows land).
+    fail_range_at: Optional[float] = None
+    fail_range_times: int = 0
+    fail_range_resource: str = "cpu"
     #: When set, range queries require `Authorization: Bearer <this>` and
     #: 401 otherwise — exercising the loader's mid-scan credential refresh.
     require_bearer: Optional[str] = None
@@ -314,6 +323,17 @@ class FakeBackend:
         step_sec = self._step_seconds(str(params.get("step", "1m")))
         req_start = float(params.get("start", 0))
         req_end = float(params.get("end", req_start))
+        if (
+            self.metrics.fail_range_at is not None
+            and self.metrics.fail_range_times > 0
+            and req_start <= self.metrics.fail_range_at <= req_end
+            and ("cpu_usage" in str(params.get("query", "")))
+            == (self.metrics.fail_range_resource == "cpu")
+        ):
+            self.metrics.fail_range_times -= 1
+            return web.json_response(
+                {"status": "error", "error": "injected window failure"}, status=500
+            )
         if (req_end - req_start) // step_sec + 1 > self.MAX_RANGE_POINTS:
             return web.json_response(
                 {"status": "error", "error": "exceeded maximum resolution of 11,000 points per timeseries"},

@@ -450,6 +450,43 @@ class TestBatchedFleetQueries:
                         histories[resource][i][pod], reference[resource][i][pod]
                     )
 
+    def test_partial_window_failure_unwinds_before_retry(self, fake_env):
+        """Streamed digest windows fold into the fleet arrays AS THEY LAND,
+        so when one sub-window exhausts its retries after siblings already
+        folded, the partial folds must be cleared before the halved-window
+        retry refetches — anything else double-counts every sample the
+        failed attempt delivered."""
+        from tests.fakes.servers import FakeBackend
+
+        metrics = fake_env["metrics"]
+        config = make_config(fake_env, prometheus_max_streamed_samples=120)
+        objects = [
+            o
+            for o in asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
+            if o.namespace == "default"
+        ]
+        scan_end = FakeBackend.SERIES_ORIGIN + 47 * 60
+        metrics.enforce_range = True
+        try:
+            # 4 series in "default" × 120-sample budget ⇒ 30-point windows
+            # (61 points ⇒ 3 windows). Fail ONLY the middle window's queries,
+            # exactly as many times as the loader retries.
+            baseline = self._gather_digests(config, objects, end_time=scan_end)
+            metrics.fail_range_at = FakeBackend.SERIES_ORIGIN + 2000
+            metrics.fail_range_times = 3
+            metrics.fail_range_resource = "cpu"
+            throttled = self._gather_digests(config, objects, end_time=scan_end)
+            assert metrics.fail_range_times == 0  # the injection really ran
+        finally:
+            metrics.fail_range_at = None
+            metrics.enforce_range = False
+            metrics._batched_bodies.clear()
+        np.testing.assert_array_equal(throttled.cpu_counts, baseline.cpu_counts)
+        np.testing.assert_array_equal(throttled.cpu_total, baseline.cpu_total)
+        np.testing.assert_array_equal(throttled.cpu_peak, baseline.cpu_peak)
+        np.testing.assert_array_equal(throttled.mem_total, baseline.mem_total)
+        np.testing.assert_array_equal(throttled.mem_peak, baseline.mem_peak)
+
     def test_fleet_fold_sink_matches_naive_routing(self, rng):
         """The direct-into-fleet streamed fold (`_FleetFoldSink` over real
         native streams) must equal a naive parse+route+merge on every
