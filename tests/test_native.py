@@ -420,6 +420,37 @@ class TestStreamingIngest:
                 stream.abort()  # clean Python exceptions are acceptable
 
 
+class TestDigestBoundaryExactness:
+    """The streamed fold's boundary-table binary search must agree with the
+    log-based rule at the hardest inputs: doubles AT and ±1 ulp around every
+    bucket boundary (the buffered parser keeps the log fold, so equality
+    here pins the table's bit-exactness)."""
+
+    def test_stream_matches_buffered_at_bucket_edges(self, library_available):
+        gamma, minv, buckets = 1.08, 1e-7, 64
+        edges = minv * gamma ** np.arange(0, buckets + 2, dtype=np.float64)
+        candidates = np.concatenate(
+            [
+                edges,
+                np.nextafter(edges, np.inf),
+                np.nextafter(edges, -np.inf),
+                [minv, np.nextafter(minv, np.inf), np.nextafter(minv, 0.0), 0.0,
+                 minv * gamma ** (buckets + 50), 1e308],
+            ]
+        )
+        candidates = candidates[np.isfinite(candidates)]
+        body = make_response([("edge-pod", list(candidates))])
+        oracle = native.parse_matrix_digest(body, gamma, minv, buckets)
+        stream = native.open_stream(gamma, minv, buckets)
+        assert stream is not None
+        for i in range(0, len(body), 7919):  # awkward chunking for good measure
+            stream.feed(body[i:i + 7919])
+        keys, counts, totals, peaks = stream.finish()
+        assert keys == [oracle[0][0]]
+        np.testing.assert_array_equal(counts[0], oracle[0][1])
+        assert totals[0] == oracle[0][2] and peaks[0] == oracle[0][3]
+
+
 class TestStreamFoldInto:
     """The fleet-fold readout path: finish_parse + read_meta +
     fold_counts_into against the buffered digest oracle, plus the error
