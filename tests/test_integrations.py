@@ -355,6 +355,33 @@ class TestBatchedFleetQueries:
         np.testing.assert_array_equal(streamed.mem_total, buffered.mem_total)
         np.testing.assert_array_equal(streamed.mem_peak, buffered.mem_peak)
 
+    def test_stats_resources_buffered_fallback_equals_streamed(self, fake_env, monkeypatch):
+        """gather_fleet's stats-only route (synthetic one-max-sample pods)
+        must produce identical histories through the native stream and the
+        buffered fallback, and the synthetic arrays must equal the full
+        series' per-pod max."""
+        from krr_tpu.integrations import native
+
+        stats = frozenset({ResourceType.Memory})
+        objects = asyncio.run(
+            KubernetesLoader(make_config(fake_env)).list_scannable_objects(["fake"])
+        )
+        streamed = self._gather(make_config(fake_env), objects, stats_resources=stats)
+        full = self._gather(make_config(fake_env), objects)
+        monkeypatch.setattr(native, "stream_available", lambda: False)
+        buffered = self._gather(make_config(fake_env), objects, stats_resources=stats)
+        for resource in ResourceType:
+            for i in range(len(objects)):
+                assert streamed[resource][i].keys() == buffered[resource][i].keys()
+                assert streamed[resource][i].keys() == full[resource][i].keys()
+                for pod, samples in streamed[resource][i].items():
+                    np.testing.assert_array_equal(samples, buffered[resource][i][pod])
+                    if resource in stats:
+                        assert samples.shape == (1,)
+                        assert samples[0] == full[resource][i][pod].max()
+                    else:
+                        np.testing.assert_array_equal(samples, full[resource][i][pod])
+
     def test_proxied_digest_ingest_streams_without_body(self, fake_env, monkeypatch):
         """Proxied environments (raw transport declined) must still get the
         zero-materialization ingest: response bytes feed the native stream
