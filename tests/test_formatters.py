@@ -296,6 +296,74 @@ class TestMachineFastPaths:
         )
         assert machine.PPrintFormatter().format(result) == pformat(result.model_dump())
 
+    def test_randomized_results_byte_equal_or_fall_back(self):
+        """Property sweep: across seeded random results with names drawn
+        from a nasty charset (digits, dots, dashes, yaml indicator chars,
+        unicode, spaces), the fast emitters either byte-match the library
+        paths or return None (library fallback) — never a divergent byte."""
+        import json
+        import random
+        from pprint import pformat
+
+        import yaml as _yaml
+
+        from krr_tpu.formatters.machine import _YAML_DUMPER, fast_pformat, fast_yaml
+
+        alphabets = [
+            "abcdefghijklmnop-0123456789",
+            "0123456789.",
+            "αβγδε漢字-x",
+            "abc xyz_",
+            "a:b/c@d%e'f\"g",
+        ]
+
+        def name(rng):
+            alphabet = rng.choice(alphabets)
+            return "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 30)))
+
+        fallbacks = 0
+        for seed in range(12):
+            rng = random.Random(seed)
+            scans = []
+            for i in range(rng.randint(1, 8)):
+                allocations = ResourceAllocations(
+                    requests={ResourceType.CPU: Decimal(str(rng.uniform(0.01, 4))),
+                              ResourceType.Memory: None},
+                    limits={ResourceType.CPU: None,
+                            ResourceType.Memory: Decimal(str(rng.randint(1, 10) * 10**8))},
+                )
+                rec = ResourceAllocations(
+                    requests={ResourceType.CPU: "?" if rng.random() < 0.2
+                              else Decimal(str(rng.uniform(0.01, 4))),
+                              ResourceType.Memory: Decimal(str(rng.randint(1, 9) * 10**8))},
+                    limits={ResourceType.CPU: None, ResourceType.Memory: None},
+                )
+                scans.append(ResourceScan.calculate(
+                    K8sObjectData(
+                        cluster=name(rng) if rng.random() < 0.8 else None,
+                        namespace=name(rng), name=name(rng),
+                        kind=rng.choice(["Deployment", "Job", None]),
+                        container=name(rng),
+                        pods=[name(rng) for _ in range(rng.randint(0, 4))],
+                        allocations=allocations,
+                    ),
+                    rec,
+                ))
+            result = Result(scans=scans)
+
+            data = json.loads(result.model_dump_json())
+            fast = fast_yaml(data)
+            if fast is None:
+                fallbacks += 1
+            else:
+                assert fast == _yaml.dump(data, sort_keys=False, Dumper=_YAML_DUMPER), seed
+
+            dumped = result.model_dump()
+            fast_p = fast_pformat(dumped)
+            if fast_p is not None:
+                assert fast_p == pformat(dumped), seed
+        assert fallbacks < 12  # the fast path engages for most seeds
+
     def test_fast_paths_are_fast_at_fleet_scale(self):
         from krr_tpu.formatters.machine import PPrintFormatter, YAMLFormatter
 
