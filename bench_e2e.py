@@ -265,7 +265,12 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
             strategy="tdigest", other_args={"digest_ingest": True}
         )
         cold_elapsed, cold_stats = one_scan(config)
-        elapsed, stats = one_scan(config)  # warm: fake's window bodies cached
+        # Warm: fake's window bodies cached. Best-of-2, matching the kernel
+        # legs' best-of-N convention — a single warm scan put the shared
+        # core's ±20% wobble straight into the round record.
+        elapsed, stats = min(
+            (one_scan(config) for _ in range(2)), key=lambda pair: pair[0]
+        )
     return {
         "fleet_e2e_containers": int(stats["objects"]),
         "fleet_e2e_objects_per_sec": round(stats["objects"] / elapsed, 1),
