@@ -415,7 +415,14 @@ def main() -> None:
             "regression_vs_previous": regression,
         }
     else:
-        previous_fields = {"vs_previous_round": None}
+        # Same shape with or without a recorded previous round — gate
+        # scripts read these fields unconditionally.
+        previous_fields = {
+            "vs_previous_round": None,
+            "previous_round_file": None,
+            "previous_round_stable_rate": None,
+            "regression_vs_previous": False,
+        }
 
     print(
         json.dumps(

@@ -382,7 +382,8 @@ class StreamIngest:
         identical bytes across windows mean an identical series list, so
         callers can reuse a cached mapping without decoding."""
         with self._op_lock:
-            assert self._handle is not None and self._count is not None
+            if self._handle is None or self._count is None:
+                raise ValueError("read_meta requires a live, parse-finished stream")
             n = self._count
             totals = np.empty(n, dtype=np.float64)
             peaks = np.empty(n, dtype=np.float64)
@@ -409,11 +410,23 @@ class StreamIngest:
         the caller's [n_rows × num_buckets] float64 accumulator (digest mode
         only). Requires :meth:`finish_parse`."""
         with self._op_lock:
-            assert self._handle is not None and self._count is not None
-            assert dst.dtype == np.float64 and dst.flags["C_CONTIGUOUS"]
-            assert dst.ndim == 2 and dst.shape[1] == self._num_buckets
+            # Real exceptions, not asserts: these guard a raw native write —
+            # stripped under ``python -O`` they would become out-of-bounds
+            # memory corruption instead of a caller error.
+            if self._handle is None or self._count is None:
+                raise ValueError("fold_counts_into requires a live, parse-finished stream")
+            if not (
+                dst.dtype == np.float64
+                and dst.flags["C_CONTIGUOUS"]
+                and dst.ndim == 2
+                and dst.shape[1] == self._num_buckets
+            ):
+                raise ValueError(
+                    f"dst must be C-contiguous float64 [rows × {self._num_buckets}]"
+                )
             rows = np.ascontiguousarray(rows, dtype=np.int64)
-            assert rows.shape == (self._count,)
+            if rows.shape != (self._count,):
+                raise ValueError(f"rows must cover all {self._count} series")
             rc = self._lib.krr_stream_fold_into(
                 self._handle,
                 rows.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),

@@ -740,6 +740,10 @@ class PrometheusLoader:
             self._cpu = resource is ResourceType.CPU
             self._cached_names: Optional[bytes] = None
             self._cached_passes: Optional[list[np.ndarray]] = None
+            #: consume runs OFF the event loop (worker threads) at fleet
+            #: width; windows of the same query target the same fleet rows,
+            #: so their folds must serialize.
+            self._fold_lock = threading.Lock()
 
         def _row_passes(self, keys: list) -> "list[np.ndarray]":
             """Row maps covering every (series, target) pair: the main pass
@@ -777,25 +781,26 @@ class PrometheusLoader:
             from krr_tpu.integrations.native import _split_keys
 
             try:
-                names, totals, peaks = stream.read_meta()
-                if self._cached_names is not None and names == self._cached_names:
-                    passes = self._cached_passes
-                else:
-                    passes = self._row_passes(_split_keys(names, len(totals)))
-                    self._cached_names, self._cached_passes = names, passes
-                fleet = self._fleet
-                for rows in passes:
-                    valid = rows >= 0
-                    if not valid.any():
-                        continue
-                    targets = rows[valid]
-                    if self._cpu:
-                        np.add.at(fleet.cpu_total, targets, totals[valid])
-                        np.maximum.at(fleet.cpu_peak, targets, peaks[valid])
-                        stream.fold_counts_into(rows, fleet.cpu_counts)
+                with self._fold_lock:
+                    names, totals, peaks = stream.read_meta()
+                    if self._cached_names is not None and names == self._cached_names:
+                        passes = self._cached_passes
                     else:
-                        np.add.at(fleet.mem_total, targets, totals[valid])
-                        np.maximum.at(fleet.mem_peak, targets, peaks[valid])
+                        passes = self._row_passes(_split_keys(names, len(totals)))
+                        self._cached_names, self._cached_passes = names, passes
+                    fleet = self._fleet
+                    for rows in passes:
+                        valid = rows >= 0
+                        if not valid.any():
+                            continue
+                        targets = rows[valid]
+                        if self._cpu:
+                            np.add.at(fleet.cpu_total, targets, totals[valid])
+                            np.maximum.at(fleet.cpu_peak, targets, peaks[valid])
+                            stream.fold_counts_into(rows, fleet.cpu_counts)
+                        else:
+                            np.add.at(fleet.mem_total, targets, totals[valid])
+                            np.maximum.at(fleet.mem_peak, targets, peaks[valid])
             finally:
                 stream.free()
 
@@ -826,11 +831,14 @@ class PrometheusLoader:
         (``return_exceptions``): raising early would leave the other windows'
         multi-MB downloads running orphaned in the semaphore — and their
         exceptions unretrieved — while the caller has already written the
-        object off.
+        object off. ``consume`` may return an awaitable (the fleet-fold sink
+        runs its CPU-bound window fold off the loop).
         """
 
         async def one(index: int, w_start: float, w_end: float) -> None:
-            consume(index, await fetch_entries(w_start, w_end))
+            outcome = consume(index, await fetch_entries(w_start, w_end))
+            if outcome is not None and hasattr(outcome, "__await__"):
+                await outcome
 
         max_points = window_points_cap(expected_series, max_samples)
         if points_divisor > 1:
@@ -958,9 +966,18 @@ class PrometheusLoader:
         else:
             fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
 
+        if use_sink:
+            # Off the loop: a window's consume is a Python routing pass plus
+            # vectorized/native folds over up to fleet-width state — tens to
+            # ~150 ms that would stall every concurrent fetch (and the httpx
+            # route's chunk pump) if run inline; the sink's fold lock
+            # serializes same-query windows across worker threads.
+            def sink_consume(index, stream):
+                return asyncio.to_thread(stream_sink.consume, index, stream)
+
         await self._window_fan_out(
             start, end, step_seconds, expected_series, fetch_entries,
-            stream_sink.consume if use_sink else consume,
+            sink_consume if use_sink else consume,
             # Streamed windows never hold the body — their looser cap trades
             # retry granularity for fewer windows (less fixed per-window cost
             # AND less concurrent native state). The buffered fallback (no
