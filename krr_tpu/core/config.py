@@ -86,6 +86,18 @@ class Config(pd.BaseModel):
     #: benchmarking against recorded history. Default: now.
     scan_end_timestamp: Optional[float] = None
 
+    # Server (`krr-tpu serve`) settings
+    server_host: str = "127.0.0.1"
+    #: 0 = an ephemeral port (tests; the chosen port is logged).
+    server_port: int = pd.Field(8080, ge=0, le=65535)
+    #: Seconds between incremental delta scans (each fetches only the window
+    #: since the last fold).
+    scan_interval_seconds: float = pd.Field(900.0, gt=0)
+    #: Seconds between fleet re-discoveries (workload churn pickup + store
+    #: compaction); effectively rounded up to the scan cadence, since
+    #: discovery staleness is checked at each scan tick.
+    discovery_interval_seconds: float = pd.Field(3600.0, gt=0)
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

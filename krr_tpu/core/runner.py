@@ -8,6 +8,12 @@ inverted for the TPU: instead of per-object asyncio tasks each firing per-pod
 range queries and a per-object strategy call, the runner bulk-fetches the whole
 fleet into a ``FleetBatch`` and makes ONE ``run_batch`` call (SURVEY.md §7).
 
+The discovery/fetch machinery lives in :class:`ScanSession`, a REUSABLE scan
+state (inventory + per-cluster history sources + strategy): the one-shot
+:class:`Runner` drives a session once per process, while ``krr-tpu serve``
+(`krr_tpu.server`) keeps one resident and re-invokes discovery and
+delta-windowed digest fetches incrementally across its lifetime.
+
 Failure semantics (SURVEY.md §5 "failure detection"): a cluster whose
 Prometheus can't be reached degrades to empty histories for its objects —
 their scans render as UNKNOWN (``?``) instead of aborting the run.
@@ -64,12 +70,48 @@ def _empty_histories(objects: list[K8sObjectData]) -> dict[ResourceType, list[Ra
     return {resource: [{} for _ in objects] for resource in ResourceType}
 
 
-class Runner:
-    """End-to-end scan orchestration.
+def round_allocations(
+    raw: RunResult, *, cpu_min_value: int, memory_min_value: int
+) -> ResourceAllocations:
+    """A strategy's raw per-object result, rounded to servable allocations —
+    shared by the one-shot Runner and the serve scheduler so the two can
+    never round differently."""
+    return ResourceAllocations(
+        requests={
+            resource: round_value(
+                raw[resource].request,
+                resource,
+                cpu_min_value=cpu_min_value,
+                memory_min_value=memory_min_value,
+            )
+            for resource in ResourceType
+        },
+        limits={
+            resource: round_value(
+                raw[resource].limit,
+                resource,
+                cpu_min_value=cpu_min_value,
+                memory_min_value=memory_min_value,
+            )
+            for resource in ResourceType
+        },
+    )
 
-    ``inventory_factory`` / ``history_factory`` are injectable so tests (and
+
+class ScanSession:
+    """Reusable scan state: strategy + inventory + per-cluster history sources.
+
+    ``inventory`` / ``history_factory`` are injectable so tests (and
     alternative backends) can swap the cluster/metrics integrations; the
-    defaults build the real Kubernetes and Prometheus loaders.
+    defaults build the real Kubernetes and Prometheus loaders. Sources are
+    cached per cluster (failures too — one broken cluster fails fast instead
+    of retrying per call), which is exactly what a long-lived server wants:
+    connections, auth state, and the native ingest stay warm across scans.
+
+    The fetch entry points accept an explicit time window
+    (``history_seconds`` / ``end_time``) overriding the strategy settings —
+    the serve scheduler's delta scans fetch only the window since the last
+    tick and fold it into resident digests.
     """
 
     def __init__(
@@ -88,21 +130,20 @@ class Runner:
         from krr_tpu.utils.compile_cache import enable_compilation_cache
 
         enable_compilation_cache(config.jax_compilation_cache_dir)
-        self._strategy = config.create_strategy()
+        self.strategy = config.create_strategy()
         self._inventory = inventory
         self._history_factory = history_factory
         self._history_sources: dict[Optional[str], Union[HistorySource, Exception]] = {}
-        self.stats: dict[str, float] = {}
 
     # ------------------------------------------------------------- plumbing
-    def _get_inventory(self) -> InventorySource:
+    def get_inventory(self) -> InventorySource:
         if self._inventory is None:
             from krr_tpu.integrations.kubernetes import KubernetesLoader
 
             self._inventory = KubernetesLoader(self.config, logger=self.logger)
         return self._inventory
 
-    def _get_history_source(self, cluster: Optional[str]) -> HistorySource:
+    def get_history_source(self, cluster: Optional[str]) -> HistorySource:
         if cluster not in self._history_sources:
             try:
                 if self._history_factory is not None:
@@ -120,32 +161,37 @@ class Runner:
             raise source
         return source
 
-    def _end_time_kwargs(self) -> dict:
+    def _end_time_kwargs(self, end_time: Optional[float]) -> dict:
         """``{"end_time": ...}`` when the scan window's right edge is pinned
-        (`--scan-end-timestamp`), else {} — so sources without the parameter
-        (simple fakes, third-party backends) keep working unpinned."""
-        if self.config.scan_end_timestamp is None:
+        (an explicit ``end_time`` or `--scan-end-timestamp`), else {} — so
+        sources without the parameter (simple fakes, third-party backends)
+        keep working unpinned."""
+        if end_time is None:
+            end_time = self.config.scan_end_timestamp
+        if end_time is None:
             return {}
-        return {"end_time": self.config.scan_end_timestamp}
+        return {"end_time": end_time}
 
-    def _greet(self) -> None:
-        self.logger.echo(ASCII_LOGO, no_prefix=True, markup=True)
-        self.logger.echo(f"Running krr-tpu (TPU-native Kubernetes Resource Recommender) {get_version()}", no_prefix=True)
-        self.logger.echo(f"Using strategy: {self._strategy}", no_prefix=True)
-        self.logger.echo(f"Using formatter: {self.config.format}", no_prefix=True)
-        self.logger.echo(no_prefix=True)
+    async def discover(self) -> list[K8sObjectData]:
+        """List clusters + scannable objects (one inventory round)."""
+        inventory = self.get_inventory()
+        clusters = await inventory.list_clusters()
+        self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
+        return await inventory.list_scannable_objects(clusters)
 
-    # ------------------------------------------------------------- the scan
-    async def _gather_fleet_history(self, objects: list[K8sObjectData]) -> FleetBatch:
+    # ------------------------------------------------------------- fetching
+    async def gather_fleet_history(
+        self, objects: list[K8sObjectData], *, end_time: Optional[float] = None
+    ) -> FleetBatch:
         """Bulk-fetch usage history for every object, grouped per cluster.
 
         Clusters fetch concurrently; a failing cluster degrades to empty
         histories (scans become UNKNOWN) with a logged warning.
         """
-        settings = self._strategy.settings
+        settings = self.strategy.settings
         history_seconds = settings.history_timedelta.total_seconds()
         step_seconds = settings.timeframe_timedelta.total_seconds()
-        stats_resources = frozenset(getattr(self._strategy, "stats_only_resources", ()) or ())
+        stats_resources = frozenset(getattr(self.strategy, "stats_only_resources", ()) or ())
 
         by_cluster: dict[Optional[str], list[int]] = {}
         for i, obj in enumerate(objects):
@@ -161,7 +207,7 @@ class Runner:
             preserved; see ``BaseStrategy.stats_only_resources``). Sources
             without the parameter (simple fakes, third-party backends) are
             handed the plain call and keep returning full series."""
-            kwargs = self._end_time_kwargs()
+            kwargs = self._end_time_kwargs(end_time)
             if stats_resources:
                 import inspect
 
@@ -176,7 +222,7 @@ class Runner:
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
             try:
-                source = self._get_history_source(cluster)
+                source = self.get_history_source(cluster)
                 fetched = await source.gather_fleet(
                     subset, history_seconds, step_seconds, **source_kwargs(source)
                 )
@@ -194,19 +240,41 @@ class Runner:
         await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
         return FleetBatch.build(objects, histories)
 
-    async def _gather_fleet_digests(self, objects: list[K8sObjectData]) -> "DigestedFleet":
-        """Digest-ingest fetch (tdigest ``--digest_ingest``): per cluster, use
-        the source's fused parse+digest path when it has one; otherwise fetch
-        raw and digest on host — so fakes and third-party sources keep working.
-        Failure semantics match the raw path (cluster failure → empty digests
-        → UNKNOWN scans)."""
+    async def gather_fleet_digests(
+        self,
+        objects: list[K8sObjectData],
+        *,
+        history_seconds: Optional[float] = None,
+        step_seconds: Optional[float] = None,
+        end_time: Optional[float] = None,
+        raise_on_failure: bool = False,
+    ) -> "DigestedFleet":
+        """Digest-ingest fetch (tdigest ``--digest_ingest`` and the serve
+        scheduler): per cluster, use the source's fused parse+digest path when
+        it has one; otherwise fetch raw and digest on host — so fakes and
+        third-party sources keep working. The window defaults to the strategy
+        settings; an explicit ``history_seconds``/``end_time`` narrows it to a
+        delta window (``[end_time - history_seconds, end_time]``). Default
+        failure semantics match the raw path (cluster failure → empty digests
+        → UNKNOWN scans); with ``raise_on_failure`` a cluster failure raises
+        instead — the serve scheduler needs the distinction, because folding
+        an empty window and moving on would silently LOSE that window's
+        samples from the accumulated store, where a one-shot scan merely
+        renders one run's objects as UNKNOWN. Coverage caveat:
+        ``raise_on_failure`` sees cluster-level failures plus per-query
+        failures a source reports via ``fleet.failed_rows`` (the bundled
+        PrometheusLoader does); a third-party source that swallows its own
+        query errors into empty histories is indistinguishable from a
+        genuinely idle fleet and cannot be caught here."""
         from krr_tpu.integrations.native import _digest_python
         from krr_tpu.models.series import DigestedFleet
 
-        settings = self._strategy.settings
+        settings = self.strategy.settings
         spec = settings.cpu_spec()
-        history_seconds = settings.history_timedelta.total_seconds()
-        step_seconds = settings.timeframe_timedelta.total_seconds()
+        if history_seconds is None:
+            history_seconds = settings.history_timedelta.total_seconds()
+        if step_seconds is None:
+            step_seconds = settings.timeframe_timedelta.total_seconds()
 
         by_cluster: dict[Optional[str], list[int]] = {}
         for i, obj in enumerate(objects):
@@ -226,49 +294,93 @@ class Runner:
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
             try:
-                source = self._get_history_source(cluster)
+                source = self.get_history_source(cluster)
                 if hasattr(source, "gather_fleet_digests"):
                     sub_fleet = await source.gather_fleet_digests(
                         subset, history_seconds, step_seconds,
                         spec.gamma, spec.min_value, spec.num_buckets,
-                        **self._end_time_kwargs(),
+                        **self._end_time_kwargs(end_time),
                     )
                     fleet.merge_from(sub_fleet, indices)
                 else:
                     fetched = await source.gather_fleet(
-                        subset, history_seconds, step_seconds, **self._end_time_kwargs()
+                        subset, history_seconds, step_seconds, **self._end_time_kwargs(end_time)
                     )
                     fold_histories(indices, fetched)
             except Exception as e:
+                if raise_on_failure:
+                    raise
+                fleet.failed_rows.update(indices)
                 self.logger.warning(
                     f"Failed to gather digests for cluster {cluster or 'default'}: {e} — "
                     f"marking {len(subset)} objects as unknown"
                 )
                 self.logger.debug_exception()
 
-        await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
+        # return_exceptions so sibling clusters' fetches settle before a
+        # failure surfaces (raising early would orphan their downloads).
+        results = await asyncio.gather(
+            *[fetch_cluster(c, idx) for c, idx in by_cluster.items()], return_exceptions=True
+        )
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        if raise_on_failure and fleet.failed_rows:
+            # Per-QUERY terminal failures inside a reachable source degrade
+            # to empty rows and are only recorded (fleet.failed_rows) — for
+            # an incremental caller that is still a lost window, so surface
+            # it as loudly as a cluster failure.
+            raise RuntimeError(
+                f"{len(fleet.failed_rows)} of {len(objects)} object fetches failed terminally"
+            )
         return fleet
 
+    async def close(self) -> None:
+        """Close every successfully-built history source that supports it."""
+        for source in self._history_sources.values():
+            close = getattr(source, "close", None)
+            if close is not None and not isinstance(source, Exception):
+                try:
+                    await close()
+                except Exception:
+                    self.logger.debug_exception()
+
+
+class Runner:
+    """One-shot end-to-end scan orchestration over a :class:`ScanSession`."""
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        inventory: Optional[InventorySource] = None,
+        history_factory: Optional[Callable[[Optional[str]], HistorySource]] = None,
+        logger: Optional[KrrLogger] = None,
+    ) -> None:
+        self.config = config
+        self.session = ScanSession(
+            config, inventory=inventory, history_factory=history_factory, logger=logger
+        )
+        self.logger = self.session.logger
+        self.stats: dict[str, float] = {}
+
+    @property
+    def _strategy(self):
+        return self.session.strategy
+
+    def _greet(self) -> None:
+        self.logger.echo(ASCII_LOGO, no_prefix=True, markup=True)
+        self.logger.echo(f"Running krr-tpu (TPU-native Kubernetes Resource Recommender) {get_version()}", no_prefix=True)
+        self.logger.echo(f"Using strategy: {self._strategy}", no_prefix=True)
+        self.logger.echo(f"Using formatter: {self.config.format}", no_prefix=True)
+        self.logger.echo(no_prefix=True)
+
+    # ------------------------------------------------------------- the scan
     def _round_result(self, raw: RunResult) -> ResourceAllocations:
-        return ResourceAllocations(
-            requests={
-                resource: round_value(
-                    raw[resource].request,
-                    resource,
-                    cpu_min_value=self.config.cpu_min_value,
-                    memory_min_value=self.config.memory_min_value,
-                )
-                for resource in ResourceType
-            },
-            limits={
-                resource: round_value(
-                    raw[resource].limit,
-                    resource,
-                    cpu_min_value=self.config.cpu_min_value,
-                    memory_min_value=self.config.memory_min_value,
-                )
-                for resource in ResourceType
-            },
+        return round_allocations(
+            raw,
+            cpu_min_value=self.config.cpu_min_value,
+            memory_min_value=self.config.memory_min_value,
         )
 
     async def _collect_result(self) -> Result:
@@ -289,11 +401,8 @@ class Runner:
                 gc.enable()
 
     async def _collect_result_inner(self) -> Result:
-        inventory = self._get_inventory()
         t0, c0 = time.perf_counter(), time.process_time()
-        clusters = await inventory.list_clusters()
-        self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
-        objects = await inventory.list_scannable_objects(clusters)
+        objects = await self.session.discover()
         t1, c1 = time.perf_counter(), time.process_time()
         self.logger.info(f"Found {len(objects)} scannable objects")
 
@@ -301,11 +410,11 @@ class Runner:
             self._strategy, "run_digested"
         )
         if digest_ingest:
-            fleet = await self._gather_fleet_digests(objects)
+            fleet = await self.session.gather_fleet_digests(objects)
             t2, c2 = time.perf_counter(), time.process_time()
             raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
         else:
-            batch = await self._gather_fleet_history(objects)
+            batch = await self.session.gather_fleet_history(objects)
             t2, c2 = time.perf_counter(), time.process_time()
             # The batched strategy call is CPU/TPU bound; keep the loop
             # responsive. Row-chunked so the packed copy never exceeds

@@ -49,6 +49,12 @@ class DigestStore:
     cpu_peak: np.ndarray = None  # [N] float32 (-inf when empty)
     mem_total: np.ndarray = None  # [N] float32
     mem_peak: np.ndarray = None  # [N] float32, in MB (-inf when empty)
+    #: Caller-owned JSON-serializable annotations persisted INSIDE the same
+    #: atomic save as the arrays (the serve scheduler keeps its window
+    #: cursor here — a sidecar file could desync from the store on a crash
+    #: between two writes, which is exactly a lost or double-counted
+    #: window). Round-trips through save/load; absent in legacy files.
+    extra_meta: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         n, b = len(self.keys), self.spec.num_buckets
@@ -122,6 +128,59 @@ class DigestStore:
             np.maximum.at(self.mem_peak, rows, f32(mem_peak))
         return rows
 
+    def fold_fleet(self, fleet, mem_scale: float = 1.0) -> np.ndarray:
+        """Delta-window fold entry point: merge one fetched (digested) window
+        into the store. The tdigest ``state_path`` merge and the serve
+        scheduler's per-tick fold share this conversion — ``DigestedFleet``
+        memory peaks arrive in bytes while the store keeps MB, so callers
+        pass ``mem_scale`` (the strategy layer's MEMORY_SCALE). Returns the
+        store row index for each fleet object, for the follow-up quantile
+        query. Exactness contract: digest bucket counts are integer-valued,
+        so folding windows one at a time accumulates bit-identical state to
+        folding their union in one window."""
+        keys = [object_key(obj) for obj in fleet.objects]
+        mem_peak = np.where(np.isfinite(fleet.mem_peak), fleet.mem_peak / mem_scale, -np.inf)
+        return self.merge_window(
+            keys, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, fleet.mem_total, mem_peak
+        )
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def rows_for(self, keys: list[str]) -> np.ndarray:
+        """Store row indices for ``keys``, growing empty rows for unseen
+        objects (which then query as NaN → UNKNOWN scans) — the serve
+        resume path's query-without-fold: recommendations straight from the
+        resident state, no new window."""
+        return self._ensure_rows(keys)
+
+    def compact(self, keep: "frozenset[str] | set[str]") -> int:
+        """Drop rows whose key is not in ``keep``, returning the number
+        dropped. A long-lived server re-discovers the fleet on a slow
+        cadence; without compaction, workload churn would grow the store
+        (and its [N x B] count matrix) without bound. Row indices shift —
+        callers re-derive them via the next ``fold_fleet``/``merge_window``."""
+        mask = np.fromiter((key in keep for key in self.keys), dtype=bool, count=len(self.keys))
+        dropped = int(len(self.keys) - mask.sum())
+        if not dropped:
+            return 0
+        self.keys = [key for key, m in zip(self.keys, mask) if m]
+        self.cpu_counts = self.cpu_counts[mask]
+        self.cpu_total = self.cpu_total[mask]
+        self.cpu_peak = self.cpu_peak[mask]
+        self.mem_total = self.mem_total[mask]
+        self.mem_peak = self.mem_peak[mask]
+        self._index = {key: i for i, key in enumerate(self.keys)}
+        return dropped
+
+    @property
+    def nbytes(self) -> int:
+        """Resident size of the row arrays (the serve ``/metrics`` gauge)."""
+        return sum(
+            a.nbytes
+            for a in (self.cpu_counts, self.cpu_total, self.cpu_peak, self.mem_total, self.mem_peak)
+        )
+
     # -------------------------------------------------------------- quantiles
     @staticmethod
     def _contiguous_slice(rows: np.ndarray, n: int) -> Optional[slice]:
@@ -177,6 +236,8 @@ class DigestStore:
             "min_value": self.spec.min_value,
             "num_buckets": self.spec.num_buckets,
         }
+        if self.extra_meta:
+            meta["extra"] = self.extra_meta
         flat = np.flatnonzero(self.cpu_counts)
         vals = self.cpu_counts.ravel()[flat]
         buckets = self.spec.num_buckets
@@ -231,6 +292,7 @@ class DigestStore:
                 cpu_peak=data["cpu_peak"],
                 mem_total=data["mem_total"],
                 mem_peak=data["mem_peak"],
+                extra_meta=meta.get("extra", {}),
             )
 
     @staticmethod

@@ -45,12 +45,14 @@ _YAML_RESOLVED_WORDS = frozenset(
 
 
 @lru_cache(maxsize=65536)
-def _yaml_scalar(value: str) -> Optional[str]:
+def _yaml_scalar(value: str, prefix: int = 0) -> Optional[str]:
     """How the dumper itself renders ``value`` as a single-line scalar, or
     None when it folds/escapes across lines (the caller then abandons the
     fast path — position-dependent folding can't be reproduced out of
-    context). Cached per unique string: severities, kinds, and namespaces
-    repeat across the fleet."""
+    context). ``prefix`` is the length of everything the emitter writes
+    before the scalar on its line (indent + key + ": ", or indent + "- ").
+    Cached per unique (string, prefix): severities, kinds, and namespaces
+    repeat across the fleet at the same few indent depths."""
     rendered = _yaml.dump(value, Dumper=_YAML_DUMPER, width=1_000_000)
     line, _, rest = rendered.partition("\n")
     if rest not in ("", "...\n"):
@@ -59,26 +61,28 @@ def _yaml_scalar(value: str) -> Optional[str]:
     # (the giant width above suppressed it): plain/single-quoted styles
     # fold at spaces only; double-quoted style may split ANYWHERE with a
     # backslash continuation. Bail on both before they can diverge — the
-    # bounds leave room for this shape's deepest indent (~16 columns).
-    if " " in value and len(line) > 40:
+    # bounds include the ACTUAL emitted line prefix, so a long mapping key
+    # can't push a near-limit scalar across PyYAML's 80-column split
+    # (conservative margins: 56/76 of the 80 columns).
+    if " " in value and prefix + len(line) > 56:
         return None
-    if line.startswith('"') and len(line) > 60:
+    if line.startswith('"') and prefix + len(line) > 76:
         return None
     return line
 
 
-def _yaml_str(value: str) -> Optional[str]:
+def _yaml_str(value: str, prefix: int = 0) -> Optional[str]:
     if _YAML_PLAIN_SAFE.fullmatch(value) and value not in _YAML_RESOLVED_WORDS:
         return value
-    return _yaml_scalar(value)
+    return _yaml_scalar(value, prefix)
 
 
-def _yaml_leaf(value: Any) -> Optional[str]:
+def _yaml_leaf(value: Any, prefix: int = 0) -> Optional[str]:
     """Scalar rendering, byte-equal to the SafeRepresenter's."""
     if value is None:
         return "null"
     if isinstance(value, str):
-        return _yaml_str(value)
+        return _yaml_str(value, prefix)
     if isinstance(value, bool):  # before int (bool is an int subclass)
         return "true" if value else "false"
     if isinstance(value, int):
@@ -102,7 +106,7 @@ def _emit_yaml(node: Any, indent: str, out: list) -> bool:
         if not node:
             return False  # "{}" placement is context-dependent; bail
         for key, value in node.items():
-            key_text = _yaml_str(key) if isinstance(key, str) else None
+            key_text = _yaml_str(key, len(indent)) if isinstance(key, str) else None
             if key_text is None:
                 return False
             if isinstance(value, dict) and value:
@@ -115,7 +119,8 @@ def _emit_yaml(node: Any, indent: str, out: list) -> bool:
                     return False
             else:
                 leaf = "{}" if value == {} and isinstance(value, dict) else (
-                    "[]" if value == [] and isinstance(value, list) else _yaml_leaf(value)
+                    "[]" if value == [] and isinstance(value, list)
+                    else _yaml_leaf(value, len(indent) + len(key_text) + 2)
                 )
                 if leaf is None:
                     return False
@@ -137,7 +142,7 @@ def _emit_yaml(node: Any, indent: str, out: list) -> bool:
             elif isinstance(item, list) and item:
                 return False  # nested block sequences: not in this shape
             else:
-                leaf = _yaml_leaf(item)
+                leaf = _yaml_leaf(item, len(indent) + 2)
                 if leaf is None:
                     return False
                 out.append(f"{indent}- {leaf}\n")

@@ -1423,14 +1423,23 @@ class PrometheusLoader:
                         if pod in wanted and total > 0 and pod not in seen:
                             seen.add(pod)
                             fleet.merge_mem_row(i, total, peak)
-            except Exception as e:
+            except BaseException as e:
                 # The sink folds windows in as they land — unwind any partial
                 # folds so this object degrades to the empty (UNKNOWN) state
-                # the pre-streamed path guaranteed.
+                # the pre-streamed path guaranteed. BaseException, matching
+                # per_namespace: a CancelledError mid-fetch must not leave
+                # double-countable partially-folded rows behind if the caller
+                # (a cancelled/retried serve scan) keeps the fleet.
                 if resource is ResourceType.CPU:
                     fleet.clear_cpu_rows([i])
                 else:
                     fleet.clear_mem_rows([i])
+                if not isinstance(e, Exception):
+                    raise
+                # This handler is the TERMINAL failure site for both fetch
+                # modes (batched failures fall back here) — record the row
+                # so incremental consumers know the window is incomplete.
+                fleet.failed_rows.add(i)
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
 

@@ -40,6 +40,7 @@ TPU_BACKEND_FIELDS = {
 #: Help-panel render order (any unlisted panel prints after these).
 PANEL_ORDER = (
     "General Settings",
+    "Server Settings",
     "Logging Settings",
     "Strategy Settings",
     "TPU Backend Settings",
@@ -268,6 +269,99 @@ def _common_options() -> list[click.Option]:
     ]
 
 
+def _server_options() -> list[click.Option]:
+    from krr_tpu.core.config import Config
+
+    defaults = {name: Config.model_fields[name].default for name in (
+        "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds"
+    )}
+    return [
+        PanelOption(
+            ["--host", "server_host"],
+            default=defaults["server_host"],
+            show_default=True,
+            panel="Server Settings",
+            help="Address to bind the HTTP server to.",
+        ),
+        PanelOption(
+            ["--port", "server_port"],
+            type=int,
+            default=defaults["server_port"],
+            show_default=True,
+            panel="Server Settings",
+            help="Port to bind the HTTP server to (0 = ephemeral).",
+        ),
+        PanelOption(
+            ["--scan-interval", "scan_interval_seconds"],
+            type=float,
+            default=defaults["scan_interval_seconds"],
+            show_default=True,
+            panel="Server Settings",
+            help="Seconds between incremental delta scans (each fetches only the window since the last fold).",
+        ),
+        PanelOption(
+            ["--discovery-interval", "discovery_interval_seconds"],
+            type=float,
+            default=defaults["discovery_interval_seconds"],
+            show_default=True,
+            panel="Server Settings",
+            help="Seconds between fleet re-discoveries (workload churn pickup + digest store compaction).",
+        ),
+    ]
+
+
+def _make_serve_command(strategy_name: str, strategy_type: Any) -> click.Command:
+    """``krr-tpu serve``: the long-running service (`krr_tpu.server`).
+
+    Rides the digest-backed strategy (tdigest) — incremental delta scans
+    fold into resident per-container digests, whose integer-count
+    mergeability is what makes a delta fold equal a cold full-window scan.
+    The strategy's settings surface as flags exactly like a scan command's.
+    """
+    settings_fields = list(strategy_type.get_settings_type().model_fields)
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+        from krr_tpu.server.app import run_server
+
+        clusters = list(kwargs.pop("clusters") or [])
+        namespaces = list(kwargs.pop("namespaces") or [])
+        other_args = {name: kwargs.pop(name) for name in settings_fields}
+        try:
+            config = Config(
+                clusters="*" if "*" in clusters else (clusters or None),
+                namespaces="*" if ("*" in namespaces or not namespaces) else namespaces,
+                strategy=strategy_name,
+                format="json",
+                other_args=other_args,
+                **kwargs,
+            )
+            config.create_strategy()  # validate strategy settings up front
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+        asyncio.run(run_server(config))
+
+    # The serve command takes the scan commands' common options MINUS the
+    # one-shot-only formatter flag (responses pick a format per request).
+    common = [o for o in _common_options() if o.name != "format"]
+    return PanelCommand(
+        "serve",
+        callback=callback,
+        params=common + _server_options() + _strategy_options(strategy_type),
+        help=(
+            "Run krr-tpu as a long-running HTTP service: a background scheduler "
+            "keeps per-container digests fresh with incremental delta scans, and "
+            "GET /recommendations answers from the resident state "
+            "(also: GET /healthz, GET /metrics)."
+        ),
+    )
+
+
 def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Command:
     settings_fields = list(strategy_type.get_settings_type().model_fields)
 
@@ -318,8 +412,11 @@ def version() -> None:
 def load_commands() -> None:
     from krr_tpu.strategies.base import BaseStrategy
 
-    for strategy_name, strategy_type in BaseStrategy.get_all().items():
+    strategies = BaseStrategy.get_all()
+    for strategy_name, strategy_type in strategies.items():
         app.add_command(_make_strategy_command(strategy_name, strategy_type))
+    if "tdigest" in strategies:  # the serve subsystem rides the digest strategy
+        app.add_command(_make_serve_command("tdigest", strategies["tdigest"]))
 
 
 def run() -> None:

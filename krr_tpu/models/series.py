@@ -66,6 +66,13 @@ class DigestedFleet:
     cpu_peak: np.ndarray  # [N] float64, -inf when empty
     mem_total: np.ndarray  # [N] float64
     mem_peak: np.ndarray  # [N] float64 bytes, -inf when empty
+    #: Row indices whose fetch TERMINALLY failed (batched query + fallback
+    #: both exhausted) and degraded to the empty state. One-shot scans
+    #: render them UNKNOWN and move on; an incremental consumer (the serve
+    #: scheduler) must instead treat the whole window as unfetched — folding
+    #: the empty rows and advancing its cursor would silently drop those
+    #: samples from the accumulated history.
+    failed_rows: "set[int]" = field(default_factory=set)
 
     def __len__(self) -> int:
         return len(self.objects)
@@ -105,6 +112,7 @@ class DigestedFleet:
         for j, i in enumerate(indices):
             self.merge_cpu_row(i, sub.cpu_counts[j], sub.cpu_total[j], sub.cpu_peak[j])
             self.merge_mem_row(i, sub.mem_total[j], sub.mem_peak[j])
+        self.failed_rows.update(indices[j] for j in sub.failed_rows)
 
     @classmethod
     def empty(cls, objects: list[K8sObjectData], gamma: float, min_value: float, num_buckets: int) -> "DigestedFleet":

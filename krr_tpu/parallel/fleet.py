@@ -31,6 +31,19 @@ from krr_tpu.ops.topk_sketch import TopKSketch
 from krr_tpu.parallel.mesh import DATA_AXIS, TIME_AXIS, fleet_sharding, fleet_spec, rows_sharding, rows_spec
 
 
+def shard_map_compat(**kwargs):
+    """``jax.shard_map`` decorator across JAX versions: new JAX exposes it
+    top-level with ``check_vma``; older releases (≤ 0.4.x) ship
+    ``jax.experimental.shard_map.shard_map`` with the same knob named
+    ``check_rep``. The kernels themselves are version-agnostic."""
+    if hasattr(jax, "shard_map"):
+        return partial(jax.shard_map, **kwargs)
+    from jax.experimental.shard_map import shard_map
+
+    kwargs["check_rep"] = kwargs.pop("check_vma")
+    return partial(shard_map, **kwargs)
+
+
 def pad_for_mesh(values: np.ndarray, counts: np.ndarray, mesh: Mesh) -> tuple[np.ndarray, np.ndarray, int]:
     """Pad rows/time so both axes divide the mesh; returns (values, counts, real_rows)."""
     n, t = values.shape
@@ -64,8 +77,7 @@ def transfer_to_mesh(
 def _sharded_digest_build(
     spec: DigestSpec, mesh: Mesh, values: jax.Array, counts: jax.Array, chunk_size: int
 ) -> Digest:
-    @partial(
-        jax.shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(fleet_spec(), rows_spec()),
         out_specs=(rows_spec(), rows_spec(), rows_spec()),
@@ -114,8 +126,7 @@ def sharded_percentile(
 def _sharded_topk_build(
     mesh: Mesh, values: jax.Array, counts: jax.Array, k: int, chunk_size: int
 ) -> TopKSketch:
-    @partial(
-        jax.shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(fleet_spec(), rows_spec()),
         out_specs=(PartitionSpec(DATA_AXIS, None), rows_spec()),
@@ -152,8 +163,7 @@ def sharded_fleet_topk(
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _sharded_max_build(mesh: Mesh, values: jax.Array, counts: jax.Array) -> jax.Array:
-    @partial(
-        jax.shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(fleet_spec(), rows_spec()),
         out_specs=rows_spec(),
@@ -184,8 +194,7 @@ def sharded_masked_max(
 def _sharded_bisect_build(
     mesh: Mesh, values: jax.Array, counts: jax.Array, q: jax.Array, num_iters: int = 31
 ) -> jax.Array:
-    @partial(
-        jax.shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(fleet_spec(), rows_spec(), PartitionSpec()),
         out_specs=rows_spec(),

@@ -1,0 +1,32 @@
+"""`krr-tpu serve`: the long-running recommendation service.
+
+The one-shot CLI re-discovers the fleet and re-fetches the full history
+window on every invocation; at production scale that is 40+ seconds of work
+per ask. This package keeps the scan state RESIDENT — per-object digests in
+a `krr_tpu.core.streaming.DigestStore`, the last published
+`krr_tpu.models.result.Result` — and amortizes the expensive scan across
+requests:
+
+* `scheduler`  — background delta scans (fetch only the window since the
+  last tick; the digest's integer-count mergeability makes the fold exact)
+  plus slower-cadence re-discovery for workload churn;
+* `state`      — the published-snapshot cache with read/write locking, so
+  queries keep serving the previous result while a scan is in flight;
+* `app`        — the asyncio HTTP surface: ``GET /recommendations``,
+  ``GET /healthz``, ``GET /metrics`` (Prometheus text format);
+* `metrics`    — a dependency-free Prometheus text-format registry.
+"""
+
+from krr_tpu.server.app import KrrServer, run_server
+from krr_tpu.server.metrics import MetricsRegistry
+from krr_tpu.server.scheduler import ScanScheduler
+from krr_tpu.server.state import ServerState, Snapshot
+
+__all__ = [
+    "KrrServer",
+    "MetricsRegistry",
+    "ScanScheduler",
+    "ServerState",
+    "Snapshot",
+    "run_server",
+]

@@ -1,0 +1,367 @@
+"""The asyncio HTTP surface of `krr-tpu serve`.
+
+Deliberately framework-free: the API is three GET routes serving
+pre-rendered bodies, and the stdlib's ``asyncio.start_server`` plus ~100
+lines of HTTP/1.1 parsing covers it — no router, no middleware stack, no
+dependency the image doesn't already carry. (aiohttp stays a TEST
+dependency: the fakes use it, the product doesn't.)
+
+Routes:
+
+* ``GET /recommendations`` — the last published scan. Whole fleet by
+  default (a byte copy of the snapshot's pre-rendered JSON); filter with
+  repeatable ``namespace=``, and ``workload=`` / ``container=``; pick a
+  machine format with ``format=json|yaml|pprint``. 503 until the first
+  scan publishes.
+* ``GET /healthz``   — liveness + scan freshness (JSON).
+* ``GET /metrics``   — Prometheus text format (`krr_tpu.server.metrics`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.parse
+from typing import Optional
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.runner import ScanSession
+from krr_tpu.core.streaming import DigestStore
+from krr_tpu.models.result import Result
+from krr_tpu.server.scheduler import ScanScheduler
+from krr_tpu.server.state import ServerState
+from krr_tpu.utils.logging import KrrLogger
+
+#: Request-line / header-section bounds (anything past them is a client bug
+#: or an attack; real Prometheus and most proxies cap around 8 KB too).
+MAX_REQUEST_LINE = 8192
+MAX_HEADER_LINES = 100
+
+_STATUS_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    431: "Request Header Fields Too Large",
+    503: "Service Unavailable",
+}
+
+#: Output formats a query may ask for — the machine formatters only (the
+#: table formatter renders a rich object for terminals, not an HTTP body).
+_FORMATS = {
+    "json": "application/json",
+    "yaml": "application/x-yaml",
+    "pprint": "text/plain; charset=utf-8",
+}
+
+_METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _json_body(payload: dict) -> bytes:
+    return (json.dumps(payload) + "\n").encode()
+
+
+class HttpApp:
+    """Route table + HTTP/1.1 plumbing over a :class:`ServerState`.
+
+    ``stale_after_seconds``: /healthz flips to 503 "stale" once the
+    published scan's window end falls this far behind the clock — a wedged
+    or perpetually-failing scheduler must trip liveness probes instead of
+    serving days-old recommendations as "ok" forever.
+    """
+
+    def __init__(
+        self,
+        state: ServerState,
+        logger: KrrLogger,
+        *,
+        stale_after_seconds: float = float("inf"),
+        clock=time.time,
+    ) -> None:
+        self.state = state
+        self.logger = logger
+        self.stale_after_seconds = stale_after_seconds
+        self.clock = clock
+        #: Open client connections, for shutdown: ``Server.close()`` stops
+        #: the listener but never touches established keep-alive
+        #: connections, and on Python ≥ 3.12.1 ``wait_closed()`` waits for
+        #: their handlers — which sit blocked in ``readline()`` — so an idle
+        #: scraper connection would hang shutdown past the kill grace.
+        self._connections: "set[asyncio.StreamWriter]" = set()
+
+    def abort_connections(self) -> None:
+        """Close every open client connection (shutdown): unblocks each
+        handler's pending ``readline()`` with EOF so it unwinds cleanly."""
+        for writer in list(self._connections):
+            writer.close()
+
+    # -------------------------------------------------------------- routes
+    async def route(
+        self, method: str, path: str, query: dict[str, list[str]]
+    ) -> tuple[int, str, bytes]:
+        """Dispatch → (status, content_type, body)."""
+        if method != "GET":
+            return 405, "application/json", _json_body({"error": "only GET is supported"})
+        if path == "/healthz":
+            return await self._healthz()
+        if path == "/metrics":
+            return 200, _METRICS_CONTENT_TYPE, self.state.metrics.render().encode()
+        if path == "/recommendations":
+            return await self._recommendations(query)
+        return 404, "application/json", _json_body({"error": f"no route for {path}"})
+
+    async def _healthz(self) -> tuple[int, str, bytes]:
+        snapshot = await self.state.snapshot()
+        if snapshot is None:
+            status = "starting"
+        elif float(self.clock()) - snapshot.window_end > self.stale_after_seconds:
+            status = "stale"
+        else:
+            status = "ok"
+        body = {
+            "status": status,
+            "uptime_seconds": round(time.time() - self.state.started_at, 3),
+            "scans": len(snapshot.result.scans) if snapshot is not None else 0,
+            "last_scan_unix": snapshot.window_end if snapshot is not None else None,
+            "store_rows": len(self.state.store.keys),
+        }
+        return (200 if status == "ok" else 503), "application/json", _json_body(body)
+
+    async def _recommendations(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        snapshot = await self.state.snapshot()
+        if snapshot is None:
+            return 503, "application/json", _json_body(
+                {"error": "no scan has completed yet; retry shortly"}
+            )
+        fmt = (query.get("format") or ["json"])[-1]
+        content_type = _FORMATS.get(fmt)
+        if content_type is None:
+            return 400, "application/json", _json_body(
+                {"error": f"unknown format {fmt!r}; one of {sorted(_FORMATS)}"}
+            )
+        namespaces = set(query.get("namespace", ()))
+        workloads = set(query.get("workload", ()))
+        containers = set(query.get("container", ()))
+        if fmt == "json" and not namespaces and not workloads and not containers:
+            # The hot path: rendered AND encoded at publish time.
+            return 200, content_type, snapshot.body_json
+
+        def render() -> bytes:
+            # Filter + score recompute + render + encode all in the worker
+            # thread — at fleet scale even the filter pass over 100k scans
+            # is tens of ms the event loop can't afford.
+            if not namespaces and not workloads and not containers:
+                return snapshot.result.format(fmt).encode()
+            scans = [
+                scan
+                for scan in snapshot.result.scans
+                if (not namespaces or scan.object.namespace in namespaces)
+                and (not workloads or scan.object.name in workloads)
+                and (not containers or scan.object.container in containers)
+            ]
+            return Result(scans=scans).format(fmt).encode()
+
+        return 200, content_type, await asyncio.to_thread(render)
+
+    # ------------------------------------------------------------ plumbing
+    async def handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._connections.add(writer)
+        try:
+            while True:
+                keep_alive = await self._handle_one(reader, writer)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            pass  # client went away mid-request: nothing to serve
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.logger.debug_exception()
+        finally:
+            self._connections.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _handle_one(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> bool:
+        """Serve one request; returns whether to keep the connection open."""
+        request_line = await reader.readline()
+        if not request_line:
+            return False
+        if len(request_line) > MAX_REQUEST_LINE:
+            self._respond(writer, 400, "application/json", _json_body({"error": "request line too long"}), False)
+            await writer.drain()
+            return False
+        parts = request_line.decode("latin-1").strip().split()
+        if len(parts) != 3 or not parts[2].startswith("HTTP/1"):
+            self._respond(writer, 400, "application/json", _json_body({"error": "malformed request line"}), False)
+            await writer.drain()
+            return False
+        method, target, version = parts
+
+        headers: dict[str, str] = {}
+        header_lines = 0  # count LINES read, not dict entries — repeated
+        while True:        # names would otherwise evade the cap unconsumed
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            header_lines += 1
+            if header_lines > MAX_HEADER_LINES:
+                self._respond(writer, 431, "application/json", _json_body({"error": "too many headers"}), False)
+                await writer.drain()
+                return False
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+
+        # GET carries no body; drain a declared one anyway so keep-alive
+        # framing survives odd clients. A body we won't fully drain (or a
+        # length we can't parse) closes the connection — anything else
+        # desyncs the framing and parses body bytes as the next request.
+        try:
+            length = int(headers.get("content-length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > (1 << 20):
+            self._respond(writer, 400, "application/json", _json_body({"error": "bad content-length"}), False)
+            await writer.drain()
+            return False
+        if length:
+            await reader.readexactly(length)
+
+        split = urllib.parse.urlsplit(target)
+        query = urllib.parse.parse_qs(split.query, keep_blank_values=False)
+
+        t0 = time.perf_counter()
+        status, content_type, body = await self.route(method, split.path, query)
+        route_label = split.path if split.path in ("/healthz", "/metrics", "/recommendations") else "other"
+        self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
+        self.state.metrics.observe(
+            "krr_tpu_http_request_seconds", time.perf_counter() - t0, route=route_label
+        )
+
+        keep_alive = headers.get("connection", "" if version == "HTTP/1.1" else "close").lower() != "close"
+        self._respond(writer, status, content_type, body, keep_alive)
+        await writer.drain()
+        return keep_alive
+
+    @staticmethod
+    def _respond(
+        writer: asyncio.StreamWriter, status: int, content_type: str, body: bytes, keep_alive: bool
+    ) -> None:
+        reason = _STATUS_REASONS.get(status, "OK")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+            "\r\n"
+        )
+        writer.write(head.encode("latin-1") + body)
+
+
+class KrrServer:
+    """Composition root: session + state + scheduler + HTTP, one lifecycle.
+
+    ``clock`` is injectable so tests (and offline replays) can pin scan
+    windows; the ``session`` injection point takes a pre-built
+    :class:`ScanSession` with fake inventory/history sources.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        session: Optional[ScanSession] = None,
+        clock=time.time,
+        logger: Optional[KrrLogger] = None,
+    ) -> None:
+        self.config = config
+        self.session = session or ScanSession(config, logger=logger)
+        self.logger = logger or self.session.logger
+        settings = self.session.strategy.settings
+        if not hasattr(settings, "cpu_spec"):
+            raise ValueError(
+                "krr-tpu serve requires a digest-backed strategy (tdigest): "
+                "incremental delta folds ride on the digest's mergeability"
+            )
+        # The resident store; with state_path configured it resumes the
+        # persisted digests (and the scheduler re-saves after every fold).
+        self.state = ServerState(
+            DigestStore.open_or_create(getattr(settings, "state_path", None), settings.cpu_spec())
+        )
+        self.scheduler = ScanScheduler(
+            self.session,
+            self.state,
+            scan_interval=config.scan_interval_seconds,
+            discovery_interval=config.discovery_interval_seconds,
+            clock=clock,
+            logger=self.logger,
+        )
+        self.app = HttpApp(
+            self.state,
+            self.logger,
+            # Three missed scan cadences (or grid steps, whichever is
+            # coarser) without a published window = stale.
+            stale_after_seconds=3.0 * max(config.scan_interval_seconds, self.scheduler._step_seconds()),
+            clock=clock,
+        )
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self, *, run_scheduler: bool = True) -> None:
+        self._server = await asyncio.start_server(
+            self.app.handle_connection, self.config.server_host, self.config.server_port
+        )
+        if run_scheduler:
+            self.scheduler.start()
+        self.logger.info(
+            f"Serving on http://{self.config.server_host}:{self.port} "
+            f"(scan every {self.scheduler.scan_interval:.0f}s, "
+            f"re-discover every {self.scheduler.discovery_interval:.0f}s)"
+        )
+
+    async def shutdown(self) -> None:
+        """Graceful: stop scans first (a cancelled scan leaves state
+        consistent — see ``ScanScheduler.stop``), then the listener, then
+        the outbound clients."""
+        await self.scheduler.stop()
+        if self._server is not None:
+            self._server.close()
+            # Established keep-alive connections survive close(); abort
+            # them so wait_closed() (which awaits their handlers on
+            # Python ≥ 3.12.1) can't hang on an idle scraper.
+            self.app.abort_connections()
+            await self._server.wait_closed()
+            self._server = None
+        await self.session.close()
+
+
+async def run_server(config: Config, *, logger: Optional[KrrLogger] = None) -> None:
+    """The `krr-tpu serve` entry point: run until SIGINT/SIGTERM."""
+    import signal
+
+    server = KrrServer(config, logger=logger)
+    await server.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix event loops
+            pass
+    try:
+        await stop.wait()
+    finally:
+        server.logger.info("Shutting down")
+        await server.shutdown()

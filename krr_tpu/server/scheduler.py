@@ -1,0 +1,310 @@
+"""The background scan scheduler: incremental delta folds + slow re-discovery.
+
+Tick semantics (the amortization contract):
+
+* The FIRST scan fetches the strategy's full history window
+  ``[now - history, now]`` and folds it into the resident digest store.
+* Every later tick fetches only the DELTA window ``[last_end + step, now]``
+  — the samples Prometheus's evaluation grid adds after the last folded
+  window — and folds it in. Digest bucket counts are integer-valued and
+  merge by exact addition (peaks by max), so the accumulated store is
+  bit-identical to a cold scan over the union window; nothing is ever
+  re-fetched or double-counted.
+* Discovery (apiserver inventory) runs on its own slower cadence; a
+  re-discovery compacts the store to the currently-discovered fleet so
+  workload churn can't grow it without bound.
+
+A scan runs entirely OUTSIDE the state's read/write lock — fetch and fold
+build a private window, the recommendation compute reads the store from a
+worker thread — and publishes with one atomic snapshot swap at the end, so
+queries serve the previous result throughout. ``state.last_end`` advances
+only after a fold completes: a scan cancelled mid-fetch (shutdown, restart)
+simply refetches its window on the next tick. A FAILED cluster fetch aborts
+the whole tick for the same reason (``raise_on_failure``): the one-shot
+CLI's degrade-to-UNKNOWN would here fold an empty window and advance past
+it, silently losing those samples from the accumulated store — instead the
+tick counts a failure and the window is refetched next tick.
+
+Window edges are clamped to the Prometheus evaluation grid: a range query
+evaluates at ``start, start + step, …``, so the fetched window's true right
+edge is the last grid point ≤ now. ``last_end`` records THAT point — with a
+wall-clock right edge, tick jitter (a 90 s sleep on a 60 s grid) would skip
+the grid samples between the last evaluated point and the clock reading.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from krr_tpu.core.runner import ScanSession, round_allocations
+from krr_tpu.core.streaming import object_key
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import ResourceScan, Result
+from krr_tpu.server.state import ServerState, Snapshot
+from krr_tpu.utils.logging import KrrLogger
+
+
+class ScanScheduler:
+    """Drives a :class:`ScanSession` incrementally against a :class:`ServerState`."""
+
+    def __init__(
+        self,
+        session: ScanSession,
+        state: ServerState,
+        *,
+        scan_interval: float,
+        discovery_interval: float,
+        clock: Callable[[], float] = time.time,
+        logger: Optional[KrrLogger] = None,
+    ) -> None:
+        self.session = session
+        self.state = state
+        self.scan_interval = float(scan_interval)
+        self.discovery_interval = float(discovery_interval)
+        self.clock = clock
+        self.logger = logger or session.logger
+        self._objects: Optional[list[K8sObjectData]] = None
+        self._discovered_at: float = -float("inf")
+        self._task: Optional[asyncio.Task] = None
+        #: The state file (tdigest ``state_path``) the resident store syncs
+        #: to after each fold, when configured — restarts resume the digests.
+        #: A RUNNING server owns its state file exclusively: each tick saves
+        #: the resident store over it, so a concurrent one-shot
+        #: ``tdigest --state_path`` merge against the same file would be
+        #: silently overwritten — run backfills before starting the server.
+        self.state_path: Optional[str] = getattr(session.strategy.settings, "state_path", None)
+        # Resume the window cursor alongside the digests: without it a
+        # restart's first scan would fold the FULL history window into a
+        # store that already contains it — double-counting every overlap
+        # sample. The cursor lives in the store's OWN extra_meta (one atomic
+        # save covers arrays + cursor; a sidecar could desync on a crash
+        # between two writes, losing or double-counting a window).
+        if self.state_path and self.state.store.keys and self.state.last_end is None:
+            cursor = self.state.store.extra_meta.get("serve_last_end")
+            if cursor is not None:
+                self.state.last_end = float(cursor)
+            else:
+                self.logger.warning(
+                    f"Digest state at {self.state_path} carries no serve window cursor — "
+                    f"the first scan re-folds the full window on top of the resumed store"
+                )
+
+    # ----------------------------------------------------------- one tick
+    def _step_seconds(self) -> float:
+        from krr_tpu.integrations.prometheus import effective_step_seconds
+
+        return float(
+            effective_step_seconds(self.session.strategy.settings.timeframe_timedelta.total_seconds())
+        )
+
+    async def _discover(self, now: float) -> None:
+        objects = await self.session.discover()
+        self._objects = objects
+        self._discovered_at = now
+        metrics = self.state.metrics
+        metrics.set("krr_tpu_fleet_objects", len(objects))
+        # Churn compaction: deleted workloads' rows leave the store. Done at
+        # every discovery (including a state_path-resumed first one, whose
+        # store may carry rows for long-gone workloads). Off the loop: at
+        # fleet scale the masked copy of the [N x B] matrix is seconds of
+        # numpy work that would stall every in-flight query.
+        dropped = await asyncio.to_thread(
+            self.state.store.compact, {object_key(obj) for obj in objects}
+        )
+        if dropped:
+            metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
+            self.logger.info(f"Compacted {dropped} stale rows out of the digest store")
+
+    def _recommend(self, objects: list[K8sObjectData], rows: np.ndarray) -> Result:
+        """Recommendations for ``objects`` from their merged store rows —
+        the store-backed twin of the tdigest strategy's ``run_digested``
+        query (host numpy; runs in a worker thread)."""
+        from krr_tpu.strategies.simple import finalize_fleet
+
+        settings = self.session.strategy.settings
+        q = float(settings.cpu_percentile)
+        cpu_p = self.state.store.cpu_percentile(rows, q)
+        mem_max = self.state.store.memory_peak(rows)
+        raw_results = finalize_fleet(
+            np.asarray(cpu_p), np.asarray(mem_max), settings.memory_buffer_percentage
+        )
+        config = self.session.config
+        scans = [
+            ResourceScan.calculate(
+                obj,
+                round_allocations(
+                    raw,
+                    cpu_min_value=config.cpu_min_value,
+                    memory_min_value=config.memory_min_value,
+                ),
+            )
+            for obj, raw in zip(objects, raw_results)
+        ]
+        return Result(scans=scans)
+
+    def _save_store(self) -> None:
+        from krr_tpu.core.streaming import DigestStore
+
+        self.state.store.extra_meta["serve_last_end"] = self.state.last_end
+        with DigestStore.locked(self.state_path):
+            self.state.store.save(self.state_path)
+
+    async def _recompute_and_publish(self, objects: list[K8sObjectData], rows: np.ndarray, window_end: float) -> None:
+        def render() -> tuple[Result, bytes]:
+            # Recommend + render + encode in ONE worker-thread hop: the
+            # whole-fleet JSON is multi-MB at scale, and any leg of it on
+            # the event loop stalls every in-flight query.
+            result = self._recommend(objects, rows)
+            return result, result.format("json").encode()
+
+        result, body = await asyncio.to_thread(render)
+        await self.state.publish(
+            Snapshot(result=result, body_json=body, window_end=window_end, published_at=time.time())
+        )
+
+    async def tick(self) -> bool:
+        """One scan: (maybe) re-discover, fetch the due window, fold,
+        recompute, publish. Returns False when no new window was due."""
+        from krr_tpu.strategies.simple import MEMORY_SCALE
+
+        async with self.state.scan_lock:
+            now = float(self.clock())
+            metrics = self.state.metrics
+            settings = self.session.strategy.settings
+            step = self._step_seconds()
+
+            t0 = time.perf_counter()
+            if self._objects is None or now - self._discovered_at >= self.discovery_interval:
+                await self._discover(now)
+            objects = self._objects or []
+            t1 = time.perf_counter()
+
+            if self.state.last_end is None:
+                start = now - settings.history_timedelta.total_seconds()
+                kind = "full"
+            else:
+                # One step past the last folded window's right edge: the
+                # range query's grid includes its own start point, so
+                # starting AT last_end would re-fetch (and double-count)
+                # the sample already folded there.
+                start = self.state.last_end + step
+                kind = "delta"
+                if start > now:
+                    metrics.inc("krr_tpu_scans_skipped_total")
+                    if self.state.peek() is None and self.state.store.keys:
+                        # A state_path restart inside one step window: the
+                        # resumed store is complete but nothing is published
+                        # yet — serve from the resident digests instead of
+                        # 503ing until the next window opens.
+                        rows = await asyncio.to_thread(
+                            self.state.store.rows_for, [object_key(obj) for obj in objects]
+                        )
+                        await self._recompute_and_publish(objects, rows, self.state.last_end)
+                    return False
+            # Clamp the right edge to the last evaluation-grid point ≤ now
+            # (see the module docstring): the next delta then starts exactly
+            # one step past the last point actually fetched.
+            end = start + ((now - start) // step) * step
+
+            # Workloads that appeared since the last scan have no store row
+            # yet; a delta-width fetch would skip everything between their
+            # creation and last_end (startup spikes included — peak-based
+            # memory recommendations would miss them forever). They get a
+            # FULL-window backfill alongside the fleet's delta.
+            fresh: list[K8sObjectData] = []
+            seasoned = objects
+            if kind == "delta":
+                fresh = [obj for obj in objects if object_key(obj) not in self.state.store]
+                if fresh:
+                    seasoned = [obj for obj in objects if object_key(obj) in self.state.store]
+            backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
+
+            async def fetch(objs: list[K8sObjectData], w_start: float) -> "object":
+                return await self.session.gather_fleet_digests(
+                    objs,
+                    history_seconds=end - w_start,
+                    step_seconds=settings.timeframe_timedelta.total_seconds(),
+                    end_time=end,
+                    raise_on_failure=True,
+                )
+
+            fetches = [fetch(seasoned, start)]
+            if fresh:
+                fetches.append(fetch(fresh, backfill_start))
+            # return_exceptions so a failing fetch doesn't orphan its
+            # sibling mid-download (same rationale as the session's own
+            # cluster fan-out).
+            fleets = await asyncio.gather(*fetches, return_exceptions=True)
+            for fleet in fleets:
+                if isinstance(fleet, BaseException):
+                    raise fleet
+            t2 = time.perf_counter()
+
+            for fleet in fleets:
+                await asyncio.to_thread(self.state.store.fold_fleet, fleet, MEMORY_SCALE)
+            rows = await asyncio.to_thread(
+                self.state.store.rows_for, [object_key(obj) for obj in objects]
+            )
+            self.state.last_end = end
+            t3 = time.perf_counter()
+
+            await self._recompute_and_publish(objects, rows, end)
+            t4 = time.perf_counter()
+
+            if self.state_path:
+                await asyncio.to_thread(self._save_store)
+
+            metrics.inc("krr_tpu_scans_total", kind=kind)
+            metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
+            if fresh:
+                metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
+                metrics.inc(
+                    "krr_tpu_fetch_window_seconds_total", end - backfill_start, kind="backfill"
+                )
+            metrics.set("krr_tpu_scan_window_seconds", end - start)
+            metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
+            metrics.set("krr_tpu_scan_duration_seconds", t1 - t0, phase="discover")
+            metrics.set("krr_tpu_scan_duration_seconds", t2 - t1, phase="fetch")
+            metrics.set("krr_tpu_scan_duration_seconds", t3 - t2, phase="fold")
+            metrics.set("krr_tpu_scan_duration_seconds", t4 - t3, phase="compute")
+            metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
+            metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
+            self.logger.info(
+                f"{kind} scan folded window [{start:.0f}, {end:.0f}] "
+                f"({len(objects)} objects, {len(self.state.store.keys)} store rows): "
+                f"discover {t1 - t0:.2f}s, fetch {t2 - t1:.2f}s, "
+                f"fold {t3 - t2:.2f}s, compute {t4 - t3:.2f}s"
+            )
+            return True
+
+    # ----------------------------------------------------------- the loop
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.state.metrics.inc("krr_tpu_scan_failures_total")
+                self.logger.warning(f"Scan failed: {e} — serving the previous result")
+                self.logger.debug_exception()
+            await asyncio.sleep(self.scan_interval)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self.run(), name="krr-tpu-scan-scheduler")
+
+    async def stop(self) -> None:
+        """Graceful shutdown: cancel the loop (a scan cancelled mid-fetch
+        leaves the store and published snapshot untouched — ``last_end``
+        advances only after a completed fold) and wait for it to unwind."""
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None

@@ -1,0 +1,113 @@
+"""Resident serve state: the digest store + the published result snapshot.
+
+The cache is a READ/WRITE-locked published snapshot: HTTP handlers take the
+read side for the few microseconds it takes to grab the current
+:class:`Snapshot` reference, and the scheduler takes the write side only for
+the atomic swap at the END of a scan — so queries keep serving the previous
+result for the whole duration of an in-flight scan (fetch, fold, compute all
+happen outside the lock, on a private window that only touches the store
+once complete). The digest store itself is owned by the scheduler (one scan
+in flight at a time, serialized by ``scan_lock``); readers never touch it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from krr_tpu.server.metrics import MetricsRegistry
+
+if TYPE_CHECKING:
+    from krr_tpu.core.streaming import DigestStore
+    from krr_tpu.models.result import Result
+
+
+class ReadWriteLock:
+    """Asyncio readers-writer lock: any number of concurrent readers, one
+    exclusive writer; a waiting writer blocks new readers (no writer
+    starvation under a steady query stream)."""
+
+    def __init__(self) -> None:
+        self._cond = asyncio.Condition()
+        self._readers = 0
+        self._writers_waiting = 0
+        self._writing = False
+
+    @contextlib.asynccontextmanager
+    async def read(self):
+        async with self._cond:
+            while self._writing or self._writers_waiting:
+                await self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            async with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextlib.asynccontextmanager
+    async def write(self):
+        async with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writing or self._readers:
+                    await self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writing = True
+        try:
+            yield
+        finally:
+            async with self._cond:
+                self._writing = False
+                self._cond.notify_all()
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One published scan: everything a query needs, immutable by contract.
+
+    ``body_json`` is the whole-fleet JSON rendered AND encoded once at
+    publish time (via the machine formatter) — the hot unfiltered response
+    is a byte copy, not a per-request model dump or UTF-8 encode (multi-MB
+    at fleet scale, and the handler runs on the event loop).
+    """
+
+    result: "Result"
+    body_json: bytes
+    window_end: float  # unix ts of the scan window's right edge
+    published_at: float
+
+
+class ServerState:
+    """The serve process's shared mutable state."""
+
+    def __init__(self, store: "DigestStore") -> None:
+        self.store = store
+        #: One scan in flight at a time (scheduler ticks + any manual kicks).
+        self.scan_lock = asyncio.Lock()
+        self.rwlock = ReadWriteLock()
+        self.metrics = MetricsRegistry()
+        self.started_at = time.time()
+        #: Right edge of the last FOLDED window — the next delta starts one
+        #: step after it. Advanced only after a fold completes, so a
+        #: cancelled scan refetches its window instead of losing it.
+        self.last_end: Optional[float] = None
+        self._snapshot: Optional[Snapshot] = None
+
+    async def publish(self, snapshot: Snapshot) -> None:
+        async with self.rwlock.write():
+            self._snapshot = snapshot
+
+    async def snapshot(self) -> Optional[Snapshot]:
+        async with self.rwlock.read():
+            return self._snapshot
+
+    def peek(self) -> Optional[Snapshot]:
+        """Lock-free read for logging/tests (reference reads are atomic)."""
+        return self._snapshot

@@ -207,25 +207,22 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         spec = DigestSpec(
             gamma=fleet.gamma, min_value=fleet.min_value, num_buckets=fleet.cpu_counts.shape[1]
         )
-        mem_peak_mb = np.where(
-            np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
-        )
         with self.profile_span():
             if self.settings.state_path:
-                from krr_tpu.core.streaming import DigestStore, object_key
+                from krr_tpu.core.streaming import DigestStore
 
-                keys = [object_key(obj) for obj in fleet.objects]
                 with DigestStore.locked(self.settings.state_path):
                     store = DigestStore.open_or_create(self.settings.state_path, spec)
-                    rows = store.merge_window(
-                        keys, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, fleet.mem_total, mem_peak_mb
-                    )
+                    rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
                     cpu_p = store.cpu_percentile(rows, q)
                     mem_max = store.memory_peak(rows)
                     store.save(self.settings.state_path)
             else:
                 cpu_p = digest_ops.percentile_host(
                     spec, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, q
+                )
+                mem_peak_mb = np.where(
+                    np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
                 )
                 mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
         return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)

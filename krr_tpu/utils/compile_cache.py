@@ -49,15 +49,22 @@ def enable_compilation_cache(cache_dir: Optional[str]) -> Optional[str]:
     # still an enabled cache, and pretending otherwise would make every
     # later call re-run (and re-fail) the whole setup.
     _enabled_dir = path
-    try:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        if previously_enabled:
-            # JAX pins its cache object on first use; a later directory
-            # change (tests, long-lived embedders) needs an explicit reset.
+    if previously_enabled:
+        # JAX pins its cache object on first use; a later directory change
+        # (tests, long-lived embedders like `krr-tpu serve`) needs an
+        # explicit reset. Its OWN try/except: sharing one with the tuning
+        # knobs below would let a knob update that raises on some JAX
+        # version silently skip the reset and pin a long-lived process to
+        # the old cache directory.
+        try:
             from jax._src import compilation_cache
 
             compilation_cache.reset_cache()
+        except Exception:
+            pass
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
     return path

@@ -138,17 +138,27 @@ struct Stream {
   // only the pages its samples actually hit — pre-faulting the full dense
   // [series x buckets] state (2 GB at 100k x 2,560) per window was a
   // measured multi-second cost, paid again at every realloc doubling.
+  // A failed reserve must leave the stream EXACTLY as before (series_cap
+  // consistent with the allocated sizes): the counts matrix is allocated
+  // first, and the meta realloc's failure frees it — so no path commits one
+  // allocation without the other.
   bool reserve_series(long n) {
     if (n <= series_cap) return true;
     if (series_count > 0 || n > (1L << 24)) return false;
-    SeriesMeta* grown =
-        static_cast<SeriesMeta*>(std::realloc(series, sizeof(SeriesMeta) * static_cast<size_t>(n)));
-    if (!grown) return false;
-    series = grown;
+    double* fresh = nullptr;
     if (num_buckets > 0) {
-      double* fresh = static_cast<double*>(
+      fresh = static_cast<double*>(
           std::calloc(static_cast<size_t>(n) * static_cast<size_t>(num_buckets), sizeof(double)));
       if (!fresh) return false;
+    }
+    SeriesMeta* grown =
+        static_cast<SeriesMeta*>(std::realloc(series, sizeof(SeriesMeta) * static_cast<size_t>(n)));
+    if (!grown) {
+      std::free(fresh);
+      return false;
+    }
+    series = grown;
+    if (num_buckets > 0) {
       std::free(counts);
       counts = fresh;
     }

@@ -1,0 +1,800 @@
+"""Hermetic tests for the `krr-tpu serve` subsystem.
+
+Everything runs against the in-process fakes (`tests.fakes.servers`) or
+injected sources — no live cluster. The headline test is the incrementality
+proof: a server that folds a delta window on a scheduler tick serves
+recommendations bit-identical to a cold full-window scan over the union
+window, without a full re-fetch (asserted via the fetch-leg counters on
+``/metrics``).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.runner import ScanSession
+from krr_tpu.core.streaming import DigestStore
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.ops.digest import DigestSpec
+from krr_tpu.server.app import KrrServer
+from krr_tpu.server.metrics import MetricsRegistry
+from krr_tpu.server.state import ReadWriteLock
+
+from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+ORIGIN = FakeBackend.SERIES_ORIGIN
+STEP = 60.0  # fake series grid (timeframe_duration=1 minute)
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(scope="module")
+def serve_env(tmp_path_factory):
+    """A fake cluster whose Prometheus enforces the requested range: series
+    are anchored at ORIGIN on a 60 s grid and sliced to [start, end] — the
+    contract delta-window fetches ride on."""
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True
+
+    rng = np.random.default_rng(99)
+    web_pods = cluster.add_workload_with_pods("Deployment", "web", "default", pod_count=2)
+    db_pods = cluster.add_workload_with_pods("StatefulSet", "db", "prod", pod_count=1)
+    for pod in web_pods:
+        metrics.set_series("default", "main", pod,
+                           cpu=rng.gamma(2.0, 0.05, 180), memory=rng.uniform(5e7, 2e8, 180))
+    for pod in db_pods:
+        metrics.set_series("prod", "main", pod,
+                           cpu=rng.gamma(2.0, 0.2, 180), memory=rng.uniform(1e8, 4e8, 180))
+
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+    kubeconfig = tmp_path_factory.mktemp("serve") / "config"
+    kubeconfig.write_text(yaml.dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+        "users": [{"name": "fake", "user": {"token": "t"}}],
+    }))
+    yield {"server": server, "cluster": cluster, "metrics": metrics, "kubeconfig": str(kubeconfig)}
+    server.stop()
+
+
+def serve_config(serve_env, **overrides) -> Config:
+    other_args = {"history_duration": 1, "timeframe_duration": 1}
+    other_args.update(overrides.pop("other_args", {}))
+    defaults = dict(
+        kubeconfig=serve_env["kubeconfig"],
+        prometheus_url=serve_env["server"].url,
+        strategy="tdigest",
+        quiet=True,
+        server_port=0,
+        other_args=other_args,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+async def http_get(port: int, path: str, params: dict | None = None):
+    import httpx
+
+    async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+        return await client.get(path, params=params or {})
+
+
+def metric_value(metrics_text: str, name: str, **labels) -> float:
+    """Parse one series out of a Prometheus text exposition."""
+    want = name
+    if labels:
+        rendered = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        want = f"{name}{{{rendered}}}"
+    for line in metrics_text.splitlines():
+        if line.startswith(want + " "):
+            return float(line.split()[-1])
+    raise AssertionError(f"{want} not found in metrics:\n{metrics_text}")
+
+
+# ------------------------------------------------------------ endpoint tests
+class TestEndpoints:
+    def test_lifecycle_and_routes(self, serve_env):
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                # Before any scan: health says starting, queries 503.
+                r = await http_get(ks.port, "/healthz")
+                assert r.status_code == 503 and r.json()["status"] == "starting"
+                r = await http_get(ks.port, "/recommendations")
+                assert r.status_code == 503
+
+                assert await ks.scheduler.tick()
+
+                r = await http_get(ks.port, "/healthz")
+                assert r.status_code == 200
+                health = r.json()
+                assert health["status"] == "ok" and health["scans"] == 2
+                assert health["last_scan_unix"] == now[0]
+
+                # Whole fleet, pre-rendered JSON == the published result.
+                r = await http_get(ks.port, "/recommendations")
+                assert r.status_code == 200
+                assert r.headers["content-type"].startswith("application/json")
+                payload = r.json()
+                assert payload == json.loads(ks.state.peek().result.format("json"))
+                assert {s["object"]["namespace"] for s in payload["scans"]} == {"default", "prod"}
+
+                # Filters.
+                r = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                assert [s["object"]["name"] for s in r.json()["scans"]] == ["db"]
+                r = await http_get(ks.port, "/recommendations", {"workload": "web", "container": "main"})
+                assert {s["object"]["name"] for s in r.json()["scans"]} == {"web"}
+                r = await http_get(ks.port, "/recommendations", {"namespace": "nope"})
+                assert r.json()["scans"] == []
+
+                # Other machine formats; bad format is a clean 400.
+                r = await http_get(ks.port, "/recommendations", {"format": "yaml"})
+                assert r.status_code == 200 and yaml.safe_load(r.text)["scans"]
+                r = await http_get(ks.port, "/recommendations", {"format": "table"})
+                assert r.status_code == 400
+
+                # Metrics exposition: typed, help'd, and counting.
+                r = await http_get(ks.port, "/metrics")
+                assert r.status_code == 200
+                assert "# TYPE krr_tpu_scans_total counter" in r.text
+                assert metric_value(r.text, "krr_tpu_scans_total", kind="full") == 1
+                assert metric_value(r.text, "krr_tpu_digest_store_rows") == 2
+                assert metric_value(r.text, "krr_tpu_fleet_objects") == 2
+
+                # Unknown route and non-GET.
+                r = await http_get(ks.port, "/nope")
+                assert r.status_code == 404
+                import httpx
+
+                async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{ks.port}") as client:
+                    assert (await client.post("/recommendations")).status_code == 405
+
+                # HTTP metrics recorded per route.
+                r = await http_get(ks.port, "/metrics")
+                assert metric_value(r.text, "krr_tpu_http_requests_total", route="/recommendations", code="200") >= 5
+                assert metric_value(r.text, "krr_tpu_http_request_seconds_count", route="/healthz") >= 2
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+    def test_healthz_goes_stale_when_scans_stop(self, serve_env):
+        """A wedged scheduler must trip probes: once the published window
+        end falls multiple scan cadences behind the clock, /healthz flips
+        to 503 'stale' instead of serving old data as healthy forever."""
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                assert (await http_get(ks.port, "/healthz")).json()["status"] == "ok"
+                now[0] += 10 * 900.0  # scans stopped for ten cadences
+                r = await http_get(ks.port, "/healthz")
+                assert r.status_code == 503 and r.json()["status"] == "stale"
+                # Recommendations keep serving (stale beats nothing).
+                assert (await http_get(ks.port, "/recommendations")).status_code == 200
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+# ------------------------------------------------------ the incrementality e2e
+class TestIncrementalScans:
+    def test_incremental_fold_matches_cold_full_scan(self, serve_env):
+        """THE acceptance test: serve, advance the fake Prometheus clock, let
+        one scheduler tick fold the delta window — GET /recommendations must
+        equal a cold full-window scan over the union window (bit-identical
+        Decimal-rounded values, bit-identical digest counts) while the
+        fetch-leg counters prove only the delta was fetched."""
+        T1 = ORIGIN + 3600.0  # first scan: full 1 h window [ORIGIN, T1]
+        T2 = T1 + 1800.0      # delta tick: [T1 + STEP, T2]
+
+        async def main():
+            now = [T1]
+            incremental = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await incremental.start(run_scheduler=False)
+            try:
+                assert await incremental.scheduler.tick()
+                now[0] = T2  # the clock advances; the fake serves the new grid slice
+                assert await incremental.scheduler.tick()
+                live = (await http_get(incremental.port, "/recommendations")).json()
+                metrics_text = (await http_get(incremental.port, "/metrics")).text
+
+                # Cold control: a fresh server whose FIRST scan covers the
+                # union window [ORIGIN, T2] in one fetch.
+                cold = KrrServer(
+                    serve_config(serve_env, other_args={"history_duration": 1.5}),
+                    clock=lambda: T2,
+                )
+                await cold.start(run_scheduler=False)
+                try:
+                    assert await cold.scheduler.tick()
+                    control = (await http_get(cold.port, "/recommendations")).json()
+                    cold_metrics = (await http_get(cold.port, "/metrics")).text
+
+                    # Decimal-rounded recommendations bit-identical.
+                    assert live == control
+
+                    # Digest counts bit-exact between the accumulated store
+                    # and the cold union-window store.
+                    a, b = incremental.state.store, cold.state.store
+                    assert a.keys == b.keys and len(a.keys) == 2
+                    assert np.array_equal(a.cpu_counts, b.cpu_counts)
+                    assert np.array_equal(a.cpu_total, b.cpu_total)
+                    assert np.array_equal(a.cpu_peak, b.cpu_peak)
+                    assert np.array_equal(a.mem_total, b.mem_total)
+                    assert np.array_equal(a.mem_peak, b.mem_peak)
+                finally:
+                    await cold.shutdown()
+
+                # No full re-fetch happened: the second scan was a delta of
+                # exactly (T2 - T1 - STEP) seconds, and cumulative fetched
+                # window seconds stay far under two full windows.
+                assert metric_value(metrics_text, "krr_tpu_scans_total", kind="full") == 1
+                assert metric_value(metrics_text, "krr_tpu_scans_total", kind="delta") == 1
+                assert metric_value(metrics_text, "krr_tpu_scan_window_seconds") == T2 - T1 - STEP
+                assert metric_value(metrics_text, "krr_tpu_fetch_window_seconds_total", kind="delta") == T2 - T1 - STEP
+                assert metric_value(metrics_text, "krr_tpu_fetch_window_seconds_total", kind="full") == 3600.0
+                # The cold control paid the whole union window in one fetch.
+                assert metric_value(cold_metrics, "krr_tpu_fetch_window_seconds_total", kind="full") == 5400.0
+            finally:
+                await incremental.shutdown()
+
+        asyncio.run(main())
+
+    def test_misaligned_wall_clock_ticks_stay_exact(self, serve_env):
+        """Tick times off the 60 s evaluation grid (real wall-clock jitter):
+        the scheduler must clamp window edges to grid points — otherwise the
+        samples between the last evaluated point and the clock reading are
+        silently skipped — and remain bit-exact vs a cold union scan."""
+        T1 = ORIGIN + 3600.0
+        T2 = T1 + 1800.0
+
+        async def main():
+            now = [T1]
+            inc = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await inc.start(run_scheduler=False)
+            try:
+                assert await inc.scheduler.tick()  # full, end = T1
+                now[0] = T1 + 90.0                 # 1.5 steps later
+                assert await inc.scheduler.tick()  # delta [T1+60, T1+60]
+                assert inc.state.last_end == T1 + 60.0  # grid point, not wall clock
+                now[0] = T2
+                assert await inc.scheduler.tick()  # delta [T1+120, T2]
+                live = (await http_get(inc.port, "/recommendations")).json()
+
+                cold = KrrServer(
+                    serve_config(serve_env, other_args={"history_duration": 1.5}),
+                    clock=lambda: T2,
+                )
+                await cold.start(run_scheduler=False)
+                try:
+                    assert await cold.scheduler.tick()
+                    assert live == (await http_get(cold.port, "/recommendations")).json()
+                    a, b = inc.state.store, cold.state.store
+                    assert np.array_equal(a.cpu_counts, b.cpu_counts)
+                    assert np.array_equal(a.mem_total, b.mem_total)
+                finally:
+                    await cold.shutdown()
+            finally:
+                await inc.shutdown()
+
+        asyncio.run(main())
+
+    def test_per_query_failure_aborts_tick_without_advancing_cursor(self, serve_env):
+        """Per-QUERY failures inside a reachable Prometheus (batched + the
+        per-workload fallback both exhausted) degrade to empty rows in the
+        one-shot CLI — but a serve tick folding those empty rows and moving
+        its cursor past the window would silently drop the samples from the
+        accumulated history. The tick must abort instead."""
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                before_end = ks.state.last_end
+                totals = ks.state.store.cpu_total.copy()
+
+                now[0] += 1800.0
+                serve_env["metrics"].fail_queries = True
+                try:
+                    with pytest.raises(RuntimeError, match="failed terminally"):
+                        await ks.scheduler.tick()
+                finally:
+                    serve_env["metrics"].fail_queries = False
+                assert ks.state.last_end == before_end
+                assert np.array_equal(ks.state.store.cpu_total, totals)
+
+                assert await ks.scheduler.tick()  # same window, refetched whole
+                assert ks.state.last_end == now[0]
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_late_discovered_workload_gets_full_backfill(self, tmp_path):
+        """A workload that appears between discoveries must get a
+        FULL-window backfill, not just the current delta — its store row
+        then matches a cold scan's over the same window."""
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        metrics.enforce_range = True
+        rng = np.random.default_rng(7)
+        web_pods = cluster.add_workload_with_pods("Deployment", "web", "default", pod_count=1)
+        metrics.set_series("default", "main", web_pods[0],
+                           cpu=rng.gamma(2.0, 0.05, 180), memory=rng.uniform(5e7, 2e8, 180))
+        # db's series exist from the start; the WORKLOAD appears later.
+        metrics.set_series("prod", "main", "db-0",
+                           cpu=rng.gamma(2.0, 0.2, 180), memory=rng.uniform(1e8, 4e8, 180))
+        server = ServerThread(FakeBackend(cluster, metrics)).start()
+        kubeconfig = tmp_path / "config"
+        kubeconfig.write_text(yaml.dump({
+            "current-context": "fake",
+            "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+            "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+            "users": [{"name": "fake", "user": {"token": "t"}}],
+        }))
+        env = {"server": server, "kubeconfig": str(kubeconfig)}
+        T1, T2 = ORIGIN + 3600.0, ORIGIN + 5400.0
+
+        async def main():
+            now = [T1]
+            inc = KrrServer(
+                serve_config(env, discovery_interval_seconds=1.0), clock=lambda: now[0]
+            )
+            await inc.start(run_scheduler=False)
+            try:
+                assert await inc.scheduler.tick()
+                assert len(inc.state.store.keys) == 1
+
+                cluster.add_workload_with_pods("StatefulSet", "db", "prod", pod_count=1)
+                now[0] = T2
+                assert await inc.scheduler.tick()  # re-discovers; db is fresh
+                m = (await http_get(inc.port, "/metrics")).text
+                assert metric_value(m, "krr_tpu_backfilled_objects_total") == 1
+                assert metric_value(m, "krr_tpu_fetch_window_seconds_total", kind="backfill") == 3600.0
+
+                # db's backfilled row equals a cold scan's over the same
+                # [T2 - H, T2] window.
+                cold = KrrServer(serve_config(env), clock=lambda: T2)
+                await cold.start(run_scheduler=False)
+                try:
+                    assert await cold.scheduler.tick()
+                    db_key = next(k for k in inc.state.store.keys if "/db/" in k)
+                    a = inc.state.store
+                    b = cold.state.store
+                    ai, bi = a.keys.index(db_key), b.keys.index(db_key)
+                    assert np.array_equal(a.cpu_counts[ai], b.cpu_counts[bi])
+                    assert a.cpu_total[ai] == b.cpu_total[bi]
+                    assert a.mem_total[ai] == b.mem_total[bi]
+                    assert a.mem_peak[ai] == b.mem_peak[bi]
+                finally:
+                    await cold.shutdown()
+            finally:
+                await inc.shutdown()
+
+        asyncio.run(main())
+        server.stop()
+
+    def test_tick_with_no_new_window_is_skipped(self, serve_env):
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                first = ks.state.peek()
+                # Clock hasn't advanced a full step: nothing new to fetch.
+                assert not await ks.scheduler.tick()
+                assert ks.state.peek() is first
+                assert ks.state.metrics.value("krr_tpu_scans_skipped_total") == 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+# ----------------------------------------- injected-source behavioral tests
+def _one_object(name="web", namespace="default"):
+    return K8sObjectData(
+        cluster="c", namespace=namespace, name=name, kind="Deployment", container="main",
+        pods=[f"{name}-0"],
+        allocations=ResourceAllocations(
+            requests={ResourceType.CPU: None, ResourceType.Memory: None},
+            limits={ResourceType.CPU: None, ResourceType.Memory: None},
+        ),
+    )
+
+
+class _Inventory:
+    def __init__(self, objects):
+        self.objects = objects
+
+    async def list_clusters(self):
+        return ["c"]
+
+    async def list_scannable_objects(self, clusters):
+        return list(self.objects)
+
+
+class _GatedSource:
+    """A history source whose fetch blocks until released — for asserting
+    behavior DURING an in-flight scan."""
+
+    def __init__(self, cpu_value: float):
+        self.cpu_value = cpu_value
+        self.started = asyncio.Event()
+        self.release = asyncio.Event()
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+        self.started.set()
+        await self.release.wait()
+        return {
+            ResourceType.CPU: [{obj.pods[0]: np.full(10, self.cpu_value)} for obj in objects],
+            ResourceType.Memory: [{obj.pods[0]: np.full(10, 1e8)} for obj in objects],
+        }
+
+
+def _injected_server(source, now: list, objects=None) -> KrrServer:
+    config = Config(
+        strategy="tdigest", quiet=True, server_port=0,
+        other_args={"history_duration": 1, "timeframe_duration": 1},
+    )
+    session = ScanSession(
+        config, inventory=_Inventory(objects or [_one_object()]),
+        history_factory=lambda cluster: source,
+    )
+    return KrrServer(config, session=session, clock=lambda: now[0])
+
+
+class TestInFlightScans:
+    def test_queries_serve_previous_result_during_scan(self):
+        async def main():
+            source = _GatedSource(cpu_value=0.1)
+            now = [1_700_000_000.0]
+            ks = _injected_server(source, now)
+            await ks.start(run_scheduler=False)
+            try:
+                source.release.set()
+                assert await ks.scheduler.tick()
+                before = (await http_get(ks.port, "/recommendations")).json()
+
+                # Second scan: slow fetch of hotter samples. While it is in
+                # flight, queries must keep serving the previous snapshot.
+                source.cpu_value = 5.0
+                source.started = asyncio.Event()
+                source.release = asyncio.Event()
+                now[0] += 120.0  # small enough to stay inside the healthz freshness bound
+                tick = asyncio.create_task(ks.scheduler.tick())
+                await asyncio.wait_for(source.started.wait(), timeout=10)
+                during = (await http_get(ks.port, "/recommendations")).json()
+                assert during == before
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["status"] == "ok"
+
+                source.release.set()
+                assert await asyncio.wait_for(tick, timeout=30)
+                after = (await http_get(ks.port, "/recommendations")).json()
+                assert after != before  # the hot delta moved the percentile
+                cpu = after["scans"][0]["recommended"]["requests"]["cpu"]["value"]
+                assert float(cpu) > float(before["scans"][0]["recommended"]["requests"]["cpu"]["value"])
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_graceful_shutdown_mid_scan(self):
+        """Shutdown while a scan is mid-fetch: the scheduler task unwinds,
+        nothing partial reaches the store or the published snapshot, and
+        last_end stays unset (the window would be refetched, not lost)."""
+
+        async def main():
+            source = _GatedSource(cpu_value=0.1)  # never released
+            ks = _injected_server(source, now=[1_700_000_000.0])
+            await ks.start(run_scheduler=True)
+            await asyncio.wait_for(source.started.wait(), timeout=10)
+            # Queries still answered while the first scan hangs.
+            r = await http_get(ks.port, "/healthz")
+            assert r.status_code == 503 and r.json()["status"] == "starting"
+
+            await asyncio.wait_for(ks.shutdown(), timeout=10)
+            assert ks.scheduler._task is None
+            assert ks.state.peek() is None
+            assert ks.state.last_end is None
+            assert ks.state.store.keys == []
+
+        asyncio.run(main())
+
+    def test_failed_cluster_fetch_aborts_tick_without_losing_window(self):
+        """Unlike the one-shot CLI's degrade-to-UNKNOWN, a serve tick whose
+        cluster fetch fails must abort WITHOUT advancing last_end: folding
+        an empty window and moving on would permanently lose that window's
+        samples from the accumulated store."""
+
+        class FailingSource:
+            def __init__(self):
+                self.fail = False
+                self.inner = _GatedSource(0.1)
+                self.inner.release.set()
+
+            async def gather_fleet(self, *args, **kwargs):
+                if self.fail:
+                    raise RuntimeError("prometheus down")
+                return await self.inner.gather_fleet(*args, **kwargs)
+
+        async def main():
+            source = FailingSource()
+            now = [1_700_000_000.0]
+            ks = _injected_server(source, now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                before_end = ks.state.last_end
+                before = ks.state.peek()
+                totals_before = ks.state.store.cpu_total.copy()
+
+                now[0] += 3600.0
+                source.fail = True
+                with pytest.raises(RuntimeError):
+                    await ks.scheduler.tick()
+                assert ks.state.last_end == before_end  # window NOT consumed
+                assert ks.state.peek() is before
+                assert np.array_equal(ks.state.store.cpu_total, totals_before)
+
+                source.fail = False
+                assert await ks.scheduler.tick()  # same window, refetched
+                assert ks.state.last_end > before_end
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_failed_scan_keeps_serving_and_counts(self):
+        async def main():
+            source = _GatedSource(cpu_value=0.1)
+            now = [1_700_000_000.0]
+            ks = _injected_server(source, now)
+            await ks.start(run_scheduler=False)
+            try:
+                source.release.set()
+                assert await ks.scheduler.tick()
+                before = (await http_get(ks.port, "/recommendations")).json()
+                now[0] += 3600.0
+
+                # Discovery blowing up mid-tick must not unpublish anything.
+                async def boom(clusters):
+                    raise RuntimeError("apiserver down")
+
+                ks.scheduler._objects = None  # force re-discovery
+                ks.session.get_inventory().list_scannable_objects = boom
+                with pytest.raises(RuntimeError):
+                    await ks.scheduler.tick()
+                assert (await http_get(ks.port, "/recommendations")).json() == before
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestChurnCompaction:
+    def test_rediscovery_compacts_dropped_workloads(self):
+        async def main():
+            objects = [_one_object("web"), _one_object("db", namespace="prod")]
+            inventory = _Inventory(objects)
+            source = _GatedSource(cpu_value=0.2)
+            source.release.set()
+            config = Config(
+                strategy="tdigest", quiet=True, server_port=0,
+                discovery_interval_seconds=0.001,  # re-discover every tick
+                other_args={"history_duration": 1, "timeframe_duration": 1},
+            )
+            session = ScanSession(config, inventory=inventory, history_factory=lambda c: source)
+            ks = KrrServer(config, session=session)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                assert len(ks.state.store.keys) == 2
+
+                del inventory.objects[1]  # the db workload is deleted
+                await asyncio.sleep(0.01)
+                ks.scheduler.clock = lambda: ks.state.last_end + 120.0
+                assert await ks.scheduler.tick()
+                assert len(ks.state.store.keys) == 1
+                assert ks.state.metrics.value("krr_tpu_store_compacted_rows_total") == 1
+                payload = (await http_get(ks.port, "/recommendations")).json()
+                assert [s["object"]["name"] for s in payload["scans"]] == ["web"]
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------------------- unit tests
+class TestDigestStoreServeSupport:
+    def _store(self, keys=("a", "b", "c")):
+        spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=32)
+        store = DigestStore(spec=spec)
+        n = len(keys)
+        counts = np.zeros((n, 32), np.float32)
+        counts[:, 3] = [5, 7, 11]
+        store.merge_window(
+            list(keys), counts, np.asarray([5.0, 7.0, 11.0]), np.asarray([0.5, 0.7, 1.1]),
+            np.asarray([10.0, 10.0, 10.0]), np.asarray([100.0, 200.0, 300.0]),
+        )
+        return store
+
+    def test_compact_drops_only_stale_rows(self):
+        store = self._store()
+        assert store.compact({"a", "c"}) == 1
+        assert store.keys == ["a", "c"]
+        assert store.cpu_total.tolist() == [5.0, 11.0]
+        assert store.mem_peak.tolist() == [100.0, 300.0]
+        # Index rebuilt: a later merge targets the surviving rows.
+        rows = store.merge_window(
+            ["c"], np.zeros((1, 32), np.float32), np.asarray([1.0]), np.asarray([0.1]),
+            np.asarray([0.0]), np.asarray([-np.inf]),
+        )
+        assert rows.tolist() == [1] and store.cpu_total[1] == 12.0
+        assert store.compact({"a", "c"}) == 0  # no-op when nothing is stale
+
+    def test_nbytes_tracks_growth(self):
+        store = self._store()
+        before = store.nbytes
+        assert before > 0
+        store.compact({"a"})
+        assert store.nbytes < before
+
+
+class TestMetricsRegistry:
+    def test_render_and_readback(self):
+        registry = MetricsRegistry()
+        registry.inc("krr_tpu_scans_total", kind="full")
+        registry.inc("krr_tpu_scans_total", kind="full")
+        registry.set("krr_tpu_scan_window_seconds", 1740.0)
+        registry.observe("krr_tpu_http_request_seconds", 0.25, route="/metrics")
+        registry.observe("krr_tpu_http_request_seconds", 0.75, route="/metrics")
+        text = registry.render()
+        assert '# TYPE krr_tpu_scans_total counter' in text
+        assert 'krr_tpu_scans_total{kind="full"} 2' in text
+        assert "krr_tpu_scan_window_seconds 1740" in text
+        assert 'krr_tpu_http_request_seconds_sum{route="/metrics"} 1' in text
+        assert 'krr_tpu_http_request_seconds_count{route="/metrics"} 2' in text
+        assert registry.value("krr_tpu_scans_total", kind="full") == 2
+        assert registry.value("krr_tpu_scans_total", kind="delta") is None
+
+    def test_label_escaping(self):
+        registry = MetricsRegistry()
+        registry.inc("krr_tpu_http_requests_total", route='we"ird\\path', code="200")
+        assert 'route="we\\"ird\\\\path"' in registry.render()
+
+
+class TestReadWriteLock:
+    def test_writer_excludes_readers(self):
+        async def main():
+            lock = ReadWriteLock()
+            order = []
+
+            async def writer():
+                async with lock.write():
+                    order.append("w-in")
+                    await asyncio.sleep(0.02)
+                    order.append("w-out")
+
+            async def reader(tag):
+                async with lock.read():
+                    order.append(tag)
+
+            async with lock.read():  # readers coexist
+                async with lock.read():
+                    pass
+            w = asyncio.create_task(writer())
+            await asyncio.sleep(0.01)  # writer holds the lock
+            await asyncio.gather(reader("r1"), reader("r2"), w)
+            assert order[0] == "w-in" and order[1] == "w-out"
+            assert sorted(order[2:]) == ["r1", "r2"]
+
+        asyncio.run(main())
+
+
+class TestServeCLI:
+    def test_serve_help_lists_server_and_strategy_flags(self):
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(app, ["serve", "--help"])
+        assert result.exit_code == 0, result.output
+        assert "Server Settings:" in result.output
+        for flag in ("--scan-interval", "--discovery-interval", "--host", "--port",
+                     "--digest_gamma", "--state_path"):
+            assert flag in result.output, flag
+        assert "--formatter" not in result.output  # per-request format instead
+
+    def test_serve_invalid_settings_clean_error(self):
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(app, ["serve", "--digest_gamma", "0.5"])
+        assert result.exit_code != 0
+        assert "Invalid settings" in result.output and "digest_gamma" in result.output
+
+
+class TestStatePersistence:
+    def test_state_path_resumes_with_delta_not_double_fold(self, serve_env, tmp_path):
+        """A restarted server must resume BOTH the digests and the window
+        cursor: its first scan folds the delta since the pre-restart fold —
+        re-folding the full window onto the resumed store would double-count
+        every overlap sample."""
+        state_path = str(tmp_path / "serve-state.npz")
+        T1, T2 = ORIGIN + 3600.0, ORIGIN + 5400.0
+
+        async def main():
+            config = serve_config(
+                serve_env, other_args={"history_duration": 1, "timeframe_duration": 1,
+                                       "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: T1)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                saved_keys = list(ks.state.store.keys)
+            finally:
+                await ks.shutdown()
+
+            # A restart INSIDE one step window: nothing new to fetch, but
+            # the server must publish from the resident store, not 503.
+            quick = KrrServer(config, clock=lambda: T1 + 30.0)
+            await quick.start(run_scheduler=False)
+            try:
+                assert quick.state.last_end == T1
+                assert not await quick.scheduler.tick()  # no new window...
+                r = await http_get(quick.port, "/recommendations")
+                assert r.status_code == 200  # ...yet resident data serves
+                assert len(r.json()["scans"]) == 2
+            finally:
+                await quick.shutdown()
+
+            resumed = KrrServer(config, clock=lambda: T2)
+            await resumed.start(run_scheduler=False)
+            try:
+                # Digests AND the window cursor resumed before any scan ran.
+                assert resumed.state.store.keys == saved_keys
+                assert resumed.state.last_end == T1
+                assert await resumed.scheduler.tick()
+                m = resumed.state.metrics
+                assert m.value("krr_tpu_scans_total", kind="delta") == 1
+                assert m.value("krr_tpu_scans_total", kind="full") is None
+
+                # The restarted store equals one continuous server's.
+                now = [T1]
+                continuous = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+                await continuous.start(run_scheduler=False)
+                try:
+                    assert await continuous.scheduler.tick()
+                    now[0] = T2
+                    assert await continuous.scheduler.tick()
+                    a, b = resumed.state.store, continuous.state.store
+                    assert a.keys == b.keys
+                    assert np.array_equal(a.cpu_counts, b.cpu_counts)
+                    assert np.array_equal(a.cpu_total, b.cpu_total)
+                    assert np.array_equal(a.mem_total, b.mem_total)
+                finally:
+                    await continuous.shutdown()
+            finally:
+                await resumed.shutdown()
+
+        asyncio.run(main())

@@ -288,3 +288,33 @@ class TestStreamedStatePath:
         np.testing.assert_array_equal(a.cpu_total, b.cpu_total)
         np.testing.assert_array_equal(a.cpu_peak, b.cpu_peak)
         np.testing.assert_array_equal(a.mem_peak, b.mem_peak)
+
+
+class TestFoldFleet:
+    def test_fold_fleet_matches_manual_merge(self):
+        """The delta-window fold entry point (serve scheduler + tdigest
+        state_path merge) is exactly merge_window with the keys derived and
+        memory peaks converted bytes → MB."""
+        from krr_tpu.models.series import DigestedFleet
+
+        spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=64)
+        objects = [make_obj("a", ["a-0"]), make_obj("b", ["b-0"])]
+        fleet = DigestedFleet.empty(objects, spec.gamma, spec.min_value, spec.num_buckets)
+        fleet.merge_cpu_row(0, np.eye(1, 64, 5, dtype=np.float64)[0] * 3, 3.0, 0.4)
+        fleet.merge_mem_row(0, 3.0, 2.5e8)  # bytes
+        # object b: no data at all (empty digest, -inf peaks)
+
+        store = DigestStore(spec=spec)
+        rows = store.fold_fleet(fleet, mem_scale=1e6)
+        assert rows.tolist() == [0, 1]
+        assert store.keys == [object_key(obj) for obj in objects]
+        assert store.cpu_total.tolist() == [3.0, 0.0]
+        assert store.mem_peak[0] == np.float32(250.0)  # MB
+        assert store.mem_peak[1] == -np.inf  # empty rows stay empty, not NaN
+
+        # Folding a second identical window doubles counts, maxes peaks —
+        # and a repeated fold targets the SAME rows.
+        rows2 = store.fold_fleet(fleet, mem_scale=1e6)
+        assert rows2.tolist() == [0, 1]
+        assert store.cpu_total.tolist() == [6.0, 0.0]
+        assert store.mem_peak[0] == np.float32(250.0)
