@@ -57,6 +57,13 @@ BENCH_CHUNK (default 8192), BENCH_RUNS (default 5), BENCH_PIPELINE_DEPTH
 (default 16), BENCH_PY_SAMPLE (default 3), BENCH_SKIP_DIGEST,
 BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default 512). The e2e leg runs `bench_e2e.py` in a subprocess with
 BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
+
+``--smoke``: the same harness at toy scale (tiny fleet, 1 run, e2e legs
+included) — a CI-speed end-to-end regression gate, not a measurement. Every
+leg still executes (kernels, parity checks, both bench_e2e subprocesses, the
+streamed-pipeline fleet leg), so a pipeline break that only shows up
+end-to-end fails here in minutes instead of surfacing in the next full bench
+round. Explicitly exported BENCH_* values still win over the smoke defaults.
 """
 
 from __future__ import annotations
@@ -94,7 +101,28 @@ def python_reference_seconds_per_container(timesteps: int, sample: int) -> float
     return (time.perf_counter() - start) / sample
 
 
+SMOKE_DEFAULTS = {
+    "BENCH_CONTAINERS": "64",
+    "BENCH_TIMESTEPS": "1024",
+    "BENCH_RUNS": "1",
+    "BENCH_PIPELINE_DEPTH": "2",
+    "BENCH_PY_SAMPLE": "1",
+    "BENCH_PARITY_ROWS": "8",
+    # bench_e2e subprocess legs, toy-sized but all EXECUTED — including the
+    # full-fleet streamed-pipeline leg (FLEET_ROWS) whose JSON carries
+    # fleet_e2e_overlap_pct and the staged-control ratio.
+    "BENCH_E2E_CONTAINERS": "8",
+    "BENCH_E2E_SAMPLES": "48",
+    "BENCH_E2E_INGEST_ROWS": "64",
+    "BENCH_E2E_STORE_ROWS": "256",
+    "BENCH_E2E_FLEET_ROWS": "12",
+}
+
+
 def main() -> None:
+    if "--smoke" in sys.argv:
+        for key, value in SMOKE_DEFAULTS.items():
+            os.environ.setdefault(key, value)
     # Shapes are aligned down to the kernel tile boundaries (8 rows, 128
     # lanes) so `fleet_exact` takes its zero-copy path: at ~10 GB of resident
     # history there is no HBM headroom for `_pad_inputs` to make padded

@@ -23,12 +23,22 @@ http.client read ~1.1 GB/s (see BASELINE.md's ingest budget). The
 digest-ingest path ships no bulk arrays to the device at all, which is why
 its e2e number is several times the raw path's here.
 
+Digest-ingest scans run the STREAMED scan pipeline by default
+(`krr_tpu.core.pipeline`: discovery, fetch, and fold overlapped through a
+bounded queue); the fleet leg also times a ``pipeline_depth=0`` staged
+control at the same warm caches and records the streamed/staged ratio plus
+the measured stage overlap (``fleet_e2e_overlap_pct``). Streamed scans fuse
+discovery CPU into the fetch leg, so ``*_discover_cpu_seconds`` reads 0 for
+them — the discover WALL span is still reported from inside the pipeline.
+
 Prints ONE JSON line:
     {"e2e_objects_per_sec": N, "e2e_objects_per_sec_cold": N,
      "e2e_containers": N, "discover_seconds": N, "fetch_seconds": N,
      "compute_seconds": N, "e2e_digest_objects_per_sec": N,
-     "e2e_digest_fetch_seconds": N, "digest_ingest_100k_objects_per_sec": N,
-     "fleet_e2e_*": ...,     # ONE FULL 100k-container scan with phase breakdown
+     "e2e_digest_fetch_seconds": N, "e2e_digest_overlap_pct": N,
+     "digest_ingest_100k_objects_per_sec": N,
+     "fleet_e2e_*": ...,     # ONE FULL 100k-container scan with phase
+                             # breakdown + staged control + overlap pct
      "digest_store_*": ...,  # 100k x 2560 store merge/query/save/load + MB
      "ingest_*": ...}        # scanner sink throughputs + bytes/sample
 
@@ -239,6 +249,7 @@ def run_e2e(n_containers: int, samples: int) -> dict:
         "compute_seconds": round(stats["compute_seconds"], 3),
         "e2e_digest_objects_per_sec": round(digest_stats["objects"] / digest_elapsed, 1),
         "e2e_digest_fetch_seconds": round(digest_stats["fetch_seconds"], 3),
+        "e2e_digest_overlap_pct": round(digest_stats.get("pipeline_overlap_pct", 0.0), 1),
         "e2e_digest_proxied_objects_per_sec": round(proxied_stats["objects"] / proxied_elapsed, 1),
         "e2e_digest_proxied_fetch_seconds": round(proxied_stats["fetch_seconds"], 3),
     }
@@ -271,12 +282,27 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
         elapsed, stats = min(
             (one_scan(config) for _ in range(2)), key=lambda pair: pair[0]
         )
+        # Staged control at the same warm caches: pipeline_depth=0 takes the
+        # gather-then-fold path the streamed pipeline replaced, so the round
+        # record carries the streamed/staged ratio as one measured pair
+        # instead of a cross-round comparison. (Rig caveat: on a core-starved
+        # box the stages serialize regardless of overlap, so the ratio there
+        # reads the rig, not the pipeline.)
+        staged_elapsed, staged_stats = min(
+            (one_scan(config.model_copy(update={"pipeline_depth": 0})) for _ in range(2)),
+            key=lambda pair: pair[0],
+        )
     return {
         "fleet_e2e_containers": int(stats["objects"]),
         "fleet_e2e_objects_per_sec": round(stats["objects"] / elapsed, 1),
         "fleet_e2e_objects_per_sec_cold": round(cold_stats["objects"] / cold_elapsed, 1),
         "fleet_e2e_seconds": round(elapsed, 3),
         "fleet_e2e_cold_seconds": round(cold_elapsed, 3),
+        "fleet_e2e_staged_seconds": round(staged_elapsed, 3),
+        "fleet_e2e_vs_staged": round(elapsed / staged_elapsed, 3) if staged_elapsed else None,
+        "fleet_e2e_overlap_pct": round(stats.get("pipeline_overlap_pct", 0.0), 1),
+        "fleet_e2e_pipeline_fetch_seconds": round(stats.get("pipeline_fetch_seconds", 0.0), 3),
+        "fleet_e2e_pipeline_fold_seconds": round(stats.get("pipeline_fold_seconds", 0.0), 3),
         "fleet_e2e_discover_seconds": round(stats["discover_seconds"], 3),
         "fleet_e2e_fetch_seconds": round(stats["fetch_seconds"], 3),
         "fleet_e2e_compute_seconds": round(stats["compute_seconds"], 3),
@@ -458,6 +484,8 @@ def main() -> None:
             f"{out['fleet_e2e_objects_per_sec']:.0f} objects/s warm "
             f"({out['fleet_e2e_seconds']}s: discover {out['fleet_e2e_discover_seconds']}s, "
             f"fetch {out['fleet_e2e_fetch_seconds']}s, compute {out['fleet_e2e_compute_seconds']}s; "
+            f"staged control {out['fleet_e2e_staged_seconds']}s -> x{out['fleet_e2e_vs_staged']}, "
+            f"pipeline overlap {out['fleet_e2e_overlap_pct']}%; "
             f"cold {out['fleet_e2e_cold_seconds']}s; warm CPU split: client fetch "
             f"{out['fleet_e2e_fetch_cpu_seconds']}s, server {out['fleet_e2e_server_cpu_seconds']}s)",
             file=sys.stderr,

@@ -86,6 +86,15 @@ class Config(pd.BaseModel):
     #: benchmarking against recorded history. Default: now.
     scan_end_timestamp: Optional[float] = None
 
+    #: Scan-pipeline depth (`krr_tpu.core.pipeline`): digest-ingest scans
+    #: fetch the fleet as per-namespace batches and fold each batch while
+    #: the rest still fetch, with at most this many batches in flight at
+    #: each of the fetch and the fold-queue stages (bounded backpressure:
+    #: ≤ 2 × depth + 1 fetched-but-unfolded batches ever exist). 0 disables
+    #: streaming — the staged gather-then-fold path, kept for A/B timing
+    #: and as an escape hatch.
+    pipeline_depth: int = pd.Field(4, ge=0)
+
     # Server (`krr-tpu serve`) settings
     server_host: str = "127.0.0.1"
     #: 0 = an ephemeral port (tests; the chosen port is logged).

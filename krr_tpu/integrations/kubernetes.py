@@ -331,7 +331,9 @@ class ClusterLoader:
         pods = await self._resolve_pods(metadata["namespace"], spec.get("selector"))
         return self._make_objects(kind, item, pods)
 
-    async def _list_workloads(self, kind: str, path: str) -> list[K8sObjectData]:
+    async def _list_kind_items(self, kind: str, path: str) -> list[dict[str, Any]]:
+        """List one workload kind's items, namespace-filtered — the listing
+        half of discovery, shared by the staged and streamed paths."""
         self.logger.debug(f"Listing {kind}s in {self.cluster or 'default'}")
         api = await self.api()
         if self.config.namespaces == "*":
@@ -352,6 +354,10 @@ class ClusterLoader:
             if self._namespace_included(item["metadata"]["namespace"])
         ]
         self.logger.debug(f"Found {len(items)} {kind}s in {self.cluster or 'default'}")
+        return items
+
+    async def _list_workloads(self, kind: str, path: str) -> list[K8sObjectData]:
+        items = await self._list_kind_items(kind, path)
         if self.config.bulk_pod_discovery:
             # Bulk mode awaits ONE pod-index fetch per distinct namespace,
             # then builds objects in a plain synchronous loop: a gather of
@@ -399,6 +405,82 @@ class ClusterLoader:
         # resolution); this flatten is the whole remaining job.
         return [obj for objs in per_kind for obj in objs]
 
+    async def stream_scannable_objects(self):
+        """Yield ``(positions, objects)`` batches, one per namespace, as each
+        namespace's pod index resolves — the streamed-discovery half of the
+        scan pipeline (`krr_tpu.core.pipeline`): a namespace whose inventory
+        is complete starts its Prometheus fetch while other namespaces' pod
+        indexes are still in flight.
+
+        ``positions[i]`` is the staged index ``objects[i]`` would have had in
+        :meth:`list_scannable_objects`' flat list (kind-major item order), so
+        a consumer that sorts by position reconstructs the staged order
+        exactly — streamed and staged scans then disagree on nothing, list
+        order included. Failure granularity is FINER than the staged path's
+        cluster-wide fail-soft: a namespace whose pod index fails is skipped
+        with a logged error while its siblings still scan (the staged path
+        would drop the whole cluster); a failed workload listing still drops
+        the cluster, matching staged."""
+        if not self.config.bulk_pod_discovery:
+            # Per-workload server-side pod resolution has no per-namespace
+            # completion structure to stream — one staged batch.
+            objects = await self.list_scannable_objects()
+            if objects:
+                yield list(range(len(objects))), objects
+            return
+        self.logger.debug(f"Streaming scannable objects in {self.cluster or 'default'}")
+        try:
+            per_kind = await asyncio.gather(
+                *[self._list_kind_items(kind, path) for kind, path in WORKLOAD_ENDPOINTS]
+            )
+        except Exception as e:
+            self.logger.error(f"Error trying to list workloads in cluster {self.cluster or 'default'}: {e}")
+            self.logger.debug_exception()
+            return
+        # Staged (kind-major) traversal, bucketed per namespace with each
+        # workload's would-be object position carried along.
+        position = 0
+        by_namespace: dict[str, list[tuple[str, dict[str, Any], int]]] = {}
+        for (kind, _path), items in zip(WORKLOAD_ENDPOINTS, per_kind):
+            for item in items:
+                pod_spec = (((item.get("spec") or {}).get("template") or {}).get("spec")) or {}
+                by_namespace.setdefault(item["metadata"]["namespace"], []).append(
+                    (kind, item, position)
+                )
+                position += len(pod_spec.get("containers") or [])
+        tasks = {
+            asyncio.ensure_future(self._namespace_pod_labels(namespace)): namespace
+            for namespace in by_namespace
+        }
+        try:
+            pending = set(tasks)
+            while pending:
+                done, pending = await asyncio.wait(pending, return_when=asyncio.FIRST_COMPLETED)
+                for task in done:
+                    namespace = tasks[task]
+                    try:
+                        index = task.result()
+                    except Exception as e:
+                        self.logger.error(
+                            f"Error resolving pods for namespace {namespace} in "
+                            f"{self.cluster or 'default'}: {e} — skipping its workloads"
+                        )
+                        self.logger.debug_exception()
+                        continue
+                    positions: list[int] = []
+                    objects: list[K8sObjectData] = []
+                    for kind, item, item_position in by_namespace[namespace]:
+                        selector = (item.get("spec") or {}).get("selector")
+                        pods = index.select(selector) if selector else []
+                        built = self._make_objects(kind, item, pods)
+                        positions.extend(range(item_position, item_position + len(built)))
+                        objects.extend(built)
+                    if objects:
+                        yield positions, objects
+        finally:
+            for task in tasks:  # an abandoned generator must not leak tasks
+                task.cancel()
+
     async def close(self) -> None:
         if self._api is not None:
             await self._api.close()
@@ -430,13 +512,54 @@ class KubernetesLoader:
             return contexts
         return [context for context in contexts if context in self.config.clusters]
 
-    async def list_scannable_objects(self, clusters: Optional[list[str]]) -> list[K8sObjectData]:
+    def _loaders(self, clusters: Optional[list[str]]) -> list[ClusterLoader]:
         if clusters is None:
-            loaders = [ClusterLoader(cluster=None, config=self.config, logger=self.logger)]
-        else:
-            loaders = [ClusterLoader(cluster=c, config=self.config, logger=self.logger) for c in clusters]
+            return [ClusterLoader(cluster=None, config=self.config, logger=self.logger)]
+        return [ClusterLoader(cluster=c, config=self.config, logger=self.logger) for c in clusters]
+
+    async def list_scannable_objects(self, clusters: Optional[list[str]]) -> list[K8sObjectData]:
+        loaders = self._loaders(clusters)
         try:
             nested = await asyncio.gather(*[loader.list_scannable_objects() for loader in loaders])
         finally:
             await asyncio.gather(*[loader.close() for loader in loaders], return_exceptions=True)
         return [obj for objs in nested for obj in objs]
+
+    async def stream_scannable_objects(self, clusters: Optional[list[str]]):
+        """Yield ``(cluster_ordinal, positions, objects)`` batches as each
+        cluster's namespaces complete discovery (`ClusterLoader.
+        stream_scannable_objects`), interleaved across clusters in completion
+        order. ``cluster_ordinal`` is the cluster's index in the staged
+        cluster list, so sorting batches by ``(ordinal, position)`` recovers
+        exactly :meth:`list_scannable_objects`' flat order. Per-cluster
+        errors degrade to that cluster's absence (fail-soft, like staged)."""
+        loaders = self._loaders(clusters)
+        queue: asyncio.Queue = asyncio.Queue()
+        _CLUSTER_DONE = object()
+
+        async def pump(ordinal: int, loader: ClusterLoader) -> None:
+            try:
+                async for positions, objects in loader.stream_scannable_objects():
+                    await queue.put((ordinal, positions, objects))
+            except Exception as e:
+                self.logger.error(
+                    f"Error trying to list workloads in cluster {loader.cluster or 'default'}: {e}"
+                )
+                self.logger.debug_exception()
+            finally:
+                await queue.put(_CLUSTER_DONE)
+
+        pumps = [asyncio.ensure_future(pump(i, loader)) for i, loader in enumerate(loaders)]
+        try:
+            remaining = len(loaders)
+            while remaining:
+                item = await queue.get()
+                if item is _CLUSTER_DONE:
+                    remaining -= 1
+                    continue
+                yield item
+        finally:
+            for task in pumps:  # an abandoned generator must not leak pumps
+                task.cancel()
+            await asyncio.gather(*pumps, return_exceptions=True)
+            await asyncio.gather(*[loader.close() for loader in loaders], return_exceptions=True)

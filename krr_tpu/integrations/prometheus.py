@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import datetime
 import http.client
+import random
 import re
 import ssl
 import threading
@@ -633,7 +634,13 @@ class PrometheusLoader:
                 last_error = PrometheusQueryError(status, detail)
             attempt += 1
             if attempt < self.retries:
-                await asyncio.sleep(0.25 * 2 ** (attempt - 1))
+                # Jittered exponential backoff: dozens of concurrent window
+                # queries see a 5xx at the same instant, and a bare 2^n
+                # schedule would march them all back onto a recovering
+                # server in lockstep — each retry wave as synchronized as
+                # the failure that caused it. ±50% jitter decorrelates the
+                # herd while keeping the expected backoff unchanged.
+                await asyncio.sleep(0.25 * 2 ** (attempt - 1) * random.uniform(0.5, 1.5))
         assert last_error is not None
         raise last_error
 

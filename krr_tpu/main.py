@@ -226,6 +226,18 @@ def _common_options() -> list[click.Option]:
                 "(reproducible scans / offline benchmarks). Default: now."
             ),
         ),
+        PanelOption(
+            ["--pipeline-depth"],
+            type=int,
+            default=4,
+            show_default=True,
+            help=(
+                "Streamed scan-pipeline depth for digest-ingest scans: fetch the "
+                "fleet as per-namespace batches and fold each batch while the rest "
+                "still fetch, with at most this many batches in flight per stage "
+                "(bounded backpressure). 0 = the staged gather-then-fold path."
+            ),
+        ),
         PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
         PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
         PanelOption(

@@ -106,13 +106,30 @@ class DigestedFleet:
         self.mem_total[rows] = 0.0
         self.mem_peak[rows] = -np.inf
 
-    def merge_from(self, sub: "DigestedFleet", indices: "list[int]") -> None:
+    def merge_from(self, sub: "DigestedFleet", indices: "list[int] | np.ndarray") -> None:
         """Fold a sub-fleet (same spec, ``sub``'s row ``j`` → our row
-        ``indices[j]``) into this fleet — the cross-cluster merge."""
-        for j, i in enumerate(indices):
-            self.merge_cpu_row(i, sub.cpu_counts[j], sub.cpu_total[j], sub.cpu_peak[j])
-            self.merge_mem_row(i, sub.mem_total[j], sub.mem_peak[j])
-        self.failed_rows.update(indices[j] for j in sub.failed_rows)
+        ``indices[j]``) into this fleet — the cross-cluster merge and the
+        scan pipeline's per-batch fold. Vectorized: a contiguous ascending
+        ``indices`` range (the common per-batch layout) merges as slice ops
+        at memory bandwidth; arbitrary orders scatter via ``np.add.at`` /
+        ``np.maximum.at`` (exact for repeated targets too). Either way the
+        arithmetic is the per-row merge's — integer-valued count adds and
+        peak maxes — so fold order across batches cannot change the result."""
+        rows = np.asarray(indices, dtype=np.int64)
+        if rows.size and np.array_equal(rows, np.arange(rows[0], rows[0] + rows.size)):
+            window = slice(int(rows[0]), int(rows[0]) + rows.size)
+            self.cpu_counts[window] += sub.cpu_counts
+            self.cpu_total[window] += sub.cpu_total
+            np.maximum(self.cpu_peak[window], sub.cpu_peak, out=self.cpu_peak[window])
+            self.mem_total[window] += sub.mem_total
+            np.maximum(self.mem_peak[window], sub.mem_peak, out=self.mem_peak[window])
+        else:
+            np.add.at(self.cpu_counts, rows, sub.cpu_counts)
+            np.add.at(self.cpu_total, rows, sub.cpu_total)
+            np.maximum.at(self.cpu_peak, rows, sub.cpu_peak)
+            np.add.at(self.mem_total, rows, sub.mem_total)
+            np.maximum.at(self.mem_peak, rows, sub.mem_peak)
+        self.failed_rows.update(int(rows[j]) for j in sub.failed_rows)
 
     @classmethod
     def empty(cls, objects: list[K8sObjectData], gamma: float, min_value: float, num_buckets: int) -> "DigestedFleet":

@@ -43,6 +43,7 @@ _STATUS_REASONS = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    411: "Length Required",
     431: "Request Header Fields Too Large",
     503: "Service Unavailable",
 }
@@ -224,6 +225,12 @@ class HttpApp:
         # framing survives odd clients. A body we won't fully drain (or a
         # length we can't parse) closes the connection — anything else
         # desyncs the framing and parses body bytes as the next request.
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # No chunked decoding here: keeping the connection would parse
+            # the chunk stream as the next request line.
+            self._respond(writer, 411, "application/json", _json_body({"error": "chunked requests unsupported"}), False)
+            await writer.drain()
+            return False
         try:
             length = int(headers.get("content-length") or 0)
         except ValueError:

@@ -21,7 +21,10 @@ SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
     ("krr_tpu_scans_total", "counter", "Completed scans by kind (full|delta)."),
     ("krr_tpu_scans_skipped_total", "counter", "Scheduler ticks skipped because no new window had elapsed."),
     ("krr_tpu_scan_failures_total", "counter", "Scans aborted by an unexpected error."),
+    ("krr_tpu_discovery_failures_total", "counter", "Discoveries that returned no objects while the store held rows — treated as transient inventory failures (no compaction)."),
     ("krr_tpu_scan_duration_seconds", "gauge", "Last scan's wall seconds by leg (discover|fetch|fold|compute)."),
+    ("krr_tpu_scan_pipeline_seconds", "gauge", "Last scan's streamed-pipeline stage busy seconds (fetch = producer span, fold = consumer busy)."),
+    ("krr_tpu_scan_overlap_pct", "gauge", "Fetch/fold overlap of the last scan's streamed pipeline as a percentage of the shorter stage (100 = fully hidden)."),
     ("krr_tpu_scan_window_seconds", "gauge", "Width of the last scan's fetched time window."),
     ("krr_tpu_fetch_window_seconds_total", "counter", "Cumulative fetched window seconds by kind — a delta-scan server grows this by the delta width per tick, a re-fetching one by the full history width."),
     ("krr_tpu_backfilled_objects_total", "counter", "Late-discovered workloads given a full-window backfill fetch."),

@@ -104,9 +104,24 @@ class ScanScheduler:
 
     async def _discover(self, now: float) -> None:
         objects = await self.session.discover()
+        metrics = self.state.metrics
+        if not objects and self.state.store.keys:
+            # Discovery is fail-soft per cluster (a listing error degrades to
+            # an empty list) — an empty fleet under a non-empty resident
+            # store is overwhelmingly an inventory outage, not real churn,
+            # and compacting on it would destroy the accumulated digest
+            # history (beyond Prometheus retention, unrecoverable). Keep the
+            # previous inventory and leave the discovery timestamp stale so
+            # the next tick retries.
+            metrics.inc("krr_tpu_discovery_failures_total")
+            self.logger.warning(
+                f"Discovery returned no objects while the digest store holds "
+                f"{len(self.state.store.keys)} rows — keeping the previous inventory "
+                f"and skipping churn compaction (transient inventory failure?)"
+            )
+            return
         self._objects = objects
         self._discovered_at = now
-        metrics = self.state.metrics
         metrics.set("krr_tpu_fleet_objects", len(objects))
         # Churn compaction: deleted workloads' rows leave the store. Done at
         # every discovery (including a state_path-resumed first one, whose
@@ -200,11 +215,20 @@ class ScanScheduler:
                         # A state_path restart inside one step window: the
                         # resumed store is complete but nothing is published
                         # yet — serve from the resident digests instead of
-                        # 503ing until the next window opens.
+                        # 503ing until the next window opens. Only objects
+                        # ALREADY resident are published: rows_for grows
+                        # empty rows for unseen keys, and inserting a
+                        # workload discovered while the server was down
+                        # would make the next tick see it as seasoned and
+                        # skip its full-window backfill forever — it joins
+                        # the published result when that tick runs instead.
+                        known = [
+                            obj for obj in objects if object_key(obj) in self.state.store
+                        ]
                         rows = await asyncio.to_thread(
-                            self.state.store.rows_for, [object_key(obj) for obj in objects]
+                            self.state.store.rows_for, [object_key(obj) for obj in known]
                         )
-                        await self._recompute_and_publish(objects, rows, self.state.last_end)
+                        await self._recompute_and_publish(known, rows, self.state.last_end)
                     return False
             # Clamp the right edge to the last evaluation-grid point ≤ now
             # (see the module docstring): the next delta then starts exactly
@@ -224,7 +248,26 @@ class ScanScheduler:
                     seasoned = [obj for obj in objects if object_key(obj) in self.state.store]
             backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
 
+            use_pipeline = self.session.config.pipeline_depth > 0
+            pipeline_stats = []
+
             async def fetch(objs: list[K8sObjectData], w_start: float) -> "object":
+                if use_pipeline:
+                    # Streamed pipeline: per-namespace batches fold into the
+                    # tick's PRIVATE window fleet while the rest still fetch
+                    # (`ScanSession.stream_fleet_digests`). The resident
+                    # store is only touched by the single fold below, after
+                    # every fetch succeeded — a failed tick still leaves it
+                    # untouched, exactly like the staged path.
+                    _objs, fleet, stats = await self.session.stream_fleet_digests(
+                        objs,
+                        history_seconds=end - w_start,
+                        step_seconds=settings.timeframe_timedelta.total_seconds(),
+                        end_time=end,
+                        raise_on_failure=True,
+                    )
+                    pipeline_stats.append(stats)
+                    return fleet
                 return await self.session.gather_fleet_digests(
                     objs,
                     history_seconds=end - w_start,
@@ -272,6 +315,24 @@ class ScanScheduler:
             metrics.set("krr_tpu_scan_duration_seconds", t2 - t1, phase="fetch")
             metrics.set("krr_tpu_scan_duration_seconds", t3 - t2, phase="fold")
             metrics.set("krr_tpu_scan_duration_seconds", t4 - t3, phase="compute")
+            if pipeline_stats:
+                # Per-stage overlap of the streamed fetch+fold pipeline —
+                # the main (seasoned) leg plus any backfill leg, summed for
+                # busy time, max'd for the overlap percentage.
+                metrics.set(
+                    "krr_tpu_scan_pipeline_seconds",
+                    sum(s.fetch_seconds for s in pipeline_stats),
+                    stage="fetch",
+                )
+                metrics.set(
+                    "krr_tpu_scan_pipeline_seconds",
+                    sum(s.fold_seconds for s in pipeline_stats),
+                    stage="fold",
+                )
+                metrics.set(
+                    "krr_tpu_scan_overlap_pct",
+                    max(s.overlap_pct for s in pipeline_stats),
+                )
             metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
             metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
             self.logger.info(

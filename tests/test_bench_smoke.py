@@ -1,0 +1,41 @@
+"""Tier-1 gate: `bench.py --smoke` must run the WHOLE bench harness — kernel
+legs, parity checks, both bench_e2e subprocesses, and the streamed-pipeline
+fleet leg — at toy scale and exit clean. Pipeline regressions that only show
+up end-to-end (a broken fetch/fold overlap, a harness wiring break, a
+subprocess that dies) fail here in CI instead of silently hollowing out the
+next recorded bench round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_end_to_end():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"bench --smoke failed:\n{proc.stderr[-4000:]}"
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    # The on-hardware parity gate ran and passed (rc would be 1 otherwise,
+    # but assert the field so a gate-skipping refactor can't pass silently).
+    assert payload["parity"] == "ok"
+    assert payload["value"] > 0
+    secondary = payload["secondary"]
+    # Both e2e subprocesses delivered real numbers (a failure degrades to a
+    # string note under "e2e"/"fleet_e2e" — that must fail THIS test).
+    assert secondary.get("e2e_objects_per_sec", 0) > 0, secondary
+    assert secondary.get("fleet_e2e_objects_per_sec", 0) > 0, secondary
+    # The streamed scan pipeline ran end-to-end: its overlap telemetry and
+    # the staged control are in the record.
+    assert "fleet_e2e_overlap_pct" in secondary
+    assert secondary.get("fleet_e2e_staged_seconds", 0) > 0
+    assert secondary.get("fleet_e2e_vs_staged") is not None

@@ -1,0 +1,527 @@
+"""Streamed scan pipeline tests (`krr_tpu.core.pipeline` + the streamed
+entry points it powers).
+
+The exactness contract is the headline: a streamed scan — fetch, fold, and
+discovery overlapped through the bounded pipeline — must produce BIT-exact
+results vs the staged gather-then-fold path, for the one-shot Runner (cold
+scans) and the serve scheduler (incremental delta scans) alike. The fold
+order the pipeline introduces is nondeterministic, so these tests assert
+the invariant rather than trusting the digest-mergeability argument.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.pipeline import ScanPipeline
+from krr_tpu.core.runner import Runner, ScanSession, fold_histories
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.series import DigestedFleet
+from krr_tpu.ops.digest import DigestSpec
+
+SPEC = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=256)
+
+
+def make_obj(name: str, namespace: str = "default", cluster: str = "c", pods: int = 1) -> K8sObjectData:
+    return K8sObjectData(
+        cluster=cluster, namespace=namespace, name=name, kind="Deployment", container="main",
+        pods=[f"{name}-{j}" for j in range(pods)],
+        allocations=ResourceAllocations(requests={}, limits={}),
+    )
+
+
+def pod_series(pod: str, n: int = 48, salt: int = 0) -> np.ndarray:
+    """Deterministic per-pod samples (stable across runs and processes)."""
+    seed = (sum(ord(c) for c in pod) * 7919 + salt) % (2**32)
+    return np.random.default_rng(seed).gamma(2.0, 0.05, n)
+
+
+class RawSource:
+    """History source WITHOUT a fused digest path — the streamed pipeline
+    digests its batches on the fold thread."""
+
+    def __init__(self, n: int = 48):
+        self.n = n
+        self.calls: list[int] = []  # objects per gather call
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, end_time=None):
+        self.calls.append(len(objects))
+        salt = int(end_time or 0)
+        return {
+            ResourceType.CPU: [
+                {pod: pod_series(pod, self.n, salt) for pod in obj.pods} for obj in objects
+            ],
+            ResourceType.Memory: [
+                {pod: pod_series(pod, self.n, salt + 1) * 1e8 for pod in obj.pods}
+                for obj in objects
+            ],
+        }
+
+
+class DigestSource(RawSource):
+    """History source WITH a fused digest path (like PrometheusLoader)."""
+
+    async def gather_fleet_digests(
+        self, objects, history_seconds, step_seconds, gamma, min_value, num_buckets, end_time=None
+    ):
+        fetched = await self.gather_fleet(objects, history_seconds, step_seconds, end_time=end_time)
+        spec = DigestSpec(gamma=gamma, min_value=min_value, num_buckets=num_buckets)
+        fleet = DigestedFleet.empty(objects, gamma, min_value, num_buckets)
+        fold_histories(fleet, range(len(objects)), fetched, spec)
+        return fleet
+
+
+class StagedInventory:
+    def __init__(self, objects):
+        self.objects = objects
+
+    async def list_clusters(self):
+        return sorted({obj.cluster for obj in self.objects})
+
+    async def list_scannable_objects(self, clusters):
+        return list(self.objects)
+
+
+class StreamingInventory(StagedInventory):
+    """Inventory with the streaming API, yielding per-namespace batches in a
+    deliberately SCRAMBLED completion order — assembly must sort them back."""
+
+    async def stream_scannable_objects(self, clusters):
+        by_key: dict[tuple[int, str], tuple[list[int], list]] = {}
+        ordinals = {cluster: i for i, cluster in enumerate(await self.list_clusters())}
+        for position, obj in enumerate(self.objects):
+            positions, objs = by_key.setdefault((ordinals[obj.cluster], obj.namespace), ([], []))
+            positions.append(position)
+            objs.append(obj)
+        for key in sorted(by_key, key=lambda k: (k[1][::-1], -k[0])):  # scrambled
+            positions, objs = by_key[key]
+            await asyncio.sleep(0)
+            yield key[0], positions, objs
+
+    async def list_scannable_objects(self, clusters):
+        raise AssertionError("streamed discovery must not fall back to the staged list")
+
+
+def fleet_config(**overrides) -> Config:
+    defaults = dict(
+        strategy="tdigest", quiet=True,
+        other_args={"history_duration": 1, "timeframe_duration": 1, "digest_ingest": True},
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def assert_fleets_equal(a: DigestedFleet, b: DigestedFleet) -> None:
+    assert [o.name for o in a.objects] == [o.name for o in b.objects]
+    np.testing.assert_array_equal(a.cpu_counts, b.cpu_counts)
+    np.testing.assert_array_equal(a.cpu_total, b.cpu_total)
+    np.testing.assert_array_equal(a.cpu_peak, b.cpu_peak)
+    np.testing.assert_array_equal(a.mem_total, b.mem_total)
+    np.testing.assert_array_equal(a.mem_peak, b.mem_peak)
+    assert a.failed_rows == b.failed_rows
+
+
+FLEET = [
+    make_obj("web", "default"), make_obj("api", "default", pods=2),
+    make_obj("db", "prod"), make_obj("cache", "prod"),
+    make_obj("job", "batch"), make_obj("edge", "default", cluster="d"),
+    make_obj("log", "infra", cluster="d"),
+]
+
+
+# ---------------------------------------------------------------- unit tests
+class TestScanPipeline:
+    def test_folds_every_batch_with_stats(self):
+        async def main():
+            seen: list[int] = []
+            async with ScanPipeline(seen.append, depth=2) as pipeline:
+                for i in range(7):
+                    await pipeline.put(i)
+            return pipeline.stats, seen
+
+        stats, seen = asyncio.run(main())
+        assert sorted(seen) == list(range(7))  # arrival order, all folded
+        assert stats.batches == 7
+        assert stats.wall_seconds > 0 and stats.fetch_seconds <= stats.wall_seconds
+        assert 0.0 <= stats.overlap_pct <= 100.0
+
+    def test_backpressure_bounds_queue_depth(self):
+        """A producer outrunning a slow consumer must block at ``depth``
+        queued batches instead of accumulating state."""
+
+        async def main():
+            async with ScanPipeline(lambda _b: time.sleep(0.02), depth=2) as pipeline:
+                for i in range(8):
+                    await pipeline.put(i)
+            return pipeline.stats
+
+        stats = asyncio.run(main())
+        assert stats.peak_queue_depth <= 2
+        assert stats.batches == 8
+
+    def test_fold_error_reraises_and_unblocks_producers(self):
+        """A fold error must surface at close — and the consumer must keep
+        draining so producers blocked on a full queue don't deadlock."""
+
+        def fold(batch):
+            raise ValueError("poisoned batch")
+
+        async def main():
+            with pytest.raises(ValueError, match="poisoned batch"):
+                async with ScanPipeline(fold, depth=1) as pipeline:
+                    for i in range(6):  # far past depth: puts must not hang
+                        await pipeline.put(i)
+
+        asyncio.run(asyncio.wait_for(main(), timeout=10))
+
+    def test_body_exception_aborts_consumer(self):
+        async def main():
+            folded: list[int] = []
+            with pytest.raises(RuntimeError, match="producer failed"):
+                async with ScanPipeline(folded.append, depth=2) as pipeline:
+                    await pipeline.put(1)
+                    raise RuntimeError("producer failed")
+
+        asyncio.run(asyncio.wait_for(main(), timeout=10))
+
+    def test_abort_while_fold_in_flight_does_not_hang(self):
+        """The abort path cancels the consumer MID-FOLD: the cancellation
+        must not be swallowed into the fold-error slot (the consumer would
+        loop back to queue.get() with no sentinel coming, and the abort's
+        await on it would hang forever — a cancelled serve scan would never
+        shut down)."""
+
+        async def main():
+            with pytest.raises(RuntimeError, match="abort mid-fold"):
+                async with ScanPipeline(lambda _b: time.sleep(1.0), depth=2) as pipeline:
+                    await pipeline.put(1)
+                    await asyncio.sleep(0.2)  # the fold is now running
+                    raise RuntimeError("abort mid-fold")
+
+        asyncio.run(asyncio.wait_for(main(), timeout=10))
+
+    def test_outer_cancellation_mid_fold_unwinds(self):
+        """Cancelling the task that owns the pipeline (serve shutdown)
+        while a fold runs must unwind promptly, not deadlock."""
+
+        async def scan():
+            async with ScanPipeline(lambda _b: time.sleep(1.0), depth=2) as pipeline:
+                await pipeline.put(1)
+                await asyncio.sleep(30)
+
+        async def main():
+            task = asyncio.create_task(scan())
+            await asyncio.sleep(0.2)  # fold in flight
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+
+        asyncio.run(asyncio.wait_for(main(), timeout=10))
+
+    def test_overlap_accounting_detects_concurrency(self):
+        """Folds that run while the producer still fetches must register as
+        overlap; the normalized percentage caps at 100."""
+
+        async def main():
+            async with ScanPipeline(lambda _b: time.sleep(0.03), depth=4) as pipeline:
+                for i in range(4):
+                    await pipeline.put(i)
+                    await asyncio.sleep(0.03)  # producer keeps "fetching"
+            return pipeline.stats
+
+        stats = asyncio.run(main())
+        assert stats.fold_seconds >= 0.09
+        assert stats.overlap_seconds > 0
+        assert 0 < stats.overlap_pct <= 100.0
+
+
+# ------------------------------------------------- session-level exactness
+class TestStreamFleetDigests:
+    @pytest.mark.parametrize("source_type", [RawSource, DigestSource])
+    def test_streamed_equals_staged_bit_exact(self, source_type):
+        """THE cold-scan acceptance at the session level: the streamed
+        pipeline's aggregate fleet is bit-identical to the staged gather,
+        for sources with and without a fused digest path."""
+
+        async def main():
+            staged = ScanSession(
+                fleet_config(), inventory=StagedInventory(FLEET),
+                history_factory=lambda cluster: source_type(),
+            )
+            want = await staged.gather_fleet_digests(FLEET, end_time=1000.0)
+
+            for depth in (1, 4):
+                streamed = ScanSession(
+                    fleet_config(pipeline_depth=depth), inventory=StagedInventory(FLEET),
+                    history_factory=lambda cluster: source_type(),
+                )
+                objects, got, stats = await streamed.stream_fleet_digests(FLEET, end_time=1000.0)
+                assert objects is FLEET
+                assert_fleets_equal(got, want)
+                assert stats.batches >= 1
+
+        asyncio.run(main())
+
+    def test_discovery_streamed_equals_staged_order_and_state(self):
+        """Discovery-overlapped streaming (batches arriving in scrambled
+        namespace order) must reassemble the exact staged object order and
+        bit-exact state."""
+
+        async def main():
+            staged = ScanSession(
+                fleet_config(), inventory=StagedInventory(FLEET),
+                history_factory=lambda cluster: DigestSource(),
+            )
+            want = await staged.gather_fleet_digests(FLEET, end_time=1000.0)
+
+            streamed = ScanSession(
+                fleet_config(), inventory=StreamingInventory(FLEET),
+                history_factory=lambda cluster: DigestSource(),
+            )
+            objects, got, stats = await streamed.stream_fleet_digests(end_time=1000.0)
+            assert objects == FLEET  # exact staged order, not just same set
+            assert_fleets_equal(got, want)
+            assert stats.discover_seconds > 0
+
+        asyncio.run(main())
+
+    def test_failed_batch_degrades_to_unknown_rows(self):
+        class FlakySource(DigestSource):
+            def __init__(self, fail: bool):
+                super().__init__()
+                self.fail = fail
+
+            async def gather_fleet_digests(self, objects, *args, **kwargs):
+                if self.fail:
+                    raise ConnectionError("cluster down")
+                return await super().gather_fleet_digests(objects, *args, **kwargs)
+
+        async def main():
+            session = ScanSession(
+                fleet_config(), inventory=StagedInventory(FLEET),
+                history_factory=lambda cluster: FlakySource(fail=cluster == "d"),
+            )
+            objects, fleet, _stats = await session.stream_fleet_digests(FLEET, end_time=1000.0)
+            bad = {i for i, obj in enumerate(FLEET) if obj.cluster == "d"}
+            assert fleet.failed_rows == bad
+            for i in bad:  # degraded rows are EMPTY, not partial
+                assert fleet.cpu_total[i] == 0.0 and fleet.cpu_peak[i] == -np.inf
+            for i in set(range(len(FLEET))) - bad:
+                assert fleet.cpu_total[i] > 0
+
+            # raise_on_failure: the same failure aborts the call instead —
+            # after sibling fetches settle.
+            with pytest.raises(ConnectionError, match="cluster down"):
+                await session.stream_fleet_digests(
+                    FLEET, end_time=1000.0, raise_on_failure=True
+                )
+
+        asyncio.run(main())
+
+    def test_batches_never_split_namespaces_or_mix_clusters(self):
+        batches = ScanSession._digest_batches(FLEET, depth=1)
+        for indices in batches:
+            assert len({FLEET[i].cluster for i in indices}) == 1
+        for namespace, cluster in {(o.namespace, o.cluster) for o in FLEET}:
+            owners = [
+                j for j, indices in enumerate(batches)
+                if any(FLEET[i].namespace == namespace and FLEET[i].cluster == cluster for i in indices)
+            ]
+            assert len(owners) == 1
+
+
+# --------------------------------------------------- fold unwind (satellite)
+class TestFoldHistoriesUnwind:
+    class _Poison:
+        """Array-like whose .values() iteration works but whose samples blow
+        up mid-fold."""
+
+        size = 4
+
+        def max(self):
+            raise RuntimeError("corrupt samples")
+
+    def test_mid_fold_failure_unwinds_partial_rows(self):
+        objects = [make_obj("a"), make_obj("b")]
+        fleet = DigestedFleet.empty(objects, SPEC.gamma, SPEC.min_value, SPEC.num_buckets)
+        fetched = {
+            ResourceType.CPU: [
+                {"a-0": pod_series("a-0")}, {"b-0": pod_series("b-0")},
+            ],
+            ResourceType.Memory: [
+                {"a-0": pod_series("a-0") * 1e8}, {"b-0": self._Poison()},
+            ],
+        }
+        with pytest.raises(RuntimeError, match="corrupt samples"):
+            fold_histories(fleet, [0, 1], fetched, SPEC)
+        # Object a folded fully before b's poison hit — both rows must be
+        # back to the empty state, not half-written behind a failure marker.
+        assert (fleet.cpu_counts == 0).all()
+        assert (fleet.cpu_total == 0).all() and (fleet.mem_total == 0).all()
+        assert (fleet.cpu_peak == -np.inf).all() and (fleet.mem_peak == -np.inf).all()
+
+    def test_session_marks_and_unwinds_failed_fold(self):
+        class PoisonSource(RawSource):
+            async def gather_fleet(self, objects, *args, **kwargs):
+                fetched = await super().gather_fleet(objects, *args, **kwargs)
+                fetched[ResourceType.Memory][-1] = {"x": TestFoldHistoriesUnwind._Poison()}
+                return fetched
+
+        async def main():
+            session = ScanSession(
+                fleet_config(), inventory=StagedInventory(FLEET),
+                history_factory=lambda cluster: PoisonSource(),
+            )
+            # Staged path: the cluster's rows unwind and mark failed.
+            fleet = await session.gather_fleet_digests(FLEET, end_time=1000.0)
+            for i in fleet.failed_rows:
+                assert fleet.cpu_total[i] == 0.0 and fleet.mem_total[i] == 0.0
+            assert fleet.failed_rows  # the poisoned cluster really failed
+
+            # Streamed path: same degradation, batch-wise.
+            _objs, streamed, _stats = await session.stream_fleet_digests(FLEET, end_time=1000.0)
+            assert streamed.failed_rows
+            for i in streamed.failed_rows:
+                assert streamed.cpu_total[i] == 0.0 and streamed.mem_total[i] == 0.0
+
+        asyncio.run(main())
+
+
+# ----------------------------------------------- end-to-end: Runner + serve
+@pytest.fixture(scope="module")
+def fake_env(tmp_path_factory):
+    """A multi-namespace fake cluster served over HTTP — the real
+    KubernetesLoader + PrometheusLoader drive against it, so the streamed
+    path is exercised end-to-end including streamed discovery."""
+    from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True
+    rng = np.random.default_rng(42)
+    for namespace, workloads in {
+        "default": ["web", "api"], "prod": ["db"], "batch": ["etl", "cron"],
+    }.items():
+        for name in workloads:
+            for pod in cluster.add_workload_with_pods("Deployment", name, namespace, pod_count=2):
+                metrics.set_series(
+                    namespace, "main", pod,
+                    cpu=rng.gamma(2.0, 0.05, 120), memory=rng.uniform(5e7, 2e8, 120),
+                )
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+    kubeconfig = tmp_path_factory.mktemp("pipeline") / "config"
+    kubeconfig.write_text(yaml.dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "u"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+        "users": [{"name": "u", "user": {"token": "t"}}],
+    }))
+    yield {"url": server.url, "kubeconfig": str(kubeconfig), "origin": FakeBackend.SERIES_ORIGIN}
+    server.stop()
+
+
+def env_config(fake_env, **overrides) -> Config:
+    other_args = {"history_duration": 1, "timeframe_duration": 1, "digest_ingest": True}
+    other_args.update(overrides.pop("other_args", {}))
+    defaults = dict(
+        kubeconfig=fake_env["kubeconfig"], prometheus_url=fake_env["url"],
+        strategy="tdigest", quiet=True, format="json",
+        scan_end_timestamp=fake_env["origin"] + 3600.0, other_args=other_args,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+class TestStreamedDiscoveryParity:
+    def test_stream_matches_staged_list(self, fake_env):
+        from krr_tpu.integrations.kubernetes import KubernetesLoader
+
+        async def main():
+            config = env_config(fake_env)
+            loader = KubernetesLoader(config)
+            clusters = await loader.list_clusters()
+            staged = await loader.list_scannable_objects(clusters)
+            rows = []
+            async for ordinal, positions, objects in loader.stream_scannable_objects(clusters):
+                assert len(positions) == len(objects)
+                rows.extend(zip([ordinal] * len(objects), positions, objects))
+            rows.sort(key=lambda row: (row[0], row[1]))
+            assert [obj for *_key, obj in rows] == staged
+
+        asyncio.run(main())
+
+
+class TestRunnerStreamedScan:
+    def test_streamed_run_bit_exact_vs_staged(self, fake_env, capsys):
+        """The cold-scan acceptance end-to-end: the real Runner over the real
+        loaders, streamed (pipeline_depth=4) vs staged (0), byte-identical
+        rendered recommendations — and the streamed stats carry the overlap
+        telemetry bench_e2e records."""
+
+        def scan(**overrides):
+            runner = Runner(env_config(fake_env, **overrides))
+            result = asyncio.run(runner.run())
+            capsys.readouterr()
+            return result.format("json"), runner.stats
+
+        staged_json, staged_stats = scan(pipeline_depth=0)
+        streamed_json, streamed_stats = scan()
+        assert streamed_json == staged_json
+        assert "pipeline_overlap_pct" in streamed_stats
+        assert streamed_stats["pipeline_batches"] >= 1
+        assert "pipeline_overlap_pct" not in staged_stats
+        assert streamed_stats["objects"] == staged_stats["objects"] == 5.0
+
+
+class TestSchedulerStreamedTicks:
+    def test_incremental_streamed_ticks_match_staged_store(self, fake_env):
+        """The incremental acceptance: a serve scheduler running streamed
+        delta ticks accumulates a digest store bit-identical to one running
+        staged ticks over the same windows — and records the pipeline's
+        overlap telemetry."""
+        from krr_tpu.server.app import KrrServer
+
+        origin = fake_env["origin"]
+        T1, T2 = origin + 3600.0, origin + 5400.0
+
+        async def run_ticks(depth: int):
+            now = [T1]
+            ks = KrrServer(
+                env_config(
+                    fake_env, pipeline_depth=depth, scan_end_timestamp=None,
+                    server_port=0, format="table",
+                ),
+                clock=lambda: now[0],
+            )
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()  # full window
+                now[0] = T2
+                assert await ks.scheduler.tick()  # delta window
+                store = ks.state.store
+                body = ks.state.peek().body_json
+                overlap = ks.state.metrics.value("krr_tpu_scan_overlap_pct")
+                return store, body, overlap
+            finally:
+                await ks.shutdown()
+
+        async def main():
+            streamed_store, streamed_body, overlap = await run_ticks(depth=4)
+            staged_store, staged_body, staged_overlap = await run_ticks(depth=0)
+            assert streamed_body == staged_body
+            assert streamed_store.keys == staged_store.keys
+            np.testing.assert_array_equal(streamed_store.cpu_counts, staged_store.cpu_counts)
+            np.testing.assert_array_equal(streamed_store.cpu_total, staged_store.cpu_total)
+            np.testing.assert_array_equal(streamed_store.cpu_peak, staged_store.cpu_peak)
+            np.testing.assert_array_equal(streamed_store.mem_total, staged_store.mem_total)
+            np.testing.assert_array_equal(streamed_store.mem_peak, staged_store.mem_peak)
+            assert overlap is not None  # streamed ticks record the gauge
+            assert staged_overlap is None  # staged ticks don't
+
+        asyncio.run(main())

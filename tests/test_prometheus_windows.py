@@ -1,0 +1,135 @@
+"""Edge-case tests for the range-query window arithmetic
+(`krr_tpu.integrations.prometheus.subwindows` / `window_points_cap`).
+
+The split-window fan-out's exactness rests on these two functions tiling the
+Prometheus evaluation grid with no duplicates and no gaps; an off-by-one at
+a grid edge double-counts (or drops) one sample per series per window, which
+the digest fold then bakes into every recommendation.
+"""
+
+import numpy as np
+import pytest
+
+from krr_tpu.integrations.prometheus import (
+    MAX_RANGE_POINTS,
+    effective_step_seconds,
+    subwindows,
+    window_points_cap,
+)
+
+
+def grid_points(start: float, end: float, step: float) -> list[float]:
+    """The evaluation grid Prometheus answers for [start, end]: start,
+    start + step, … ≤ end."""
+    points = []
+    t = start
+    while t <= end + 1e-9:
+        points.append(round(t, 6))
+        t += step
+    return points
+
+
+def tiled_points(windows: list[tuple[float, float]], step: float) -> list[float]:
+    return [p for w_start, w_end in windows for p in grid_points(w_start, w_end, step)]
+
+
+class TestSubwindows:
+    def test_window_shorter_than_one_step(self):
+        """A window narrower than a step still evaluates ONE grid point (the
+        start) — one window, never zero."""
+        assert subwindows(1000.0, 1030.0, 60.0) == [(1000.0, 1030.0)]
+        assert subwindows(1000.0, 1030.0, 60.0, max_points=1) == [(1000.0, 1030.0)]
+
+    def test_zero_width_window(self):
+        """start == end: a single instant evaluation."""
+        assert subwindows(1000.0, 1000.0, 60.0) == [(1000.0, 1000.0)]
+
+    def test_end_exactly_on_grid_edge_splits_without_overlap(self):
+        """(end - start) an exact multiple of step, with the point count an
+        exact multiple of max_points: windows must not share the edge point.
+        Window j starts at point j·M, so window 0 of [0, 1140] at 60 s with
+        M=10 ends at point 9 (540 s) and window 1 starts at point 10."""
+        step, m = 60.0, 10
+        end = 19 * step  # 20 grid points: exactly two full windows
+        windows = subwindows(0.0, end, step, max_points=m)
+        assert windows == [(0.0, 540.0), (600.0, 1140.0)]
+        assert tiled_points(windows, step) == grid_points(0.0, end, step)
+
+    def test_end_off_grid_keeps_true_right_edge(self):
+        """An off-grid end: the last window's nominal end may exceed the last
+        grid point but never the requested end, and the union grid still
+        matches the single query's."""
+        step = 60.0
+        start, end = 0.0, 19 * step + 30.0  # last grid point at 1140, end 1170
+        windows = subwindows(start, end, step, max_points=7)
+        assert windows[-1][1] <= end
+        assert tiled_points(windows, step) == grid_points(start, end, step)
+
+    @pytest.mark.parametrize(
+        "start,end,step,max_points",
+        [
+            (0.0, 11_000 * 5.0, 5.0, MAX_RANGE_POINTS),  # server cap boundary
+            (1_700_000_000.0, 1_700_000_000.0 + 86_400, 60.0, 100),
+            (500.0, 500.0 + 3599.0, 60.0, 13),  # ragged tail window
+            (0.0, 7 * 86_400.0, 5.0, 11_000),  # the 7 d @ 5 s headline shape
+            (0.0, 359.0, 45.0, 3),  # sub-minute step (45 s stays 45 s)
+        ],
+    )
+    def test_exact_tiling_no_gaps_no_duplicates(self, start, end, step, max_points):
+        windows = subwindows(start, end, step, max_points=max_points)
+        step_eff = effective_step_seconds(step)
+        union = tiled_points(windows, step_eff)
+        assert union == grid_points(start, end, step_eff)
+        assert len(set(union)) == len(union)
+        assert all(len(grid_points(s, e, step_eff)) <= max_points for s, e in windows)
+
+    def test_point_count_at_exact_cap_stays_single_query(self):
+        """Exactly max_points grid points: no split; one more point: split."""
+        step = 60.0
+        at_cap = subwindows(0.0, (MAX_RANGE_POINTS - 1) * step, step)
+        assert len(at_cap) == 1
+        over_cap = subwindows(0.0, MAX_RANGE_POINTS * step, step)
+        assert len(over_cap) == 2
+        assert len(grid_points(*over_cap[0], step)) == MAX_RANGE_POINTS
+        assert len(grid_points(*over_cap[1], step)) == 1
+
+
+class TestWindowPointsCap:
+    def test_unknown_series_count_defaults_to_server_cap(self):
+        assert window_points_cap(0, 40_000_000) == MAX_RANGE_POINTS
+        assert window_points_cap(-5, 40_000_000) == MAX_RANGE_POINTS
+
+    def test_sample_budget_boundary(self):
+        """series × points must stay ≤ max_samples, tight at the boundary:
+        a budget of exactly MAX_RANGE_POINTS × series keeps the server cap;
+        one sample less drops below it."""
+        series = 10
+        budget = MAX_RANGE_POINTS * series
+        assert window_points_cap(series, budget) == MAX_RANGE_POINTS
+        assert window_points_cap(series, budget - 1) == MAX_RANGE_POINTS - 1
+
+    def test_wide_fanout_never_reaches_zero_points(self):
+        """More series than the whole budget: at least one point per window
+        (a zero-point window would be an infinite loop in subwindows)."""
+        assert window_points_cap(1_000_000, 100) == 1
+
+    def test_cap_feeds_subwindows_within_budget(self):
+        """End-to-end: a capped fan-out's windows each stay under the sample
+        budget for the probed series count."""
+        series, budget, step = 7_000, 2_000_000, 60.0
+        cap = window_points_cap(series, budget)
+        windows = subwindows(0.0, 100_000 * step, step, max_points=cap)
+        for w_start, w_end in windows:
+            points = len(grid_points(w_start, w_end, step))
+            assert points * series <= budget
+        union = tiled_points(windows, step)
+        assert union == grid_points(0.0, 100_000 * step, step)
+
+    def test_sub_minute_step_grid(self):
+        """Sub-minute steps are a krr-tpu extension: the grid tiles at the
+        raw second resolution, not clamped to whole minutes."""
+        assert effective_step_seconds(5.0) == 5
+        assert effective_step_seconds(0.4) == 1  # floor at 1 s
+        windows = subwindows(0.0, 5.0 * 99, 5.0, max_points=40)
+        assert tiled_points(windows, 5.0) == grid_points(0.0, 495.0, 5.0)
+        assert np.isclose(windows[1][0] - windows[0][1], 5.0)

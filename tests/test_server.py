@@ -798,3 +798,157 @@ class TestStatePersistence:
                 await resumed.shutdown()
 
         asyncio.run(main())
+
+
+class _PlainSource:
+    """Deterministic injected history source (no gating, no windows)."""
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+        return {
+            ResourceType.CPU: [{obj.pods[0]: np.full(10, 0.2)} for obj in objects],
+            ResourceType.Memory: [{obj.pods[0]: np.full(10, 1e8)} for obj in objects],
+        }
+
+
+class TestDiscoveryFailureGuards:
+    def test_empty_discovery_does_not_wipe_resident_store(self):
+        """Discovery is fail-soft per cluster, so a transient apiserver
+        outage surfaces as an EMPTY object list — which must not compact the
+        accumulated digest store to zero rows (history beyond Prometheus
+        retention would be unrecoverable) nor discard the previous
+        inventory."""
+
+        class FlakyInventory:
+            def __init__(self, objects):
+                self.objects = objects
+                self.calls = 0
+
+            async def list_clusters(self):
+                return ["c"]
+
+            async def list_scannable_objects(self, clusters):
+                self.calls += 1
+                return [] if self.calls > 1 else list(self.objects)
+
+        async def main():
+            now = [1_700_000_000.0]
+            config = Config(
+                strategy="tdigest", quiet=True, server_port=0,
+                discovery_interval_seconds=1.0,
+                other_args={"history_duration": 1, "timeframe_duration": 1},
+            )
+            session = ScanSession(
+                config, inventory=FlakyInventory([_one_object()]),
+                history_factory=lambda cluster: _PlainSource(),
+            )
+            ks = KrrServer(config, session=session, clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                assert len(ks.state.store.keys) == 1
+
+                now[0] += 900.0  # discovery due again — and it fails (empty)
+                assert await ks.scheduler.tick()
+                assert len(ks.state.store.keys) == 1  # store NOT wiped
+                m = ks.state.metrics
+                assert m.value("krr_tpu_discovery_failures_total") == 1
+                assert m.value("krr_tpu_store_compacted_rows_total") is None
+                # The previous inventory kept scanning: the tick was a delta
+                # over the known fleet, and recommendations still serve it.
+                assert m.value("krr_tpu_scans_total", kind="delta") == 1
+                assert len(ks.state.peek().result.scans) == 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_resume_publish_keeps_fresh_workloads_eligible_for_backfill(self):
+        """The within-one-step resume publish reads the store via rows_for,
+        which GROWS rows for unseen keys — a workload discovered while the
+        server was down must not be inserted there, or the next tick would
+        see it as seasoned and skip its full-window backfill forever."""
+        from krr_tpu.core.streaming import object_key
+
+        web, db = _one_object("web"), _one_object("db")
+
+        class RecordingSource(_PlainSource):
+            def __init__(self):
+                self.windows: list[tuple[tuple, float]] = []
+
+            async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+                self.windows.append(
+                    (tuple(sorted(obj.name for obj in objects)), history_seconds)
+                )
+                return await super().gather_fleet(objects, history_seconds, step_seconds)
+
+        async def main():
+            now = [0.0]
+            source = RecordingSource()
+            config = Config(
+                strategy="tdigest", quiet=True, server_port=0,
+                other_args={"history_duration": 1, "timeframe_duration": 1},
+            )
+            session = ScanSession(
+                config, inventory=_Inventory([web, db]),
+                history_factory=lambda cluster: source,
+            )
+            ks = KrrServer(config, session=session, clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                # Simulate a state-path-style resume: web is resident with a
+                # window cursor, db appeared while the server was down.
+                store = ks.state.store
+                store.merge_window(
+                    [object_key(web)],
+                    np.ones((1, store.spec.num_buckets), np.float32),
+                    np.asarray([10.0], np.float32), np.asarray([0.5], np.float32),
+                    np.asarray([10.0], np.float32), np.asarray([100.0], np.float32),
+                )
+                ks.state.last_end = 1_700_000_000.0
+                now[0] = ks.state.last_end + 30.0  # inside one 60 s step
+
+                assert not await ks.scheduler.tick()  # skipped — but publishes
+                published = [s.object.name for s in ks.state.peek().result.scans]
+                assert published == ["web"]  # fresh db waits for its backfill
+                assert object_key(db) not in store  # NOT grown into the store
+
+                # The next due tick backfills db with the FULL history window
+                # while web fetches only the delta.
+                now[0] = ks.state.last_end + 120.0
+                assert await ks.scheduler.tick()
+                widths = dict(source.windows)
+                assert widths[("db",)] == 3600.0
+                assert widths[("web",)] == 60.0
+                assert {s.object.name for s in ks.state.peek().result.scans} == {"web", "db"}
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestRequestFraming:
+    def test_chunked_request_closes_connection(self, serve_env):
+        """A Transfer-Encoding: chunked request can't be drained (no chunk
+        decoding here) — the server must answer once and CLOSE, not keep the
+        connection and parse the chunk stream as the next request line."""
+
+        async def main():
+            ks = KrrServer(serve_config(serve_env), clock=lambda: ORIGIN + 3600.0)
+            await ks.start(run_scheduler=False)
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", ks.port)
+                writer.write(
+                    b"GET /healthz HTTP/1.1\r\nHost: x\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    b"5\r\nhello\r\n0\r\n\r\n"
+                )
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(), timeout=10)  # to EOF
+                writer.close()
+                assert data.split(b"\r\n", 1)[0] == b"HTTP/1.1 411 Length Required"
+                # One response only: the chunk bytes never became a request.
+                assert data.count(b"HTTP/1.1") == 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
