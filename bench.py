@@ -55,7 +55,11 @@ headline against the newest recorded ``BENCH_r*.json`` stable rate;
 Env knobs: BENCH_CONTAINERS (default 10000), BENCH_TIMESTEPS (default 120960),
 BENCH_CHUNK (default 8192), BENCH_RUNS (default 5), BENCH_PIPELINE_DEPTH
 (default 16), BENCH_PY_SAMPLE (default 3), BENCH_SKIP_DIGEST,
-BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default 512). The e2e leg runs `bench_e2e.py` in a subprocess with
+BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default 512), BENCH_SKIP_JOURNAL,
+BENCH_JOURNAL_ROWS (default 2000), BENCH_JOURNAL_TICKS (default 32 — the
+history-journal leg: fsync'd append + compaction throughput and a
+journal-diff render through the formatter registry, carried under
+``secondary.journal_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
 BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
 
 ``--smoke``: the same harness at toy scale (tiny fleet, 1 run, e2e legs
@@ -116,7 +120,71 @@ SMOKE_DEFAULTS = {
     "BENCH_E2E_INGEST_ROWS": "64",
     "BENCH_E2E_STORE_ROWS": "256",
     "BENCH_E2E_FLEET_ROWS": "12",
+    # History-journal leg (host-only): append/compaction throughput plus a
+    # diff render through the formatter registry, all EXECUTED at toy scale.
+    "BENCH_JOURNAL_ROWS": "32",
+    "BENCH_JOURNAL_TICKS": "4",
 }
+
+
+def journal_leg(secondary: dict) -> None:
+    """Journal append/compaction throughput + an end-to-end diff render —
+    the history subsystem's secondary numbers (host numpy + disk, no
+    accelerator). Appends are fsync'd per tick (the crash-safe contract is
+    part of what's being measured); compaction is the atomic whole-file
+    rewrite. The diff leg renders the first-vs-last tick delta through the
+    json formatter, exercising journal → diff → formatter end to end."""
+    import tempfile
+
+    import numpy as np
+
+    from krr_tpu.history.diff import build_diff_result, tick_values
+    from krr_tpu.history.journal import RecommendationJournal
+
+    rows = int(os.environ.get("BENCH_JOURNAL_ROWS", 2000))
+    ticks = max(2, int(os.environ.get("BENCH_JOURNAL_TICKS", 32)))
+    rng = np.random.default_rng(11)
+    keys = [f"bench/ns{i % 16}/w{i}/main/Deployment" for i in range(rows)]
+    cpu = rng.gamma(2.0, 0.05, rows).astype(np.float32)
+    mem = rng.uniform(50, 400, rows).astype(np.float32)
+    base_ts = 1_700_000_000.0
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.journal")
+        # Retention sized so compaction drops the older half of the ticks.
+        journal = RecommendationJournal(path, retention_seconds=(ticks // 2) * 60.0)
+        start = time.perf_counter()
+        for t in range(ticks):
+            published = np.full(rows, t == 0)
+            journal.append_tick(base_ts + t * 60.0, keys, cpu * (1 + 0.01 * t), mem, published)
+        append_seconds = time.perf_counter() - start
+        total = rows * ticks
+        secondary["journal_append_records_per_sec"] = round(total / append_seconds, 1)
+
+        before = journal.record_count
+        start = time.perf_counter()
+        dropped = journal.compact(base_ts + ticks * 60.0)
+        compact_seconds = time.perf_counter() - start
+        assert dropped > 0, "bench journal compaction dropped nothing — retention sizing bug"
+        secondary["journal_compact_records_per_sec"] = round(before / max(compact_seconds, 1e-9), 1)
+
+        # Diff leg over the surviving window: oldest surviving tick vs newest.
+        remaining = journal.tick_timestamps()
+        start = time.perf_counter()
+        diff = build_diff_result(
+            tick_values(journal, float(remaining[0])), tick_values(journal, float(remaining[-1]))
+        )
+        rendered = diff.format("json")
+        diff_seconds = time.perf_counter() - start
+        assert len(diff.scans) == rows and rendered
+        secondary["journal_diff_objects_per_sec"] = round(rows / max(diff_seconds, 1e-9), 1)
+        journal.close()
+    print(
+        f"bench: journal {total} appends {append_seconds:.3f}s "
+        f"({total / append_seconds:.0f} rec/s), compaction of {before} recs "
+        f"{compact_seconds * 1e3:.1f} ms, diff render {rows} objects {diff_seconds:.3f}s",
+        file=sys.stderr,
+    )
 
 
 def main() -> None:
@@ -364,6 +432,9 @@ def main() -> None:
             bool(np.array_equal(peak_sub, want_peak)),
             "peak mismatch",
         )
+
+    if not os.environ.get("BENCH_SKIP_JOURNAL"):
+        journal_leg(secondary)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

@@ -107,6 +107,28 @@ class Config(pd.BaseModel):
     #: discovery staleness is checked at each scan tick.
     discovery_interval_seconds: float = pd.Field(3600.0, gt=0)
 
+    # Recommendation history + hysteresis (`krr_tpu.history`, serve publish path)
+    #: Journal file recording every recompute's raw recommendations (the
+    #: flight recorder behind GET /history, GET /drift, and `krr-tpu diff`).
+    #: None = derive ``<state_path>.journal`` when the strategy's state_path
+    #: is set, else keep the journal memory-only; an explicit empty string
+    #: forces memory-only even with a state_path.
+    history_path: Optional[str] = None
+    #: Journal retention window — records older than this are dropped by the
+    #: per-tick compaction, bounding journal growth at fleet scale.
+    history_retention_seconds: float = pd.Field(7 * 24 * 3600.0, gt=0)
+    #: Hysteresis dead band: a workload's published recommendation holds
+    #: until the raw recommendation drifts more than this percentage from
+    #: it (relative, per resource)...
+    hysteresis_dead_band_pct: float = pd.Field(5.0, ge=0)
+    #: ...for this many CONSECUTIVE scan ticks (then it jumps straight to
+    #: the current raw value).
+    hysteresis_confirm_ticks: int = pd.Field(2, ge=1)
+    #: The --no-hysteresis escape hatch: False publishes every recompute
+    #: verbatim (bit-exact legacy behavior); the journal still records
+    #: every tick either way.
+    hysteresis_enabled: bool = True
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

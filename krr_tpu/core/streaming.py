@@ -38,6 +38,44 @@ def object_key(obj: K8sObjectData) -> str:
     return f"{obj.cluster or ''}/{obj.namespace}/{obj.name}/{obj.container}/{obj.kind or ''}"
 
 
+def split_object_key(key: str) -> "tuple[Optional[str], str, str, str, Optional[str]]":
+    """The inverse of :func:`object_key`: ``(cluster, namespace, name,
+    container, kind)`` with empty segments back to None. Splits from the
+    RIGHT: only the cluster segment can itself contain ``/`` (EKS context
+    names are ARNs like ``arn:aws:eks:...:cluster/prod``), and a left split
+    would shift every field. Lives beside the forward map so every consumer
+    (the /history filters, the diff renderer) parses identically."""
+    parts = key.rsplit("/", 4)
+    if len(parts) < 5:
+        parts = [""] * (5 - len(parts)) + parts
+    cluster, namespace, name, container, kind = parts
+    return cluster or None, namespace, name, container, kind or None
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb") -> Iterator:
+    """Crash-safe file replacement: write a temp file in the target's
+    directory, FSYNC it, then atomically rename over ``path``. The fsync
+    before the rename is load-bearing: rename-only guarantees the old OR
+    new *name*, but a crash shortly after the rename can land the new name
+    on unwritten data — a truncated store/journal, which is strictly worse
+    than the stale-but-complete file the rename was meant to preserve.
+    Shared by the digest store, the serve window cursor (inside the store's
+    save), and the recommendation journal."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 @dataclass
 class DigestStore:
     """Host-side persistent digest state for a fleet."""
@@ -220,6 +258,13 @@ class DigestStore:
         total, peak = self._take(rows, self.mem_total, self.mem_peak)
         return np.where(total > 0, peak, np.nan).astype(np.float32)
 
+    def query_recommendation(self, rows: np.ndarray, q: float) -> tuple[np.ndarray, np.ndarray]:
+        """(CPU percentile, memory peak MB) for ``rows`` — THE digested-store
+        recommendation query, shared by ``TDigestStrategy.run_digested``, the
+        serve scheduler's publish path, and the journal/diff tooling, so no
+        two consumers can drift apart on what a recommendation is."""
+        return np.asarray(self.cpu_percentile(rows, q)), np.asarray(self.memory_peak(rows))
+
     # ------------------------------------------------------------ persistence
     #
     # On-disk format: the count matrix is stored SPARSELY (CSR — concatenated
@@ -230,7 +275,9 @@ class DigestStore:
     # and the write/read run at disk speed. Dense legacy files still load.
 
     def save(self, path: str) -> None:
-        """Atomic write (tmp + rename): a crash mid-save keeps the old state."""
+        """Atomic write (tmp + fsync + rename via :func:`atomic_write`): a
+        crash at any point keeps a complete file — old state before the
+        rename, fully-written new state after it, never a truncated one."""
         meta = {
             "gamma": self.spec.gamma,
             "min_value": self.spec.min_value,
@@ -247,27 +294,19 @@ class DigestStore:
         indptr = np.zeros(len(self.keys) + 1, dtype=np.int64)
         np.cumsum(per_row, out=indptr[1:])
 
-        directory = os.path.dirname(os.path.abspath(path)) or "."
-        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(
-                    f,
-                    meta=json.dumps(meta),
-                    keys=np.asarray(self.keys),
-                    csr_vals=vals,
-                    csr_cols=cols,
-                    csr_indptr=indptr,
-                    cpu_total=self.cpu_total,
-                    cpu_peak=self.cpu_peak,
-                    mem_total=self.mem_total,
-                    mem_peak=self.mem_peak,
-                )
-            os.replace(tmp, path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        with atomic_write(path) as f:
+            np.savez(
+                f,
+                meta=json.dumps(meta),
+                keys=np.asarray(self.keys),
+                csr_vals=vals,
+                csr_cols=cols,
+                csr_indptr=indptr,
+                cpu_total=self.cpu_total,
+                cpu_peak=self.cpu_peak,
+                mem_total=self.mem_total,
+                mem_peak=self.mem_peak,
+            )
 
     @classmethod
     def load(cls, path: str) -> "DigestStore":

@@ -285,7 +285,8 @@ def _server_options() -> list[click.Option]:
     from krr_tpu.core.config import Config
 
     defaults = {name: Config.model_fields[name].default for name in (
-        "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds"
+        "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds",
+        "history_retention_seconds", "hysteresis_dead_band_pct", "hysteresis_confirm_ticks",
     )}
     return [
         PanelOption(
@@ -318,6 +319,56 @@ def _server_options() -> list[click.Option]:
             show_default=True,
             panel="Server Settings",
             help="Seconds between fleet re-discoveries (workload churn pickup + digest store compaction).",
+        ),
+        PanelOption(
+            ["--history-path", "history_path"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "Journal file recording every recompute's raw recommendations "
+                "(GET /history, GET /drift, krr-tpu diff). Default: "
+                "<state_path>.journal when --state_path is set; pass an empty "
+                "string to keep the journal memory-only."
+            ),
+        ),
+        PanelOption(
+            ["--history-retention", "history_retention_seconds"],
+            type=float,
+            default=defaults["history_retention_seconds"],
+            show_default=True,
+            panel="Server Settings",
+            help="Seconds of recommendation history the journal retains (older records are compacted away).",
+        ),
+        PanelOption(
+            ["--dead-band-pct", "hysteresis_dead_band_pct"],
+            type=float,
+            default=defaults["hysteresis_dead_band_pct"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Hysteresis dead band: a workload's published recommendation "
+                "holds until the raw recommendation drifts more than this "
+                "percentage from it..."
+            ),
+        ),
+        PanelOption(
+            ["--confirm-ticks", "hysteresis_confirm_ticks"],
+            type=int,
+            default=defaults["hysteresis_confirm_ticks"],
+            show_default=True,
+            panel="Server Settings",
+            help="...for this many consecutive scan ticks (then it jumps to the current raw value).",
+        ),
+        PanelOption(
+            ["--no-hysteresis", "hysteresis_enabled"],
+            is_flag=True,
+            flag_value=False,
+            default=True,
+            panel="Server Settings",
+            help=(
+                "Publish every recompute verbatim (no dead-band gate) — "
+                "bit-exact legacy behavior; the journal still records every tick."
+            ),
         ),
     ]
 
@@ -370,6 +421,162 @@ def _make_serve_command(strategy_name: str, strategy_type: Any) -> click.Command
             "keeps per-container digests fresh with incremental delta scans, and "
             "GET /recommendations answers from the resident state "
             "(also: GET /healthz, GET /metrics)."
+        ),
+    )
+
+
+def _make_diff_command(strategy_name: str, strategy_type: Any) -> click.Command:
+    """``krr-tpu diff``: render the delta between two recommendation points.
+
+    Points come from a serve journal (two tick timestamps; defaults are the
+    newest two) or, with ``--live``, the newest journal tick vs a fresh
+    one-shot scan. The delta renders through the existing formatter registry
+    (`krr_tpu.history.diff` — a diff IS a scan result whose "current"
+    allocations are the baseline point), so every formatter including
+    plugins works unchanged.
+    """
+    settings_fields = list(strategy_type.get_settings_type().model_fields)
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+
+        journal_path = kwargs.pop("journal_path")
+        at = kwargs.pop("at")
+        baseline = kwargs.pop("baseline")
+        live = kwargs.pop("live")
+        clusters = list(kwargs.pop("clusters") or [])
+        namespaces = list(kwargs.pop("namespaces") or [])
+        other_args = {name: kwargs.pop(name) for name in settings_fields}
+        try:
+            config = Config(
+                clusters="*" if "*" in clusters else (clusters or None),
+                namespaces="*" if ("*" in namespaces or not namespaces) else namespaces,
+                strategy=strategy_name,
+                other_args=other_args,
+                **kwargs,
+            )
+            settings = config.create_strategy().settings  # validated strategy settings
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+
+        if journal_path is None:
+            state_path = other_args.get("state_path")
+            if state_path:
+                journal_path = f"{state_path}.journal"
+            else:
+                raise click.UsageError("pass --journal (or --state_path to derive <state_path>.journal)")
+
+        from krr_tpu.history.diff import (
+            build_diff_result,
+            live_values,
+            newest_at_or_before,
+            resolve_ticks,
+            tick_values,
+        )
+        from krr_tpu.history.journal import RecommendationJournal
+
+        logger = config.create_logger()
+        try:
+            # readonly: a diff must never create, repair, or truncate a
+            # journal — including one a running server is mid-append on.
+            journal = RecommendationJournal(
+                journal_path,
+                retention_seconds=config.history_retention_seconds,
+                logger=logger,
+                readonly=True,
+            )
+        except ValueError as e:
+            raise click.UsageError(str(e)) from e
+        if journal.record_count == 0:
+            raise click.UsageError(f"journal at {journal_path} holds no ticks")
+        if live and baseline is not None:
+            raise click.UsageError(
+                "--baseline picks a second JOURNAL point and --live replaces that "
+                "point with a fresh scan — pass one or the other (use --at to pick "
+                "the journal tick a live diff compares against)"
+            )
+
+        def scoped(values: dict) -> dict:
+            # The server journals the WHOLE fleet; honor -n/-c on the
+            # journal side too, or a filtered --live scan renders everything
+            # outside the filter as spuriously vanished (and in
+            # journal-vs-journal mode the flags would be silently ignored).
+            from krr_tpu.core.streaming import split_object_key
+
+            if config.namespaces == "*" and not isinstance(config.clusters, list):
+                return values
+            out = {}
+            for key, point in values.items():
+                cluster, namespace, _name, _container, _kind = split_object_key(key)
+                if config.namespaces != "*" and namespace not in config.namespaces:
+                    continue
+                if isinstance(config.clusters, list) and (cluster or "") not in config.clusters:
+                    continue
+                out[key] = point
+            return out
+
+        try:
+            if live:
+                base_ts = newest_at_or_before(journal, at)
+                baseline_values = scoped(tick_values(journal, base_ts))
+                target_values = scoped(asyncio.run(live_values(config)))
+                logger.info(f"diff: journal tick {base_ts:.0f} vs live scan")
+            else:
+                base_ts, at_ts = resolve_ticks(journal, at=at, baseline=baseline)
+                baseline_values = scoped(tick_values(journal, base_ts))
+                target_values = scoped(tick_values(journal, at_ts))
+                logger.info(f"diff: journal tick {base_ts:.0f} vs {at_ts:.0f}")
+        except ValueError as e:
+            raise click.UsageError(str(e)) from e
+        result = build_diff_result(
+            baseline_values,
+            target_values,
+            cpu_min_value=config.cpu_min_value,
+            memory_min_value=config.memory_min_value,
+            # The journal stores PRE-buffer raw memory; re-apply the
+            # strategy's buffer so diff memory matches served values.
+            memory_buffer_percentage=settings.memory_buffer_percentage,
+        )
+        logger.print_result(result.format(config.format))
+
+    diff_options = [
+        PanelOption(
+            ["--journal", "journal_path"],
+            default=None,
+            help="Path to the serve journal file (default: <state_path>.journal when --state_path is set).",
+        ),
+        PanelOption(
+            ["--at"],
+            type=float,
+            default=None,
+            help="Target point: the newest journal tick at or before this unix timestamp (default: the newest tick).",
+        ),
+        PanelOption(
+            ["--baseline"],
+            type=float,
+            default=None,
+            help="Baseline point: the newest journal tick at or before this unix timestamp (default: the tick before the target).",
+        ),
+        PanelOption(
+            ["--live"],
+            is_flag=True,
+            default=False,
+            help="Diff the newest journal tick against a fresh one-shot scan instead of a second journal point.",
+        ),
+    ]
+    return PanelCommand(
+        "diff",
+        callback=callback,
+        params=diff_options + _common_options() + _strategy_options(strategy_type),
+        help=(
+            "Render the delta between two recommendation points — two serve "
+            "journal ticks, or (--live) the newest tick vs a fresh scan — "
+            "through any registered formatter."
         ),
     )
 
@@ -427,8 +634,9 @@ def load_commands() -> None:
     strategies = BaseStrategy.get_all()
     for strategy_name, strategy_type in strategies.items():
         app.add_command(_make_strategy_command(strategy_name, strategy_type))
-    if "tdigest" in strategies:  # the serve subsystem rides the digest strategy
+    if "tdigest" in strategies:  # the serve + history subsystems ride the digest strategy
         app.add_command(_make_serve_command("tdigest", strategies["tdigest"]))
+        app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
 
 
 def run() -> None:

@@ -1,10 +1,10 @@
 """The asyncio HTTP surface of `krr-tpu serve`.
 
-Deliberately framework-free: the API is three GET routes serving
-pre-rendered bodies, and the stdlib's ``asyncio.start_server`` plus ~100
-lines of HTTP/1.1 parsing covers it — no router, no middleware stack, no
-dependency the image doesn't already carry. (aiohttp stays a TEST
-dependency: the fakes use it, the product doesn't.)
+Deliberately framework-free: the API is a handful of GET routes serving
+pre-rendered or worker-thread-rendered bodies, and the stdlib's
+``asyncio.start_server`` plus ~100 lines of HTTP/1.1 parsing covers it — no
+router, no middleware stack, no dependency the image doesn't already carry.
+(aiohttp stays a TEST dependency: the fakes use it, the product doesn't.)
 
 Routes:
 
@@ -13,7 +13,12 @@ Routes:
   repeatable ``namespace=``, and ``workload=`` / ``container=``; pick a
   machine format with ``format=json|yaml|pprint``. 503 until the first
   scan publishes.
-* ``GET /healthz``   — liveness + scan freshness (JSON).
+* ``GET /history``   — per-workload journal of recommendation ticks (the
+  raw series behind the hysteresis-gated snapshot); same filters, plus
+  ``limit=`` for the newest N ticks per workload.
+* ``GET /drift``     — fleet drift summary (`krr_tpu.history.drift`): raw
+  vs published drift, flap counts, regime-change flags.
+* ``GET /healthz``   — liveness + scan freshness + journal age (JSON).
 * ``GET /metrics``   — Prometheus text format (`krr_tpu.server.metrics`).
 """
 
@@ -79,11 +84,19 @@ class HttpApp:
         *,
         stale_after_seconds: float = float("inf"),
         clock=time.time,
+        drift_dead_band_pct: float = 5.0,
+        drift_confirm_ticks: int = 2,
+        hysteresis_enabled: bool = True,
     ) -> None:
         self.state = state
         self.logger = logger
         self.stale_after_seconds = stale_after_seconds
         self.clock = clock
+        #: The gate knobs, echoed by /drift so its out-of-band/regime flags
+        #: are interpretable without reading the server's flags.
+        self.drift_dead_band_pct = float(drift_dead_band_pct)
+        self.drift_confirm_ticks = int(drift_confirm_ticks)
+        self.hysteresis_enabled = bool(hysteresis_enabled)
         #: Open client connections, for shutdown: ``Server.close()`` stops
         #: the listener but never touches established keep-alive
         #: connections, and on Python ≥ 3.12.1 ``wait_closed()`` waits for
@@ -110,6 +123,10 @@ class HttpApp:
             return 200, _METRICS_CONTENT_TYPE, self.state.metrics.render().encode()
         if path == "/recommendations":
             return await self._recommendations(query)
+        if path == "/history":
+            return await self._history(query)
+        if path == "/drift":
+            return await self._drift()
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
 
     async def _healthz(self) -> tuple[int, str, bytes]:
@@ -120,12 +137,25 @@ class HttpApp:
             status = "stale"
         else:
             status = "ok"
+        journal = self.state.journal
+        journal_newest = journal.newest_ts if journal is not None else None
         body = {
             "status": status,
             "uptime_seconds": round(time.time() - self.state.started_at, 3),
             "scans": len(snapshot.result.scans) if snapshot is not None else 0,
             "last_scan_unix": snapshot.window_end if snapshot is not None else None,
             "store_rows": len(self.state.store.keys),
+            # Hysteresis visibility: a fleet publishing nothing is either
+            # genuinely quiet (suppressed 0) or held behind the gate
+            # (suppressed > 0) — operators need the distinction.
+            "last_publish_suppressed": self.state.last_publish_suppressed,
+            "last_publish_changed": self.state.last_publish_changed,
+            "journal_records": journal.record_count if journal is not None else 0,
+            "journal_age_seconds": (
+                round(float(self.clock()) - journal_newest, 3)
+                if journal_newest is not None
+                else None
+            ),
         }
         return (200 if status == "ok" else 503), "application/json", _json_body(body)
 
@@ -164,6 +194,106 @@ class HttpApp:
             return Result(scans=scans).format(fmt).encode()
 
         return 200, content_type, await asyncio.to_thread(render)
+
+    async def _history(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """Per-workload journal series: every recompute's raw recommendation
+        with its published flag — the audit trail behind the gated snapshot."""
+        journal = self.state.journal
+        if journal is None:
+            return 404, "application/json", _json_body({"error": "no journal on this server"})
+        namespaces = set(query.get("namespace", ()))
+        workloads = set(query.get("workload", ()))
+        containers = set(query.get("container", ()))
+        try:
+            limit = int((query.get("limit") or ["0"])[-1])
+        except ValueError:
+            return 400, "application/json", _json_body({"error": "limit must be an integer"})
+
+        def render() -> bytes:
+            from krr_tpu.core.streaming import split_object_key
+            from krr_tpu.history.drift import finite_or_none
+            from krr_tpu.history.journal import FLAG_PUBLISHED
+
+            payload: dict = {
+                "records": journal.record_count,
+                "oldest_ts": journal.oldest_ts,
+                "newest_ts": journal.newest_ts,
+                "retention_seconds": journal.retention_seconds,
+                "workloads": [],
+            }
+            for key, group in journal.records_by_workload():
+                unresolved = "/" not in key  # hex fallback: lost key sidecar
+                if unresolved:
+                    # Splitting a hash as an object key would scatter it
+                    # into the wrong identity fields; it matches no filter.
+                    if namespaces or workloads or containers:
+                        continue
+                    cluster = namespace = name = container = kind = None
+                else:
+                    cluster, namespace, name, container, kind = split_object_key(key)
+                    if namespaces and namespace not in namespaces:
+                        continue
+                    if workloads and name not in workloads:
+                        continue
+                    if containers and container not in containers:
+                        continue
+                if limit > 0:
+                    group = group[-limit:]
+                payload["workloads"].append(
+                    {
+                        "key": key,
+                        "unresolved": unresolved,
+                        "cluster": cluster,
+                        "namespace": namespace,
+                        "workload": name,
+                        "container": container,
+                        "kind": kind,
+                        "ticks": [
+                            {
+                                "ts": float(row["ts"]),
+                                "cpu": finite_or_none(row["cpu"]),
+                                "memory_mb": finite_or_none(row["mem"]),
+                                "published": bool(row["flags"] & FLAG_PUBLISHED),
+                            }
+                            for row in group
+                        ],
+                    }
+                )
+            return _json_body(payload)
+
+        return 200, "application/json", await asyncio.to_thread(render)
+
+    async def _drift(self) -> tuple[int, str, bytes]:
+        """Fleet drift posture from the journal (`krr_tpu.history.drift`)."""
+        journal = self.state.journal
+        if journal is None:
+            return 404, "application/json", _json_body({"error": "no journal on this server"})
+
+        def render() -> bytes:
+            from krr_tpu.history.drift import fleet_drift
+
+            rows = fleet_drift(
+                journal,
+                dead_band_pct=self.drift_dead_band_pct,
+                confirm_ticks=self.drift_confirm_ticks,
+            )
+            out_of_band = sum(1 for row in rows if row.out_of_band_streak > 0)
+            payload = {
+                "dead_band_pct": self.drift_dead_band_pct,
+                "confirm_ticks": self.drift_confirm_ticks,
+                "hysteresis_enabled": self.hysteresis_enabled,
+                "last_publish_suppressed": self.state.last_publish_suppressed,
+                "summary": {
+                    "workloads": len(rows),
+                    "out_of_band": out_of_band,
+                    "regime_changes": sum(1 for row in rows if row.regime_change),
+                    "flaps": sum(row.flaps for row in rows),
+                },
+                "workloads": [row.as_dict() for row in rows],
+            }
+            return _json_body(payload)
+
+        return 200, "application/json", await asyncio.to_thread(render)
 
     # ------------------------------------------------------------ plumbing
     async def handle_connection(
@@ -247,7 +377,11 @@ class HttpApp:
 
         t0 = time.perf_counter()
         status, content_type, body = await self.route(method, split.path, query)
-        route_label = split.path if split.path in ("/healthz", "/metrics", "/recommendations") else "other"
+        route_label = (
+            split.path
+            if split.path in ("/healthz", "/metrics", "/recommendations", "/history", "/drift")
+            else "other"
+        )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
         self.state.metrics.observe(
             "krr_tpu_http_request_seconds", time.perf_counter() - t0, route=route_label
@@ -300,8 +434,22 @@ class KrrServer:
             )
         # The resident store; with state_path configured it resumes the
         # persisted digests (and the scheduler re-saves after every fold).
+        # The journal rides alongside: default path <state_path>.journal
+        # (memory-only when neither is set; --history-path "" forces
+        # memory-only even with a state_path).
+        from krr_tpu.history.journal import RecommendationJournal
+
+        state_path = getattr(settings, "state_path", None)
+        journal_path = config.history_path
+        if journal_path is None and state_path:
+            journal_path = f"{state_path}.journal"
         self.state = ServerState(
-            DigestStore.open_or_create(getattr(settings, "state_path", None), settings.cpu_spec())
+            DigestStore.open_or_create(state_path, settings.cpu_spec()),
+            journal=RecommendationJournal(
+                journal_path or None,
+                retention_seconds=config.history_retention_seconds,
+                logger=self.logger,
+            ),
         )
         self.scheduler = ScanScheduler(
             self.session,
@@ -318,6 +466,9 @@ class KrrServer:
             # coarser) without a published window = stale.
             stale_after_seconds=3.0 * max(config.scan_interval_seconds, self.scheduler._step_seconds()),
             clock=clock,
+            drift_dead_band_pct=config.hysteresis_dead_band_pct,
+            drift_confirm_ticks=config.hysteresis_confirm_ticks,
+            hysteresis_enabled=config.hysteresis_enabled,
         )
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -351,6 +502,8 @@ class KrrServer:
             self.app.abort_connections()
             await self._server.wait_closed()
             self._server = None
+        if self.state.journal is not None:
+            self.state.journal.close()
         await self.session.close()
 
 

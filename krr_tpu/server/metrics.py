@@ -33,6 +33,12 @@ SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
     ("krr_tpu_digest_store_rows", "gauge", "Rows (containers) resident in the digest store."),
     ("krr_tpu_digest_store_bytes", "gauge", "Resident bytes of the digest store's row arrays."),
     ("krr_tpu_store_compacted_rows_total", "counter", "Store rows dropped by churn compaction."),
+    ("krr_tpu_recommendation_churn_total", "counter", "Published recommendation changes: workloads whose published values moved this tick (first-time publishes excluded)."),
+    ("krr_tpu_hysteresis_suppressed_total", "counter", "Workload-ticks where an out-of-dead-band recommendation change was withheld by the hysteresis gate."),
+    ("krr_tpu_journal_records", "gauge", "Recommendation-tick records resident in the history journal."),
+    ("krr_tpu_journal_bytes", "gauge", "Resident bytes of the history journal's record array."),
+    ("krr_tpu_journal_span_seconds", "gauge", "Time between the journal's oldest and newest records (retention coverage)."),
+    ("krr_tpu_journal_compacted_records_total", "counter", "Journal records dropped by retention compaction."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
     ("krr_tpu_http_request_seconds", "summary", "HTTP request latency by route."),
 )

@@ -30,6 +30,14 @@ evaluates at ``start, start + step, …``, so the fetched window's true right
 edge is the last grid point ≤ now. ``last_end`` records THAT point — with a
 wall-clock right edge, tick jitter (a 90 s sleep on a 60 s grid) would skip
 the grid samples between the last evaluated point and the clock reading.
+
+The publish leg runs through `krr_tpu.history`: every recompute's raw
+recommendations append to the journal (the flight recorder behind
+``GET /history`` / ``GET /drift`` / ``krr-tpu diff``), and the values that
+reach the published snapshot are filtered by the hysteresis gate — they only
+move when drift exceeds the dead band for the confirmation window, so the
+snapshot the fleet consumes is stable by construction while the journal
+retains the raw series (``--no-hysteresis`` restores verbatim publishing).
 """
 
 from __future__ import annotations
@@ -43,6 +51,7 @@ import numpy as np
 
 from krr_tpu.core.runner import ScanSession, round_allocations
 from krr_tpu.core.streaming import object_key
+from krr_tpu.history.policy import HysteresisGate
 from krr_tpu.models.objects import K8sObjectData
 from krr_tpu.models.result import ResourceScan, Result
 from krr_tpu.server.state import ServerState, Snapshot
@@ -93,6 +102,26 @@ class ScanScheduler:
                     f"Digest state at {self.state_path} carries no serve window cursor — "
                     f"the first scan re-folds the full window on top of the resumed store"
                 )
+        # The hysteresis gate on the publish path (`krr_tpu.history.policy`).
+        # A resumed journal re-seeds the trailing published baselines, so a
+        # restart keeps gating against the pre-restart published values
+        # instead of re-publishing the whole fleet as "new".
+        config = session.config
+        self.gate = HysteresisGate(
+            dead_band_pct=config.hysteresis_dead_band_pct,
+            confirm_ticks=config.hysteresis_confirm_ticks,
+            enabled=config.hysteresis_enabled,
+        )
+        journal = state.journal
+        if journal is not None and journal.record_count:
+            published = journal.last_published()
+            if published:
+                keys = list(published)
+                self.gate.seed(
+                    keys,
+                    np.asarray([published[k][0] for k in keys], np.float32),
+                    np.asarray([published[k][1] for k in keys], np.float32),
+                )
 
     # ----------------------------------------------------------- one tick
     def _step_seconds(self) -> float:
@@ -135,33 +164,6 @@ class ScanScheduler:
             metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
             self.logger.info(f"Compacted {dropped} stale rows out of the digest store")
 
-    def _recommend(self, objects: list[K8sObjectData], rows: np.ndarray) -> Result:
-        """Recommendations for ``objects`` from their merged store rows —
-        the store-backed twin of the tdigest strategy's ``run_digested``
-        query (host numpy; runs in a worker thread)."""
-        from krr_tpu.strategies.simple import finalize_fleet
-
-        settings = self.session.strategy.settings
-        q = float(settings.cpu_percentile)
-        cpu_p = self.state.store.cpu_percentile(rows, q)
-        mem_max = self.state.store.memory_peak(rows)
-        raw_results = finalize_fleet(
-            np.asarray(cpu_p), np.asarray(mem_max), settings.memory_buffer_percentage
-        )
-        config = self.session.config
-        scans = [
-            ResourceScan.calculate(
-                obj,
-                round_allocations(
-                    raw,
-                    cpu_min_value=config.cpu_min_value,
-                    memory_min_value=config.memory_min_value,
-                ),
-            )
-            for obj, raw in zip(objects, raw_results)
-        ]
-        return Result(scans=scans)
-
     def _save_store(self) -> None:
         from krr_tpu.core.streaming import DigestStore
 
@@ -169,15 +171,109 @@ class ScanScheduler:
         with DigestStore.locked(self.state_path):
             self.state.store.save(self.state_path)
 
-    async def _recompute_and_publish(self, objects: list[K8sObjectData], rows: np.ndarray, window_end: float) -> None:
-        def render() -> tuple[Result, bytes]:
-            # Recommend + render + encode in ONE worker-thread hop: the
-            # whole-fleet JSON is multi-MB at scale, and any leg of it on
-            # the event loop stalls every in-flight query.
-            result = self._recommend(objects, rows)
-            return result, result.format("json").encode()
+    async def _recompute_and_publish(
+        self,
+        objects: list[K8sObjectData],
+        rows: np.ndarray,
+        window_end: float,
+        *,
+        record: bool = True,
+    ) -> None:
+        """Query the store, gate through hysteresis, journal the raw tick,
+        render, publish. ``record=False`` on the resume re-publish (the tick
+        was already journaled before the restart)."""
+        from krr_tpu.strategies.simple import finalize_fleet
 
-        result, body = await asyncio.to_thread(render)
+        metrics = self.state.metrics
+        journal = self.state.journal
+
+        def render() -> "tuple[Result, bytes, object]":
+            # Query + gate + journal + recommend + render + encode in ONE
+            # worker-thread hop: the whole-fleet JSON is multi-MB at scale,
+            # and any leg of it on the event loop stalls every in-flight
+            # query. The store query is the shared
+            # `DigestStore.query_recommendation` — the same path the tdigest
+            # strategy's run_digested uses, queried exactly once per tick.
+            settings = self.session.strategy.settings
+            config = self.session.config
+            cpu_raw, mem_raw = self.state.store.query_recommendation(
+                rows, float(settings.cpu_percentile)
+            )
+            keys = [object_key(obj) for obj in objects]
+            decision = self.gate.observe(keys, cpu_raw, mem_raw)
+            if journal is not None:
+                if record:
+                    journal.append_tick(window_end, keys, cpu_raw, mem_raw, decision.published)
+                    dropped = journal.compact(window_end)
+                    if dropped:
+                        metrics.inc("krr_tpu_journal_compacted_records_total", dropped)
+                elif self.gate.enabled:
+                    # The resume re-publish normally journals nothing (the
+                    # window was journaled before the restart) — but rows the
+                    # gate publishes FIRST-TIME here (workloads the journal
+                    # seed couldn't cover: flagged records aged out, lost
+                    # sidecar) must gain a FLAG_PUBLISHED record, or the
+                    # journal's forward-filled published series (drift, the
+                    # next restart's seed) diverges from what the gate holds.
+                    # Excluded: seed-covered rows whose gate happened to open
+                    # (published & changed), and any key that ALREADY has a
+                    # record at this window_end (its raw tick survived
+                    # retention even though its published flag didn't) — a
+                    # duplicate same-timestamp record would distort the
+                    # /history tick counts and the drift/flap series.
+                    first = decision.published & ~decision.changed
+                    if bool(np.any(first)):
+                        from krr_tpu.history.journal import hash_key
+
+                        recs = journal.records()
+                        at_tick = {int(h) for h in recs["key_hash"][recs["ts"] == window_end]}
+                        if at_tick:
+                            first &= np.fromiter(
+                                (hash_key(k) not in at_tick for k in keys), bool, len(keys)
+                            )
+                    if bool(np.any(first)):
+                        idx = np.flatnonzero(first)
+                        journal.append_tick(
+                            window_end,
+                            [keys[i] for i in idx],
+                            cpu_raw[idx],
+                            mem_raw[idx],
+                            np.ones(len(idx), bool),
+                        )
+            raw_results = finalize_fleet(
+                decision.cpu, decision.mem, settings.memory_buffer_percentage
+            )
+            scans = [
+                ResourceScan.calculate(
+                    obj,
+                    round_allocations(
+                        raw,
+                        cpu_min_value=config.cpu_min_value,
+                        memory_min_value=config.memory_min_value,
+                    ),
+                )
+                for obj, raw in zip(objects, raw_results)
+            ]
+            result = Result(scans=scans)
+            return result, result.format("json").encode(), decision
+
+        result, body, decision = await asyncio.to_thread(render)
+        changed = int(np.count_nonzero(decision.changed))
+        suppressed = int(np.count_nonzero(decision.suppressed))
+        if changed:
+            metrics.inc("krr_tpu_recommendation_churn_total", changed)
+        if suppressed:
+            metrics.inc("krr_tpu_hysteresis_suppressed_total", suppressed)
+        self.state.last_publish_changed = changed
+        self.state.last_publish_suppressed = suppressed
+        if journal is not None:
+            metrics.set("krr_tpu_journal_records", journal.record_count)
+            metrics.set("krr_tpu_journal_bytes", journal.nbytes)
+            newest, oldest = journal.newest_ts, journal.oldest_ts
+            metrics.set(
+                "krr_tpu_journal_span_seconds",
+                (newest - oldest) if newest is not None and oldest is not None else 0.0,
+            )
         await self.state.publish(
             Snapshot(result=result, body_json=body, window_end=window_end, published_at=time.time())
         )
@@ -228,7 +324,12 @@ class ScanScheduler:
                         rows = await asyncio.to_thread(
                             self.state.store.rows_for, [object_key(obj) for obj in known]
                         )
-                        await self._recompute_and_publish(known, rows, self.state.last_end)
+                        # record=False: this window's tick was journaled
+                        # before the restart — re-appending it would
+                        # double-record the same timestamp.
+                        await self._recompute_and_publish(
+                            known, rows, self.state.last_end, record=False
+                        )
                     return False
             # Clamp the right edge to the last evaluation-grid point ≤ now
             # (see the module docstring): the next delta then starts exactly

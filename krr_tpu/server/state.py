@@ -22,6 +22,7 @@ from krr_tpu.server.metrics import MetricsRegistry
 
 if TYPE_CHECKING:
     from krr_tpu.core.streaming import DigestStore
+    from krr_tpu.history.journal import RecommendationJournal
     from krr_tpu.models.result import Result
 
 
@@ -87,8 +88,13 @@ class Snapshot:
 class ServerState:
     """The serve process's shared mutable state."""
 
-    def __init__(self, store: "DigestStore") -> None:
+    def __init__(self, store: "DigestStore", journal: "Optional[RecommendationJournal]" = None) -> None:
         self.store = store
+        #: The recommendation flight recorder (`krr_tpu.history.journal`):
+        #: every scheduler recompute appends here; GET /history and
+        #: GET /drift read it from worker threads (the journal carries its
+        #: own lock). None only for states built without a server.
+        self.journal = journal
         #: One scan in flight at a time (scheduler ticks + any manual kicks).
         self.scan_lock = asyncio.Lock()
         self.rwlock = ReadWriteLock()
@@ -98,6 +104,12 @@ class ServerState:
         #: step after it. Advanced only after a fold completes, so a
         #: cancelled scan refetches its window instead of losing it.
         self.last_end: Optional[float] = None
+        #: The last publish's hysteresis outcome (None before any publish):
+        #: how many workloads' out-of-band changes were withheld, and how
+        #: many published values moved — surfaced on /healthz so operators
+        #: can tell a quiet fleet from a stuck gate.
+        self.last_publish_suppressed: Optional[int] = None
+        self.last_publish_changed: Optional[int] = None
         self._snapshot: Optional[Snapshot] = None
 
     async def publish(self, snapshot: Snapshot) -> None:

@@ -214,8 +214,7 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
                 with DigestStore.locked(self.settings.state_path):
                     store = DigestStore.open_or_create(self.settings.state_path, spec)
                     rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
-                    cpu_p = store.cpu_percentile(rows, q)
-                    mem_max = store.memory_peak(rows)
+                    cpu_p, mem_max = store.query_recommendation(rows, q)
                     store.save(self.settings.state_path)
             else:
                 cpu_p = digest_ops.percentile_host(
@@ -246,8 +245,7 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
                 with DigestStore.locked(self.settings.state_path):
                     store = DigestStore.open_or_create(self.settings.state_path, spec)
                     rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
-                    cpu_p = store.cpu_percentile(rows, q)
-                    mem_max = store.memory_peak(rows)
+                    cpu_p, mem_max = store.query_recommendation(rows, q)
                     store.save(self.settings.state_path)
             elif self._use_host_stream(batch, mesh):
                 cpu_p, mem_max = self._streamed_sketch(batch, spec, q, mesh)

@@ -39,3 +39,9 @@ def test_bench_smoke_end_to_end():
     assert "fleet_e2e_overlap_pct" in secondary
     assert secondary.get("fleet_e2e_staged_seconds", 0) > 0
     assert secondary.get("fleet_e2e_vs_staged") is not None
+    # The history-journal leg ran end-to-end: fsync'd appends, retention
+    # compaction, and a journal-diff render through the formatter registry
+    # all executed (a break in any of them zeroes or drops these keys).
+    assert secondary.get("journal_append_records_per_sec", 0) > 0, secondary
+    assert secondary.get("journal_compact_records_per_sec", 0) > 0, secondary
+    assert secondary.get("journal_diff_objects_per_sec", 0) > 0, secondary

@@ -1,0 +1,535 @@
+"""Unit tests for the recommendation flight recorder (`krr_tpu.history`):
+journal persistence + crash recovery, retention compaction, drift analysis,
+the hysteresis gate, and diff rendering."""
+
+import json
+import os
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from krr_tpu.models.allocations import ResourceType
+
+from krr_tpu.history.diff import (
+    build_diff_result,
+    parse_object_key,
+    resolve_ticks,
+    tick_values,
+)
+from krr_tpu.history.drift import fleet_drift
+from krr_tpu.history.journal import (
+    FLAG_PUBLISHED,
+    RECORD_DTYPE,
+    MAGIC,
+    RecommendationJournal,
+    hash_key,
+)
+from krr_tpu.history.policy import HysteresisGate
+
+
+KEYS = ["c/default/web/main/Deployment", "c/prod/db/main/StatefulSet"]
+
+
+def _tick(journal, ts, cpu, mem=None, published=None, keys=KEYS):
+    n = len(keys)
+    journal.append_tick(
+        ts,
+        keys,
+        np.asarray(cpu, np.float32),
+        np.asarray(mem if mem is not None else [100.0] * n, np.float32),
+        np.asarray(published if published is not None else [False] * n, bool),
+    )
+
+
+# ---------------------------------------------------------------- journal
+class TestJournal:
+    def test_append_persist_reload_round_trip(self, tmp_path):
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path)
+        _tick(journal, 100.0, [0.2, 1.5], [64.0, 256.0], [True, True])
+        _tick(journal, 160.0, [0.21, 1.4], [64.0, 250.0], [False, False])
+        journal.close()
+
+        reopened = RecommendationJournal(path)
+        recs = reopened.records()
+        assert len(recs) == 4
+        assert reopened.record_count == 4
+        assert reopened.oldest_ts == 100.0 and reopened.newest_ts == 160.0
+        assert reopened.tick_timestamps().tolist() == [100.0, 160.0]
+        # Values round-trip bit-exactly through float32.
+        web = recs[recs["key_hash"] == np.uint64(hash_key(KEYS[0]))]
+        assert web["cpu"].tolist() == [np.float32(0.2), np.float32(0.21)]
+        # The key table sidecar resolves hashes back to names.
+        assert reopened.key_name(hash_key(KEYS[1])) == KEYS[1]
+        reopened.close()
+
+    def test_memory_only_journal_needs_no_path(self):
+        journal = RecommendationJournal(None)
+        _tick(journal, 100.0, [0.2, 1.5])
+        assert journal.record_count == 2
+        assert journal.nbytes == 2 * RECORD_DTYPE.itemsize
+        journal.close()
+
+    def test_torn_final_record_is_dropped_not_fatal(self, tmp_path):
+        """A crash mid-append leaves a partial trailing record: open must
+        drop it (with the file truncated back so later appends stay
+        aligned), keeping every whole record."""
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path)
+        _tick(journal, 100.0, [0.2, 1.5], published=[True, True])
+        _tick(journal, 160.0, [0.21, 1.4])
+        journal.close()
+
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 10)  # tear the final record
+
+        reopened = RecommendationJournal(path)
+        assert reopened.record_count == 3  # the torn record is gone
+        # Appends after the repair stay record-aligned.
+        _tick(reopened, 220.0, [0.22, 1.3])
+        reopened.close()
+        final = RecommendationJournal(path)
+        assert final.record_count == 5
+        assert final.newest_ts == 220.0
+        final.close()
+
+    def test_corrupt_header_is_a_clear_error(self, tmp_path):
+        path = str(tmp_path / "j")
+        with open(path, "wb") as f:
+            f.write(b"not a journal at all")
+        with pytest.raises(ValueError, match="unrecognized header"):
+            RecommendationJournal(path)
+
+    def test_sub_header_stub_restarts_fresh_not_fatal(self, tmp_path):
+        """A crash between file creation and the header write leaves a
+        short stub — our own crash artifact, which must not wedge startup."""
+        path = str(tmp_path / "j")
+        with open(path, "wb") as f:
+            f.write(MAGIC[:3])
+        journal = RecommendationJournal(path)
+        assert journal.record_count == 0
+        _tick(journal, 100.0, [0.2, 1.5])
+        journal.close()
+        reopened = RecommendationJournal(path)
+        assert reopened.record_count == 2
+        reopened.close()
+
+    def test_failed_rewrite_keeps_the_append_handle_alive(self, tmp_path, monkeypatch):
+        """Disk trouble mid-compaction must not silently downgrade the
+        journal to memory-only: the append handle reopens even when the
+        atomic rewrite raised, so later ticks keep reaching disk."""
+        import krr_tpu.core.streaming as streaming
+
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path, retention_seconds=60.0)
+        for i in range(4):
+            _tick(journal, 100.0 + i * 60.0, [0.2, 1.5])
+
+        def boom(*args, **kwargs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(streaming, "atomic_write", boom)
+        with pytest.raises(OSError):
+            journal.compact(now=100.0 + 10 * 60.0)
+        monkeypatch.undo()
+
+        _tick(journal, 700.0, [0.2, 1.5])  # must still persist
+        journal.close()
+        reopened = RecommendationJournal(path, retention_seconds=60.0)
+        assert reopened.newest_ts == 700.0
+        reopened.close()
+
+    def test_file_rewrite_is_debounced_until_enough_ages_out(self, tmp_path):
+        """Steady-state compaction trims memory per tick but must NOT
+        rewrite+fsync the whole file per tick: the rewrite waits until ~10%
+        of the on-disk records have aged out (aged records on disk simply
+        re-trim on reload)."""
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path, retention_seconds=600.0)
+        for i in range(20):
+            _tick(journal, 100.0 + i * 60.0, [0.2, 1.5])
+        size_before = os.path.getsize(path)
+        # One tick ages out: 2 of 40 records = 5% < 10% — memory trims,
+        # the file stays untouched.
+        assert journal.compact(now=100.0 + 11 * 60.0) == 2
+        assert journal.record_count == 38
+        assert os.path.getsize(path) == size_before
+        # Two more ticks age out: debt reaches 6/40 = 15% — rewrite fires.
+        assert journal.compact(now=100.0 + 13 * 60.0) == 4
+        assert os.path.getsize(path) < size_before
+        journal.close()
+        reopened = RecommendationJournal(path, retention_seconds=600.0)
+        assert reopened.record_count == 34
+        reopened.close()
+
+    def test_retention_compaction_round_trip(self, tmp_path):
+        """Compaction drops aged-out records from memory AND disk (atomic
+        rewrite), prunes orphaned key-table entries, and later appends keep
+        working against the rewritten file."""
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path, retention_seconds=120.0)
+        old_key = ["c/default/gone/main/Deployment"]
+        _tick(journal, 100.0, [0.5], keys=old_key, published=[True])
+        _tick(journal, 400.0, [0.2, 1.5], published=[True, True])
+        _tick(journal, 460.0, [0.21, 1.4])
+
+        dropped = journal.compact(now=520.0)  # cutoff 400: the 100.0 tick ages out
+        assert dropped == 1
+        assert journal.record_count == 4
+        assert journal.oldest_ts == 400.0
+        # The vanished workload's key-table entry is pruned with its records.
+        assert journal.key_name(hash_key(old_key[0])) == f"{hash_key(old_key[0]):016x}"
+        assert journal.compact(now=520.0) == 0  # idempotent no-op
+
+        _tick(journal, 520.0, [0.22, 1.3])
+        journal.close()
+        reopened = RecommendationJournal(path, retention_seconds=120.0)
+        assert reopened.record_count == 6
+        assert reopened.tick_timestamps().tolist() == [400.0, 460.0, 520.0]
+        assert reopened.key_name(hash_key(KEYS[0])) == KEYS[0]
+        reopened.close()
+
+    def test_missing_key_sidecar_degrades_to_hex_names(self, tmp_path):
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path)
+        _tick(journal, 100.0, [0.2, 1.5], published=[True, True])
+        journal.close()
+        os.unlink(path + ".keys.json")
+        reopened = RecommendationJournal(path)
+        assert reopened.record_count == 2
+        assert reopened.key_name(hash_key(KEYS[0])) == f"{hash_key(KEYS[0]):016x}"
+        # Unresolvable hashes are EXCLUDED from the gate-seeding baseline: a
+        # hex name can never match a live object_key, so seeding it would
+        # park dead state in the gate — those workloads re-publish instead.
+        assert reopened.last_published() == {}
+        reopened.close()
+
+    def test_readonly_open_never_creates_repairs_or_writes(self, tmp_path):
+        """The `krr-tpu diff` open: a missing path is an error (no stray
+        file created), a torn tail is dropped from the snapshot but NOT
+        truncated on disk (it may be the owning server's append in flight),
+        and mutation raises."""
+        missing = str(tmp_path / "nope.journal")
+        with pytest.raises(ValueError, match="no journal"):
+            RecommendationJournal(missing, readonly=True)
+        assert not os.path.exists(missing)
+
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path)
+        _tick(journal, 100.0, [0.2, 1.5])
+        journal.close()
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 10)
+
+        reader = RecommendationJournal(path, readonly=True)
+        assert reader.record_count == 1  # torn tail dropped from the snapshot
+        assert os.path.getsize(path) == size - 10  # ...but the file untouched
+        with pytest.raises(RuntimeError, match="readonly"):
+            _tick(reader, 200.0, [0.2, 1.5])
+        with pytest.raises(RuntimeError, match="readonly"):
+            reader.compact(1e12)
+
+    def test_last_published_is_the_trailing_published_baseline(self):
+        journal = RecommendationJournal(None)
+        _tick(journal, 100.0, [0.2, 1.5], published=[True, True])
+        _tick(journal, 160.0, [0.3, 1.4], published=[False, True])
+        _tick(journal, 220.0, [0.4, 1.3], published=[False, False])
+        published = journal.last_published()
+        assert published[KEYS[0]] == (np.float32(0.2), np.float32(100.0))
+        assert published[KEYS[1]] == (np.float32(1.4), np.float32(100.0))
+
+    def test_last_published_fills_nan_resources_like_the_gate(self):
+        """A publish with one NaN resource kept that resource's prior held
+        value in the gate — the restart seed must reconstruct the same,
+        not seed NaN over a finite pre-restart recommendation."""
+        journal = RecommendationJournal(None)
+        key = KEYS[:1]
+        _tick(journal, 100.0, [1.0], [200.0], published=[True], keys=key)
+        _tick(journal, 160.0, [np.nan], [400.0], published=[True], keys=key)
+        published = journal.last_published()
+        assert published[key[0]] == (np.float32(1.0), np.float32(400.0))
+
+    def test_readonly_open_creates_no_lock_file(self, tmp_path):
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path)
+        _tick(journal, 100.0, [0.2, 1.5])
+        journal.close()
+        for stray in (path + ".lock",):
+            if os.path.exists(stray):
+                os.unlink(stray)
+        reader = RecommendationJournal(path, readonly=True)
+        assert reader.record_count == 2
+        # A purely-read open must not touch the directory at all.
+        assert not os.path.exists(path + ".lock")
+
+    def test_hash_is_stable_across_processes(self):
+        # Pinned value: the on-disk format depends on this staying fixed.
+        assert hash_key("a/b/c/d/E") == hash_key("a/b/c/d/E")
+        assert hash_key("a/b/c/d/E") != hash_key("a/b/c/d/F")
+        assert MAGIC == b"KRRJRNL1"
+
+
+# ----------------------------------------------------------------- policy
+class TestHysteresisGate:
+    def test_first_tick_publishes_then_sub_band_wiggle_holds(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=2)
+        first = gate.observe(KEYS, np.asarray([1.0, 2.0], np.float32), np.asarray([100.0, 200.0], np.float32))
+        assert first.published.all() and not first.changed.any()
+        assert first.cpu.tolist() == [1.0, 2.0]
+
+        wiggle = gate.observe(KEYS, np.asarray([1.04, 1.96], np.float32), np.asarray([100.0, 200.0], np.float32))
+        assert not wiggle.published.any()
+        assert not wiggle.suppressed.any()  # in-band: held, but nothing withheld
+        assert wiggle.cpu.tolist() == [1.0, 2.0]  # the published values hold
+
+    def test_out_of_band_needs_consecutive_confirmation(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=2)
+        gate.observe(KEYS, np.asarray([1.0, 2.0], np.float32), np.asarray([100.0, 200.0], np.float32))
+
+        hot = np.asarray([2.0, 2.0], np.float32)
+        mem = np.asarray([100.0, 200.0], np.float32)
+        one = gate.observe(KEYS, hot, mem)
+        assert one.suppressed.tolist() == [True, False]
+        assert one.cpu.tolist() == [1.0, 2.0]  # still held
+
+        # A reset tick in between breaks the streak: confirmation must be
+        # CONSECUTIVE.
+        gate.observe(KEYS, np.asarray([1.0, 2.0], np.float32), mem)
+        gate.observe(KEYS, hot, mem)  # streak restarts at 1
+        held = gate.observe(KEYS, np.asarray([1.0, 2.0], np.float32), mem)
+        assert held.cpu.tolist() == [1.0, 2.0]
+
+        gate.observe(KEYS, hot, mem)
+        two = gate.observe(KEYS, hot, mem)  # second consecutive: gate opens
+        assert two.published.tolist() == [True, False]
+        assert two.changed.tolist() == [True, False]
+        assert two.cpu.tolist() == [2.0, 2.0]
+
+    def test_memory_drift_gates_too(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=1)
+        cpu = np.asarray([1.0], np.float32)
+        gate.observe(KEYS[:1], cpu, np.asarray([100.0], np.float32))
+        moved = gate.observe(KEYS[:1], cpu, np.asarray([150.0], np.float32))
+        assert moved.published.all()
+        assert moved.mem.tolist() == [150.0]
+
+    def test_disabled_gate_is_a_bit_exact_pass_through(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=2, enabled=False)
+        cpu = np.asarray([1.0, np.nan], np.float32)
+        mem = np.asarray([100.0, 200.0], np.float32)
+        out = gate.observe(KEYS, cpu, mem)
+        assert out.cpu is cpu and out.mem is mem  # the SAME arrays: bit-exact
+        assert out.published.all() and not out.changed.any()
+        moved = gate.observe(KEYS, np.asarray([3.0, np.nan], np.float32), mem)
+        assert moved.changed.tolist() == [True, False]  # NaN == NaN: no churn
+
+    def test_nan_raw_holds_the_last_good_value(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=1)
+        gate.observe(KEYS[:1], np.asarray([1.0], np.float32), np.asarray([100.0], np.float32))
+        dark = gate.observe(
+            KEYS[:1], np.asarray([np.nan], np.float32), np.asarray([np.nan], np.float32)
+        )
+        assert dark.cpu.tolist() == [1.0]  # an UNKNOWN tick doesn't erase
+        assert not dark.suppressed.any()
+
+    def test_all_nan_first_tick_does_not_delay_the_first_real_value(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=3)
+        empty = np.asarray([np.nan], np.float32)
+        gate.observe(KEYS[:1], empty, empty)
+        real = gate.observe(
+            KEYS[:1], np.asarray([1.0], np.float32), np.asarray([100.0], np.float32)
+        )
+        assert real.published.all()  # not held hostage by the confirm window
+        assert real.cpu.tolist() == [1.0]
+
+    def test_fleet_churn_resets_departed_and_admits_new(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=2)
+        gate.observe(KEYS, np.asarray([1.0, 2.0], np.float32), np.asarray([100.0, 200.0], np.float32))
+        new_keys = [KEYS[0], "c/default/fresh/main/Deployment"]
+        out = gate.observe(
+            new_keys, np.asarray([1.0, 9.0], np.float32), np.asarray([100.0, 50.0], np.float32)
+        )
+        assert out.published.tolist() == [False, True]  # kept state vs first publish
+        assert out.cpu.tolist() == [1.0, 9.0]
+
+    def test_seed_installs_already_seen_baselines(self):
+        gate = HysteresisGate(dead_band_pct=5.0, confirm_ticks=2)
+        gate.seed(KEYS, np.asarray([1.0, 2.0], np.float32), np.asarray([100.0, 200.0], np.float32))
+        out = gate.observe(
+            KEYS, np.asarray([1.01, 1.99], np.float32), np.asarray([100.0, 200.0], np.float32)
+        )
+        assert not out.published.any()  # gated against the seeded baselines
+        assert out.cpu.tolist() == [1.0, 2.0]
+
+
+# ------------------------------------------------------------------ drift
+class TestDrift:
+    def test_drift_vs_trailing_published_with_flaps_and_regime(self):
+        journal = RecommendationJournal(None)
+        key = KEYS[:1]
+        _tick(journal, 100.0, [1.0], published=[True], keys=key)
+        _tick(journal, 160.0, [1.5], published=[False], keys=key)   # +50% up
+        _tick(journal, 220.0, [0.5], published=[False], keys=key)   # -50% down: flap
+        _tick(journal, 280.0, [2.0], published=[False], keys=key)   # up: flap
+        _tick(journal, 340.0, [2.1], published=[False], keys=key)   # up again: streak 2
+
+        rows = fleet_drift(journal, dead_band_pct=10.0, confirm_ticks=2)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row.key == key[0]
+        assert row.ticks == 5
+        assert row.published_cpu == 1.0  # the only published record
+        assert row.raw_cpu == pytest.approx(2.1)
+        assert row.cpu_drift_pct == pytest.approx(110.0)
+        assert row.flaps == 2
+        assert row.out_of_band_streak == 2
+        assert row.regime_change is True
+
+    def test_in_band_fleet_reports_no_regime(self):
+        journal = RecommendationJournal(None)
+        _tick(journal, 100.0, [1.0, 2.0], published=[True, True])
+        _tick(journal, 160.0, [1.02, 1.98], published=[False, False])
+        rows = fleet_drift(journal, dead_band_pct=5.0, confirm_ticks=2)
+        assert len(rows) == 2
+        for row in rows:
+            assert row.out_of_band_streak == 0
+            assert row.regime_change is False
+            assert row.max_drift_pct == pytest.approx(abs(row.cpu_drift_pct))
+
+    def test_unpublished_prefix_after_compaction_is_not_a_crash(self):
+        """Retention can drop a workload's original published record; drift
+        over the orphaned unpublished tail reports None baselines."""
+        journal = RecommendationJournal(None, retention_seconds=100.0)
+        _tick(journal, 100.0, [1.0], published=[True], keys=KEYS[:1])
+        _tick(journal, 300.0, [1.5], published=[False], keys=KEYS[:1])
+        journal.compact(now=350.0)
+        rows = fleet_drift(journal, dead_band_pct=5.0, confirm_ticks=2)
+        assert rows[0].published_cpu is None
+        assert rows[0].cpu_drift_pct is None
+
+    def test_nan_resource_at_publish_keeps_the_prior_published_baseline(self):
+        """Mirrors the gate: a publish whose CPU was NaN kept the prior held
+        CPU, so the drift baseline forward-fills per resource."""
+        journal = RecommendationJournal(None)
+        key = KEYS[:1]
+        _tick(journal, 100.0, [1.0], [200.0], published=[True], keys=key)
+        _tick(journal, 160.0, [np.nan], [400.0], published=[True], keys=key)
+        _tick(journal, 220.0, [1.2], [400.0], published=[False], keys=key)
+        row = fleet_drift(journal, dead_band_pct=5.0, confirm_ticks=2)[0]
+        assert row.published_cpu == 1.0  # not None: the NaN publish didn't erase it
+        assert row.published_mem == 400.0
+        assert row.cpu_drift_pct == pytest.approx(20.0, rel=1e-3)
+
+    def test_empty_journal(self):
+        assert fleet_drift(RecommendationJournal(None), dead_band_pct=5.0, confirm_ticks=2) == []
+
+
+# ------------------------------------------------------------------- diff
+class TestDiff:
+    def test_parse_object_key_round_trips_identity(self):
+        obj = parse_object_key("c/prod/db/main/StatefulSet")
+        assert (obj.cluster, obj.namespace, obj.name, obj.container, obj.kind) == (
+            "c", "prod", "db", "main", "StatefulSet",
+        )
+        clusterless = parse_object_key("/default/web/main/")
+        assert clusterless.cluster is None and clusterless.kind is None
+        # EKS context names are ARNs containing '/': only the CLUSTER
+        # segment may hold slashes, so the split comes from the right.
+        arn = parse_object_key("arn:aws:eks:us-east-1:1:cluster/prod/team-a/web/main/Deployment")
+        assert arn.cluster == "arn:aws:eks:us-east-1:1:cluster/prod"
+        assert (arn.namespace, arn.name, arn.container, arn.kind) == (
+            "team-a", "web", "main", "Deployment",
+        )
+        # A hex-hash fallback (lost sidecar) surfaces as an unresolved name,
+        # not scattered across the identity fields.
+        unresolved = parse_object_key("00deadbeef015eed")
+        assert unresolved.name == "00deadbeef015eed"
+        assert unresolved.namespace == "" and unresolved.kind is None
+
+    def test_resolve_ticks_defaults_and_bounds(self):
+        journal = RecommendationJournal(None)
+        for ts in (100.0, 160.0, 220.0):
+            _tick(journal, ts, [0.2, 1.5])
+        assert resolve_ticks(journal) == (160.0, 220.0)
+        assert resolve_ticks(journal, at=200.0) == (100.0, 160.0)
+        assert resolve_ticks(journal, at=220.0, baseline=110.0) == (100.0, 220.0)
+        with pytest.raises(ValueError, match="no journal tick"):
+            resolve_ticks(journal, at=50.0)
+        # Swapped timestamps must error, not render an inverted diff.
+        with pytest.raises(ValueError, match="not older"):
+            resolve_ticks(journal, at=110.0, baseline=220.0)
+        single = RecommendationJournal(None)
+        _tick(single, 100.0, [0.2, 1.5])
+        with pytest.raises(ValueError, match="no tick before"):
+            resolve_ticks(single)
+
+    def test_diff_result_scores_the_movement(self):
+        journal = RecommendationJournal(None)
+        _tick(journal, 100.0, [1.0, 2.0], [100.0, 200.0], [True, True])
+        _tick(journal, 160.0, [2.5, 2.0], [100.0, 200.0], [False, False])
+        base_ts, at_ts = resolve_ticks(journal)
+        result = build_diff_result(
+            tick_values(journal, base_ts), tick_values(journal, at_ts)
+        )
+        by_name = {scan.object.name: scan for scan in result.scans}
+        # web's cpu moved 1.0 -> 2.5 (CRITICAL by severity rules); db held
+        # (OK, not GOOD: the None/None cpu-limit cell outranks GOOD in the
+        # severity precedence, exactly as on the publish path).
+        assert by_name["web"].severity.value == "CRITICAL"
+        assert by_name["db"].severity.value == "OK"
+        assert by_name["web"].object.allocations.requests[ResourceType.CPU] == Decimal("1")
+        assert by_name["web"].recommended.requests[ResourceType.CPU].value == Decimal("2.5")
+        # Renders through the machine formatter registry unchanged.
+        payload = json.loads(result.format("json"))
+        assert len(payload["scans"]) == 2
+
+    def test_memory_buffer_applies_like_the_publish_path(self):
+        """The journal stores PRE-buffer raw memory; the diff must re-apply
+        the strategy buffer or its memory values disagree with every served
+        recommendation by the buffer factor."""
+        result = build_diff_result(
+            {"c/default/web/main/Deployment": (1.0, 100.0)},
+            {"c/default/web/main/Deployment": (1.0, 100.0)},
+            memory_buffer_percentage=Decimal(15),
+        )
+        cell = result.scans[0].recommended.requests[ResourceType.Memory].value
+        assert cell == Decimal(115_000_000)  # 100 MB * 1.15, like finalize_fleet
+
+    def test_cli_diff_honors_namespace_filter_on_the_journal_side(self, tmp_path):
+        journal_path = str(tmp_path / "j")
+        journal = RecommendationJournal(journal_path)
+        _tick(journal, 100.0, [1.0, 2.0], published=[True, True])
+        _tick(journal, 160.0, [1.5, 2.5])
+        journal.close()
+
+        from click.testing import CliRunner
+
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(
+            app, ["diff", "--journal", journal_path, "-q", "-f", "json", "-n", "prod"]
+        )
+        assert result.exit_code == 0, result.output
+        scans = json.loads(result.output)["scans"]
+        assert [s["object"]["namespace"] for s in scans] == ["prod"]
+
+        # --live conflicts with --baseline: clean usage error, not silence.
+        result = CliRunner().invoke(
+            app, ["diff", "--journal", journal_path, "--live", "--baseline", "100"]
+        )
+        assert result.exit_code != 0
+        assert "--baseline" in result.output
+
+    def test_one_sided_workloads_render_as_appeared_or_vanished(self):
+        result = build_diff_result(
+            {"c/default/old/main/Deployment": (1.0, 100.0)},
+            {"c/default/new/main/Deployment": (2.0, 200.0)},
+        )
+        by_name = {scan.object.name: scan for scan in result.scans}
+        assert by_name["new"].object.allocations.requests[ResourceType.CPU] is None  # appeared
+        assert by_name["old"].recommended.requests[ResourceType.CPU].value is None  # vanished
+        assert by_name["new"].severity.value == "WARNING"

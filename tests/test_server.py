@@ -73,6 +73,11 @@ def serve_config(serve_env, **overrides) -> Config:
         strategy="tdigest",
         quiet=True,
         server_port=0,
+        # Most tests here prove publish/incrementality semantics that predate
+        # the hysteresis gate — running them with the gate OFF pins the
+        # --no-hysteresis acceptance criterion: the legacy publish behavior
+        # stays bit-exact. TestHysteresisPublishing turns the gate on.
+        hysteresis_enabled=False,
         other_args=other_args,
     )
     defaults.update(overrides)
@@ -450,10 +455,12 @@ class _GatedSource:
         }
 
 
-def _injected_server(source, now: list, objects=None) -> KrrServer:
+def _injected_server(source, now: list, objects=None, **config_overrides) -> KrrServer:
     config = Config(
         strategy="tdigest", quiet=True, server_port=0,
+        hysteresis_enabled=False,  # legacy publish semantics (see serve_config)
         other_args={"history_duration": 1, "timeframe_duration": 1},
+        **config_overrides,
     )
     session = ScanSession(
         config, inventory=_Inventory(objects or [_one_object()]),
@@ -600,6 +607,7 @@ class TestChurnCompaction:
             source.release.set()
             config = Config(
                 strategy="tdigest", quiet=True, server_port=0,
+                hysteresis_enabled=False,
                 discovery_interval_seconds=0.001,  # re-discover every tick
                 other_args={"history_duration": 1, "timeframe_duration": 1},
             )
@@ -720,9 +728,28 @@ class TestServeCLI:
         assert result.exit_code == 0, result.output
         assert "Server Settings:" in result.output
         for flag in ("--scan-interval", "--discovery-interval", "--host", "--port",
-                     "--digest_gamma", "--state_path"):
+                     "--digest_gamma", "--state_path", "--history-path",
+                     "--history-retention", "--dead-band-pct", "--confirm-ticks",
+                     "--no-hysteresis"):
             assert flag in result.output, flag
         assert "--formatter" not in result.output  # per-request format instead
+
+    def test_diff_help_lists_journal_and_live_flags(self):
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(app, ["diff", "--help"])
+        assert result.exit_code == 0, result.output
+        for flag in ("--journal", "--at", "--baseline", "--live", "--formatter"):
+            assert flag in result.output, flag
+
+    def test_diff_without_journal_is_a_clean_usage_error(self):
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(app, ["diff"])
+        assert result.exit_code != 0
+        assert "--journal" in result.output
 
     def test_serve_invalid_settings_clean_error(self):
         from krr_tpu.main import app, load_commands
@@ -834,6 +861,7 @@ class TestDiscoveryFailureGuards:
             now = [1_700_000_000.0]
             config = Config(
                 strategy="tdigest", quiet=True, server_port=0,
+                hysteresis_enabled=False,
                 discovery_interval_seconds=1.0,
                 other_args={"history_duration": 1, "timeframe_duration": 1},
             )
@@ -886,6 +914,7 @@ class TestDiscoveryFailureGuards:
             source = RecordingSource()
             config = Config(
                 strategy="tdigest", quiet=True, server_port=0,
+                hysteresis_enabled=False,
                 other_args={"history_duration": 1, "timeframe_duration": 1},
             )
             session = ScanSession(
@@ -922,6 +951,317 @@ class TestDiscoveryFailureGuards:
                 assert {s.object.name for s in ks.state.peek().result.scans} == {"web", "db"}
             finally:
                 await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class _NoisySource:
+    """Deterministic noisy-but-stationary injected history source: every
+    fetch returns fresh samples from a seeded rng inside a narrow band
+    (sub-dead-band percentile wiggle), scaled by ``scale`` (bump it for a
+    regime change)."""
+
+    def __init__(self, low: float = 0.19, high: float = 0.21):
+        self.low, self.high = low, high
+        self.scale = 1.0
+        self._rng = np.random.default_rng(42)
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+        return {
+            ResourceType.CPU: [
+                {obj.pods[0]: self.scale * self._rng.uniform(self.low, self.high, 12)}
+                for obj in objects
+            ],
+            ResourceType.Memory: [{obj.pods[0]: np.full(12, 1e8)} for obj in objects],
+        }
+
+
+class TestHysteresisPublishing:
+    def _server(self, source, now, objects, **overrides) -> KrrServer:
+        # Default knobs with the gate ON (5% dead band, 2 confirm ticks).
+        settings = dict(
+            strategy="tdigest", quiet=True, server_port=0,
+            hysteresis_enabled=True,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        settings.update(overrides)
+        config = Config(**settings)
+        session = ScanSession(
+            config, inventory=_Inventory(objects), history_factory=lambda cluster: source
+        )
+        return KrrServer(config, session=session, clock=lambda: now[0])
+
+    def test_stationary_noise_publishes_zero_changes_while_journal_records_every_tick(self):
+        """THE hysteresis acceptance test: a noisy-but-stationary fleet
+        publishes ZERO recommendation changes after warm-up (every tick's
+        snapshot is byte-identical), while the journal records every tick's
+        raw (wiggling) series."""
+
+        async def main():
+            objects = [_one_object("web"), _one_object("db", namespace="prod")]
+            now = [1_700_000_000.0]
+            ks = self._server(_NoisySource(), now, objects)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()  # warm-up: first publish
+                warmup = (await http_get(ks.port, "/recommendations")).content
+                ticks = 4
+                for _ in range(ticks):
+                    now[0] += 120.0
+                    assert await ks.scheduler.tick()
+                    body = (await http_get(ks.port, "/recommendations")).content
+                    assert body == warmup  # the published snapshot never moved
+                m = ks.state.metrics
+                assert m.value("krr_tpu_recommendation_churn_total") is None
+                # The journal kept the raw series: one record per object per
+                # tick, only the warm-up tick flagged published, and the raw
+                # cpu values DID wiggle underneath the stable publish.
+                journal = ks.state.journal
+                assert journal.record_count == len(objects) * (ticks + 1)
+                recs = journal.records()
+                from krr_tpu.history.journal import FLAG_PUBLISHED
+
+                published = recs[(recs["flags"] & FLAG_PUBLISHED) != 0]
+                assert len(published) == len(objects)
+                assert published["ts"].tolist() == [1_700_000_000.0] * len(objects)
+                web_cpu = recs[recs["ts"] > 1_700_000_000.0]["cpu"]
+                assert len(np.unique(web_cpu)) > 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_regime_change_passes_after_confirmation_while_first_tick_is_suppressed(self):
+        """A sustained regime change must flow through: the FIRST
+        out-of-band tick is suppressed (published snapshot holds), the
+        SECOND consecutive one opens the gate and the published value jumps
+        to the current raw recommendation."""
+
+        async def main():
+            objects = [_one_object("web")]
+            now = [1_700_000_000.0]
+            source = _NoisySource()
+            ks = self._server(source, now, objects)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                before = (await http_get(ks.port, "/recommendations")).json()
+
+                source.scale = 4.0  # the regime changes: 4x the usage
+                now[0] += 120.0
+                assert await ks.scheduler.tick()
+                m = ks.state.metrics
+                held = (await http_get(ks.port, "/recommendations")).json()
+                assert held == before  # one hot tick: suppressed, not published
+                assert m.value("krr_tpu_hysteresis_suppressed_total") == 1
+                assert ks.state.last_publish_suppressed == 1
+                r = await http_get(ks.port, "/healthz")
+                assert r.json()["last_publish_suppressed"] == 1
+
+                now[0] += 120.0
+                assert await ks.scheduler.tick()  # second consecutive hot tick
+                after = (await http_get(ks.port, "/recommendations")).json()
+                assert after != before
+                cpu_after = float(after["scans"][0]["recommended"]["requests"]["cpu"]["value"])
+                cpu_before = float(before["scans"][0]["recommended"]["requests"]["cpu"]["value"])
+                assert cpu_after > cpu_before
+                assert m.value("krr_tpu_recommendation_churn_total") == 1
+                assert ks.state.last_publish_suppressed == 0
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_disabled_gate_publishes_every_wiggle_and_flags_every_tick(self):
+        """--no-hysteresis: the published snapshot tracks the raw series
+        verbatim (churn counts the wiggles) and every journal record is
+        flagged published."""
+
+        async def main():
+            objects = [_one_object("web")]
+            now = [1_700_000_000.0]
+            source = _NoisySource()
+            ks = self._server(source, now, objects, hysteresis_enabled=False)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                before = (await http_get(ks.port, "/recommendations")).json()
+                source.scale = 4.0  # with the gate OFF this publishes at once
+                now[0] += 120.0
+                assert await ks.scheduler.tick()
+                after = (await http_get(ks.port, "/recommendations")).json()
+                assert after != before  # no suppression, no confirmation wait
+                from krr_tpu.history.journal import FLAG_PUBLISHED
+
+                recs = ks.state.journal.records()
+                assert len(recs) == 2
+                assert bool(np.all(recs["flags"] & FLAG_PUBLISHED))
+                assert ks.state.metrics.value("krr_tpu_recommendation_churn_total") == 1
+                assert ks.state.metrics.value("krr_tpu_hysteresis_suppressed_total") is None
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestHistoryEndpoints:
+    def test_history_drift_and_cli_diff_render_from_the_same_journal_file(self, serve_env, tmp_path):
+        """The acceptance wiring test: a serve run with a journal file, then
+        GET /history, GET /drift, /healthz's journal fields, and the
+        `krr-tpu diff` CLI all render from that ONE journal file."""
+        journal_path = str(tmp_path / "serve.journal")
+        T1, T2 = ORIGIN + 3600.0, ORIGIN + 5400.0
+
+        async def main():
+            now = [T1]
+            config = serve_config(serve_env, hysteresis_enabled=True, history_path=journal_path)
+            ks = KrrServer(config, clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                now[0] = T2
+                assert await ks.scheduler.tick()
+
+                r = await http_get(ks.port, "/history")
+                assert r.status_code == 200
+                payload = r.json()
+                assert payload["records"] == 4  # 2 workloads x 2 ticks
+                assert {w["workload"] for w in payload["workloads"]} == {"web", "db"}
+                web = next(w for w in payload["workloads"] if w["workload"] == "web")
+                assert [t["ts"] for t in web["ticks"]] == [T1, T2]
+                assert web["ticks"][0]["published"] is True
+                assert web["ticks"][0]["cpu"] > 0 and web["ticks"][0]["memory_mb"] > 0
+
+                # Filters + limit.
+                r = await http_get(ks.port, "/history", {"namespace": "prod", "limit": "1"})
+                filtered = r.json()["workloads"]
+                assert [w["workload"] for w in filtered] == ["db"]
+                assert len(filtered[0]["ticks"]) == 1
+
+                r = await http_get(ks.port, "/drift")
+                assert r.status_code == 200
+                drift = r.json()
+                assert drift["dead_band_pct"] == 5.0 and drift["confirm_ticks"] == 2
+                assert drift["summary"]["workloads"] == 2
+                for row in drift["workloads"]:
+                    assert row["published_cpu"] is not None
+                    assert row["ticks"] == 2
+
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["journal_records"] == 4
+                assert health["journal_age_seconds"] is not None
+                assert health["last_publish_suppressed"] is not None
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+        # The CLI diff renders the same journal file after the server exited.
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(
+            app, ["diff", "--journal", journal_path, "-q", "--formatter", "json"]
+        )
+        assert result.exit_code == 0, result.output
+        diff = json.loads(result.output)
+        assert len(diff["scans"]) == 2
+        assert {s["object"]["name"] for s in diff["scans"]} == {"web", "db"}
+        # Baseline == the T1 tick, rendered as "current allocations".
+        assert all(
+            s["object"]["allocations"]["requests"]["cpu"] is not None for s in diff["scans"]
+        )
+
+    def test_cli_diff_live_compares_journal_against_a_fresh_scan(self, serve_env, tmp_path):
+        """`krr-tpu diff --live`: the newest journal tick vs a one-shot scan
+        through the same digest fold + store query the server publishes from
+        — over identical windows the delta is all-GOOD/OK, never UNKNOWN."""
+        journal_path = str(tmp_path / "serve.journal")
+        T1 = ORIGIN + 3600.0
+
+        async def main():
+            config = serve_config(serve_env, history_path=journal_path)
+            ks = KrrServer(config, clock=lambda: T1)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+        from krr_tpu.main import app, load_commands
+
+        load_commands()
+        result = CliRunner().invoke(
+            app,
+            ["diff", "--journal", journal_path, "--live", "-q", "--formatter", "json",
+             "--kubeconfig", serve_env["kubeconfig"],
+             "--prometheus-url", serve_env["server"].url,
+             # Pin the live scan to the journal tick's window: identical
+             # samples, so the diff shows no movement.
+             "--scan-end-timestamp", str(T1),
+             "--history_duration", "1", "--timeframe_duration", "1"],
+        )
+        assert result.exit_code == 0, result.output
+        diff = json.loads(result.output)
+        assert {s["object"]["name"] for s in diff["scans"]} == {"web", "db"}
+        assert all(s["severity"] in ("GOOD", "OK") for s in diff["scans"]), diff
+
+    def test_journal_resume_seeds_the_gate_and_survives_restart(self, serve_env, tmp_path):
+        """A restarted server re-seeds hysteresis baselines from the journal
+        riding <state_path>.journal by default: the first post-restart tick
+        of a stationary fleet is gated (no spurious re-publish churn), and
+        the journal keeps accumulating in the same file."""
+        state_path = str(tmp_path / "serve-state.npz")
+        T1, T2 = ORIGIN + 3600.0, ORIGIN + 5400.0
+
+        async def main():
+            config = serve_config(
+                serve_env, hysteresis_enabled=True,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: T1)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                assert ks.state.journal.path == state_path + ".journal"
+                assert ks.state.journal.record_count == 2
+            finally:
+                await ks.shutdown()
+
+            # A restart INSIDE one step window hits the resume re-publish
+            # with the gate ON: seed-covered workloads publish nothing new,
+            # so the journal must NOT gain duplicate records for the
+            # already-journaled tick.
+            quick = KrrServer(config, clock=lambda: T1 + 30.0)
+            await quick.start(run_scheduler=False)
+            try:
+                assert not await quick.scheduler.tick()
+                assert quick.state.peek() is not None  # resident data served
+                assert quick.state.journal.record_count == 2  # no re-append
+            finally:
+                await quick.shutdown()
+
+            resumed = KrrServer(config, clock=lambda: T2)
+            await resumed.start(run_scheduler=False)
+            try:
+                assert resumed.state.journal.record_count == 2  # resumed from disk
+                assert resumed.scheduler.gate._seen.any()  # baselines seeded
+                assert await resumed.scheduler.tick()
+                recs = resumed.state.journal.records()
+                assert resumed.state.journal.record_count == 4
+                # The delta tick over the stationary fake stays in-band
+                # against the PRE-restart baseline: nothing re-published.
+                from krr_tpu.history.journal import FLAG_PUBLISHED
+
+                second = recs[recs["ts"] > T1]
+                assert len(second) == 2
+                assert not np.any(second["flags"] & FLAG_PUBLISHED)
+                assert resumed.state.metrics.value("krr_tpu_recommendation_churn_total") is None
+            finally:
+                await resumed.shutdown()
 
         asyncio.run(main())
 
