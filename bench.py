@@ -59,7 +59,11 @@ BENCH_SKIP_E2E, BENCH_PARITY_ROWS (default 512), BENCH_SKIP_JOURNAL,
 BENCH_JOURNAL_ROWS (default 2000), BENCH_JOURNAL_TICKS (default 32 — the
 history-journal leg: fsync'd append + compaction throughput and a
 journal-diff render through the formatter registry, carried under
-``secondary.journal_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
+``secondary.journal_*``), BENCH_SKIP_OBS, BENCH_OBS_ROWS (default 256),
+BENCH_OBS_SAMPLES (default 4096), BENCH_OBS_RUNS (default 5 — the
+tracing-overhead leg: one identical in-process digest scan with the no-op
+vs a recording tracer, gated at <2% wall overhead and bit-exact results,
+carried under ``secondary.obs_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
 BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
 
 ``--smoke``: the same harness at toy scale (tiny fleet, 1 run, e2e legs
@@ -124,6 +128,11 @@ SMOKE_DEFAULTS = {
     # diff render through the formatter registry, all EXECUTED at toy scale.
     "BENCH_JOURNAL_ROWS": "32",
     "BENCH_JOURNAL_TICKS": "4",
+    # Tracing-overhead leg (host-only): the traced-vs-no-op scan pair still
+    # EXECUTES at toy scale (the <2% gate leans on its 10 ms noise floor).
+    "BENCH_OBS_ROWS": "48",
+    "BENCH_OBS_SAMPLES": "1024",
+    "BENCH_OBS_RUNS": "3",
 }
 
 
@@ -184,6 +193,114 @@ def journal_leg(secondary: dict) -> None:
         f"({total / append_seconds:.0f} rec/s), compaction of {before} recs "
         f"{compact_seconds * 1e3:.1f} ms, diff render {rows} objects {diff_seconds:.3f}s",
         file=sys.stderr,
+    )
+
+
+def obs_leg(secondary: dict, check) -> None:
+    """Tracing-overhead leg: the SAME in-process digest scan (fake inventory
+    + deterministic history source, streamed pipeline, tdigest
+    digest-ingest) run with the no-op tracer and with a recording tracer +
+    metrics registry. Two gates ride on it: the traced wall must stay
+    within 2% of the plain wall (with a 10 ms absolute floor — at smoke
+    scale 2% of a ~50 ms scan is below timer noise, while 10 ms of genuine
+    span overhead would mean a real hot-path regression), and the
+    recommendations must be BIT-exact — observability must never perturb
+    results. Reported under ``secondary.obs_*``."""
+    import asyncio
+    import contextlib
+    import io
+
+    import numpy as np
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.core.runner import Runner
+    from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+    from krr_tpu.models.objects import K8sObjectData
+    from krr_tpu.obs.metrics import MetricsRegistry
+    from krr_tpu.obs.trace import NULL_TRACER, Tracer
+
+    rows = int(os.environ.get("BENCH_OBS_ROWS", 256))
+    samples = int(os.environ.get("BENCH_OBS_SAMPLES", 4096))
+    runs = max(2, int(os.environ.get("BENCH_OBS_RUNS", 5)))
+
+    rng = np.random.default_rng(23)
+    alloc = ResourceAllocations(
+        requests={ResourceType.CPU: None, ResourceType.Memory: None},
+        limits={ResourceType.CPU: None, ResourceType.Memory: None},
+    )
+    objects = [
+        K8sObjectData(
+            cluster=None, namespace=f"ns{i % 8}", name=f"w{i}", kind="Deployment",
+            container="main", pods=[f"w{i}-0"], allocations=alloc,
+        )
+        for i in range(rows)
+    ]
+    # Series precomputed ONCE and shared by every run: both tracer modes
+    # scan identical data, and the timed region holds no rng work.
+    series = {
+        ResourceType.CPU: [{f"w{i}-0": rng.gamma(2.0, 0.05, samples)} for i in range(rows)],
+        ResourceType.Memory: [{f"w{i}-0": rng.uniform(5e7, 4e8, samples)} for i in range(rows)],
+    }
+    by_key = {(obj.namespace, obj.name): i for i, obj in enumerate(objects)}
+
+    class Inventory:
+        async def list_clusters(self):
+            return None
+
+        async def list_scannable_objects(self, clusters):
+            return objects
+
+    class Source:
+        async def gather_fleet(self, objs, history_seconds, step_seconds, **kw):
+            indices = [by_key[(obj.namespace, obj.name)] for obj in objs]
+            return {r: [series[r][i] for i in indices] for r in ResourceType}
+
+    def scan(tracer):
+        config = Config(quiet=True, format="json", strategy="tdigest",
+                        other_args={"digest_ingest": True})
+        r = Runner(
+            config, inventory=Inventory(), history_factory=lambda cluster: Source(),
+            tracer=tracer, metrics=MetricsRegistry(),
+        )
+        with contextlib.redirect_stdout(io.StringIO()):
+            return asyncio.run(r.run())
+
+    scan(NULL_TRACER)  # warmup: jit compile + import costs out of the timing
+    tracer = None
+    plain_times, traced_times = [], []
+    plain_result = traced_result = None
+    for _ in range(runs):  # interleaved so machine-load drift hits both modes
+        start = time.perf_counter()
+        plain_result = scan(NULL_TRACER)
+        plain_times.append(time.perf_counter() - start)
+        tracer = Tracer(ring_scans=4)
+        start = time.perf_counter()
+        traced_result = scan(tracer)
+        traced_times.append(time.perf_counter() - start)
+
+    plain_best, traced_best = min(plain_times), min(traced_times)
+    overhead = traced_best - plain_best
+    overhead_pct = 100.0 * overhead / plain_best
+    span_count = len(tracer.traces()[-1])
+    secondary["obs_plain_scan_seconds"] = round(plain_best, 4)
+    secondary["obs_traced_scan_seconds"] = round(traced_best, 4)
+    secondary["obs_trace_overhead_pct"] = round(max(0.0, overhead_pct), 2)
+    secondary["obs_spans_per_scan"] = span_count
+    print(
+        f"bench: obs overhead plain {plain_best:.4f}s vs traced {traced_best:.4f}s "
+        f"({max(0.0, overhead_pct):.2f}% over {runs} interleaved runs, "
+        f"{span_count} spans/scan)",
+        file=sys.stderr,
+    )
+    check(
+        "obs_overhead<2%",
+        overhead <= max(0.02 * plain_best, 0.010),
+        f"traced {traced_best:.4f}s vs plain {plain_best:.4f}s (+{overhead_pct:.2f}%)",
+    )
+    check(
+        "obs_bitexact",
+        plain_result.model_dump_json() == traced_result.model_dump_json(),
+        "tracing changed the recommendations",
     )
 
 
@@ -435,6 +552,12 @@ def main() -> None:
 
     if not os.environ.get("BENCH_SKIP_JOURNAL"):
         journal_leg(secondary)
+
+    if not os.environ.get("BENCH_SKIP_OBS"):
+        # Tracing-overhead gate (`krr_tpu.obs`): a parity-style failure here
+        # (>2% traced overhead, or traced results not bit-exact) exits
+        # nonzero like any other parity break.
+        obs_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

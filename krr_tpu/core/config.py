@@ -66,6 +66,30 @@ class Config(pd.BaseModel):
     format: str = "table"
     strategy: str = "simple"
     log_to_stderr: bool = False
+    #: "console" = rich prefixed lines (the reference UX); "json" = one
+    #: structured object per line carrying scan_id/span_id from the active
+    #: trace span, so log lines join back to --trace / /debug/trace output.
+    log_format: Literal["console", "json"] = "console"
+
+    # Observability (`krr_tpu.obs`)
+    #: Write a Chrome trace-event JSON (chrome://tracing / Perfetto) of the
+    #: scan's spans to this file at exit. None = tracing stays the no-op
+    #: tracer on the CLI hot path (serve always records into its ring for
+    #: GET /debug/trace).
+    trace_path: Optional[str] = None
+    #: Completed scan traces the in-memory ring retains (serve's
+    #: GET /debug/trace window; also the CLI export buffer).
+    trace_ring_scans: int = pd.Field(16, ge=1)
+    #: Write a Prometheus text-exposition snapshot of the scan's metrics
+    #: registry to this file at exit (the CLI twin of serve's GET /metrics).
+    metrics_dump_path: Optional[str] = None
+    #: Exit nonzero when any object's fetch failed terminally (rows rendered
+    #: UNKNOWN) — CI/cron scans must not mistake a half-fetched fleet for a
+    #: clean run.
+    strict: bool = False
+    #: Log a warning for any Prometheus range query slower than this many
+    #: seconds (retries included); 0 disables the slow-query log.
+    prometheus_slow_query_seconds: float = pd.Field(10.0, ge=0)
 
     # Kubernetes discovery
     #: One pods request per namespace with client-side selector matching
@@ -176,4 +200,20 @@ class Config(pd.BaseModel):
         return strategy_type(settings_type(**self.other_args))
 
     def create_logger(self) -> KrrLogger:
-        return KrrLogger(quiet=self.quiet, verbose=self.verbose, log_to_stderr=self.log_to_stderr)
+        return KrrLogger(
+            quiet=self.quiet,
+            verbose=self.verbose,
+            log_to_stderr=self.log_to_stderr,
+            log_format=self.log_format,
+        )
+
+    def create_tracer(self):
+        """A recording tracer when ``--trace`` asked for one, else the no-op
+        tracer — the disabled path must stay free (`krr_tpu.obs.trace`).
+        Serve swaps in a recording tracer unconditionally (its ring backs
+        ``GET /debug/trace``)."""
+        from krr_tpu.obs.trace import NULL_TRACER, Tracer
+
+        if self.trace_path:
+            return Tracer(ring_scans=self.trace_ring_scans)
+        return NULL_TRACER

@@ -44,6 +44,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from krr_tpu.obs.trace import NULL_TRACER, NullTracer
+
 #: Default bounded-queue depth (`Config.pipeline_depth` overrides; 0 there
 #: disables streaming entirely and callers take the staged path).
 DEFAULT_PIPELINE_DEPTH = 4
@@ -101,8 +103,19 @@ class ScanPipeline:
     caller's to discard).
     """
 
-    def __init__(self, fold: Callable[[Any], None], *, depth: int = DEFAULT_PIPELINE_DEPTH):
+    def __init__(
+        self,
+        fold: Callable[[Any], None],
+        *,
+        depth: int = DEFAULT_PIPELINE_DEPTH,
+        tracer: NullTracer = NULL_TRACER,
+    ):
         self._fold = fold
+        #: Each fold call gets a ``fold`` span (no-op by default). The
+        #: consumer task is created in ``__aenter__`` and copies the
+        #: caller's context, so fold spans parent to whatever span was
+        #: active when the pipeline opened — the scan root.
+        self._tracer = tracer
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, depth))
         self._consumer: Optional[asyncio.Task] = None
         self._error: Optional[BaseException] = None
@@ -136,7 +149,8 @@ class ScanPipeline:
                 continue  # drain mode: unblock producers, discard batches
             fold_start = time.perf_counter()
             try:
-                await asyncio.to_thread(self._fold, batch)
+                with self._tracer.span("fold", queued=self._queue.qsize()):
+                    await asyncio.to_thread(self._fold, batch)
             except asyncio.CancelledError:
                 # The abort path (__aexit__ on a body exception) cancels this
                 # task; swallowing the cancellation into _error would loop

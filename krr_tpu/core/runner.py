@@ -34,6 +34,8 @@ from krr_tpu.models.allocations import ResourceAllocations, ResourceType
 from krr_tpu.models.objects import K8sObjectData
 from krr_tpu.models.result import ResourceScan, Result
 from krr_tpu.models.series import FleetBatch, RaggedHistory
+from krr_tpu.obs.metrics import MetricsRegistry
+from krr_tpu.obs.trace import NullTracer
 from krr_tpu.strategies.base import RunResult
 from krr_tpu.utils.logging import KrrLogger
 from krr_tpu.utils.logo import ASCII_LOGO
@@ -151,9 +153,18 @@ class ScanSession:
         inventory: Optional[InventorySource] = None,
         history_factory: Optional[Callable[[Optional[str]], HistorySource]] = None,
         logger: Optional[KrrLogger] = None,
+        tracer: Optional[NullTracer] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.config = config
         self.logger = logger or config.create_logger()
+        #: Observability core (`krr_tpu.obs`): the tracer defaults to the
+        #: no-op unless --trace asked for recording (serve swaps in a real
+        #: one before any scan); the metrics registry is ALWAYS real — it's
+        #: just labeled dicts — and shared with the Prometheus loaders, so
+        #: per-query telemetry lands in one place for CLI, serve, and bench.
+        self.tracer: NullTracer = tracer if tracer is not None else config.create_tracer()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Before any strategy can trace/compile: point XLA's persistent
         # compilation cache at the configured directory so fresh processes
         # skip the cold-start compile (utils/compile_cache.py).
@@ -182,7 +193,11 @@ class ScanSession:
                     from krr_tpu.integrations.prometheus import PrometheusLoader
 
                     self._history_sources[cluster] = PrometheusLoader(
-                        self.config, cluster=cluster, logger=self.logger
+                        self.config,
+                        cluster=cluster,
+                        logger=self.logger,
+                        tracer=self.tracer,
+                        metrics=self.metrics,
                     )
             except Exception as e:  # cache the failure: fail fast per cluster
                 self._history_sources[cluster] = e
@@ -204,10 +219,13 @@ class ScanSession:
 
     async def discover(self) -> list[K8sObjectData]:
         """List clusters + scannable objects (one inventory round)."""
-        inventory = self.get_inventory()
-        clusters = await inventory.list_clusters()
-        self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
-        return await inventory.list_scannable_objects(clusters)
+        with self.tracer.span("discover") as span:
+            inventory = self.get_inventory()
+            clusters = await inventory.list_clusters()
+            self.logger.debug(f"Using clusters: {clusters if clusters is not None else 'inner cluster'}")
+            objects = await inventory.list_scannable_objects(clusters)
+            span.set(objects=len(objects))
+            return objects
 
     # ------------------------------------------------------------- fetching
     async def gather_fleet_history(
@@ -228,47 +246,59 @@ class ScanSession:
             by_cluster.setdefault(obj.cluster, []).append(i)
 
         histories = _empty_histories(objects)
+        failed: set[int] = set()
 
-        def source_kwargs(source) -> dict:
-            """end_time plus, for sources that support it, the strategy's
-            stats-only resources (fetched as per-pod (count, max) and
-            represented as one synthetic max-sample per pod — identical
-            results for max-only consumers; true sample counts are NOT
-            preserved; see ``BaseStrategy.stats_only_resources``). Sources
-            without the parameter (simple fakes, third-party backends) are
-            handed the plain call and keep returning full series."""
+        def source_kwargs(source, cluster_failed: "set[int]") -> dict:
+            """end_time plus, for sources that support them (signature-probed
+            so simple fakes and third-party backends keep working with the
+            plain call), the strategy's stats-only resources (fetched as
+            per-pod (count, max) and represented as one synthetic max-sample
+            per pod — identical results for max-only consumers; true sample
+            counts are NOT preserved; see
+            ``BaseStrategy.stats_only_resources``) and the per-row
+            failed-fetch out-channel (``cluster_failed`` — subset-local
+            indices of terminally failed queries, feeding the fetch-health
+            summary and --strict)."""
             kwargs = self._end_time_kwargs(end_time)
-            if stats_resources:
-                import inspect
+            import inspect
 
-                try:
-                    parameters = inspect.signature(source.gather_fleet).parameters
-                except (TypeError, ValueError):
-                    parameters = {}
-                if "stats_resources" in parameters:
-                    kwargs["stats_resources"] = stats_resources
+            try:
+                parameters = inspect.signature(source.gather_fleet).parameters
+            except (TypeError, ValueError):
+                parameters = {}
+            if stats_resources and "stats_resources" in parameters:
+                kwargs["stats_resources"] = stats_resources
+            if "failed_rows" in parameters:
+                kwargs["failed_rows"] = cluster_failed
             return kwargs
 
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
-            try:
-                source = self.get_history_source(cluster)
-                fetched = await source.gather_fleet(
-                    subset, history_seconds, step_seconds, **source_kwargs(source)
-                )
-            except Exception as e:
-                self.logger.warning(
-                    f"Failed to gather history for cluster {cluster or 'default'}: {e} — "
-                    f"marking {len(subset)} objects as unknown"
-                )
-                self.logger.debug_exception()
-                return
-            for resource in ResourceType:
-                for local_i, global_i in enumerate(indices):
-                    histories[resource][global_i] = fetched[resource][local_i]
+            cluster_failed: set[int] = set()
+            with self.tracer.span("fetch", cluster=cluster or "default", rows=len(subset)):
+                try:
+                    source = self.get_history_source(cluster)
+                    fetched = await source.gather_fleet(
+                        subset, history_seconds, step_seconds,
+                        **source_kwargs(source, cluster_failed),
+                    )
+                    failed.update(indices[local_i] for local_i in cluster_failed)
+                except Exception as e:
+                    failed.update(indices)
+                    self.logger.warning(
+                        f"Failed to gather history for cluster {cluster or 'default'}: {e} — "
+                        f"marking {len(subset)} objects as unknown"
+                    )
+                    self.logger.debug_exception()
+                    return
+                for resource in ResourceType:
+                    for local_i, global_i in enumerate(indices):
+                        histories[resource][global_i] = fetched[resource][local_i]
 
         await asyncio.gather(*[fetch_cluster(c, idx) for c, idx in by_cluster.items()])
-        return FleetBatch.build(objects, histories)
+        batch = FleetBatch.build(objects, histories)
+        batch.failed_rows.update(failed)
+        return batch
 
     async def gather_fleet_digests(
         self,
@@ -314,19 +344,24 @@ class ScanSession:
         async def fetch_cluster(cluster: Optional[str], indices: list[int]) -> None:
             subset = [objects[i] for i in indices]
             try:
-                source = self.get_history_source(cluster)
-                if hasattr(source, "gather_fleet_digests"):
-                    sub_fleet = await source.gather_fleet_digests(
-                        subset, history_seconds, step_seconds,
-                        spec.gamma, spec.min_value, spec.num_buckets,
-                        **self._end_time_kwargs(end_time),
-                    )
-                    fleet.merge_from(sub_fleet, indices)
-                else:
-                    fetched = await source.gather_fleet(
-                        subset, history_seconds, step_seconds, **self._end_time_kwargs(end_time)
-                    )
-                    fold_histories(fleet, indices, fetched, spec)
+                with self.tracer.span("fetch", cluster=cluster or "default", rows=len(subset)):
+                    source = self.get_history_source(cluster)
+                    if hasattr(source, "gather_fleet_digests"):
+                        sub_fleet = await source.gather_fleet_digests(
+                            subset, history_seconds, step_seconds,
+                            spec.gamma, spec.min_value, spec.num_buckets,
+                            **self._end_time_kwargs(end_time),
+                        )
+                    else:
+                        sub_fleet = None
+                        fetched = await source.gather_fleet(
+                            subset, history_seconds, step_seconds, **self._end_time_kwargs(end_time)
+                        )
+                with self.tracer.span("fold", rows=len(subset)):
+                    if sub_fleet is not None:
+                        fleet.merge_from(sub_fleet, indices)
+                    else:
+                        fold_histories(fleet, indices, fetched, spec)
             except Exception as e:
                 if raise_on_failure:
                     raise
@@ -507,30 +542,36 @@ class ScanSession:
             # the unbounded host state the depth cap exists to prevent.
             async with fetch_semaphore:
                 cluster = subset[0].cluster
-                try:
-                    source = self.get_history_source(cluster)
-                    if hasattr(source, "gather_fleet_digests"):
-                        payload = await source.gather_fleet_digests(
-                            subset, history_seconds, step_seconds,
-                            spec.gamma, spec.min_value, spec.num_buckets,
-                            **self._end_time_kwargs(end_time),
+                with self.tracer.span(
+                    "fetch",
+                    namespace=",".join(sorted({obj.namespace for obj in subset})),
+                    cluster=cluster or "default",
+                    rows=len(subset),
+                ):
+                    try:
+                        source = self.get_history_source(cluster)
+                        if hasattr(source, "gather_fleet_digests"):
+                            payload = await source.gather_fleet_digests(
+                                subset, history_seconds, step_seconds,
+                                spec.gamma, spec.min_value, spec.num_buckets,
+                                **self._end_time_kwargs(end_time),
+                            )
+                        else:
+                            payload = await source.gather_fleet(
+                                subset, history_seconds, step_seconds, **self._end_time_kwargs(end_time)
+                            )
+                    except Exception as e:
+                        if raise_on_failure:
+                            raise
+                        self.logger.warning(
+                            f"Failed to gather digests for cluster {cluster or 'default'}: {e} — "
+                            f"marking {len(subset)} objects as unknown"
                         )
-                    else:
-                        payload = await source.gather_fleet(
-                            subset, history_seconds, step_seconds, **self._end_time_kwargs(end_time)
-                        )
-                except Exception as e:
-                    if raise_on_failure:
-                        raise
-                    self.logger.warning(
-                        f"Failed to gather digests for cluster {cluster or 'default'}: {e} — "
-                        f"marking {len(subset)} objects as unknown"
-                    )
-                    self.logger.debug_exception()
-                    payload = None
+                        self.logger.debug_exception()
+                        payload = None
                 await pipeline.put((key, subset, payload))
 
-        async with ScanPipeline(fold, depth=depth) as pipeline:
+        async with ScanPipeline(fold, depth=depth, tracer=self.tracer) as pipeline:
             if staged_inventory:
                 results = await asyncio.gather(
                     *[
@@ -545,6 +586,10 @@ class ScanSession:
                 )
             else:
                 discover_started = time.perf_counter()
+                # start/finish (not a ``with`` block): activating the span
+                # here would make every fetch task launched in the loop body
+                # a CHILD of discover instead of a sibling under the scan.
+                discover_span = self.tracer.start_span("discover")
                 fetch_tasks: list[asyncio.Task] = []
                 try:
                     async for ordinal, positions, subset in self.discover_stream():
@@ -555,6 +600,8 @@ class ScanSession:
                         )
                     pipeline.stats.discover_seconds = time.perf_counter() - discover_started
                 finally:
+                    discover_span.set(batches=len(fetch_tasks))
+                    self.tracer.finish_span(discover_span)
                     # Settle every launched fetch even when discovery raises —
                     # orphaned downloads would outlive the scan.
                     results = await asyncio.gather(*fetch_tasks, return_exceptions=True)
@@ -620,13 +667,28 @@ class Runner:
         inventory: Optional[InventorySource] = None,
         history_factory: Optional[Callable[[Optional[str]], HistorySource]] = None,
         logger: Optional[KrrLogger] = None,
+        tracer: Optional[NullTracer] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.config = config
         self.session = ScanSession(
-            config, inventory=inventory, history_factory=history_factory, logger=logger
+            config,
+            inventory=inventory,
+            history_factory=history_factory,
+            logger=logger,
+            tracer=tracer,
+            metrics=metrics,
         )
         self.logger = self.session.logger
         self.stats: dict[str, float] = {}
+
+    @property
+    def tracer(self) -> NullTracer:
+        return self.session.tracer
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self.session.metrics
 
     @property
     def _strategy(self):
@@ -665,31 +727,42 @@ class Runner:
                 gc.enable()
 
     async def _collect_result_inner(self) -> Result:
+        with self.session.tracer.span("scan", kind="cli") as scan_span:
+            return await self._collect_result_traced(scan_span)
+
+    async def _collect_result_traced(self, scan_span) -> Result:
+        tracer = self.session.tracer
         t0, c0 = time.perf_counter(), time.process_time()
         digest_ingest = bool(getattr(self._strategy.settings, "digest_ingest", False)) and hasattr(
             self._strategy, "run_digested"
         )
         pipeline_stats = None
+        failed_rows = 0
         if digest_ingest and self.config.pipeline_depth > 0:
             # Streamed scan pipeline: discovery, fetch, and fold overlap
             # (`ScanSession.stream_fleet_digests`). Discovery has no distinct
             # wall phase anymore; its span is reported from inside the
             # pipeline and its CPU rides the fetch leg.
             objects, fleet, pipeline_stats = await self.session.stream_fleet_digests()
+            failed_rows = len(fleet.failed_rows)
             t1, c1 = t0 + pipeline_stats.discover_seconds, c0
             self.logger.info(f"Found {len(objects)} scannable objects")
             t2, c2 = time.perf_counter(), time.process_time()
-            raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
+            with tracer.span("compute", rows=len(objects)):
+                raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
         else:
             objects = await self.session.discover()
             t1, c1 = time.perf_counter(), time.process_time()
             self.logger.info(f"Found {len(objects)} scannable objects")
             if digest_ingest:  # staged digest path (pipeline_depth=0)
                 fleet = await self.session.gather_fleet_digests(objects)
+                failed_rows = len(fleet.failed_rows)
                 t2, c2 = time.perf_counter(), time.process_time()
-                raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
+                with tracer.span("compute", rows=len(objects)):
+                    raw_results = await asyncio.to_thread(self._strategy.run_digested, fleet)
             else:
                 batch = await self.session.gather_fleet_history(objects)
+                failed_rows = len(batch.failed_rows)
                 t2, c2 = time.perf_counter(), time.process_time()
                 # The batched strategy call is CPU/TPU bound; keep the loop
                 # responsive. Row-chunked so the packed copy never exceeds
@@ -697,9 +770,10 @@ class Runner:
                 # chunking; row-local strategies make chunked == unbatched).
                 from krr_tpu.strategies.base import run_batch_row_chunks
 
-                raw_results = await asyncio.to_thread(
-                    run_batch_row_chunks, self._strategy, batch, self.config.max_fleet_rows_per_device
-                )
+                with tracer.span("compute", rows=len(objects)):
+                    raw_results = await asyncio.to_thread(
+                        run_batch_row_chunks, self._strategy, batch, self.config.max_fleet_rows_per_device
+                    )
         t3, c3 = time.perf_counter(), time.process_time()
 
         scans = [
@@ -718,6 +792,13 @@ class Runner:
             "compute_cpu_seconds": c3 - c2,
             "objects": float(len(objects)),
             "objects_per_second": len(objects) / (t3 - t2) if t3 > t2 and objects else 0.0,
+            # The fetch-health legs the CLI summary (and --strict) surfaces:
+            # rows whose fetch failed terminally, and how many Prometheus
+            # retry attempts the scan burned getting what it got.
+            "failed_rows": float(failed_rows),
+            "fetch_retries": float(
+                self.session.metrics.value("krr_tpu_prom_query_retries_total") or 0.0
+            ),
         }
         if pipeline_stats is not None:
             self.stats.update(
@@ -730,11 +811,24 @@ class Runner:
                 }
             )
         end_to_end = (len(objects) / (t3 - t0)) if t3 > t0 and objects else 0.0
+        retries = int(self.stats["fetch_retries"])
         self.logger.info(
             f"Scanned {len(objects)} objects: discover {self.stats['discover_seconds']:.2f}s, "
             f"fetch {self.stats['fetch_seconds']:.2f}s, compute {self.stats['compute_seconds']:.2f}s "
             f"({end_to_end:.1f} objects/s end-to-end)"
         )
+        if failed_rows or retries:
+            # Fetch health is part of the one-shot summary too (it used to
+            # be serve-only telemetry): a half-fetched fleet renders UNKNOWN
+            # rows, and --strict turns this line into a nonzero exit.
+            self.logger.warning(
+                f"Fetch health: {failed_rows} of {len(objects)} object fetches failed "
+                f"(rendered UNKNOWN), {retries} Prometheus retr{'y' if retries == 1 else 'ies'}"
+            )
+        scan_span.set(
+            objects=len(objects), failed_rows=failed_rows, fetch_retries=retries
+        )
+        self.metrics.set("krr_tpu_scan_failed_rows", failed_rows)
         return Result(scans=scans)
 
     def _process_result(self, result: Result) -> None:

@@ -38,6 +38,7 @@ import random
 import re
 import ssl
 import threading
+import time
 import urllib.parse
 import urllib.request
 from typing import Any, Optional
@@ -52,6 +53,8 @@ from krr_tpu.integrations.service_discovery import PROMETHEUS_SELECTORS, Service
 from krr_tpu.models.allocations import ResourceType
 from krr_tpu.models.objects import K8sObjectData
 from krr_tpu.models.series import RaggedHistory
+from krr_tpu.obs.metrics import MetricsRegistry
+from krr_tpu.obs.trace import NULL_TRACER, NullTracer
 from krr_tpu.utils.logging import KrrLogger, NULL_LOGGER
 
 
@@ -251,6 +254,13 @@ def step_string(step_seconds: float) -> str:
     return f"{eff // 60}m" if eff >= 60 else f"{eff}s"
 
 
+def step_string_seconds(step: str) -> float:
+    """Inverse of :func:`step_string` — seconds of an "Nm"/"Ns" duration
+    (the per-query telemetry computes grid points from the step string the
+    fetch paths already carry)."""
+    return float(step[:-1]) * (60.0 if step.endswith("m") else 1.0)
+
+
 #: Prometheus rejects range queries that would return more than this many
 #: points per series ("exceeded maximum resolution of 11,000 points").
 MAX_RANGE_POINTS = 11_000
@@ -323,13 +333,46 @@ def subwindows(
     return windows
 
 
+class _QueryMeter:
+    """Per-query instrumentation accumulator: attempts made and response
+    bytes seen, across retries. One query runs one attempt at a time, so
+    plain int adds suffice (worker-thread attempts hand the meter back
+    before the next attempt starts)."""
+
+    __slots__ = ("attempts", "bytes")
+
+    def __init__(self) -> None:
+        self.attempts = 0
+        self.bytes = 0
+
+    def add_bytes(self, n: int) -> None:
+        self.bytes += n
+
+
 class PrometheusLoader:
     """Per-cluster bulk history source (the Runner's ``HistorySource``)."""
 
-    def __init__(self, config: Config, *, cluster: Optional[str] = None, logger: KrrLogger = NULL_LOGGER):
+    def __init__(
+        self,
+        config: Config,
+        *,
+        cluster: Optional[str] = None,
+        logger: KrrLogger = NULL_LOGGER,
+        tracer: NullTracer = NULL_TRACER,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
         self.config = config
         self.cluster = cluster
         self.logger = logger
+        #: Observability (`krr_tpu.obs`): every range query gets a
+        #: ``prom_query`` span (child of the active fetch span) carrying
+        #: retries/points/bytes, fires the shared per-query metrics, and —
+        #: past ``prometheus_slow_query_seconds`` — a slow-query log line.
+        self.tracer = tracer
+        self.metrics = metrics
+        self.slow_query_seconds = float(
+            getattr(config, "prometheus_slow_query_seconds", 0.0) or 0.0
+        )
         self.url: Optional[str] = config.prometheus_url
         self._client: Optional[httpx.AsyncClient] = None
         self._raw: Optional[_RawTransport] = None
@@ -476,19 +519,30 @@ class PrometheusLoader:
         assert self._raw is not None
         return self._raw.request(*self._range_request_parts(query, start, end, step))
 
-    def _stream_attempt(self, query: str, start: float, end: float, step: str, make_stream, finalize):
+    def _stream_attempt(
+        self, query: str, start: float, end: float, step: str, make_stream, finalize, meter=None
+    ):
         """One STREAMED range request (sync — worker thread): response bytes
         feed a fresh native ingest stream as they arrive; returns
         (status, ``finalize(stream)`` or None, error body). The stream is
         aborted on any failure — a partially-fed stream can never be resumed
         (retrying would duplicate samples), so each attempt starts a fresh
         one. ``finalize`` is either ``StreamIngest.finish`` (full readout) or
-        ``finish_parse`` (hand the live stream back for a native fold)."""
+        ``finish_parse`` (hand the live stream back for a native fold).
+        ``meter`` counts the fed bytes for the query span/telemetry — the
+        body is never materialized, so the sink is the only place its size
+        is observable."""
         assert self._raw is not None
         stream = make_stream()
+        if meter is None:
+            sink = stream.feed
+        else:
+            def sink(chunk: bytes) -> None:
+                meter.add_bytes(len(chunk))
+                stream.feed(chunk)
         try:
             status, err = self._raw.request_streaming(
-                *self._range_request_parts(query, start, end, step), sink=stream.feed
+                *self._range_request_parts(query, start, end, step), sink=sink
             )
             if status >= 300:
                 stream.abort()
@@ -516,7 +570,7 @@ class PrometheusLoader:
         return response.status_code, response.content
 
     async def _httpx_stream_attempt(
-        self, query: str, start: float, end: float, step: str, make_stream, finalize
+        self, query: str, start: float, end: float, step: str, make_stream, finalize, meter=None
     ):
         """One STREAMED range request on the httpx client (proxied
         environments): response bytes feed a fresh native ingest stream as
@@ -538,6 +592,8 @@ class PrometheusLoader:
                     stream.abort()
                     return response.status_code, None, err
                 async for chunk in response.aiter_bytes(1 << 20):
+                    if meter is not None:
+                        meter.add_bytes(len(chunk))
                     await asyncio.to_thread(stream.feed, chunk)
             return response.status_code, await asyncio.to_thread(finalize, stream), b""
         except BaseException:
@@ -597,7 +653,7 @@ class PrometheusLoader:
         )
         return None
 
-    async def _retrying(self, attempt_fn):
+    async def _retrying(self, attempt_fn, meter: "Optional[_QueryMeter]" = None):
         """Shared retry/auth policy around one range-request attempt.
 
         ``attempt_fn() -> (status, result, detail_bytes)``; transport errors
@@ -609,6 +665,8 @@ class PrometheusLoader:
         expired kubeconfig token mid-scan; single-flight across the
         fan-out, and free so a 401 on the last transient attempt still gets
         its refreshed retry; a second 401 is a real authz failure).
+        ``meter`` counts attempts actually made (retries = attempts − 1 in
+        the per-query telemetry).
         """
         last_error: Optional[Exception] = None
         auth_refreshed = False
@@ -616,6 +674,8 @@ class PrometheusLoader:
         while attempt < self.retries:
             generation = self._auth_generation
             try:
+                if meter is not None:
+                    meter.attempts += 1
                 async with self._semaphore:
                     status, result, detail_bytes = await attempt_fn()
             except (http.client.HTTPException, httpx.TransportError, OSError) as e:
@@ -644,6 +704,42 @@ class PrometheusLoader:
         assert last_error is not None
         raise last_error
 
+    async def _instrumented(self, query: str, start: float, end: float, step: str, route: str, attempt_fn, meter: _QueryMeter):
+        """One range query through the retry policy, with per-query
+        observability around it: a ``prom_query`` span (child of the active
+        fetch span) carrying retries/points/bytes, the shared
+        ``krr_tpu_prom_query_*`` metrics, and the slow-query log. All of it
+        is downstream of the no-op checks — with the null tracer and no
+        registry the cost is one time read and two attribute tests."""
+        points = int((end - start) // step_string_seconds(step)) + 1
+        span = self.tracer.start_span("prom_query", route=route, points=points, query=query[:160])
+        t0 = time.perf_counter()
+        status = "error"
+        try:
+            result = await self._retrying(attempt_fn, meter=meter)
+            status = "ok"
+            return result
+        except BaseException as e:
+            span.set(error=f"{type(e).__name__}: {e}"[:200])
+            raise
+        finally:
+            elapsed = time.perf_counter() - t0
+            retries = max(0, meter.attempts - 1)
+            span.set(status=status, retries=retries, bytes=meter.bytes)
+            self.tracer.finish_span(span)
+            if self.metrics is not None:
+                self.metrics.observe("krr_tpu_prom_query_seconds", elapsed, route=route)
+                if retries:
+                    self.metrics.inc("krr_tpu_prom_query_retries_total", retries)
+                if status == "ok":
+                    self.metrics.inc("krr_tpu_prom_points_total", points)
+            if self.slow_query_seconds and elapsed >= self.slow_query_seconds:
+                self.logger.warning(
+                    f"Slow Prometheus query: {elapsed:.1f}s ({route}, window "
+                    f"[{start:.0f}, {end:.0f}] step {step}, {points} points, "
+                    f"{retries} retries, {status}): {query[:200]}"
+                )
+
     async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
         """Range query with the shared retry policy; returns the raw response
         body (callers pick their parser).
@@ -655,6 +751,7 @@ class PrometheusLoader:
         scale needs the extra `create services/proxy` RBAC verb either way).
         """
         await self._ensure_connected()
+        meter = _QueryMeter()
 
         async def attempt():
             if self._raw is not None:
@@ -663,9 +760,10 @@ class PrometheusLoader:
                 )
             else:  # proxied environment: ride the httpx client
                 status, body = await self._httpx_range_query(query, start, end, step)
+            meter.add_bytes(len(body))
             return status, body, body
 
-        return await self._retrying(attempt)
+        return await self._instrumented(query, start, end, step, "buffered", attempt, meter)
 
     async def _fetch_streamed_series(
         self, query: str, start: float, end: float, step: str, make_stream, finalize
@@ -679,17 +777,20 @@ class PrometheusLoader:
         retry policy as the buffered path — each attempt runs on a FRESH
         stream (a partially-fed one cannot be resumed)."""
         await self._ensure_connected()
+        meter = _QueryMeter()
 
         if self._raw is not None:
             async def attempt():
                 return await asyncio.to_thread(
-                    self._stream_attempt, query, start, end, step, make_stream, finalize
+                    self._stream_attempt, query, start, end, step, make_stream, finalize, meter
                 )
         else:
             async def attempt():
-                return await self._httpx_stream_attempt(query, start, end, step, make_stream, finalize)
+                return await self._httpx_stream_attempt(
+                    query, start, end, step, make_stream, finalize, meter
+                )
 
-        return await self._retrying(attempt)
+        return await self._instrumented(query, start, end, step, "streamed", attempt, meter)
 
     async def _refresh_auth(self, seen_generation: int) -> None:
         """Single-flight credential refresh: with dozens of windows in
@@ -1191,6 +1292,7 @@ class PrometheusLoader:
         step_seconds: float,
         end_time: Optional[float] = None,
         stats_resources: "frozenset[ResourceType]" = frozenset(),
+        failed_rows: "Optional[set[int]]" = None,
     ) -> dict[ResourceType, list[RaggedHistory]]:
         """Fetch per-pod series for the whole fleet.
 
@@ -1216,6 +1318,13 @@ class PrometheusLoader:
         for that resource shrinks from [rows × T] to [rows × pods],
         removing what is at fleet scale the LARGER of the two host→device
         transfers (memory histories are float64; CPU packs float32).
+
+        ``failed_rows`` (optional out-channel, indices into ``objects``):
+        rows whose queries failed TERMINALLY are recorded there — an empty
+        history from a failed query reads identically to a genuinely idle
+        workload otherwise, and the caller's fetch-health summary
+        (``--strict``) needs the distinction. Same contract as
+        ``DigestedFleet.failed_rows`` on the digest path.
         """
         await self._ensure_connected()
         end = datetime.datetime.now().timestamp() if end_time is None else end_time
@@ -1250,6 +1359,8 @@ class PrometheusLoader:
                         if pod in wanted and samples.size and pod not in history:
                             history[pod] = samples
             except Exception as e:
+                if failed_rows is not None:
+                    failed_rows.add(i)
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
             histories[resource][i] = history

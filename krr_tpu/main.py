@@ -238,6 +238,56 @@ def _common_options() -> list[click.Option]:
                 "(bounded backpressure). 0 = the staged gather-then-fold path."
             ),
         ),
+        PanelOption(
+            ["--trace", "trace_path"],
+            default=None,
+            help=(
+                "Write the scan's spans (scan → discover → fetch → fold → "
+                "compute, plus per-Prometheus-query children) as Chrome "
+                "trace-event JSON to this file at exit — load it in "
+                "chrome://tracing or Perfetto. Off by default (no-op tracer)."
+            ),
+        ),
+        PanelOption(
+            ["--metrics-dump", "metrics_dump_path"],
+            default=None,
+            help=(
+                "Write a Prometheus text-exposition snapshot of the scan's "
+                "metrics (per-query latency/retries/points, build info) to "
+                "this file at exit — the one-shot twin of serve's /metrics."
+            ),
+        ),
+        PanelOption(
+            ["--strict"],
+            is_flag=True,
+            default=False,
+            help=(
+                "Exit nonzero when any object's history fetch failed terminally "
+                "(rows rendered UNKNOWN) — for CI/cron scans that must not "
+                "mistake a half-fetched fleet for a clean run."
+            ),
+        ),
+        PanelOption(
+            ["--slow-query-seconds", "prometheus_slow_query_seconds"],
+            type=float,
+            default=10.0,
+            show_default=True,
+            help=(
+                "Log a warning for any Prometheus range query slower than this "
+                "many seconds (retries included); 0 disables the slow-query log."
+            ),
+        ),
+        PanelOption(
+            ["--log-format", "log_format"],
+            type=click.Choice(["console", "json"]),
+            default="console",
+            show_default=True,
+            panel="Logging Settings",
+            help=(
+                "console = rich prefixed lines; json = one structured object "
+                "per line carrying scan_id/span_id from the active trace span."
+            ),
+        ),
         PanelOption(["--cpu-min-value"], type=int, default=5, show_default=True, help="Minimum CPU recommendation, in millicores."),
         PanelOption(["--memory-min-value"], type=int, default=10, show_default=True, help="Minimum memory recommendation, in megabytes."),
         PanelOption(
@@ -287,8 +337,20 @@ def _server_options() -> list[click.Option]:
     defaults = {name: Config.model_fields[name].default for name in (
         "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds",
         "history_retention_seconds", "hysteresis_dead_band_pct", "hysteresis_confirm_ticks",
+        "trace_ring_scans",
     )}
     return [
+        PanelOption(
+            ["--trace-ring-scans"],
+            type=int,
+            default=defaults["trace_ring_scans"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Completed scan ticks the in-memory trace ring retains — "
+                "the window GET /debug/trace exports."
+            ),
+        ),
         PanelOption(
             ["--host", "server_host"],
             default=defaults["server_host"],
@@ -581,6 +643,22 @@ def _make_diff_command(strategy_name: str, strategy_type: Any) -> click.Command:
     )
 
 
+def _finish_observability(config: Any, session: Any) -> None:
+    """The ``--trace`` / ``--metrics-dump`` exit hooks of a one-shot scan:
+    dump the session tracer's ring as Chrome trace JSON, and/or the shared
+    metrics registry as a Prometheus exposition snapshot."""
+    if config.trace_path:
+        from krr_tpu.obs.trace import write_chrome_trace
+
+        write_chrome_trace(session.tracer, config.trace_path)
+    if config.metrics_dump_path:
+        from krr_tpu.obs.metrics import record_build_info
+
+        record_build_info(session.metrics)
+        with open(config.metrics_dump_path, "w") as f:
+            f.write(session.metrics.render())
+
+
 def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Command:
     settings_fields = list(strategy_type.get_settings_type().model_fields)
 
@@ -607,7 +685,15 @@ def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Comm
                 f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
             )
             raise click.UsageError(f"Invalid settings — {details}") from e
-        asyncio.run(runner.run())
+        try:
+            asyncio.run(runner.run())
+        finally:
+            # Dump even when the scan raised: a partial trace of a failed
+            # scan is exactly what --trace exists to capture.
+            _finish_observability(config, runner.session)
+        failed_rows = int(runner.stats.get("failed_rows", 0))
+        if config.strict and failed_rows:
+            raise SystemExit(3)
 
     return PanelCommand(
         strategy_name,

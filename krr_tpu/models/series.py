@@ -152,6 +152,11 @@ class FleetBatch:
 
     objects: list[K8sObjectData]
     ragged: dict[ResourceType, list[RaggedHistory]]
+    #: Row indices whose history fetch failed terminally (their empty
+    #: histories mean UNKNOWN, not idle) — same contract as
+    #: ``DigestedFleet.failed_rows``, so the CLI summary and ``--strict``
+    #: read one field on either ingest path.
+    failed_rows: "set[int]" = field(default_factory=set)
     _packed: dict[ResourceType, PackedSeries] = field(default_factory=dict)
     #: Minimum packed time capacity per resource. Row-sliced sub-batches pin
     #: this to the parent's full-fleet capacity so every chunk packs to the

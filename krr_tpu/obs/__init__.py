@@ -1,0 +1,35 @@
+"""Unified observability core: tracing, metrics, structured-log correlation.
+
+Shared by every execution mode — the one-shot CLI (``--trace`` /
+``--metrics-dump``), ``krr-tpu serve`` (``GET /metrics``,
+``GET /debug/trace``), and ``bench.py`` (the obs overhead leg) — and
+deliberately dependency-free: the image carries no opentelemetry or
+prometheus_client, and a scan's observability needs are small enough that
+~400 lines cover spans, a trace ring, Chrome-trace export, and a
+Prometheus text-format registry.
+
+* `trace`   — hierarchical thread/async-safe spans
+  (``scan → discover → fetch(namespace=…) → fold → compute → publish``
+  plus per-Prometheus-query children), a bounded in-memory ring of
+  completed scan traces, Chrome trace-event JSON export, and the
+  ``current_ids()`` hook structured logging uses to stamp
+  ``scan_id``/``span_id`` onto log lines. ``NULL_TRACER`` is the no-op
+  default on every hot path.
+* `metrics` — the Prometheus registry (promoted from
+  ``krr_tpu.server.metrics``, which re-exports for back-compat) so CLI
+  scans, serve, and bench record into the same declarations.
+"""
+
+from krr_tpu.obs.metrics import MetricsRegistry, record_build_info
+from krr_tpu.obs.trace import NULL_TRACER, NullTracer, Span, Tracer, current_ids, write_chrome_trace
+
+__all__ = [
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "NullTracer",
+    "Span",
+    "Tracer",
+    "current_ids",
+    "record_build_info",
+    "write_chrome_trace",
+]

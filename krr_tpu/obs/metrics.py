@@ -1,0 +1,146 @@
+"""Dependency-free Prometheus text-format metrics — the SHARED registry.
+
+Promoted from ``krr_tpu/server/metrics.py`` (which re-exports for
+back-compat) so every execution mode records into the same machinery: the
+serve process exposes its registry on ``GET /metrics``, a one-shot CLI scan
+snapshots its own to ``--metrics-dump FILE``, and ``bench.py``'s obs leg
+instruments its synthetic scans the same way. The image deliberately
+carries no prometheus_client, and the exposition format (version 0.0.4) is
+simple enough that a registry is ~100 lines: counters, gauges, and
+summaries (sum + count), with labels. Values live in plain dicts mutated
+from the event loop and worker threads — each mutation is a single dict
+item assignment (atomic under the GIL), and the render is a snapshot-free
+pass whose worst case is a metrics line reflecting a half-finished scan,
+which Prometheus scraping tolerates by design.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+#: (name, kind, help) for every metric krr-tpu emits — declared up front so
+#: an exposition carries complete HELP/TYPE headers from the first scrape,
+#: not only for series that happen to have fired already.
+SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
+    ("krr_tpu_build_info", "gauge", "Constant 1 labeled with the running build: krr-tpu version, jax version, device backend."),
+    ("krr_tpu_scans_total", "counter", "Completed scans by kind (full|delta)."),
+    ("krr_tpu_scans_skipped_total", "counter", "Scheduler ticks skipped because no new window had elapsed."),
+    ("krr_tpu_scan_failures_total", "counter", "Scans aborted by an unexpected error."),
+    ("krr_tpu_discovery_failures_total", "counter", "Discoveries that returned no objects while the store held rows — treated as transient inventory failures (no compaction)."),
+    ("krr_tpu_scan_duration_seconds", "gauge", "Last scan's wall seconds by leg (discover|fetch|fold|compute)."),
+    ("krr_tpu_scan_pipeline_seconds", "gauge", "Last scan's streamed-pipeline stage busy seconds (fetch = producer span, fold = consumer busy)."),
+    ("krr_tpu_scan_overlap_pct", "gauge", "Fetch/fold overlap of the last scan's streamed pipeline as a percentage of the shorter stage (100 = fully hidden)."),
+    ("krr_tpu_scan_window_seconds", "gauge", "Width of the last scan's fetched time window."),
+    ("krr_tpu_scan_failed_rows", "gauge", "Object fetches that failed terminally in the last scan (rows rendered UNKNOWN)."),
+    ("krr_tpu_fetch_window_seconds_total", "counter", "Cumulative fetched window seconds by kind — a delta-scan server grows this by the delta width per tick, a re-fetching one by the full history width."),
+    ("krr_tpu_backfilled_objects_total", "counter", "Late-discovered workloads given a full-window backfill fetch."),
+    ("krr_tpu_last_scan_timestamp_seconds", "gauge", "Unix time of the last published scan's window end."),
+    ("krr_tpu_fleet_objects", "gauge", "Scannable objects in the last discovery."),
+    ("krr_tpu_digest_store_rows", "gauge", "Rows (containers) resident in the digest store."),
+    ("krr_tpu_digest_store_bytes", "gauge", "Resident bytes of the digest store's row arrays."),
+    ("krr_tpu_store_compacted_rows_total", "counter", "Store rows dropped by churn compaction."),
+    ("krr_tpu_recommendation_churn_total", "counter", "Published recommendation changes: workloads whose published values moved this tick (first-time publishes excluded)."),
+    ("krr_tpu_hysteresis_suppressed_total", "counter", "Workload-ticks where an out-of-dead-band recommendation change was withheld by the hysteresis gate."),
+    ("krr_tpu_journal_records", "gauge", "Recommendation-tick records resident in the history journal."),
+    ("krr_tpu_journal_bytes", "gauge", "Resident bytes of the history journal's record array."),
+    ("krr_tpu_journal_span_seconds", "gauge", "Time between the journal's oldest and newest records (retention coverage)."),
+    ("krr_tpu_journal_compacted_records_total", "counter", "Journal records dropped by retention compaction."),
+    ("krr_tpu_prom_query_seconds", "summary", "Prometheus range-query latency by data plane (buffered|streamed), retries included."),
+    ("krr_tpu_prom_query_retries_total", "counter", "Prometheus range-query retry attempts beyond each query's first try."),
+    ("krr_tpu_prom_points_total", "counter", "Evaluation-grid points covered by successful Prometheus range queries."),
+    ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
+    ("krr_tpu_http_request_seconds", "summary", "HTTP request latency by route."),
+)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_value(value: float) -> str:
+    # Prometheus text format accepts integers and floats; keep integers
+    # unadorned so counters read naturally.
+    if float(value).is_integer() and abs(value) < 2**53:
+        return str(int(value))
+    return repr(float(value))
+
+
+class MetricsRegistry:
+    """Declared-up-front counters/gauges/summaries with labeled series."""
+
+    def __init__(self, declarations: Iterable[tuple[str, str, str]] = SERVER_METRICS):
+        self._meta: dict[str, tuple[str, str]] = {}
+        #: name -> {sorted-label-tuple -> value}; summaries keep two inner
+        #: maps under name+"_sum" / name+"_count".
+        self._values: dict[str, dict[tuple[tuple[str, str], ...], float]] = {}
+        for name, kind, help_text in declarations:
+            self.declare(name, kind, help_text)
+
+    def declare(self, name: str, kind: str, help_text: str) -> None:
+        if kind not in ("counter", "gauge", "summary"):
+            raise ValueError(f"unknown metric kind {kind!r}")
+        self._meta[name] = (kind, help_text)
+        if kind == "summary":
+            self._values.setdefault(name + "_sum", {})
+            self._values.setdefault(name + "_count", {})
+        else:
+            self._values.setdefault(name, {})
+
+    def _series(self, name: str, labels: dict) -> tuple[tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def inc(self, name: str, amount: float = 1.0, **labels: str) -> None:
+        series = self._series(name, labels)
+        bucket = self._values[name]
+        bucket[series] = bucket.get(series, 0.0) + amount
+
+    def set(self, name: str, value: float, **labels: str) -> None:
+        self._values[name][self._series(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels: str) -> None:
+        """One summary observation: ``name_sum`` += value, ``name_count`` += 1."""
+        series = self._series(name, labels)
+        for suffix, amount in (("_sum", float(value)), ("_count", 1.0)):
+            bucket = self._values[name + suffix]
+            bucket[series] = bucket.get(series, 0.0) + amount
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        """Read one series back (tests and the health route)."""
+        return self._values.get(name, {}).get(self._series(name, labels))
+
+    def render(self) -> str:
+        """Prometheus exposition format 0.0.4."""
+        out: list[str] = []
+        for name, (kind, help_text) in self._meta.items():
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {kind}")
+            suffixes = ("_sum", "_count") if kind == "summary" else ("",)
+            for suffix in suffixes:
+                for series, value in sorted(self._values[name + suffix].items()):
+                    if series:
+                        rendered_labels = ",".join(
+                            f'{key}="{_escape_label(val)}"' for key, val in series
+                        )
+                        out.append(f"{name}{suffix}{{{rendered_labels}}} {_format_value(value)}")
+                    else:
+                        out.append(f"{name}{suffix} {_format_value(value)}")
+        return "\n".join(out) + "\n"
+
+
+def record_build_info(registry: MetricsRegistry) -> None:
+    """Fire ``krr_tpu_build_info`` so scrapes/dumps identify the running
+    build. jax introspection is defensive — a metrics snapshot must not
+    fail (or force accelerator init) when jax is absent or broken."""
+    from krr_tpu.utils.version import get_version
+
+    jax_version = backend = "unavailable"
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        backend = jax.default_backend()
+    except Exception:
+        pass
+    registry.set(
+        "krr_tpu_build_info", 1, version=get_version(), jax=jax_version, backend=backend
+    )

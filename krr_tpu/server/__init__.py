@@ -13,8 +13,11 @@ requests:
 * `state`      — the published-snapshot cache with read/write locking, so
   queries keep serving the previous result while a scan is in flight;
 * `app`        — the asyncio HTTP surface: ``GET /recommendations``,
-  ``GET /healthz``, ``GET /metrics`` (Prometheus text format);
-* `metrics`    — a dependency-free Prometheus text-format registry.
+  ``GET /healthz``, ``GET /metrics`` (Prometheus text format),
+  ``GET /debug/trace`` (Chrome trace JSON of the last scan ticks);
+* `metrics`    — back-compat re-export of the shared registry, which now
+  lives in `krr_tpu.obs.metrics` (CLI scans and bench record into the
+  same declarations).
 """
 
 from krr_tpu.server.app import KrrServer, run_server

@@ -19,7 +19,9 @@ Routes:
 * ``GET /drift``     — fleet drift summary (`krr_tpu.history.drift`): raw
   vs published drift, flap counts, regime-change flags.
 * ``GET /healthz``   — liveness + scan freshness + journal age (JSON).
-* ``GET /metrics``   — Prometheus text format (`krr_tpu.server.metrics`).
+* ``GET /metrics``   — Prometheus text format (`krr_tpu.obs.metrics`).
+* ``GET /debug/trace`` — the last N scan ticks' spans as Chrome trace-event
+  JSON (`krr_tpu.obs.trace` ring; load in ``chrome://tracing``/Perfetto).
 """
 
 from __future__ import annotations
@@ -34,6 +36,8 @@ from krr_tpu.core.config import Config
 from krr_tpu.core.runner import ScanSession
 from krr_tpu.core.streaming import DigestStore
 from krr_tpu.models.result import Result
+from krr_tpu.obs.metrics import record_build_info
+from krr_tpu.obs.trace import NULL_TRACER, NullTracer, Tracer
 from krr_tpu.server.scheduler import ScanScheduler
 from krr_tpu.server.state import ServerState
 from krr_tpu.utils.logging import KrrLogger
@@ -87,11 +91,14 @@ class HttpApp:
         drift_dead_band_pct: float = 5.0,
         drift_confirm_ticks: int = 2,
         hysteresis_enabled: bool = True,
+        tracer: NullTracer = NULL_TRACER,
     ) -> None:
         self.state = state
         self.logger = logger
         self.stale_after_seconds = stale_after_seconds
         self.clock = clock
+        #: The scan session's tracer ring, exported by GET /debug/trace.
+        self.tracer = tracer
         #: The gate knobs, echoed by /drift so its out-of-band/regime flags
         #: are interpretable without reading the server's flags.
         self.drift_dead_band_pct = float(drift_dead_band_pct)
@@ -127,7 +134,23 @@ class HttpApp:
             return await self._history(query)
         if path == "/drift":
             return await self._drift()
+        if path == "/debug/trace":
+            return await self._debug_trace(query)
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
+
+    async def _debug_trace(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """The last N completed scan ticks' spans as Chrome trace-event JSON
+        (``?n=`` limits; default the whole ring). Rendered in a worker
+        thread — a full ring of wide-fleet scans is thousands of events."""
+        try:
+            n = int((query.get("n") or ["0"])[-1])
+        except ValueError:
+            return 400, "application/json", _json_body({"error": "n must be an integer"})
+
+        def render() -> bytes:
+            return _json_body(self.tracer.export_chrome(n if n > 0 else None))
+
+        return 200, "application/json", await asyncio.to_thread(render)
 
     async def _healthz(self) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
@@ -144,6 +167,7 @@ class HttpApp:
             "uptime_seconds": round(time.time() - self.state.started_at, 3),
             "scans": len(snapshot.result.scans) if snapshot is not None else 0,
             "last_scan_unix": snapshot.window_end if snapshot is not None else None,
+            "last_scan_id": self.state.last_scan_id,
             "store_rows": len(self.state.store.keys),
             # Hysteresis visibility: a fleet publishing nothing is either
             # genuinely quiet (suppressed 0) or held behind the gate
@@ -379,7 +403,8 @@ class HttpApp:
         status, content_type, body = await self.route(method, split.path, query)
         route_label = (
             split.path
-            if split.path in ("/healthz", "/metrics", "/recommendations", "/history", "/drift")
+            if split.path
+            in ("/healthz", "/metrics", "/recommendations", "/history", "/drift", "/debug/trace")
             else "other"
         )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
@@ -443,6 +468,14 @@ class KrrServer:
         journal_path = config.history_path
         if journal_path is None and state_path:
             journal_path = f"{state_path}.journal"
+        # Serve always records traces: the ring is what GET /debug/trace
+        # serves, and the per-tick span cost is noise next to a scan. The
+        # swap happens before any scan, so lazily-built Prometheus loaders
+        # pick up the recording tracer. An injected session that already
+        # carries a recording tracer (tests pinning their own ring) is
+        # respected.
+        if not self.session.tracer.enabled:
+            self.session.tracer = Tracer(ring_scans=config.trace_ring_scans)
         self.state = ServerState(
             DigestStore.open_or_create(state_path, settings.cpu_spec()),
             journal=RecommendationJournal(
@@ -450,6 +483,9 @@ class KrrServer:
                 retention_seconds=config.history_retention_seconds,
                 logger=self.logger,
             ),
+            # One registry for the whole process: the session's loaders fire
+            # per-query telemetry into the same exposition /metrics serves.
+            metrics=self.session.metrics,
         )
         self.scheduler = ScanScheduler(
             self.session,
@@ -469,6 +505,7 @@ class KrrServer:
             drift_dead_band_pct=config.hysteresis_dead_band_pct,
             drift_confirm_ticks=config.hysteresis_confirm_ticks,
             hysteresis_enabled=config.hysteresis_enabled,
+            tracer=self.session.tracer,
         )
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -478,6 +515,8 @@ class KrrServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def start(self, *, run_scheduler: bool = True) -> None:
+        # Scrapes identify the running build from the first response on.
+        record_build_info(self.state.metrics)
         self._server = await asyncio.start_server(
             self.app.handle_connection, self.config.server_host, self.config.server_port
         )
@@ -525,3 +564,9 @@ async def run_server(config: Config, *, logger: Optional[KrrLogger] = None) -> N
     finally:
         server.logger.info("Shutting down")
         await server.shutdown()
+        if config.trace_path:
+            # Same contract as a CLI scan's --trace: the ring (the last N
+            # ticks) lands on disk as Chrome trace JSON at shutdown.
+            from krr_tpu.obs.trace import write_chrome_trace
+
+            write_chrome_trace(server.session.tracer, config.trace_path)

@@ -257,192 +257,221 @@ class ScanScheduler:
             result = Result(scans=scans)
             return result, result.format("json").encode(), decision
 
-        result, body, decision = await asyncio.to_thread(render)
-        changed = int(np.count_nonzero(decision.changed))
-        suppressed = int(np.count_nonzero(decision.suppressed))
-        if changed:
-            metrics.inc("krr_tpu_recommendation_churn_total", changed)
-        if suppressed:
-            metrics.inc("krr_tpu_hysteresis_suppressed_total", suppressed)
-        self.state.last_publish_changed = changed
-        self.state.last_publish_suppressed = suppressed
-        if journal is not None:
-            metrics.set("krr_tpu_journal_records", journal.record_count)
-            metrics.set("krr_tpu_journal_bytes", journal.nbytes)
-            newest, oldest = journal.newest_ts, journal.oldest_ts
-            metrics.set(
-                "krr_tpu_journal_span_seconds",
-                (newest - oldest) if newest is not None and oldest is not None else 0.0,
+        tracer = self.session.tracer
+        with tracer.span("compute", rows=len(objects)):
+            result, body, decision = await asyncio.to_thread(render)
+        with tracer.span("publish") as publish_span:
+            changed = int(np.count_nonzero(decision.changed))
+            suppressed = int(np.count_nonzero(decision.suppressed))
+            if changed:
+                metrics.inc("krr_tpu_recommendation_churn_total", changed)
+            if suppressed:
+                metrics.inc("krr_tpu_hysteresis_suppressed_total", suppressed)
+            self.state.last_publish_changed = changed
+            self.state.last_publish_suppressed = suppressed
+            if journal is not None:
+                metrics.set("krr_tpu_journal_records", journal.record_count)
+                metrics.set("krr_tpu_journal_bytes", journal.nbytes)
+                newest, oldest = journal.newest_ts, journal.oldest_ts
+                metrics.set(
+                    "krr_tpu_journal_span_seconds",
+                    (newest - oldest) if newest is not None and oldest is not None else 0.0,
+                )
+            publish_span.set(changed=changed, suppressed=suppressed)
+            await self.state.publish(
+                Snapshot(result=result, body_json=body, window_end=window_end, published_at=time.time())
             )
-        await self.state.publish(
-            Snapshot(result=result, body_json=body, window_end=window_end, published_at=time.time())
-        )
 
     async def tick(self) -> bool:
         """One scan: (maybe) re-discover, fetch the due window, fold,
         recompute, publish. Returns False when no new window was due."""
+        async with self.state.scan_lock:
+            # One trace per tick: the root span's trace_id IS the scan id
+            # stamped through structured logs (contextvar propagation),
+            # /healthz (last_scan_id), and /debug/trace. Ticks that turn
+            # out to be pure no-ops are discarded from the ring below so
+            # they can't evict real scans.
+            tracer = self.session.tracer
+            with tracer.span("scan", kind="serve") as scan_span:
+                did_scan = await self._tick_traced(scan_span)
+            if not did_scan and scan_span.attributes.get("kind") == "skipped":
+                tracer.discard(scan_span.trace_id)
+            return did_scan
+
+    async def _tick_traced(self, scan_span) -> bool:
         from krr_tpu.strategies.simple import MEMORY_SCALE
 
-        async with self.state.scan_lock:
-            now = float(self.clock())
-            metrics = self.state.metrics
-            settings = self.session.strategy.settings
-            step = self._step_seconds()
+        now = float(self.clock())
+        metrics = self.state.metrics
+        settings = self.session.strategy.settings
+        step = self._step_seconds()
 
-            t0 = time.perf_counter()
-            if self._objects is None or now - self._discovered_at >= self.discovery_interval:
-                await self._discover(now)
-            objects = self._objects or []
-            t1 = time.perf_counter()
+        t0 = time.perf_counter()
+        if self._objects is None or now - self._discovered_at >= self.discovery_interval:
+            await self._discover(now)
+        objects = self._objects or []
+        t1 = time.perf_counter()
 
-            if self.state.last_end is None:
-                start = now - settings.history_timedelta.total_seconds()
-                kind = "full"
-            else:
-                # One step past the last folded window's right edge: the
-                # range query's grid includes its own start point, so
-                # starting AT last_end would re-fetch (and double-count)
-                # the sample already folded there.
-                start = self.state.last_end + step
-                kind = "delta"
-                if start > now:
-                    metrics.inc("krr_tpu_scans_skipped_total")
-                    if self.state.peek() is None and self.state.store.keys:
-                        # A state_path restart inside one step window: the
-                        # resumed store is complete but nothing is published
-                        # yet — serve from the resident digests instead of
-                        # 503ing until the next window opens. Only objects
-                        # ALREADY resident are published: rows_for grows
-                        # empty rows for unseen keys, and inserting a
-                        # workload discovered while the server was down
-                        # would make the next tick see it as seasoned and
-                        # skip its full-window backfill forever — it joins
-                        # the published result when that tick runs instead.
-                        known = [
-                            obj for obj in objects if object_key(obj) in self.state.store
-                        ]
-                        rows = await asyncio.to_thread(
-                            self.state.store.rows_for, [object_key(obj) for obj in known]
-                        )
-                        # record=False: this window's tick was journaled
-                        # before the restart — re-appending it would
-                        # double-record the same timestamp.
-                        await self._recompute_and_publish(
-                            known, rows, self.state.last_end, record=False
-                        )
-                    return False
-            # Clamp the right edge to the last evaluation-grid point ≤ now
-            # (see the module docstring): the next delta then starts exactly
-            # one step past the last point actually fetched.
-            end = start + ((now - start) // step) * step
-
-            # Workloads that appeared since the last scan have no store row
-            # yet; a delta-width fetch would skip everything between their
-            # creation and last_end (startup spikes included — peak-based
-            # memory recommendations would miss them forever). They get a
-            # FULL-window backfill alongside the fleet's delta.
-            fresh: list[K8sObjectData] = []
-            seasoned = objects
-            if kind == "delta":
-                fresh = [obj for obj in objects if object_key(obj) not in self.state.store]
-                if fresh:
-                    seasoned = [obj for obj in objects if object_key(obj) in self.state.store]
-            backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
-
-            use_pipeline = self.session.config.pipeline_depth > 0
-            pipeline_stats = []
-
-            async def fetch(objs: list[K8sObjectData], w_start: float) -> "object":
-                if use_pipeline:
-                    # Streamed pipeline: per-namespace batches fold into the
-                    # tick's PRIVATE window fleet while the rest still fetch
-                    # (`ScanSession.stream_fleet_digests`). The resident
-                    # store is only touched by the single fold below, after
-                    # every fetch succeeded — a failed tick still leaves it
-                    # untouched, exactly like the staged path.
-                    _objs, fleet, stats = await self.session.stream_fleet_digests(
-                        objs,
-                        history_seconds=end - w_start,
-                        step_seconds=settings.timeframe_timedelta.total_seconds(),
-                        end_time=end,
-                        raise_on_failure=True,
+        if self.state.last_end is None:
+            start = now - settings.history_timedelta.total_seconds()
+            kind = "full"
+        else:
+            # One step past the last folded window's right edge: the
+            # range query's grid includes its own start point, so
+            # starting AT last_end would re-fetch (and double-count)
+            # the sample already folded there.
+            start = self.state.last_end + step
+            kind = "delta"
+            if start > now:
+                metrics.inc("krr_tpu_scans_skipped_total")
+                scan_span.set(kind="skipped")
+                if self.state.peek() is None and self.state.store.keys:
+                    scan_span.set(kind="resume-publish")
+                    # A state_path restart inside one step window: the
+                    # resumed store is complete but nothing is published
+                    # yet — serve from the resident digests instead of
+                    # 503ing until the next window opens. Only objects
+                    # ALREADY resident are published: rows_for grows
+                    # empty rows for unseen keys, and inserting a
+                    # workload discovered while the server was down
+                    # would make the next tick see it as seasoned and
+                    # skip its full-window backfill forever — it joins
+                    # the published result when that tick runs instead.
+                    known = [
+                        obj for obj in objects if object_key(obj) in self.state.store
+                    ]
+                    rows = await asyncio.to_thread(
+                        self.state.store.rows_for, [object_key(obj) for obj in known]
                     )
-                    pipeline_stats.append(stats)
-                    return fleet
-                return await self.session.gather_fleet_digests(
+                    # record=False: this window's tick was journaled
+                    # before the restart — re-appending it would
+                    # double-record the same timestamp.
+                    await self._recompute_and_publish(
+                        known, rows, self.state.last_end, record=False
+                    )
+                    self.state.last_scan_id = scan_span.trace_id
+                return False
+        # Clamp the right edge to the last evaluation-grid point ≤ now
+        # (see the module docstring): the next delta then starts exactly
+        # one step past the last point actually fetched.
+        end = start + ((now - start) // step) * step
+
+        # Workloads that appeared since the last scan have no store row
+        # yet; a delta-width fetch would skip everything between their
+        # creation and last_end (startup spikes included — peak-based
+        # memory recommendations would miss them forever). They get a
+        # FULL-window backfill alongside the fleet's delta.
+        fresh: list[K8sObjectData] = []
+        seasoned = objects
+        if kind == "delta":
+            fresh = [obj for obj in objects if object_key(obj) not in self.state.store]
+            if fresh:
+                seasoned = [obj for obj in objects if object_key(obj) in self.state.store]
+        backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
+
+        use_pipeline = self.session.config.pipeline_depth > 0
+        pipeline_stats = []
+
+        async def fetch(objs: list[K8sObjectData], w_start: float) -> "object":
+            if use_pipeline:
+                # Streamed pipeline: per-namespace batches fold into the
+                # tick's PRIVATE window fleet while the rest still fetch
+                # (`ScanSession.stream_fleet_digests`). The resident
+                # store is only touched by the single fold below, after
+                # every fetch succeeded — a failed tick still leaves it
+                # untouched, exactly like the staged path.
+                _objs, fleet, stats = await self.session.stream_fleet_digests(
                     objs,
                     history_seconds=end - w_start,
                     step_seconds=settings.timeframe_timedelta.total_seconds(),
                     end_time=end,
                     raise_on_failure=True,
                 )
+                pipeline_stats.append(stats)
+                return fleet
+            return await self.session.gather_fleet_digests(
+                objs,
+                history_seconds=end - w_start,
+                step_seconds=settings.timeframe_timedelta.total_seconds(),
+                end_time=end,
+                raise_on_failure=True,
+            )
 
-            fetches = [fetch(seasoned, start)]
-            if fresh:
-                fetches.append(fetch(fresh, backfill_start))
-            # return_exceptions so a failing fetch doesn't orphan its
-            # sibling mid-download (same rationale as the session's own
-            # cluster fan-out).
-            fleets = await asyncio.gather(*fetches, return_exceptions=True)
-            for fleet in fleets:
-                if isinstance(fleet, BaseException):
-                    raise fleet
-            t2 = time.perf_counter()
+        fetches = [fetch(seasoned, start)]
+        if fresh:
+            fetches.append(fetch(fresh, backfill_start))
+        # return_exceptions so a failing fetch doesn't orphan its
+        # sibling mid-download (same rationale as the session's own
+        # cluster fan-out).
+        fleets = await asyncio.gather(*fetches, return_exceptions=True)
+        for fleet in fleets:
+            if isinstance(fleet, BaseException):
+                raise fleet
+        t2 = time.perf_counter()
 
+        with self.session.tracer.span("fold", rows=len(objects)):
             for fleet in fleets:
                 await asyncio.to_thread(self.state.store.fold_fleet, fleet, MEMORY_SCALE)
             rows = await asyncio.to_thread(
                 self.state.store.rows_for, [object_key(obj) for obj in objects]
             )
-            self.state.last_end = end
-            t3 = time.perf_counter()
+        self.state.last_end = end
+        t3 = time.perf_counter()
 
-            await self._recompute_and_publish(objects, rows, end)
-            t4 = time.perf_counter()
+        await self._recompute_and_publish(objects, rows, end)
+        t4 = time.perf_counter()
 
-            if self.state_path:
-                await asyncio.to_thread(self._save_store)
+        if self.state_path:
+            await asyncio.to_thread(self._save_store)
 
-            metrics.inc("krr_tpu_scans_total", kind=kind)
-            metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
-            if fresh:
-                metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
-                metrics.inc(
-                    "krr_tpu_fetch_window_seconds_total", end - backfill_start, kind="backfill"
-                )
-            metrics.set("krr_tpu_scan_window_seconds", end - start)
-            metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
-            metrics.set("krr_tpu_scan_duration_seconds", t1 - t0, phase="discover")
-            metrics.set("krr_tpu_scan_duration_seconds", t2 - t1, phase="fetch")
-            metrics.set("krr_tpu_scan_duration_seconds", t3 - t2, phase="fold")
-            metrics.set("krr_tpu_scan_duration_seconds", t4 - t3, phase="compute")
-            if pipeline_stats:
-                # Per-stage overlap of the streamed fetch+fold pipeline —
-                # the main (seasoned) leg plus any backfill leg, summed for
-                # busy time, max'd for the overlap percentage.
-                metrics.set(
-                    "krr_tpu_scan_pipeline_seconds",
-                    sum(s.fetch_seconds for s in pipeline_stats),
-                    stage="fetch",
-                )
-                metrics.set(
-                    "krr_tpu_scan_pipeline_seconds",
-                    sum(s.fold_seconds for s in pipeline_stats),
-                    stage="fold",
-                )
-                metrics.set(
-                    "krr_tpu_scan_overlap_pct",
-                    max(s.overlap_pct for s in pipeline_stats),
-                )
-            metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
-            metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
-            self.logger.info(
-                f"{kind} scan folded window [{start:.0f}, {end:.0f}] "
-                f"({len(objects)} objects, {len(self.state.store.keys)} store rows): "
-                f"discover {t1 - t0:.2f}s, fetch {t2 - t1:.2f}s, "
-                f"fold {t3 - t2:.2f}s, compute {t4 - t3:.2f}s"
+        metrics.inc("krr_tpu_scans_total", kind=kind)
+        metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
+        if fresh:
+            metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
+            metrics.inc(
+                "krr_tpu_fetch_window_seconds_total", end - backfill_start, kind="backfill"
             )
-            return True
+        metrics.set("krr_tpu_scan_window_seconds", end - start)
+        metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
+        metrics.set("krr_tpu_scan_duration_seconds", t1 - t0, phase="discover")
+        metrics.set("krr_tpu_scan_duration_seconds", t2 - t1, phase="fetch")
+        metrics.set("krr_tpu_scan_duration_seconds", t3 - t2, phase="fold")
+        metrics.set("krr_tpu_scan_duration_seconds", t4 - t3, phase="compute")
+        if pipeline_stats:
+            # Per-stage overlap of the streamed fetch+fold pipeline —
+            # the main (seasoned) leg plus any backfill leg, summed for
+            # busy time, max'd for the overlap percentage.
+            metrics.set(
+                "krr_tpu_scan_pipeline_seconds",
+                sum(s.fetch_seconds for s in pipeline_stats),
+                stage="fetch",
+            )
+            metrics.set(
+                "krr_tpu_scan_pipeline_seconds",
+                sum(s.fold_seconds for s in pipeline_stats),
+                stage="fold",
+            )
+            metrics.set(
+                "krr_tpu_scan_overlap_pct",
+                max(s.overlap_pct for s in pipeline_stats),
+            )
+        metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
+        metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
+        scan_span.set(
+            kind=kind,
+            window_start=start,
+            window_end=end,
+            objects=len(objects),
+            backfilled=len(fresh),
+        )
+        self.state.last_scan_id = scan_span.trace_id
+        self.logger.info(
+            f"{kind} scan {scan_span.trace_id or ''} folded window [{start:.0f}, {end:.0f}] "
+            f"({len(objects)} objects, {len(self.state.store.keys)} store rows): "
+            f"discover {t1 - t0:.2f}s, fetch {t2 - t1:.2f}s, "
+            f"fold {t3 - t2:.2f}s, compute {t4 - t3:.2f}s"
+        )
+        return True
 
     # ----------------------------------------------------------- the loop
     async def run(self) -> None:

@@ -88,7 +88,12 @@ class Snapshot:
 class ServerState:
     """The serve process's shared mutable state."""
 
-    def __init__(self, store: "DigestStore", journal: "Optional[RecommendationJournal]" = None) -> None:
+    def __init__(
+        self,
+        store: "DigestStore",
+        journal: "Optional[RecommendationJournal]" = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
         self.store = store
         #: The recommendation flight recorder (`krr_tpu.history.journal`):
         #: every scheduler recompute appends here; GET /history and
@@ -98,7 +103,10 @@ class ServerState:
         #: One scan in flight at a time (scheduler ticks + any manual kicks).
         self.scan_lock = asyncio.Lock()
         self.rwlock = ReadWriteLock()
-        self.metrics = MetricsRegistry()
+        #: Injectable so the serve composition root can hand in the scan
+        #: session's registry — per-query Prometheus telemetry then lands on
+        #: the same /metrics exposition as the scheduler's scan telemetry.
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.started_at = time.time()
         #: Right edge of the last FOLDED window — the next delta starts one
         #: step after it. Advanced only after a fold completes, so a
@@ -110,6 +118,9 @@ class ServerState:
         #: can tell a quiet fleet from a stuck gate.
         self.last_publish_suppressed: Optional[int] = None
         self.last_publish_changed: Optional[int] = None
+        #: Trace id of the last completed scan tick — the join key between
+        #: /healthz, structured log lines, and /debug/trace spans.
+        self.last_scan_id: Optional[str] = None
         self._snapshot: Optional[Snapshot] = None
 
     async def publish(self, snapshot: Snapshot) -> None:

@@ -45,3 +45,11 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("journal_append_records_per_sec", 0) > 0, secondary
     assert secondary.get("journal_compact_records_per_sec", 0) > 0, secondary
     assert secondary.get("journal_diff_objects_per_sec", 0) > 0, secondary
+    # The tracing-overhead leg ran: both tracer modes scanned, spans were
+    # recorded, and the <2%-overhead + bit-exactness gates passed (a gate
+    # failure is a parity failure — rc 1 — but assert the fields so a
+    # leg-skipping refactor can't pass silently).
+    assert secondary.get("obs_plain_scan_seconds", 0) > 0, secondary
+    assert secondary.get("obs_traced_scan_seconds", 0) > 0, secondary
+    assert secondary.get("obs_spans_per_scan", 0) > 0, secondary
+    assert "obs_trace_overhead_pct" in secondary, secondary

@@ -1,0 +1,499 @@
+"""The unified observability core (`krr_tpu.obs`): tracer semantics, Chrome
+trace export, Prometheus exposition correctness, structured logging, and the
+CLI/serve wiring (--trace / --metrics-dump / --strict / /debug/trace)."""
+
+import asyncio
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from krr_tpu.obs.metrics import MetricsRegistry, record_build_info
+from krr_tpu.obs.trace import NULL_TRACER, Tracer, current_ids, write_chrome_trace
+
+from .test_integrations import fake_env, make_config  # noqa: F401  (fixture re-export)
+
+
+# ------------------------------------------------------------------ tracer
+class TestTracer:
+    def test_nesting_and_ring(self):
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan", kind="test") as root:
+            assert current_ids() == (root.trace_id, f"{root.span_id:x}")
+            with tracer.span("discover") as child:
+                assert child.parent_id == root.span_id
+                assert child.trace_id == root.trace_id
+        assert current_ids() == (None, None)
+        [spans] = tracer.traces()
+        assert [s.name for s in spans] == ["discover", "scan"]  # completion order
+        assert spans[1].parent_id is None and spans[1].duration >= spans[0].duration
+
+    def test_concurrent_tasks_parent_correctly(self):
+        """Sibling asyncio tasks each see their own current span; their
+        children parent to the right fetch, not to a sibling's."""
+        tracer = Tracer()
+
+        async def main():
+            with tracer.span("scan"):
+                async def fetch(namespace):
+                    with tracer.span("fetch", namespace=namespace) as f:
+                        await asyncio.sleep(0.001)
+                        with tracer.span("prom_query") as q:
+                            await asyncio.sleep(0.001)
+                        assert q.parent_id == f.span_id
+
+                await asyncio.gather(fetch("a"), fetch("b"), fetch("c"))
+
+        asyncio.run(main())
+        [spans] = tracer.traces()
+        root = next(s for s in spans if s.parent_id is None)
+        fetches = {s.span_id: s for s in spans if s.name == "fetch"}
+        assert len(fetches) == 3
+        assert all(f.parent_id == root.span_id for f in fetches.values())
+        queries = [s for s in spans if s.name == "prom_query"]
+        assert sorted(q.parent_id for q in queries) == sorted(fetches)
+
+    def test_to_thread_span_parents_to_caller(self):
+        """asyncio.to_thread copies the context, so a span opened on the
+        worker thread nests under the caller's active span — the fold path."""
+        tracer = Tracer()
+
+        async def main():
+            with tracer.span("scan") as root:
+                def fold():
+                    with tracer.span("fold") as f:
+                        assert f.parent_id == root.span_id
+
+                await asyncio.to_thread(fold)
+
+        asyncio.run(main())
+        [spans] = tracer.traces()
+        assert {s.name for s in spans} == {"scan", "fold"}
+
+    def test_ring_eviction(self):
+        tracer = Tracer(ring_scans=2)
+        ids = []
+        for i in range(3):
+            with tracer.span("scan", index=i) as root:
+                ids.append(root.trace_id)
+        traces = tracer.traces()
+        assert [t[0].trace_id for t in traces] == ids[1:]  # oldest evicted
+        assert tracer.traces(n=1)[0][0].trace_id == ids[-1]
+
+    def test_discard_drops_a_ringed_trace(self):
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan") as kept:
+            pass
+        with tracer.span("scan") as dropped:
+            pass
+        tracer.discard(dropped.trace_id)
+        assert [t[0].trace_id for t in tracer.traces()] == [kept.trace_id]
+
+    def test_span_cap_counts_drops(self):
+        tracer = Tracer(max_spans_per_trace=3)
+        with tracer.span("scan") as root:
+            for _ in range(5):
+                with tracer.span("leaf"):
+                    pass
+        [spans] = tracer.traces()
+        # 3 kept children + the root (always kept), 2 dropped and counted.
+        assert len(spans) == 4
+        assert root.attributes["dropped_spans"] == 2
+
+    def test_attributes_and_error_capture(self):
+        tracer = Tracer()
+        with pytest.raises(ValueError):
+            with tracer.span("scan") as root:
+                root.set(objects=7)
+                raise ValueError("boom")
+        [spans] = tracer.traces()
+        assert spans[0].attributes["objects"] == 7
+        assert "ValueError: boom" in spans[0].attributes["error"]
+
+    def test_straggler_span_after_root_close_does_not_reopen_trace(self):
+        """An aborted scan can leave un-awaited fetch tasks whose spans
+        finish AFTER the root closed; they must be dropped, not resurrect
+        the trace as a permanently-open entry (a serve-lifetime leak)."""
+        tracer = Tracer()
+        with tracer.span("scan") as root:
+            straggler = tracer.start_span("fetch")  # still open at root close
+        tracer.finish_span(straggler)  # lands after the trace flushed
+        assert tracer._open == {}
+        [spans] = tracer.traces()
+        assert [s.name for s in spans] == ["scan"]
+        assert tracer._flushed[root.trace_id] == 1  # counted, not stored
+        # Same contract for discarded traces.
+        with tracer.span("scan") as discarded:
+            late = tracer.start_span("fetch")
+        tracer.discard(discarded.trace_id)
+        tracer.finish_span(late)
+        assert tracer._open == {}
+
+    def test_null_tracer_is_inert(self):
+        with NULL_TRACER.span("scan", anything=1) as span:
+            span.set(more=2)
+            assert current_ids() == (None, None)
+        leaf = NULL_TRACER.start_span("x")
+        NULL_TRACER.finish_span(leaf)
+        NULL_TRACER.discard("nope")
+        assert NULL_TRACER.traces() == []
+        assert NULL_TRACER.export_chrome() == {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+class TestChromeExport:
+    def _scan_trace(self) -> Tracer:
+        tracer = Tracer()
+
+        async def main():
+            with tracer.span("scan"):
+                with tracer.span("discover"):
+                    await asyncio.sleep(0.002)
+
+                async def fetch(namespace):
+                    with tracer.span("fetch", namespace=namespace):
+                        await asyncio.sleep(0.003)
+
+                await asyncio.gather(fetch("a"), fetch("b"))
+                with tracer.span("compute"):
+                    await asyncio.sleep(0.002)
+
+        asyncio.run(main())
+        return tracer
+
+    def test_export_is_valid_and_nested(self):
+        tracer = self._scan_trace()
+        payload = json.loads(json.dumps(tracer.export_chrome()))  # JSON round-trip
+        events = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+        assert {e["name"] for e in events} == {"scan", "discover", "fetch", "compute"}
+        for event in events:
+            assert event["dur"] >= 0 and isinstance(event["ts"], float)
+        by_id = {e["args"]["span_id"]: e for e in events}
+        root = next(e for e in events if e["args"]["parent_id"] is None)
+        for event in events:
+            parent_id = event["args"]["parent_id"]
+            if parent_id is None:
+                continue
+            parent = by_id[parent_id]
+            # Chrome nesting contract: a child's interval sits inside its
+            # parent's (small float tolerance from the µs rounding).
+            assert event["ts"] >= parent["ts"] - 1.0
+            assert event["ts"] + event["dur"] <= parent["ts"] + parent["dur"] + 1.0
+            assert event["args"]["trace_id"] == root["args"]["trace_id"]
+        # The two concurrent fetches cannot share a lane (they overlap), and
+        # each lane renders proper containment.
+        fetch_tids = [e["tid"] for e in events if e["name"] == "fetch"]
+        assert len(set(fetch_tids)) == 2
+        # Process metadata names the trace.
+        meta = [e for e in payload["traceEvents"] if e.get("ph") == "M"]
+        assert meta and meta[0]["args"]["name"] == root["args"]["trace_id"]
+
+    def test_write_chrome_trace_file(self, tmp_path):
+        tracer = self._scan_trace()
+        path = tmp_path / "trace.json"
+        write_chrome_trace(tracer, str(path))
+        payload = json.loads(path.read_text())
+        assert payload["traceEvents"]
+        # The null tracer writes a loadable empty trace (the --trace flag on
+        # a scan that never started one must not leave a corrupt file).
+        write_chrome_trace(NULL_TRACER, str(path))
+        assert json.loads(path.read_text())["traceEvents"] == []
+
+
+# ------------------------------------------------------- exposition golden
+def _parse_labels(labels_part: str) -> list:
+    """Parse `key="value",…` honoring the format's escapes (\\\\, \\", \\n);
+    raises on anything malformed."""
+    labels = []
+    i = 0
+    while i < len(labels_part):
+        eq = labels_part.index("=", i)
+        key = labels_part[i:eq]
+        assert labels_part[eq + 1] == '"', labels_part
+        j = eq + 2
+        value_chars = []
+        while labels_part[j] != '"':
+            if labels_part[j] == "\\":
+                value_chars.append({"n": "\n", '"': '"', "\\": "\\"}[labels_part[j + 1]])
+                j += 2
+            else:
+                value_chars.append(labels_part[j])
+                j += 1
+        labels.append((key, "".join(value_chars)))
+        i = j + 2 if j + 1 < len(labels_part) and labels_part[j + 1] == "," else j + 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text-format 0.0.4 parser: {metric-family: {"type",
+    "help", "samples": {(name, labels-tuple): value}}}. Raises on lines that
+    violate the format — the golden-parse gate."""
+    families: dict = {}
+    current = None
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            current = families.setdefault(name, {"help": help_text, "type": None, "samples": {}})
+            current["help"] = help_text
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name in families, f"TYPE before HELP for {name}"
+            families[name]["type"] = kind
+        else:
+            brace = line.find("{")
+            if brace != -1 and brace < line.find(" "):
+                name = line[:brace]
+                labels_part, _, value_part = line[brace + 1 :].rpartition("} ")
+                labels = _parse_labels(labels_part)
+                value = float(value_part)
+            else:
+                name, _, value_part = line.partition(" ")
+                labels = []
+                value = float(value_part)
+            family = name
+            for suffix in ("_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in families:
+                    family = name[: -len(suffix)]
+            assert family in families, f"sample {name} with no TYPE/HELP header"
+            families[family]["samples"][(name, tuple(labels))] = value
+    return families
+
+
+class TestExposition:
+    def test_declared_but_unfired_series_keep_headers(self):
+        """Every declared metric renders HELP/TYPE even before any series
+        fires — scrape-time discovery must see the full inventory."""
+        registry = MetricsRegistry()
+        families = parse_exposition(registry.render())
+        assert "krr_tpu_scans_total" in families
+        assert families["krr_tpu_scans_total"]["type"] == "counter"
+        assert families["krr_tpu_prom_query_seconds"]["type"] == "summary"
+        assert all(meta["type"] is not None for meta in families.values())
+        assert all(not meta["samples"] for meta in families.values())
+
+    def test_label_escaping_round_trips(self):
+        registry = MetricsRegistry()
+        nasty = 'a"b\\c\nnewline'
+        registry.inc("krr_tpu_http_requests_total", route=nasty, code="200")
+        text = registry.render()
+        assert '\\"' in text and "\\n" in text and "\\\\" in text
+        families = parse_exposition(text)
+        [(name, labels)] = families["krr_tpu_http_requests_total"]["samples"]
+        assert dict(labels)["route"] == nasty
+
+    def test_summary_sum_count_pairing(self):
+        registry = MetricsRegistry()
+        registry.observe("krr_tpu_prom_query_seconds", 0.25, route="buffered")
+        registry.observe("krr_tpu_prom_query_seconds", 0.75, route="buffered")
+        registry.observe("krr_tpu_prom_query_seconds", 1.5, route="streamed")
+        families = parse_exposition(registry.render())
+        samples = families["krr_tpu_prom_query_seconds"]["samples"]
+        for route, want_sum, want_count in (("buffered", 1.0, 2), ("streamed", 1.5, 1)):
+            labels = (("route", route),)
+            assert samples[("krr_tpu_prom_query_seconds_sum", labels)] == want_sum
+            assert samples[("krr_tpu_prom_query_seconds_count", labels)] == want_count
+        # Pairing invariant: every _sum series has its _count twin.
+        sums = {k[1] for k in samples if k[0].endswith("_sum")}
+        counts = {k[1] for k in samples if k[0].endswith("_count")}
+        assert sums == counts
+
+    def test_build_info(self):
+        registry = MetricsRegistry()
+        record_build_info(registry)
+        from krr_tpu.utils.version import get_version
+
+        families = parse_exposition(registry.render())
+        [(_name, labels)] = families["krr_tpu_build_info"]["samples"]
+        labels = dict(labels)
+        assert labels["version"] == get_version()
+        assert labels["jax"] and labels["backend"]
+
+
+# --------------------------------------------------------- structured logs
+class TestStructuredLogging:
+    def test_json_lines_carry_scan_and_span_ids(self, capsys):
+        from krr_tpu.utils.logging import KrrLogger
+
+        logger = KrrLogger(log_format="json")
+        tracer = Tracer()
+        logger.info("outside any scan")
+        with tracer.span("scan") as root:
+            with tracer.span("fetch") as fetch:
+                logger.warning("inside the fetch")
+        lines = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+        assert lines[0]["level"] == "INFO" and "scan_id" not in lines[0]
+        assert lines[1]["level"] == "WARNING"
+        assert lines[1]["scan_id"] == root.trace_id
+        assert lines[1]["span_id"] == f"{fetch.span_id:x}"
+        assert isinstance(lines[1]["ts"], float)
+
+    def test_json_respects_quiet_and_stderr(self, capsys):
+        from krr_tpu.utils.logging import KrrLogger
+
+        KrrLogger(quiet=True, log_format="json").info("silent")
+        out, err = capsys.readouterr()
+        assert out == "" and err == ""
+        KrrLogger(log_to_stderr=True, log_format="json").error("to stderr")
+        out, err = capsys.readouterr()
+        assert out == "" and json.loads(err)["level"] == "ERROR"
+
+    def test_json_skips_console_chrome(self, capsys):
+        """markup=True content (the ASCII banner) and blank separators are
+        console chrome — a json aggregator must never ingest them."""
+        from krr_tpu.utils.logging import KrrLogger
+        from krr_tpu.utils.logo import ASCII_LOGO
+
+        logger = KrrLogger(log_format="json")
+        logger.echo(ASCII_LOGO, no_prefix=True, markup=True)
+        logger.echo("\n", no_prefix=True)
+        logger.echo("real event")
+        lines = capsys.readouterr().out.splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["message"] == "real event"
+
+    def test_json_debug_includes_caller(self, capsys):
+        from krr_tpu.utils.logging import KrrLogger
+
+        KrrLogger(verbose=True, log_format="json").debug("dbg")
+        record = json.loads(capsys.readouterr().out)
+        assert record["level"] == "DEBUG" and "test_obs.py" in record["caller"]
+
+
+# ------------------------------------------------------------- CLI wiring
+def _scan_cli(fake_env, *extra):  # noqa: F811
+    from krr_tpu.main import app, load_commands
+
+    load_commands()
+    return CliRunner().invoke(
+        app,
+        ["simple", "-q", "-f", "json", "--kubeconfig", fake_env["kubeconfig"],
+         "-p", fake_env["server"].url, *extra],
+    )
+
+
+class TestCLIWiring:
+    def test_trace_and_metrics_dump_files(self, fake_env, tmp_path):  # noqa: F811
+        trace_path = tmp_path / "scan-trace.json"
+        dump_path = tmp_path / "metrics.prom"
+        result = _scan_cli(
+            fake_env, "--trace", str(trace_path), "--metrics-dump", str(dump_path)
+        )
+        assert result.exit_code == 0, result.output
+
+        payload = json.loads(trace_path.read_text())
+        events = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in events}
+        assert {"scan", "discover", "fetch", "compute", "prom_query"} <= names
+        root = next(e for e in events if e["name"] == "scan")
+        assert root["args"]["kind"] == "cli" and root["args"]["objects"] == 4
+        queries = [e for e in events if e["name"] == "prom_query"]
+        fetch_ids = {e["args"]["span_id"] for e in events if e["name"] == "fetch"}
+        assert queries and all(q["args"]["parent_id"] in fetch_ids for q in queries)
+        for q in queries:
+            assert q["args"]["status"] == "ok"
+            assert q["args"]["points"] > 0 and q["args"]["bytes"] > 0
+            assert q["args"]["retries"] == 0
+
+        families = parse_exposition(dump_path.read_text())
+        samples = families["krr_tpu_prom_query_seconds"]["samples"]
+        total_queries = sum(
+            v for (name, _labels), v in samples.items() if name.endswith("_count")
+        )
+        assert total_queries == len(queries)
+        assert sum(families["krr_tpu_prom_points_total"]["samples"].values()) > 0
+        assert families["krr_tpu_build_info"]["samples"]
+
+    def test_strict_exits_nonzero_on_failed_rows(self, fake_env):  # noqa: F811
+        fake_env["metrics"].fail_queries = True
+        try:
+            result = _scan_cli(fake_env, "--strict")
+            assert result.exit_code == 3, result.output
+            result = _scan_cli(fake_env)  # without --strict the scan degrades
+            assert result.exit_code == 0, result.output
+        finally:
+            fake_env["metrics"].fail_queries = False
+        result = _scan_cli(fake_env, "--strict")  # healthy fleet: strict passes
+        assert result.exit_code == 0, result.output
+
+    def test_stats_carry_fetch_health(self, fake_env):  # noqa: F811
+        import contextlib
+        import io
+
+        from krr_tpu.core.runner import Runner
+
+        config = make_config(fake_env, quiet=True, format="json")
+        runner = Runner(config)
+        with contextlib.redirect_stdout(io.StringIO()):
+            asyncio.run(runner.run())
+        assert runner.stats["failed_rows"] == 0
+        assert runner.stats["fetch_retries"] == 0
+
+    def test_stage_spans_align_with_runner_stats(self, fake_env):  # noqa: F811
+        """Acceptance: per-stage spans account for the runner's timing legs.
+        On the staged (unpipelined) path the boundaries coincide, so the
+        sums agree within 5% (plus a small absolute tolerance at
+        toy-fleet millisecond scale)."""
+        import contextlib
+        import io
+
+        from krr_tpu.core.runner import Runner
+
+        config = make_config(
+            fake_env, quiet=True, format="json", strategy="tdigest",
+            pipeline_depth=0, other_args={"digest_ingest": True},
+        )
+        tracer = Tracer()
+        runner = Runner(config, tracer=tracer)
+        with contextlib.redirect_stdout(io.StringIO()):
+            asyncio.run(runner.run())
+        [spans] = tracer.traces()
+        by_stage: dict = {}
+        for span in spans:
+            by_stage.setdefault(span.name, 0.0)
+            by_stage[span.name] += span.duration
+
+        def close(span_sum, leg, slack=0.05, absolute=0.02):
+            return abs(span_sum - leg) <= max(slack * leg, absolute)
+
+        assert close(by_stage["discover"], runner.stats["discover_seconds"])
+        # fetch spans (per cluster) also bracket the host fold on this path;
+        # together fetch+fold account for the runner's fetch leg.
+        assert close(
+            by_stage["fetch"] + by_stage.get("fold", 0.0), runner.stats["fetch_seconds"]
+        )
+        assert close(by_stage["compute"], runner.stats["compute_seconds"])
+        root = next(s for s in spans if s.parent_id is None)
+        total_legs = (
+            runner.stats["discover_seconds"]
+            + runner.stats["fetch_seconds"]
+            + runner.stats["compute_seconds"]
+        )
+        assert root.duration >= total_legs * 0.95
+
+
+# ------------------------------------------------------------ serve wiring
+class TestServeDebugTrace:
+    def test_debug_trace_route(self):
+        from krr_tpu.server.app import HttpApp
+        from krr_tpu.server.state import ServerState
+        from krr_tpu.utils.logging import NULL_LOGGER
+
+        class FakeStore:
+            keys: list = []
+
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan", kind="serve"):
+            with tracer.span("fetch", namespace="default"):
+                pass
+        app = HttpApp(ServerState(FakeStore()), NULL_LOGGER, tracer=tracer)
+
+        status, content_type, body = asyncio.run(app.route("GET", "/debug/trace", {}))
+        assert status == 200 and content_type == "application/json"
+        payload = json.loads(body)
+        names = {e["name"] for e in payload["traceEvents"] if e.get("ph") == "X"}
+        assert names == {"scan", "fetch"}
+
+        status, _ct, body = asyncio.run(app.route("GET", "/debug/trace", {"n": ["1"]}))
+        assert status == 200 and json.loads(body)["traceEvents"]
+        status, _ct, _body = asyncio.run(app.route("GET", "/debug/trace", {"n": ["x"]}))
+        assert status == 400

@@ -173,6 +173,69 @@ class TestEndpoints:
         asyncio.run(main())
 
 
+    def test_debug_trace_and_scan_id_after_tick(self, serve_env):
+        """One scheduler tick leaves a full trace in the ring: /debug/trace
+        exports nested scan→discover→fetch→fold→compute→publish spans with
+        prom_query children, /healthz carries the tick's scan id, and the
+        per-query Prometheus telemetry lands on the SAME /metrics exposition
+        as the scan counters (one registry for the whole process)."""
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+
+                r = await http_get(ks.port, "/debug/trace")
+                assert r.status_code == 200
+                events = [e for e in r.json()["traceEvents"] if e.get("ph") == "X"]
+                names = {e["name"] for e in events}
+                assert {"scan", "discover", "fetch", "fold", "compute", "publish",
+                        "prom_query"} <= names
+                root = next(e for e in events if e["name"] == "scan")
+                assert root["args"]["kind"] == "full"
+                assert root["args"]["window_end"] == now[0]
+                # Streamed fetch batches are namespace-labeled (the
+                # fetch(namespace=…) level of the span taxonomy).
+                fetch_spans = [e for e in events if e["name"] == "fetch"]
+                assert fetch_spans and all(e["args"]["namespace"] for e in fetch_spans)
+                assert {"default", "prod"} <= {
+                    ns for e in fetch_spans for ns in e["args"]["namespace"].split(",")
+                }
+                # prom_query spans nest under fetch spans and carry telemetry.
+                fetch_ids = {e["args"]["span_id"] for e in events if e["name"] == "fetch"}
+                queries = [e for e in events if e["name"] == "prom_query"]
+                assert queries and all(q["args"]["parent_id"] in fetch_ids for q in queries)
+                assert all(q["args"]["status"] == "ok" and q["args"]["points"] > 0 for q in queries)
+
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["last_scan_id"] == root["args"]["trace_id"]
+
+                metrics_text = (await http_get(ks.port, "/metrics")).text
+                streamed = sum(
+                    metric_value(metrics_text, "krr_tpu_prom_query_seconds_count", route=route)
+                    for route in ("buffered", "streamed")
+                    if f'route="{route}"' in metrics_text
+                )
+                assert streamed == len(queries)
+                assert metric_value(metrics_text, "krr_tpu_prom_points_total") > 0
+                assert "# TYPE krr_tpu_build_info gauge" in metrics_text
+                assert "krr_tpu_build_info{" in metrics_text
+
+                # A skipped tick (no new window) must not evict the real scan
+                # from the ring.
+                assert not await ks.scheduler.tick()
+                events_after = [
+                    e for e in (await http_get(ks.port, "/debug/trace")).json()["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == "scan"
+                ]
+                assert [e["args"]["trace_id"] for e in events_after] == [root["args"]["trace_id"]]
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
     def test_healthz_goes_stale_when_scans_stop(self, serve_env):
         """A wedged scheduler must trip probes: once the published window
         end falls multiple scan cadences behind the clock, /healthz flips
