@@ -61,9 +61,12 @@ history-journal leg: fsync'd append + compaction throughput and a
 journal-diff render through the formatter registry, carried under
 ``secondary.journal_*``), BENCH_SKIP_OBS, BENCH_OBS_ROWS (default 256),
 BENCH_OBS_SAMPLES (default 4096), BENCH_OBS_RUNS (default 5 — the
-tracing-overhead leg: one identical in-process digest scan with the no-op
+tracing-overhead legs: one identical in-process digest scan with the no-op
 vs a recording tracer, gated at <2% wall overhead and bit-exact results,
-carried under ``secondary.obs_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
+carried under ``secondary.obs_*``; plus the device-observability leg —
+the same ``run_batch`` compute with staged pack/quantile/round sub-spans,
+fencing, and padding gauges vs the inert default, same gates, carried
+under ``secondary.obs_device_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
 BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
 
 ``--smoke``: the same harness at toy scale (tiny fleet, 1 run, e2e legs
@@ -301,6 +304,111 @@ def obs_leg(secondary: dict, check) -> None:
         "obs_bitexact",
         plain_result.model_dump_json() == traced_result.model_dump_json(),
         "tracing changed the recommendations",
+    )
+
+
+def obs_device_leg(secondary: dict, check) -> None:
+    """Device-observability leg (`krr_tpu.obs.device`): the SAME compute —
+    one `SimpleStrategy.run_batch` over a fixed synthetic fleet — run with
+    the inert NULL_DEVICE_OBS and with a recording DeviceObs (staged
+    pack/quantile/round sub-spans, `block_until_ready` fencing, compile
+    attribution, padding gauges). Gates mirror the scan-level obs leg:
+    instrumented compute must stay within 2% wall of plain (10 ms absolute
+    floor at smoke scale) and BIT-exact. Also asserts the device stages
+    actually recorded: stage spans present, padding waste fired. Reported
+    under ``secondary.obs_device_*``."""
+    import numpy as np
+
+    from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+    from krr_tpu.models.objects import K8sObjectData
+    from krr_tpu.models.series import FleetBatch
+    from krr_tpu.obs.device import NULL_DEVICE_OBS, DeviceObs
+    from krr_tpu.obs.metrics import MetricsRegistry
+    from krr_tpu.obs.trace import Tracer
+    from krr_tpu.strategies.simple import SimpleStrategy, SimpleStrategySettings
+
+    rows = int(os.environ.get("BENCH_OBS_ROWS", 256))
+    samples = int(os.environ.get("BENCH_OBS_SAMPLES", 4096))
+    runs = max(2, int(os.environ.get("BENCH_OBS_RUNS", 5)))
+
+    rng = np.random.default_rng(29)
+    alloc = ResourceAllocations(
+        requests={ResourceType.CPU: None, ResourceType.Memory: None},
+        limits={ResourceType.CPU: None, ResourceType.Memory: None},
+    )
+    objects = [
+        K8sObjectData(
+            cluster=None, namespace=f"ns{i % 8}", name=f"w{i}", kind="Deployment",
+            container="main", pods=[f"w{i}-0"], allocations=alloc,
+        )
+        for i in range(rows)
+    ]
+    # Ragged on purpose (varying sample counts) so the padding gauges
+    # measure genuine waste, not a degenerate all-full matrix.
+    histories = {
+        ResourceType.CPU: [
+            {f"w{i}-0": rng.gamma(2.0, 0.05, samples - (i % 7) * (samples // 8))}
+            for i in range(rows)
+        ],
+        ResourceType.Memory: [
+            {f"w{i}-0": rng.uniform(5e7, 4e8, samples - (i % 5) * (samples // 8))}
+            for i in range(rows)
+        ],
+    }
+    batch = FleetBatch.build(objects, histories)
+    strategy = SimpleStrategy(SimpleStrategySettings(use_pallas=False, use_mesh=False))
+    strategy.run_batch(batch)  # warmup: jit compile out of the timing
+
+    tracer = registry = None
+    plain_times, traced_times = [], []
+    plain_result = traced_result = None
+    for _ in range(runs):  # interleaved so machine-load drift hits both modes
+        strategy.obs = NULL_DEVICE_OBS
+        start = time.perf_counter()
+        plain_result = strategy.run_batch(batch)
+        plain_times.append(time.perf_counter() - start)
+        tracer, registry = Tracer(ring_scans=4), MetricsRegistry()
+        strategy.obs = DeviceObs(tracer, registry)
+        start = time.perf_counter()
+        with tracer.span("compute", rows=rows):
+            traced_result = strategy.run_batch(batch)
+        traced_times.append(time.perf_counter() - start)
+    strategy.obs = NULL_DEVICE_OBS
+
+    plain_best, traced_best = min(plain_times), min(traced_times)
+    overhead = traced_best - plain_best
+    overhead_pct = 100.0 * overhead / plain_best
+    stages = [s.name for s in tracer.traces()[-1] if s.name != "compute"]
+    secondary["obs_device_plain_seconds"] = round(plain_best, 4)
+    secondary["obs_device_traced_seconds"] = round(traced_best, 4)
+    secondary["obs_device_overhead_pct"] = round(max(0.0, overhead_pct), 2)
+    secondary["obs_device_stage_spans"] = len(stages)
+    print(
+        f"bench: obs-device overhead plain {plain_best:.4f}s vs traced {traced_best:.4f}s "
+        f"({max(0.0, overhead_pct):.2f}% over {runs} interleaved runs, "
+        f"stages {sorted(set(stages))})",
+        file=sys.stderr,
+    )
+    check(
+        "obs_device_overhead<2%",
+        overhead <= max(0.02 * plain_best, 0.010),
+        f"traced {traced_best:.4f}s vs plain {plain_best:.4f}s (+{overhead_pct:.2f}%)",
+    )
+    check(
+        "obs_device_bitexact",
+        repr(plain_result) == repr(traced_result),
+        "device instrumentation changed the recommendations",
+    )
+    check(
+        "obs_device_stages",
+        {"pack", "quantile", "round"} <= set(stages),
+        f"missing compute sub-spans: {sorted(set(stages))}",
+    )
+    waste = registry.value("krr_tpu_pad_waste_pct", resource="cpu")
+    check(
+        "obs_device_pad_waste",
+        waste is not None and 0.0 < waste < 100.0,
+        f"pad waste gauge: {waste}",
     )
 
 
@@ -554,10 +662,13 @@ def main() -> None:
         journal_leg(secondary)
 
     if not os.environ.get("BENCH_SKIP_OBS"):
-        # Tracing-overhead gate (`krr_tpu.obs`): a parity-style failure here
+        # Tracing-overhead gates (`krr_tpu.obs`): a parity-style failure here
         # (>2% traced overhead, or traced results not bit-exact) exits
-        # nonzero like any other parity break.
+        # nonzero like any other parity break. The scan-level leg covers the
+        # whole Runner pipeline; the device leg isolates the staged compute
+        # sub-spans + fencing added by `krr_tpu.obs.device`.
         obs_leg(secondary, check)
+        obs_device_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

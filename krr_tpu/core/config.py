@@ -90,6 +90,38 @@ class Config(pd.BaseModel):
     #: Log a warning for any Prometheus range query slower than this many
     #: seconds (retries included); 0 disables the slow-query log.
     prometheus_slow_query_seconds: float = pd.Field(10.0, ge=0)
+    #: Write a one-shot SLO evaluation (`krr_tpu.obs.health` — the same
+    #: objectives `krr-tpu serve` exposes on GET /statusz, evaluated once
+    #: over this scan's registry) as JSON to this file at exit.
+    statusz_path: Optional[str] = None
+
+    # SLO engine (`krr_tpu.obs.health`) — serve evaluates per scheduler
+    # tick; one-shot scans evaluate once for --statusz.
+    #: Error budget for the scan-failure objective: the fraction of scans
+    #: allowed to abort before the budget burns.
+    slo_scan_failure_budget: float = pd.Field(0.05, gt=0, le=1)
+    #: Error budget for the fetch failed-row objective: the fraction of
+    #: object fetches allowed to fail terminally (rows rendered UNKNOWN).
+    slo_fetch_failure_budget: float = pd.Field(0.05, gt=0, le=1)
+    #: Scan-latency objective limit: a scan's wall must fit this many
+    #: seconds. 0 = auto: the serve scan cadence (a scan that can't fit its
+    #: own interval is falling behind by construction).
+    slo_scan_latency_seconds: float = pd.Field(0.0, ge=0)
+    #: Freshness objective limit: the published window may age this many
+    #: seconds before evaluations count as bad. 0 = auto: three scan
+    #: cadences (aligned with /healthz's stale threshold).
+    slo_freshness_seconds: float = pd.Field(0.0, ge=0)
+    #: Burn-rate windows: the FAST window makes detection quick, the SLOW
+    #: window keeps a brief blip from alerting — an alert fires only while
+    #: both windows burn past their thresholds.
+    slo_fast_window_seconds: float = pd.Field(300.0, gt=0)
+    slo_slow_window_seconds: float = pd.Field(3600.0, gt=0)
+    #: Burn-rate thresholds (windowed bad ratio ÷ budget; 1.0 = consuming
+    #: exactly the budget). With the default 5% budgets a full outage burns
+    #: at 20×, so 10/5 fires within a few ticks and resolves at
+    #: fast-window speed.
+    slo_fast_burn: float = pd.Field(10.0, gt=0)
+    slo_slow_burn: float = pd.Field(5.0, gt=0)
 
     # Kubernetes discovery
     #: One pods request per namespace with client-side selector matching

@@ -163,20 +163,47 @@ class ScanSession:
         #: one before any scan); the metrics registry is ALWAYS real — it's
         #: just labeled dicts — and shared with the Prometheus loaders, so
         #: per-query telemetry lands in one place for CLI, serve, and bench.
-        self.tracer: NullTracer = tracer if tracer is not None else config.create_tracer()
+        self.tracer = tracer if tracer is not None else config.create_tracer()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Before any strategy can trace/compile: point XLA's persistent
         # compilation cache at the configured directory so fresh processes
-        # skip the cold-start compile (utils/compile_cache.py).
+        # skip the cold-start compile (utils/compile_cache.py), and route
+        # jax's compile/cache monitoring events into the shared registry
+        # (compile-vs-execute split, krr_tpu_compile_cache_* counters).
+        from krr_tpu.obs.device import install_compile_hooks
         from krr_tpu.utils.compile_cache import enable_compilation_cache
 
         enable_compilation_cache(config.jax_compilation_cache_dir)
+        install_compile_hooks(self.metrics)
         self.strategy = config.create_strategy()
+        self._wire_obs()
         self._inventory = inventory
         self._history_factory = history_factory
         self._history_sources: dict[Optional[str], Union[HistorySource, Exception]] = {}
 
     # ------------------------------------------------------------- plumbing
+    @property
+    def tracer(self) -> NullTracer:
+        return self._tracer
+
+    @tracer.setter
+    def tracer(self, value: NullTracer) -> None:
+        # Swapping the tracer mid-lifecycle (serve installs its recording
+        # ring after session construction) must re-wire the strategy's
+        # device instrumentation, or compute sub-spans would keep feeding
+        # the old tracer.
+        self._tracer = value
+        if getattr(self, "strategy", None) is not None:
+            self._wire_obs()
+
+    def _wire_obs(self) -> None:
+        """Give the strategy its device-compute instrumentation
+        (`krr_tpu.obs.device`): stage spans into THIS session's tracer,
+        padding/memory gauges into its registry."""
+        from krr_tpu.obs.device import DeviceObs
+
+        self.strategy.obs = DeviceObs(self._tracer, self.metrics)
+
     def get_inventory(self) -> InventorySource:
         if self._inventory is None:
             from krr_tpu.integrations.kubernetes import KubernetesLoader
@@ -829,6 +856,25 @@ class Runner:
             objects=len(objects), failed_rows=failed_rows, fetch_retries=retries
         )
         self.metrics.set("krr_tpu_scan_failed_rows", failed_rows)
+        # Cumulative twins of the per-scan gauge: the numerator/denominator
+        # the SLO engine's fetch failed-row objective reads.
+        if objects:
+            self.metrics.inc("krr_tpu_fetch_rows_total", len(objects))
+        if failed_rows:
+            self.metrics.inc("krr_tpu_fetch_failed_rows_total", failed_rows)
+        # The scan-level series the serve scheduler fires per tick, fired
+        # here for the one-shot scan too — a --statusz evaluation must see
+        # THIS scan's completion, legs, and window end, not 0/0 vacuous
+        # health (failures land in Runner.run's except).
+        self.metrics.inc("krr_tpu_scans_total", kind="cli")
+        for phase in ("discover", "fetch", "compute"):
+            self.metrics.set(
+                "krr_tpu_scan_duration_seconds", self.stats[f"{phase}_seconds"], phase=phase
+            )
+        self.metrics.set(
+            "krr_tpu_last_scan_timestamp_seconds",
+            self.config.scan_end_timestamp or time.time(),
+        )
         return Result(scans=scans)
 
     def _process_result(self, result: Result) -> None:
@@ -838,6 +884,13 @@ class Runner:
 
     async def run(self) -> Result:
         self._greet()
-        result = await self._collect_result()
+        try:
+            result = await self._collect_result()
+        except Exception:
+            # The one-shot twin of the scheduler loop's failure accounting:
+            # an aborted scan must burn the scan-failure SLO budget a
+            # --statusz evaluation (which runs in the CLI's finally) reads.
+            self.metrics.inc("krr_tpu_scan_failures_total")
+            raise
         self._process_result(result)
         return result

@@ -41,6 +41,7 @@ TPU_BACKEND_FIELDS = {
 PANEL_ORDER = (
     "General Settings",
     "Server Settings",
+    "SLO Settings",
     "Logging Settings",
     "Strategy Settings",
     "TPU Backend Settings",
@@ -258,6 +259,15 @@ def _common_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--statusz", "statusz_path"],
+            default=None,
+            help=(
+                "Write a one-shot SLO evaluation (the objectives serve exposes "
+                "on GET /statusz — scan failures, fetch failed rows, latency — "
+                "evaluated once over this scan) as JSON to this file at exit."
+            ),
+        ),
+        PanelOption(
             ["--strict"],
             is_flag=True,
             default=False,
@@ -435,6 +445,86 @@ def _server_options() -> list[click.Option]:
     ]
 
 
+def _slo_options() -> list[click.Option]:
+    """The SLO engine's knobs (`krr_tpu.obs.health`) — on serve (evaluated
+    per scheduler tick) AND on one-shot scan commands (the ``--statusz``
+    single evaluation reads the same fields)."""
+    from krr_tpu.core.config import Config
+
+    defaults = {name: Config.model_fields[name].default for name in (
+        "slo_scan_failure_budget", "slo_fetch_failure_budget",
+        "slo_scan_latency_seconds", "slo_freshness_seconds",
+        "slo_fast_window_seconds", "slo_slow_window_seconds",
+        "slo_fast_burn", "slo_slow_burn",
+    )}
+    return [
+        PanelOption(
+            ["--slo-scan-failure-budget", "slo_scan_failure_budget"],
+            type=float,
+            default=defaults["slo_scan_failure_budget"],
+            show_default=True,
+            panel="SLO Settings",
+            help="SLO error budget: the fraction of scans allowed to abort.",
+        ),
+        PanelOption(
+            ["--slo-fetch-failure-budget", "slo_fetch_failure_budget"],
+            type=float,
+            default=defaults["slo_fetch_failure_budget"],
+            show_default=True,
+            panel="SLO Settings",
+            help="SLO error budget: the fraction of object fetches allowed to fail terminally.",
+        ),
+        PanelOption(
+            ["--slo-scan-latency", "slo_scan_latency_seconds"],
+            type=float,
+            default=defaults["slo_scan_latency_seconds"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Scan-latency SLO limit in seconds (0 = auto: one scan cadence).",
+        ),
+        PanelOption(
+            ["--slo-freshness", "slo_freshness_seconds"],
+            type=float,
+            default=defaults["slo_freshness_seconds"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Freshness SLO limit in seconds for the published window's age (0 = auto: three scan cadences).",
+        ),
+        PanelOption(
+            ["--slo-fast-window", "slo_fast_window_seconds"],
+            type=float,
+            default=defaults["slo_fast_window_seconds"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Fast burn-rate window in seconds (detection speed).",
+        ),
+        PanelOption(
+            ["--slo-slow-window", "slo_slow_window_seconds"],
+            type=float,
+            default=defaults["slo_slow_window_seconds"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Slow burn-rate window in seconds (blip damping).",
+        ),
+        PanelOption(
+            ["--slo-fast-burn", "slo_fast_burn"],
+            type=float,
+            default=defaults["slo_fast_burn"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Fast-window burn-rate threshold (windowed bad ratio ÷ budget).",
+        ),
+        PanelOption(
+            ["--slo-slow-burn", "slo_slow_burn"],
+            type=float,
+            default=defaults["slo_slow_burn"],
+            show_default=True,
+            panel="SLO Settings",
+            help="Slow-window burn-rate threshold — alerts fire only while BOTH windows burn past their thresholds.",
+        ),
+    ]
+
+
 def _make_serve_command(strategy_name: str, strategy_type: Any) -> click.Command:
     """``krr-tpu serve``: the long-running service (`krr_tpu.server`).
 
@@ -472,12 +562,14 @@ def _make_serve_command(strategy_name: str, strategy_type: Any) -> click.Command
         asyncio.run(run_server(config))
 
     # The serve command takes the scan commands' common options MINUS the
-    # one-shot-only formatter flag (responses pick a format per request).
-    common = [o for o in _common_options() if o.name != "format"]
+    # one-shot-only flags: the formatter (responses pick a format per
+    # request) and --statusz (serve exposes the live GET /statusz route;
+    # nothing would read a statusz_path at exit).
+    common = [o for o in _common_options() if o.name not in ("format", "statusz_path")]
     return PanelCommand(
         "serve",
         callback=callback,
-        params=common + _server_options() + _strategy_options(strategy_type),
+        params=common + _server_options() + _slo_options() + _strategy_options(strategy_type),
         help=(
             "Run krr-tpu as a long-running HTTP service: a background scheduler "
             "keeps per-container digests fresh with incremental delta scans, and "
@@ -644,17 +736,36 @@ def _make_diff_command(strategy_name: str, strategy_type: Any) -> click.Command:
 
 
 def _finish_observability(config: Any, session: Any) -> None:
-    """The ``--trace`` / ``--metrics-dump`` exit hooks of a one-shot scan:
-    dump the session tracer's ring as Chrome trace JSON, and/or the shared
-    metrics registry as a Prometheus exposition snapshot."""
+    """The ``--trace`` / ``--metrics-dump`` / ``--statusz`` exit hooks of a
+    one-shot scan: dump the session tracer's ring as Chrome trace JSON, the
+    shared metrics registry as a Prometheus exposition snapshot (process
+    self-metrics refreshed), and/or a one-shot SLO evaluation."""
     if config.trace_path:
         from krr_tpu.obs.trace import write_chrome_trace
 
         write_chrome_trace(session.tracer, config.trace_path)
+    if config.statusz_path:
+        import json
+
+        from krr_tpu.obs.health import engine_from_config
+
+        # One evaluation whose window is the whole scan (the engine seeds a
+        # zero baseline at construction): cumulative failure/fetch ratios
+        # plus the scan-latency check, same JSON shape as GET /statusz.
+        # Evaluated BEFORE the metrics dump so the krr_tpu_slo_* series it
+        # fires land in the same exposition — the two artifacts must agree.
+        engine = engine_from_config(
+            session.metrics, config, one_shot=True, logger=session.logger
+        )
+        engine.evaluate()
+        with open(config.statusz_path, "w") as f:
+            json.dump(engine.status(), f, indent=2)
+            f.write("\n")
     if config.metrics_dump_path:
-        from krr_tpu.obs.metrics import record_build_info
+        from krr_tpu.obs.metrics import record_build_info, refresh_process_metrics
 
         record_build_info(session.metrics)
+        refresh_process_metrics(session.metrics)
         with open(config.metrics_dump_path, "w") as f:
             f.write(session.metrics.render())
 
@@ -685,6 +796,17 @@ def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Comm
                 f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
             )
             raise click.UsageError(f"Invalid settings — {details}") from e
+        from krr_tpu.obs.dump import install_signal_dump
+
+        # kill -USR2 <pid> mid-scan dumps the trace ring + metrics snapshot
+        # (long one-shot scans get the same debug hook as serve).
+        install_signal_dump(
+            runner.session.tracer,
+            runner.session.metrics,
+            trace_target=config.trace_path,
+            metrics_target=config.metrics_dump_path,
+            logger=runner.logger,
+        )
         try:
             asyncio.run(runner.run())
         finally:
@@ -698,7 +820,7 @@ def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Comm
     return PanelCommand(
         strategy_name,
         callback=callback,
-        params=_common_options() + _strategy_options(strategy_type),
+        params=_common_options() + _slo_options() + _strategy_options(strategy_type),
         help=f"Run krr-tpu using the `{strategy_name}` strategy",
     )
 

@@ -17,19 +17,38 @@ Prometheus text-format registry.
   default on every hot path.
 * `metrics` — the Prometheus registry (promoted from
   ``krr_tpu.server.metrics``, which re-exports for back-compat) so CLI
-  scans, serve, and bench record into the same declarations.
+  scans, serve, and bench record into the same declarations; native
+  histograms plus process self-metrics refreshed at scrape/dump time.
+* `device`  — device-level compute observability: staged ``compute``
+  sub-spans with dispatch fencing, compile-vs-execute attribution and
+  persistent-compile-cache hit/miss counters via ``jax.monitoring``,
+  padding-efficiency gauges, device memory watermarks.
+* `health`  — the SLO engine: declarative objectives over rolling windows
+  fed by the registry, fast/slow burn-rate alerts, ``GET /statusz`` and
+  the ``/healthz`` ``degraded`` verdict ride on it.
+* `dump`    — SIGUSR2 on-demand debug dumps (trace ring + metrics
+  snapshot to timestamped files).
 """
 
-from krr_tpu.obs.metrics import MetricsRegistry, record_build_info
+from krr_tpu.obs.device import NULL_DEVICE_OBS, DeviceObs, install_compile_hooks
+from krr_tpu.obs.health import Objective, SloEngine, default_objectives
+from krr_tpu.obs.metrics import MetricsRegistry, record_build_info, refresh_process_metrics
 from krr_tpu.obs.trace import NULL_TRACER, NullTracer, Span, Tracer, current_ids, write_chrome_trace
 
 __all__ = [
+    "DeviceObs",
     "MetricsRegistry",
+    "NULL_DEVICE_OBS",
     "NULL_TRACER",
     "NullTracer",
+    "Objective",
+    "SloEngine",
     "Span",
     "Tracer",
     "current_ids",
+    "default_objectives",
+    "install_compile_hooks",
     "record_build_info",
+    "refresh_process_metrics",
     "write_chrome_trace",
 ]

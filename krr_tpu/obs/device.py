@@ -1,0 +1,245 @@
+"""Device-level compute observability: staged spans, compile accounting,
+padding efficiency, and memory watermarks.
+
+PR 4's telemetry stops at the host: a scan trace shows ``compute`` as one
+opaque span, so "where does TPU time go" — compile vs execute, padding
+waste, cache misses — was unanswerable. This module closes that gap with
+four instruments, all wired through :class:`DeviceObs` (one per scan
+session, injected into the strategy as ``strategy.obs``):
+
+* **Stage spans** — :meth:`DeviceObs.stage` opens a child span of the
+  active ``compute`` span for each compute leg (``pack`` → ``digest``/
+  ``fold`` → ``quantile`` → ``round``). Spans measure WALL time, and JAX
+  dispatch is asynchronous — a stage that merely enqueues device work would
+  read as free while the next stage pays for it — so call sites fence
+  results through :meth:`DeviceObs.fence` (``jax.block_until_ready``)
+  before the span closes. Fencing serializes the dispatch pipeline, so it
+  (like every instrument here that could perturb the hot path) only runs
+  when the tracer is recording: with :data:`NULL_TRACER` a stage is the
+  shared no-op context and ``fence`` is the identity.
+
+* **Compile vs execute split** — ``jax.monitoring`` fires duration events
+  for every jitted entry point's trace/lower/backend-compile phases and
+  counting events for persistent-compilation-cache hits/misses
+  (`krr_tpu.utils.compile_cache`). :func:`install_compile_hooks` registers
+  one process-wide listener pair that (a) feeds the shared registry
+  (``krr_tpu_compile_seconds{phase=…}``,
+  ``krr_tpu_compile_cache_{hits,misses}_total``) and (b) advances a
+  process-global compile clock. A recording stage reads the clock at
+  enter/exit: a nonzero delta means this stage's wall includes a first-call
+  compile, and the span gains ``compile_seconds`` / ``execute_seconds``
+  attributes splitting the two. (The clock is process-global, so a
+  concurrent compile on another thread would be attributed to whichever
+  stage is open — scans serialize their device work, so in practice the
+  open stage is the compiling one.)
+
+* **Padding efficiency** — the packed ``[rows × capacity]`` matrix
+  (`krr_tpu.ops.packing`) is mostly padding for ragged fleets;
+  :meth:`DeviceObs.record_padding` turns a packed batch into
+  ``krr_tpu_pad_waste_pct{resource=…}`` and
+  ``krr_tpu_packed_elements{resource=…,kind=real|padding}`` gauges (a
+  partition: the two kinds sum to the rectangular matrix). Cheap (one
+  counts-sum per batch), so it fires on every mode, tracer or not.
+
+* **Memory watermarks** — :meth:`DeviceObs.record_device_memory` snapshots
+  each local device's ``memory_stats()`` (bytes in use / peak / limit)
+  into ``krr_tpu_device_memory_bytes``; backends that report nothing (CPU)
+  are a graceful no-op.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from krr_tpu.obs.metrics import MetricsRegistry
+from krr_tpu.obs.trace import NULL_TRACER, NullTracer
+
+#: jax.monitoring counting events → our counters.
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "krr_tpu_compile_cache_hits_total",
+    "/jax/compilation_cache/cache_misses": "krr_tpu_compile_cache_misses_total",
+}
+
+#: jax.monitoring duration events → compile phases. Together these three
+#: cover a jitted entry point's whole first-call cost; a persistent-cache
+#: hit still pays trace+lower but skips backend_compile.
+_DURATION_PHASES = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+}
+
+_hook_lock = threading.Lock()
+_hooks_installed = False
+#: The registry compile events currently land in. jax.monitoring listeners
+#: cannot be unregistered, so ONE listener pair forwards to a swappable
+#: target — last installer wins (each scan session installs its own
+#: registry; in-process tests get deterministic counts the same way).
+_target: Optional[MetricsRegistry] = None
+#: Monotone total of compile seconds this process has spent — the clock
+#: stage spans diff to attribute compile time. Guarded by the GIL (+= on a
+#: float is not atomic across threads, but jax serializes compiles per
+#: program and the worst case is a lost fraction of one phase).
+_compile_seconds = 0.0
+
+
+def compile_seconds_total() -> float:
+    """Process-wide compile seconds so far (see the module docstring)."""
+    return _compile_seconds
+
+
+def _on_event(event: str, **_kwargs: Any) -> None:
+    name = _EVENT_COUNTERS.get(event)
+    target = _target
+    if name is not None and target is not None:
+        target.inc(name)
+
+
+def _on_duration(event: str, duration: float, **_kwargs: Any) -> None:
+    global _compile_seconds
+    phase = _DURATION_PHASES.get(event)
+    if phase is None:
+        return
+    _compile_seconds += duration
+    target = _target
+    if target is not None:
+        target.observe("krr_tpu_compile_seconds", duration, phase=phase)
+
+
+def install_compile_hooks(metrics: MetricsRegistry) -> None:
+    """Route jax compile/cache monitoring events into ``metrics`` (and the
+    process compile clock). Idempotent; safe when jax is absent or its
+    monitoring API changes — compile telemetry is an optimization aid,
+    never a scan-failure reason."""
+    global _hooks_installed, _target
+    _target = metrics
+    with _hook_lock:
+        if _hooks_installed:
+            return
+        try:
+            from jax import monitoring
+        except Exception:
+            return
+        try:
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return
+        _hooks_installed = True
+
+
+class _Stage:
+    """A recording compute stage: the underlying tracer span plus the
+    compile-clock bracket that splits its wall into compile vs execute."""
+
+    __slots__ = ("_ctx", "_t0", "_compile0")
+
+    def __init__(self, ctx) -> None:
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._compile0 = compile_seconds_total()
+        self._t0 = time.perf_counter()
+        return self._ctx.__enter__()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        wall = time.perf_counter() - self._t0
+        compiled = compile_seconds_total() - self._compile0
+        if compiled > 0.0:
+            self._ctx.span.set(
+                compile_seconds=round(compiled, 6),
+                execute_seconds=round(max(0.0, wall - compiled), 6),
+            )
+        return self._ctx.__exit__(exc_type, exc, tb)
+
+
+class DeviceObs:
+    """Per-session device-compute instrumentation (see module docstring).
+
+    Always constructed with a REAL metrics registry (metrics are labeled
+    dicts — cheap) but usually the no-op tracer: stage spans and fencing
+    only activate when the tracer records, so the hot path stays untouched
+    on the default CLI scan."""
+
+    __slots__ = ("tracer", "metrics")
+
+    def __init__(
+        self, tracer: NullTracer = NULL_TRACER, metrics: Optional[MetricsRegistry] = None
+    ) -> None:
+        self.tracer = tracer
+        self.metrics = metrics
+
+    @property
+    def enabled(self) -> bool:
+        return self.tracer.enabled
+
+    def stage(self, name: str, **attributes: Any):
+        """A compute-stage span (child of the active ``compute`` span via
+        contextvar propagation — including across ``asyncio.to_thread``).
+        No-op (the shared null context, no allocation) when not recording."""
+        if not self.tracer.enabled:
+            return self.tracer.span(name, **attributes)
+        return _Stage(self.tracer.span(name, **attributes))
+
+    def fence(self, value):
+        """``jax.block_until_ready`` when recording, identity otherwise —
+        the dispatch fence that makes stage walls mean device time without
+        serializing the pipeline on untraced scans."""
+        if not self.tracer.enabled:
+            return value
+        try:
+            import jax
+
+            return jax.block_until_ready(value)
+        except Exception:
+            return value
+
+    def record_padding(self, resource: str, packed) -> None:
+        """Padding-efficiency gauges from one packed batch
+        (`krr_tpu.ops.packing.padding_stats`)."""
+        if self.metrics is None:
+            return
+        from krr_tpu.ops.packing import padding_stats
+
+        real, total = padding_stats(packed.counts, packed.capacity)
+        # A true partition: real + padding = the rectangular matrix the
+        # device streams, so the two kinds sum meaningfully on a dashboard.
+        self.metrics.set("krr_tpu_packed_elements", real, resource=resource, kind="real")
+        self.metrics.set(
+            "krr_tpu_packed_elements", total - real, resource=resource, kind="padding"
+        )
+        waste = 100.0 * (total - real) / total if total else 0.0
+        self.metrics.set("krr_tpu_pad_waste_pct", waste, resource=resource)
+
+    def record_device_memory(self) -> None:
+        """Snapshot device memory watermarks where the backend reports them
+        (``Device.memory_stats()``; CPU returns nothing — graceful no-op)."""
+        if self.metrics is None:
+            return
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return
+        for device in devices:
+            try:
+                stats = device.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            label = f"{device.platform}:{device.id}"
+            for kind in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                value = stats.get(kind)
+                if value is not None:
+                    self.metrics.set(
+                        "krr_tpu_device_memory_bytes", value, device=label, kind=kind
+                    )
+
+
+#: The inert default every strategy carries until a scan session wires in
+#: its own (`krr_tpu.core.runner.ScanSession`): null tracer, no registry.
+NULL_DEVICE_OBS = DeviceObs()

@@ -1,0 +1,112 @@
+"""On-demand debug dumps: SIGUSR2 writes the trace ring + metrics snapshot.
+
+A wedged serve process (or a long one-shot scan that is "taking forever")
+usually gets killed before anyone captures what it was doing. SIGUSR2
+turns that moment into artifacts instead: the handler writes the tracer's
+completed-scan ring as Chrome trace-event JSON and the shared registry as
+a Prometheus exposition snapshot (process self-metrics and build info
+refreshed) to TIMESTAMPED files — next to the configured ``--trace`` /
+``--metrics-dump`` targets when set, the working directory otherwise — and
+logs one structured line naming both paths, so the operator's ``kill
+-USR2 <pid>`` shows up in the log stream with everything needed to open
+the trace.
+
+Two installation flavors, one per execution mode: serve installs through
+the event loop (``loop.add_signal_handler`` — the handler runs as a normal
+callback), one-shot CLI scans through ``signal.signal`` (the handler runs
+in the main thread between bytecodes; it only does Python-level file IO,
+which is safe there). Platforms without SIGUSR2 are a no-op.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import signal
+import time
+from typing import Optional
+
+from krr_tpu.obs.metrics import MetricsRegistry, record_build_info, refresh_process_metrics
+from krr_tpu.obs.trace import NullTracer, write_chrome_trace
+
+#: Per-process dump sequence — two dumps inside one second must not
+#: overwrite each other.
+_SEQUENCE = itertools.count(1)
+
+
+def _dump_path(target: Optional[str], stem: str, stamp: str, suffix: str) -> str:
+    """``<target>.<stamp>-<n><suffix>`` next to the configured target, or
+    ``<stem>.<stamp>-<n><suffix>`` in the working directory without one."""
+    n = next(_SEQUENCE)
+    if target:
+        return os.path.join(
+            os.path.dirname(os.path.abspath(target)),
+            f"{os.path.basename(target)}.{stamp}-{n}{suffix}",
+        )
+    return f"{stem}.{stamp}-{n}{suffix}"
+
+
+def debug_dump(
+    tracer: NullTracer,
+    metrics: MetricsRegistry,
+    *,
+    trace_target: Optional[str] = None,
+    metrics_target: Optional[str] = None,
+    logger=None,
+) -> tuple[str, str]:
+    """Write the trace ring + a metrics exposition snapshot; returns the two
+    paths. Never raises past logging — a debug aid must not take down the
+    process it is inspecting."""
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    trace_path = _dump_path(trace_target, "krr-tpu-trace", stamp, ".json")
+    metrics_path = _dump_path(metrics_target, "krr-tpu-metrics", stamp, ".prom")
+    try:
+        write_chrome_trace(tracer, trace_path)
+        refresh_process_metrics(metrics)
+        record_build_info(metrics)
+        metrics.inc("krr_tpu_debug_dumps_total")
+        with open(metrics_path, "w") as f:
+            f.write(metrics.render())
+    except Exception:
+        if logger is not None:
+            logger.warning(f"debug dump failed (trace={trace_path} metrics={metrics_path})")
+            logger.debug_exception()
+        return trace_path, metrics_path
+    if logger is not None:
+        logger.info(f"debug dump written: trace={trace_path} metrics={metrics_path}")
+    return trace_path, metrics_path
+
+
+def install_signal_dump(
+    tracer: NullTracer,
+    metrics: MetricsRegistry,
+    *,
+    trace_target: Optional[str] = None,
+    metrics_target: Optional[str] = None,
+    logger=None,
+    loop=None,
+) -> bool:
+    """Install the SIGUSR2 handler. With ``loop`` (serve) it registers on
+    the event loop; without (one-shot scans) through ``signal.signal``.
+    Returns whether a handler was installed (False off-unix)."""
+    if not hasattr(signal, "SIGUSR2"):
+        return False
+
+    def dump(*_args) -> None:
+        debug_dump(
+            tracer,
+            metrics,
+            trace_target=trace_target,
+            metrics_target=metrics_target,
+            logger=logger,
+        )
+
+    try:
+        if loop is not None:
+            loop.add_signal_handler(signal.SIGUSR2, dump)
+        else:
+            signal.signal(signal.SIGUSR2, dump)
+    except (NotImplementedError, ValueError, OSError):
+        # Non-unix event loops / non-main threads: a debug hook is optional.
+        return False
+    return True

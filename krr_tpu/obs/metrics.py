@@ -6,24 +6,41 @@ serve process exposes its registry on ``GET /metrics``, a one-shot CLI scan
 snapshots its own to ``--metrics-dump FILE``, and ``bench.py``'s obs leg
 instruments its synthetic scans the same way. The image deliberately
 carries no prometheus_client, and the exposition format (version 0.0.4) is
-simple enough that a registry is ~100 lines: counters, gauges, and
-summaries (sum + count), with labels. Values live in plain dicts mutated
+simple enough that a registry is ~150 lines: counters, gauges, summaries
+(sum + count), and native histograms (cumulative ``le`` buckets +
+``_sum``/``_count``), with labels. Values live in plain dicts mutated
 from the event loop and worker threads — each mutation is a single dict
 item assignment (atomic under the GIL), and the render is a snapshot-free
 pass whose worst case is a metrics line reflecting a half-finished scan,
 which Prometheus scraping tolerates by design.
+
+Latency metrics are native histograms (one shared bucket ladder,
+:data:`DEFAULT_SECONDS_BUCKETS`): the SLO engine (`krr_tpu.obs.health`)
+and a scraping Prometheus then derive quantiles/ratios from the SAME
+cumulative-bucket representation instead of two divergent summaries. The
+summary kind is kept for back-compat with third-party declarations.
 """
 
 from __future__ import annotations
 
+import bisect
+import gc
+import os
+import time
 from typing import Iterable, Optional
 
-#: (name, kind, help) for every metric krr-tpu emits — declared up front so
-#: an exposition carries complete HELP/TYPE headers from the first scrape,
-#: not only for series that happen to have fired already.
-SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
+#: The classic Prometheus seconds ladder — shared by every latency
+#: histogram so recording rules and the SLO engine see one bucket scheme.
+DEFAULT_SECONDS_BUCKETS: tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: (name, kind, help[, buckets]) for every metric krr-tpu emits — declared up
+#: front so an exposition carries complete HELP/TYPE headers from the first
+#: scrape, not only for series that happen to have fired already.
+SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_build_info", "gauge", "Constant 1 labeled with the running build: krr-tpu version, jax version, device backend."),
-    ("krr_tpu_scans_total", "counter", "Completed scans by kind (full|delta)."),
+    ("krr_tpu_scans_total", "counter", "Completed scans by kind (full|delta for serve ticks, cli for one-shot scans)."),
     ("krr_tpu_scans_skipped_total", "counter", "Scheduler ticks skipped because no new window had elapsed."),
     ("krr_tpu_scan_failures_total", "counter", "Scans aborted by an unexpected error."),
     ("krr_tpu_discovery_failures_total", "counter", "Discoveries that returned no objects while the store held rows — treated as transient inventory failures (no compaction)."),
@@ -32,6 +49,8 @@ SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
     ("krr_tpu_scan_overlap_pct", "gauge", "Fetch/fold overlap of the last scan's streamed pipeline as a percentage of the shorter stage (100 = fully hidden)."),
     ("krr_tpu_scan_window_seconds", "gauge", "Width of the last scan's fetched time window."),
     ("krr_tpu_scan_failed_rows", "gauge", "Object fetches that failed terminally in the last scan (rows rendered UNKNOWN)."),
+    ("krr_tpu_fetch_rows_total", "counter", "Cumulative object fetches attempted by completed scans (the denominator of the fetch failed-row SLO)."),
+    ("krr_tpu_fetch_failed_rows_total", "counter", "Cumulative object fetches that failed terminally (the numerator of the fetch failed-row SLO)."),
     ("krr_tpu_fetch_window_seconds_total", "counter", "Cumulative fetched window seconds by kind — a delta-scan server grows this by the delta width per tick, a re-fetching one by the full history width."),
     ("krr_tpu_backfilled_objects_total", "counter", "Late-discovered workloads given a full-window backfill fetch."),
     ("krr_tpu_last_scan_timestamp_seconds", "gauge", "Unix time of the last published scan's window end."),
@@ -45,11 +64,29 @@ SERVER_METRICS: tuple[tuple[str, str, str], ...] = (
     ("krr_tpu_journal_bytes", "gauge", "Resident bytes of the history journal's record array."),
     ("krr_tpu_journal_span_seconds", "gauge", "Time between the journal's oldest and newest records (retention coverage)."),
     ("krr_tpu_journal_compacted_records_total", "counter", "Journal records dropped by retention compaction."),
-    ("krr_tpu_prom_query_seconds", "summary", "Prometheus range-query latency by data plane (buffered|streamed), retries included."),
+    ("krr_tpu_prom_query_seconds", "histogram", "Prometheus range-query latency by data plane (buffered|streamed), retries included.", DEFAULT_SECONDS_BUCKETS),
     ("krr_tpu_prom_query_retries_total", "counter", "Prometheus range-query retry attempts beyond each query's first try."),
     ("krr_tpu_prom_points_total", "counter", "Evaluation-grid points covered by successful Prometheus range queries."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
-    ("krr_tpu_http_request_seconds", "summary", "HTTP request latency by route."),
+    ("krr_tpu_http_request_seconds", "histogram", "HTTP request latency by route.", DEFAULT_SECONDS_BUCKETS),
+    # Device-level compute observability (`krr_tpu.obs.device`).
+    ("krr_tpu_compile_cache_hits_total", "counter", "Jitted programs served from the persistent XLA compilation cache instead of recompiling."),
+    ("krr_tpu_compile_cache_misses_total", "counter", "Jitted programs the persistent XLA compilation cache had to compile and store."),
+    ("krr_tpu_compile_seconds", "summary", "JAX compile time by phase (trace|lower|backend_compile) — fires on first-call compiles; cache hits skip the backend_compile leg."),
+    ("krr_tpu_pad_waste_pct", "gauge", "Padding waste of the last packed batch by resource: percent of the rectangular [rows x capacity] matrix that is padding, not real samples."),
+    ("krr_tpu_packed_elements", "gauge", "Elements of the last packed batch by resource and kind — a partition: real samples plus padding sum to the rectangular [rows x capacity] matrix."),
+    ("krr_tpu_device_memory_bytes", "gauge", "Device memory watermarks by device and kind (bytes_in_use|peak_bytes_in_use|bytes_limit) where the backend reports them (no-op on CPU)."),
+    # SLO engine (`krr_tpu.obs.health`).
+    ("krr_tpu_slo_burn_rate", "gauge", "Error-budget burn rate by objective and window (fast|slow): windowed bad ratio divided by the objective's budget; 1.0 consumes exactly the budget over the window."),
+    ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),
+    ("krr_tpu_slo_alert_firing", "gauge", "1 while the objective's fast AND slow burn rates exceed their thresholds, else 0."),
+    ("krr_tpu_slo_alert_transitions_total", "counter", "SLO alert state transitions by objective and direction (firing|resolved)."),
+    # Process self-metrics (refreshed on scrape/dump).
+    ("krr_tpu_process_resident_bytes", "gauge", "Resident set size of this process."),
+    ("krr_tpu_process_open_fds", "gauge", "Open file descriptors of this process."),
+    ("krr_tpu_process_uptime_seconds", "gauge", "Seconds since this process imported the metrics core (≈ process start for krr-tpu entry points)."),
+    ("krr_tpu_process_gc_collections_total", "counter", "Cyclic-GC collections by generation."),
+    ("krr_tpu_debug_dumps_total", "counter", "On-demand debug dumps written (SIGUSR2)."),
 )
 
 
@@ -65,24 +102,47 @@ def _format_value(value: float) -> str:
     return repr(float(value))
 
 
-class MetricsRegistry:
-    """Declared-up-front counters/gauges/summaries with labeled series."""
+def _format_le(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else _format_value(bound)
 
-    def __init__(self, declarations: Iterable[tuple[str, str, str]] = SERVER_METRICS):
+
+class MetricsRegistry:
+    """Declared-up-front counters/gauges/summaries/histograms with labeled
+    series."""
+
+    def __init__(self, declarations: Iterable[tuple] = SERVER_METRICS):
         self._meta: dict[str, tuple[str, str]] = {}
         #: name -> {sorted-label-tuple -> value}; summaries keep two inner
-        #: maps under name+"_sum" / name+"_count".
+        #: maps under name+"_sum" / name+"_count" (histograms too, plus the
+        #: per-bucket counts under ``_buckets``).
         self._values: dict[str, dict[tuple[tuple[str, str], ...], float]] = {}
-        for name, kind, help_text in declarations:
-            self.declare(name, kind, help_text)
+        #: histogram name -> upper bounds (excluding +Inf).
+        self._bounds: dict[str, tuple[float, ...]] = {}
+        #: histogram name -> {series -> per-bucket NON-cumulative counts
+        #: (len(bounds) + 1, last slot = +Inf)}; cumulated at render.
+        self._buckets: dict[str, dict[tuple[tuple[str, str], ...], list[float]]] = {}
+        for declaration in declarations:
+            self.declare(*declaration)
 
-    def declare(self, name: str, kind: str, help_text: str) -> None:
-        if kind not in ("counter", "gauge", "summary"):
+    def declare(
+        self,
+        name: str,
+        kind: str,
+        help_text: str,
+        buckets: Optional[Iterable[float]] = None,
+    ) -> None:
+        if kind not in ("counter", "gauge", "summary", "histogram"):
             raise ValueError(f"unknown metric kind {kind!r}")
         self._meta[name] = (kind, help_text)
-        if kind == "summary":
+        if kind in ("summary", "histogram"):
             self._values.setdefault(name + "_sum", {})
             self._values.setdefault(name + "_count", {})
+            if kind == "histogram":
+                bounds = tuple(sorted(buckets or DEFAULT_SECONDS_BUCKETS))
+                if not bounds:
+                    raise ValueError(f"histogram {name} needs at least one bucket")
+                self._bounds[name] = bounds
+                self._buckets.setdefault(name, {})
         else:
             self._values.setdefault(name, {})
 
@@ -98,15 +158,44 @@ class MetricsRegistry:
         self._values[name][self._series(name, labels)] = float(value)
 
     def observe(self, name: str, value: float, **labels: str) -> None:
-        """One summary observation: ``name_sum`` += value, ``name_count`` += 1."""
+        """One observation. Summaries get ``name_sum`` += value and
+        ``name_count`` += 1; histograms additionally count the value into
+        its cumulative ``le`` bucket (rendered cumulatively)."""
         series = self._series(name, labels)
         for suffix, amount in (("_sum", float(value)), ("_count", 1.0)):
             bucket = self._values[name + suffix]
             bucket[series] = bucket.get(series, 0.0) + amount
+        bounds = self._bounds.get(name)
+        if bounds is not None:
+            counts = self._buckets[name].setdefault(series, [0.0] * (len(bounds) + 1))
+            counts[bisect.bisect_left(bounds, float(value))] += 1.0
 
     def value(self, name: str, **labels: str) -> Optional[float]:
         """Read one series back (tests and the health route)."""
         return self._values.get(name, {}).get(self._series(name, labels))
+
+    def total(self, name: str) -> float:
+        """Sum of a metric's series across ALL label values — how the SLO
+        engine reads e.g. ``krr_tpu_scans_total`` regardless of its ``kind``
+        label. Summaries/histograms: pass the explicit ``_sum``/``_count``
+        name."""
+        return float(sum(self._values.get(name, {}).values()))
+
+    def histogram_buckets(
+        self, name: str, **labels: str
+    ) -> "Optional[list[tuple[float, float]]]":
+        """One histogram series as cumulative ``(le, count)`` pairs ending in
+        ``(+Inf, total)`` — the representation the SLO engine and Prometheus
+        quantile rules share. None when the series never fired."""
+        bounds = self._bounds.get(name)
+        counts = self._buckets.get(name, {}).get(self._series(name, labels))
+        if bounds is None or counts is None:
+            return None
+        out, running = [], 0.0
+        for bound, count in zip((*bounds, float("inf")), counts):
+            running += count
+            out.append((bound, running))
+        return out
 
     def render(self) -> str:
         """Prometheus exposition format 0.0.4."""
@@ -114,7 +203,16 @@ class MetricsRegistry:
         for name, (kind, help_text) in self._meta.items():
             out.append(f"# HELP {name} {help_text}")
             out.append(f"# TYPE {name} {kind}")
-            suffixes = ("_sum", "_count") if kind == "summary" else ("",)
+            if kind == "histogram":
+                for series, counts in sorted(self._buckets[name].items()):
+                    running = 0.0
+                    for bound, count in zip((*self._bounds[name], float("inf")), counts):
+                        running += count
+                        rendered_labels = ",".join(
+                            f'{key}="{_escape_label(val)}"' for key, val in series
+                        ) + ("," if series else "") + f'le="{_format_le(bound)}"'
+                        out.append(f"{name}_bucket{{{rendered_labels}}} {_format_value(running)}")
+            suffixes = ("_sum", "_count") if kind in ("summary", "histogram") else ("",)
             for suffix in suffixes:
                 for series, value in sorted(self._values[name + suffix].items()):
                     if series:
@@ -144,3 +242,40 @@ def record_build_info(registry: MetricsRegistry) -> None:
     registry.set(
         "krr_tpu_build_info", 1, version=get_version(), jax=jax_version, backend=backend
     )
+
+
+#: Anchor for the uptime gauge. This module imports in the first moments of
+#: every krr-tpu entry point (config → logging → metrics), so the delta is
+#: process uptime for all practical purposes without touching /proc parsing.
+_PROCESS_START = time.time()
+
+
+def refresh_process_metrics(registry: MetricsRegistry) -> None:
+    """Refresh the process self-metrics (RSS, open fds, uptime, GC
+    collections) into ``registry`` — called at scrape/dump time (serve's
+    ``GET /metrics``, the CLI's ``--metrics-dump``, SIGUSR2 debug dumps), so
+    the gauges are as fresh as the exposition that carries them. Every probe
+    is defensive: /proc may be absent (non-Linux) and a metrics snapshot
+    must never fail because of it."""
+    registry.set("krr_tpu_process_uptime_seconds", time.time() - _PROCESS_START)
+    try:
+        with open("/proc/self/statm") as f:
+            resident_pages = int(f.read().split()[1])
+        registry.set(
+            "krr_tpu_process_resident_bytes", resident_pages * os.sysconf("SC_PAGE_SIZE")
+        )
+    except Exception:
+        pass
+    try:
+        registry.set("krr_tpu_process_open_fds", len(os.listdir("/proc/self/fd")))
+    except Exception:
+        pass
+    try:
+        for generation, stats in enumerate(gc.get_stats()):
+            registry.set(
+                "krr_tpu_process_gc_collections_total",
+                stats.get("collections", 0),
+                generation=str(generation),
+            )
+    except Exception:
+        pass

@@ -18,8 +18,13 @@ Routes:
   ``limit=`` for the newest N ticks per workload.
 * ``GET /drift``     — fleet drift summary (`krr_tpu.history.drift`): raw
   vs published drift, flap counts, regime-change flags.
-* ``GET /healthz``   — liveness + scan freshness + journal age (JSON).
-* ``GET /metrics``   — Prometheus text format (`krr_tpu.obs.metrics`).
+* ``GET /healthz``   — liveness + scan freshness + journal age (JSON); the
+  verdict downgrades to ``degraded`` (still 200) while any SLO alert fires.
+* ``GET /metrics``   — Prometheus text format (`krr_tpu.obs.metrics`),
+  process self-metrics refreshed per scrape.
+* ``GET /statusz``   — the SLO engine's posture (`krr_tpu.obs.health`):
+  objectives, burn rates, error budgets, firing alerts. JSON by default,
+  ``?format=text`` for humans.
 * ``GET /debug/trace`` — the last N scan ticks' spans as Chrome trace-event
   JSON (`krr_tpu.obs.trace` ring; load in ``chrome://tracing``/Perfetto).
 """
@@ -127,7 +132,12 @@ class HttpApp:
         if path == "/healthz":
             return await self._healthz()
         if path == "/metrics":
+            from krr_tpu.obs.metrics import refresh_process_metrics
+
+            refresh_process_metrics(self.state.metrics)
             return 200, _METRICS_CONTENT_TYPE, self.state.metrics.render().encode()
+        if path == "/statusz":
+            return await self._statusz(query)
         if path == "/recommendations":
             return await self._recommendations(query)
         if path == "/history":
@@ -152,12 +162,36 @@ class HttpApp:
 
         return 200, "application/json", await asyncio.to_thread(render)
 
+    async def _statusz(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """The SLO engine's posture. READ-ONLY: burn rates recompute at the
+        request clock from the tick-cadenced samples — scrape traffic never
+        appends events (`krr_tpu.obs.health.SloEngine.status`)."""
+        engine = self.state.slo
+        if engine is None:
+            return 404, "application/json", _json_body(
+                {"error": "no SLO engine on this server"}
+            )
+        fmt = (query.get("format") or ["json"])[-1]
+        if fmt == "text":
+            return 200, "text/plain; charset=utf-8", engine.render_text().encode()
+        if fmt != "json":
+            return 400, "application/json", _json_body(
+                {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
+            )
+        return 200, "application/json", _json_body(engine.status())
+
     async def _healthz(self) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
+        firing = self.state.slo.firing() if self.state.slo is not None else []
         if snapshot is None:
             status = "starting"
         elif float(self.clock()) - snapshot.window_end > self.stale_after_seconds:
             status = "stale"
+        elif firing:
+            # SLO burn downgrades the verdict without failing liveness: the
+            # pod is alive and serving, but its error budget is burning —
+            # /statusz has the details. ``stale`` (503) outranks it.
+            status = "degraded"
         else:
             status = "ok"
         journal = self.state.journal
@@ -180,8 +214,9 @@ class HttpApp:
                 if journal_newest is not None
                 else None
             ),
+            "slo_firing": firing,
         }
-        return (200 if status == "ok" else 503), "application/json", _json_body(body)
+        return (200 if status in ("ok", "degraded") else 503), "application/json", _json_body(body)
 
     async def _recommendations(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
@@ -404,7 +439,7 @@ class HttpApp:
         route_label = (
             split.path
             if split.path
-            in ("/healthz", "/metrics", "/recommendations", "/history", "/drift", "/debug/trace")
+            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace")
             else "other"
         )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
@@ -487,6 +522,14 @@ class KrrServer:
             # per-query telemetry into the same exposition /metrics serves.
             metrics=self.session.metrics,
         )
+        # The SLO engine rides the same registry and clock: the scheduler
+        # evaluates per tick, /statusz renders it, /healthz downgrades to
+        # ``degraded`` while it fires (`krr_tpu.obs.health`).
+        from krr_tpu.obs.health import engine_from_config
+
+        self.state.slo = engine_from_config(
+            self.session.metrics, config, clock=clock, logger=self.logger
+        )
         self.scheduler = ScanScheduler(
             self.session,
             self.state,
@@ -559,6 +602,18 @@ async def run_server(config: Config, *, logger: Optional[KrrLogger] = None) -> N
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # non-unix event loops
             pass
+    # kill -USR2 <pid> dumps the trace ring + a metrics snapshot to
+    # timestamped files without stopping the server (`krr_tpu.obs.dump`).
+    from krr_tpu.obs.dump import install_signal_dump
+
+    install_signal_dump(
+        server.session.tracer,
+        server.state.metrics,
+        trace_target=config.trace_path,
+        metrics_target=config.metrics_dump_path,
+        logger=server.logger,
+        loop=loop,
+    )
     try:
         await stop.wait()
     finally:

@@ -194,11 +194,15 @@ class ScanScheduler:
             # query. The store query is the shared
             # `DigestStore.query_recommendation` — the same path the tdigest
             # strategy's run_digested uses, queried exactly once per tick.
+            # The quantile/round sub-spans (the serve legs of the compute
+            # taxonomy, `krr_tpu.obs.device`) parent to the compute span via
+            # the contextvar copied into this worker thread.
             settings = self.session.strategy.settings
             config = self.session.config
-            cpu_raw, mem_raw = self.state.store.query_recommendation(
-                rows, float(settings.cpu_percentile)
-            )
+            with tracer.span("quantile", rows=len(objects), path="store"):
+                cpu_raw, mem_raw = self.state.store.query_recommendation(
+                    rows, float(settings.cpu_percentile)
+                )
             keys = [object_key(obj) for obj in objects]
             decision = self.gate.observe(keys, cpu_raw, mem_raw)
             if journal is not None:
@@ -240,21 +244,22 @@ class ScanScheduler:
                             mem_raw[idx],
                             np.ones(len(idx), bool),
                         )
-            raw_results = finalize_fleet(
-                decision.cpu, decision.mem, settings.memory_buffer_percentage
-            )
-            scans = [
-                ResourceScan.calculate(
-                    obj,
-                    round_allocations(
-                        raw,
-                        cpu_min_value=config.cpu_min_value,
-                        memory_min_value=config.memory_min_value,
-                    ),
+            with tracer.span("round", rows=len(objects)):
+                raw_results = finalize_fleet(
+                    decision.cpu, decision.mem, settings.memory_buffer_percentage
                 )
-                for obj, raw in zip(objects, raw_results)
-            ]
-            result = Result(scans=scans)
+                scans = [
+                    ResourceScan.calculate(
+                        obj,
+                        round_allocations(
+                            raw,
+                            cpu_min_value=config.cpu_min_value,
+                            memory_min_value=config.memory_min_value,
+                        ),
+                    )
+                    for obj, raw in zip(objects, raw_results)
+                ]
+                result = Result(scans=scans)
             return result, result.format("json").encode(), decision
 
         tracer = self.session.tracer
@@ -425,6 +430,10 @@ class ScanScheduler:
             await asyncio.to_thread(self._save_store)
 
         metrics.inc("krr_tpu_scans_total", kind=kind)
+        # A completed tick fetched every object (raise_on_failure: partial
+        # fetches abort the tick) — the SLO fetch objective's denominator.
+        if objects:
+            metrics.inc("krr_tpu_fetch_rows_total", len(objects))
         metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
         if fresh:
             metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
@@ -474,16 +483,28 @@ class ScanScheduler:
         return True
 
     # ----------------------------------------------------------- the loop
+    async def run_once(self) -> "Optional[bool]":
+        """One guarded scheduler round: tick, count a failure if it aborts,
+        then evaluate the SLO engine — failures included, which is the
+        point: the burn-rate windows must see bad ticks the moment they
+        happen, not whenever the next healthy tick lands. Returns the
+        tick's result (None when it failed)."""
+        did_scan: Optional[bool] = None
+        try:
+            did_scan = await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.state.metrics.inc("krr_tpu_scan_failures_total")
+            self.logger.warning(f"Scan failed: {e} — serving the previous result")
+            self.logger.debug_exception()
+        if self.state.slo is not None:
+            self.state.slo.evaluate()
+        return did_scan
+
     async def run(self) -> None:
         while True:
-            try:
-                await self.tick()
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                self.state.metrics.inc("krr_tpu_scan_failures_total")
-                self.logger.warning(f"Scan failed: {e} — serving the previous result")
-                self.logger.debug_exception()
+            await self.run_once()
             await asyncio.sleep(self.scan_interval)
 
     def start(self) -> None:

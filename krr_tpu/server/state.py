@@ -24,6 +24,7 @@ if TYPE_CHECKING:
     from krr_tpu.core.streaming import DigestStore
     from krr_tpu.history.journal import RecommendationJournal
     from krr_tpu.models.result import Result
+    from krr_tpu.obs.health import SloEngine
 
 
 class ReadWriteLock:
@@ -121,6 +122,11 @@ class ServerState:
         #: Trace id of the last completed scan tick — the join key between
         #: /healthz, structured log lines, and /debug/trace spans.
         self.last_scan_id: Optional[str] = None
+        #: The SLO engine (`krr_tpu.obs.health`): the scheduler evaluates it
+        #: per tick, GET /statusz renders it, /healthz downgrades to
+        #: ``degraded`` while it has firing alerts. None for states built
+        #: without a server (unit tests, embedders).
+        self.slo: "Optional[SloEngine]" = None
         self._snapshot: Optional[Snapshot] = None
 
     async def publish(self, snapshot: Snapshot) -> None:

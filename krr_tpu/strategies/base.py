@@ -28,6 +28,7 @@ import pydantic as pd
 from krr_tpu.models.allocations import ResourceType
 from krr_tpu.models.objects import K8sObjectData
 from krr_tpu.models.series import FleetBatch
+from krr_tpu.obs.device import NULL_DEVICE_OBS, DeviceObs
 from krr_tpu.utils.registry import PluginRegistry
 
 
@@ -110,6 +111,13 @@ class BaseStrategy(abc.ABC, Generic[_S]):
         # defining `run` or by opting out with `__register__ = False`.
         if cls.run is not BaseStrategy.run and cls.__dict__.get("__register__", True):
             _STRATEGY_REGISTRY.register(cls)
+
+    #: Device-compute instrumentation (`krr_tpu.obs.device`): staged
+    #: pack/digest/quantile/round sub-spans, compile attribution, padding
+    #: gauges. The scan session swaps in its own wired instance
+    #: (`ScanSession._wire_obs`); the class default keeps strategies built
+    #: outside a session (plugins, unit tests) inert and import-cheap.
+    obs: DeviceObs = NULL_DEVICE_OBS
 
     def __init__(self, settings: _S):
         self.settings = settings

@@ -238,31 +238,45 @@ class SimpleStrategy(BatchedStrategy[SimpleStrategySettings]):
             return []
         q = float(self.settings.cpu_percentile)
         mesh = resolve_mesh(self.settings)
+        obs = self.obs
 
         with self.profile_span():
+            # The pack stage brackets the ragged→rectangular host pack (the
+            # packed views are cached on the batch, so re-reads below are
+            # free) and fires the padding-efficiency gauges.
+            with obs.stage("pack", rows=len(batch)):
+                cpu = batch.packed(ResourceType.CPU)
+                mem = batch.packed(ResourceType.Memory)
+                obs.record_padding(ResourceType.CPU.value, cpu)
+                obs.record_padding(ResourceType.Memory.value, mem)
             if use_host_stream(batch, mesh, self.settings.host_stream_mb):
-                cpu_p, mem_max = self._streamed_exact(batch, q, mesh)
+                with obs.stage("quantile", rows=len(batch), path="host_stream"):
+                    cpu_p, mem_max = obs.fence(self._streamed_exact(batch, q, mesh))
             elif mesh is not None:
                 from krr_tpu.parallel import sharded_masked_max, sharded_percentile_bisect
 
-                cpu = batch.packed(ResourceType.CPU)
-                mem = batch.packed(ResourceType.Memory)
-                cpu_p = sharded_percentile_bisect(cpu.values, cpu.counts, q, mesh)
-                mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
+                with obs.stage("quantile", rows=len(batch), path="mesh"):
+                    cpu_p = sharded_percentile_bisect(cpu.values, cpu.counts, q, mesh)
+                    mem_max = obs.fence(
+                        sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
+                    )
             else:
-                cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
-                mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-                if self.settings.use_pallas:
-                    from krr_tpu.ops.pallas_select import fleet_exact
+                with obs.stage("quantile", rows=len(batch), path="resident"):
+                    cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
+                    mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
+                    if self.settings.use_pallas:
+                        from krr_tpu.ops.pallas_select import fleet_exact
 
-                    # One dispatch, one readback: on a tunneled TPU backend
-                    # each round trip costs tens of ms (see pallas_select).
-                    stacked = np.asarray(fleet_exact(cpu_values, cpu_counts, mem_values, mem_counts, q))
-                    cpu_p, mem_max = stacked[0], stacked[1]
-                else:
-                    cpu_p = np.asarray(masked_percentile_bisect(cpu_values, cpu_counts, q))
-                    mem_max = np.asarray(masked_max(mem_values, mem_counts))
+                        # One dispatch, one readback: on a tunneled TPU backend
+                        # each round trip costs tens of ms (see pallas_select).
+                        stacked = np.asarray(fleet_exact(cpu_values, cpu_counts, mem_values, mem_counts, q))
+                        cpu_p, mem_max = stacked[0], stacked[1]
+                    else:
+                        cpu_p = np.asarray(masked_percentile_bisect(cpu_values, cpu_counts, q))
+                        mem_max = np.asarray(masked_max(mem_values, mem_counts))
+            obs.record_device_memory()
 
-        return finalize_fleet(
-            np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage
-        )
+        with obs.stage("round", rows=len(batch)):
+            return finalize_fleet(
+                np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage
+            )

@@ -207,24 +207,29 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         spec = DigestSpec(
             gamma=fleet.gamma, min_value=fleet.min_value, num_buckets=fleet.cpu_counts.shape[1]
         )
+        obs = self.obs
         with self.profile_span():
             if self.settings.state_path:
                 from krr_tpu.core.streaming import DigestStore
 
                 with DigestStore.locked(self.settings.state_path):
                     store = DigestStore.open_or_create(self.settings.state_path, spec)
-                    rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
-                    cpu_p, mem_max = store.query_recommendation(rows, q)
+                    with obs.stage("fold", rows=len(fleet.objects)):
+                        rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
+                    with obs.stage("quantile", rows=len(fleet.objects), path="store"):
+                        cpu_p, mem_max = store.query_recommendation(rows, q)
                     store.save(self.settings.state_path)
             else:
-                cpu_p = digest_ops.percentile_host(
-                    spec, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, q
-                )
-                mem_peak_mb = np.where(
-                    np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
-                )
-                mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
-        return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
+                with obs.stage("quantile", rows=len(fleet.objects), path="ingest"):
+                    cpu_p = digest_ops.percentile_host(
+                        spec, fleet.cpu_counts, fleet.cpu_total, fleet.cpu_peak, q
+                    )
+                    mem_peak_mb = np.where(
+                        np.isfinite(fleet.mem_peak), fleet.mem_peak / MEMORY_SCALE, -np.inf
+                    )
+                    mem_max = np.where(fleet.mem_total > 0, mem_peak_mb, np.nan)
+        with obs.stage("round", rows=len(fleet.objects)):
+            return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
 
     def run_batch(self, batch: FleetBatch) -> list[RunResult]:
         if not batch.objects:
@@ -232,23 +237,33 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         spec = self.settings.cpu_spec()
         mesh = resolve_mesh(self.settings)
         q = float(self.settings.cpu_percentile)
+        obs = self.obs
 
         with self.profile_span():
+            with obs.stage("pack", rows=len(batch)):
+                cpu = batch.packed(ResourceType.CPU)
+                mem = batch.packed(ResourceType.Memory)
+                obs.record_padding(ResourceType.CPU.value, cpu)
+                obs.record_padding(ResourceType.Memory.value, mem)
             if self.settings.state_path:
                 # Incremental path: fold this window into the persistent store and
                 # recommend from the merged history (streaming / multi-source /
                 # resume — krr_tpu.core.streaming).
                 from krr_tpu.core.streaming import DigestStore, object_key
 
-                counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
+                with obs.stage("digest", rows=len(batch)):
+                    counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
                 keys = [object_key(obj) for obj in batch.objects]
                 with DigestStore.locked(self.settings.state_path):
                     store = DigestStore.open_or_create(self.settings.state_path, spec)
-                    rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
-                    cpu_p, mem_max = store.query_recommendation(rows, q)
+                    with obs.stage("fold", rows=len(batch)):
+                        rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
+                    with obs.stage("quantile", rows=len(batch), path="store"):
+                        cpu_p, mem_max = store.query_recommendation(rows, q)
                     store.save(self.settings.state_path)
             elif self._use_host_stream(batch, mesh):
-                cpu_p, mem_max = self._streamed_sketch(batch, spec, q, mesh)
+                with obs.stage("quantile", rows=len(batch), path="host_stream"):
+                    cpu_p, mem_max = obs.fence(self._streamed_sketch(batch, spec, q, mesh))
             elif mesh is not None:
                 from krr_tpu.parallel import (
                     sharded_fleet_digest,
@@ -257,34 +272,50 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
                     sharded_percentile,
                 )
 
-                cpu = batch.packed(ResourceType.CPU)
-                mem = batch.packed(ResourceType.Memory)
                 k = self._exact_topk_k(cpu.capacity, q)
-                if k is not None:
-                    sketch, real_rows = sharded_fleet_topk(
-                        cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
+                with obs.stage("digest", rows=len(batch), sketch="topk" if k is not None else "digest"):
+                    if k is not None:
+                        sketch, real_rows = sharded_fleet_topk(
+                            cpu.values, cpu.counts, k, mesh, chunk_size=self.settings.chunk_size
+                        )
+                        sketch = obs.fence(sketch)
+                    else:
+                        cpu_digest, real_rows = sharded_fleet_digest(
+                            spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
+                        )
+                        cpu_digest = obs.fence(cpu_digest)
+                with obs.stage("quantile", rows=len(batch), path="mesh"):
+                    if k is not None:
+                        cpu_p = np.asarray(topk_ops.percentile(sketch, q))[:real_rows]
+                    else:
+                        cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
+                    mem_max = obs.fence(
+                        sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
                     )
-                    cpu_p = np.asarray(topk_ops.percentile(sketch, q))[:real_rows]
-                else:
-                    cpu_digest, real_rows = sharded_fleet_digest(
-                        spec, cpu.values, cpu.counts, mesh, chunk_size=self.settings.chunk_size
-                    )
-                    cpu_p = sharded_percentile(spec, cpu_digest, q, real_rows)
-                mem_max = sharded_masked_max(mem.values / MEMORY_SCALE, mem.counts, mesh)
             else:
                 cpu_values, cpu_counts = fleet_device_arrays(batch, ResourceType.CPU)
                 mem_values, mem_counts = fleet_device_arrays(batch, ResourceType.Memory, scale=MEMORY_SCALE)
-                k = self._exact_topk_k(batch.packed(ResourceType.CPU).capacity, q)
-                if k is not None:
-                    sketch = topk_ops.build_from_packed(
-                        cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
-                    )
-                    cpu_p = np.asarray(topk_ops.percentile(sketch, q))
-                else:
-                    cpu_digest = digest_ops.build_from_packed(
-                        spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size
-                    )
-                    cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
-                mem_max = np.asarray(masked_max(mem_values, mem_counts))
+                k = self._exact_topk_k(cpu.capacity, q)
+                with obs.stage("digest", rows=len(batch), sketch="topk" if k is not None else "digest"):
+                    if k is not None:
+                        sketch = obs.fence(
+                            topk_ops.build_from_packed(
+                                cpu_values, cpu_counts, k=k, chunk_size=self.settings.chunk_size
+                            )
+                        )
+                    else:
+                        cpu_digest = obs.fence(
+                            digest_ops.build_from_packed(
+                                spec, cpu_values, cpu_counts, chunk_size=self.settings.chunk_size
+                            )
+                        )
+                with obs.stage("quantile", rows=len(batch), path="resident"):
+                    if k is not None:
+                        cpu_p = np.asarray(topk_ops.percentile(sketch, q))
+                    else:
+                        cpu_p = np.asarray(digest_ops.percentile(spec, cpu_digest, q))
+                    mem_max = np.asarray(masked_max(mem_values, mem_counts))
+            obs.record_device_memory()
 
-        return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)
+        with obs.stage("round", rows=len(batch)):
+            return finalize_fleet(np.asarray(cpu_p), np.asarray(mem_max), self.settings.memory_buffer_percentage)

@@ -20,6 +20,14 @@ from typing import Optional
 _enabled_dir: Optional[str] = None
 
 
+def enabled_dir() -> Optional[str]:
+    """The directory the persistent cache currently points at, or None when
+    disabled — what the compile-cache hit/miss telemetry
+    (``krr_tpu_compile_cache_{hits,misses}_total``, `krr_tpu.obs.device`)
+    is counting against."""
+    return _enabled_dir
+
+
 def enable_compilation_cache(cache_dir: Optional[str]) -> Optional[str]:
     """Point JAX's persistent compilation cache at ``cache_dir`` (user-path
     expanded, created if missing). Returns the resolved path, or None when

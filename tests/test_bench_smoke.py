@@ -53,3 +53,11 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("obs_traced_scan_seconds", 0) > 0, secondary
     assert secondary.get("obs_spans_per_scan", 0) > 0, secondary
     assert "obs_trace_overhead_pct" in secondary, secondary
+    # The device-observability leg ran: staged compute sub-spans recorded,
+    # and the <2%-overhead + bit-exactness + stage/padding gates passed
+    # (a gate failure is rc 1; assert the fields so a leg-skipping refactor
+    # can't pass silently).
+    assert secondary.get("obs_device_plain_seconds", 0) > 0, secondary
+    assert secondary.get("obs_device_traced_seconds", 0) > 0, secondary
+    assert secondary.get("obs_device_stage_spans", 0) > 0, secondary
+    assert "obs_device_overhead_pct" in secondary, secondary

@@ -1,0 +1,379 @@
+"""The SLO engine (`krr_tpu.obs.health`) — burn-rate math, alert
+transitions, /statusz rendering — plus the hermetic serve acceptance loop:
+an induced failure regime burns an objective, /healthz degrades, /statusz
+shows the burn, recovery clears the alert, and the tick traces carry the
+device-level compute sub-spans."""
+
+import asyncio
+import json
+
+import pytest
+
+from krr_tpu.obs.health import (
+    Objective,
+    SloEngine,
+    default_objectives,
+    engine_from_config,
+)
+from krr_tpu.obs.metrics import MetricsRegistry
+
+from .test_server import ORIGIN, http_get, metric_value, serve_config, serve_env  # noqa: F401
+
+
+def make_engine(registry, now, **overrides):
+    defaults = dict(
+        fast_window_seconds=300.0,
+        slow_window_seconds=3600.0,
+        fast_burn_threshold=10.0,
+        slow_burn_threshold=5.0,
+        clock=lambda: now[0],
+    )
+    defaults.update(overrides)
+    objectives = defaults.pop("objectives", None) or default_objectives(
+        registry,
+        scan_failure_budget=0.05,
+        fetch_failure_budget=0.05,
+        scan_latency_seconds=60.0,
+        freshness_seconds=300.0,
+        clock=defaults["clock"],
+    )
+    return SloEngine(objectives, registry, **defaults)
+
+
+# -------------------------------------------------------------- unit tests
+class TestSloEngine:
+    def test_outage_fires_and_recovery_resolves_at_fast_window_speed(self):
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)
+
+        # Full outage: every tick fails — burn 20x the 5% budget on both
+        # windows. The first bad evaluation is still a "blip" under the
+        # min-slow-bad-events floor; the SECOND confirms sustained burn.
+        transitions = []
+        for _ in range(4):
+            now[0] += 60
+            registry.inc("krr_tpu_scan_failures_total")
+            transitions += engine.evaluate()
+        assert transitions == [{"objective": "scan_failures", "to": "firing", "at": 1120.0}]
+        assert engine.firing() == ["scan_failures"]
+        assert registry.value(
+            "krr_tpu_slo_alert_firing", objective="scan_failures"
+        ) == 1.0
+        assert registry.value(
+            "krr_tpu_slo_burn_rate", objective="scan_failures", window="fast"
+        ) >= 10.0
+        assert registry.value(
+            "krr_tpu_slo_alert_transitions_total", objective="scan_failures", to="firing"
+        ) == 1.0
+
+        # Recovery: good ticks. The alert resolves once the FAST window's
+        # burn drops below threshold — well before the slow window forgets.
+        resolved_at = None
+        for _ in range(8):
+            now[0] += 60
+            registry.inc("krr_tpu_scans_total", kind="delta")
+            for transition in engine.evaluate():
+                if transition["to"] == "resolved":
+                    resolved_at = transition["at"]
+        assert resolved_at is not None and resolved_at - 1240.0 <= 300.0
+        assert engine.firing() == []
+        assert registry.value(
+            "krr_tpu_slo_alert_firing", objective="scan_failures"
+        ) == 0.0
+        # The slow window still remembers the burn (budget overspent).
+        assert registry.value(
+            "krr_tpu_slo_error_budget_remaining", objective="scan_failures"
+        ) < 0.0
+
+    def test_slow_window_damps_a_single_blip(self):
+        """One failure inside a long healthy run spikes the fast burn but
+        not the slow one — the two-window AND keeps it from alerting."""
+        registry = MetricsRegistry()
+        now = [1000.0]
+        # Fast window = one tick: the blip maxes the fast burn instantly.
+        engine = make_engine(registry, now, fast_window_seconds=60.0)
+        for _ in range(50):
+            now[0] += 60
+            registry.inc("krr_tpu_scans_total", kind="delta")
+            engine.evaluate()
+        registry.inc("krr_tpu_scan_failures_total")
+        now[0] += 60
+        assert engine.evaluate() == []
+        status = engine.status()
+        scan = next(o for o in status["objectives"] if o["name"] == "scan_failures")
+        assert scan["burn_rate"]["fast"] >= 10.0  # the blip IS visible…
+        assert scan["burn_rate"]["slow"] < 5.0    # …but the slow window vetoes
+        assert not scan["firing"]
+
+    def test_threshold_objective_counts_violations_and_none_is_no_event(self):
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)
+        # No publish yet: freshness value is None -> NO event recorded.
+        now[0] += 60
+        engine.evaluate()
+        fresh = next(
+            o for o in engine.status()["objectives"] if o["name"] == "freshness"
+        )
+        assert fresh["last_value"] is None
+        assert fresh["events"] == {"bad": 0.0, "total": 0.0}
+        # Publish, then let it age past the 300s limit: every evaluation is
+        # a violation; with a 10% budget the burn crosses both thresholds.
+        registry.set("krr_tpu_last_scan_timestamp_seconds", now[0])
+        transitions = []
+        for _ in range(6):
+            now[0] += 400
+            transitions += engine.evaluate()
+        assert any(
+            t["objective"] == "freshness" and t["to"] == "firing" for t in transitions
+        )
+        fresh = next(
+            o for o in engine.status()["objectives"] if o["name"] == "freshness"
+        )
+        assert fresh["last_value"] > 300.0 and fresh["firing"]
+
+    def test_single_failure_at_coarse_cadence_does_not_fire(self):
+        """Default serve cadence (900s) holds only ~4 samples per slow
+        window, so one transient failure clears both RATIO thresholds — the
+        min-slow-bad-events floor is what keeps it a blip. Two failures
+        inside the slow window are sustained burn and fire."""
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)
+        for _ in range(3):
+            now[0] += 900
+            registry.inc("krr_tpu_scans_total", kind="delta")
+            engine.evaluate()
+        registry.inc("krr_tpu_scan_failures_total")
+        now[0] += 900
+        assert engine.evaluate() == []
+        assert engine.firing() == []
+        # A second failure within the hour: no longer a blip.
+        registry.inc("krr_tpu_scan_failures_total")
+        now[0] += 900
+        transitions = engine.evaluate()
+        assert [t["to"] for t in transitions] == ["firing"]
+
+    def test_scan_latency_samples_only_new_scans(self):
+        """Skipped ticks re-evaluate the engine but must not re-count the
+        LAST scan's duration gauge as fresh events — one slow scan is one
+        bad event, however many no-op ticks follow it."""
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)  # latency limit 60s
+        registry.inc("krr_tpu_scans_total", kind="full")
+        registry.set("krr_tpu_scan_duration_seconds", 400.0, phase="fetch")  # slow!
+        for _ in range(10):  # 1 real scan + 9 skipped ticks
+            now[0] += 30
+            engine.evaluate()
+        latency = next(
+            o for o in engine.status()["objectives"] if o["name"] == "scan_latency"
+        )
+        assert latency["events"] == {"bad": 1.0, "total": 1.0}
+        assert latency["last_value"] == 400.0
+        assert not latency["firing"]  # one slow scan stays a blip
+
+    def test_pinned_scan_end_drops_freshness(self):
+        from krr_tpu.core.config import Config
+
+        registry = MetricsRegistry()
+        pinned = engine_from_config(
+            registry, Config(scan_end_timestamp=1_700_000_000.0)
+        )
+        assert [o.name for o in pinned.objectives] == [
+            "scan_failures", "fetch_failed_rows", "scan_latency",
+        ]
+
+    def test_one_shot_engine_fires_on_a_single_bad_event(self):
+        """One scan contributes at most one bad event, so the serve blip
+        floor would make a one-shot --statusz constitutionally unable to
+        fire — one_shot mode lowers it to 1."""
+        from krr_tpu.core.config import Config
+
+        registry = MetricsRegistry()
+        registry.inc("krr_tpu_scan_failures_total")  # the aborted scan
+        engine = engine_from_config(registry, Config(), one_shot=True)
+        assert engine.min_slow_bad_events == 1
+        engine.evaluate()
+        assert engine.firing() == ["scan_failures"]
+        # The serve-mode engine keeps the damping floor.
+        assert engine_from_config(registry, Config()).min_slow_bad_events == 2
+
+    def test_status_is_read_only(self):
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)
+        now[0] += 60
+        engine.evaluate()
+        before = {
+            o["name"]: o["events"]["total"] for o in engine.status()["objectives"]
+        }
+        for _ in range(5):  # scrape storms must not dilute tick sampling
+            engine.status()
+            engine.render_text()
+        after = {
+            o["name"]: o["events"]["total"] for o in engine.status()["objectives"]
+        }
+        assert before == after
+
+    def test_render_text_lists_every_objective(self):
+        registry = MetricsRegistry()
+        now = [1000.0]
+        engine = make_engine(registry, now)
+        engine.evaluate()
+        text = engine.render_text()
+        for name in ("scan_failures", "fetch_failed_rows", "scan_latency", "freshness"):
+            assert name in text
+        assert "firing: none" in text
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            Objective(name="x", description="", budget=0.0, sample=lambda: (0, 0))
+        with pytest.raises(ValueError):
+            Objective(name="x", description="", budget=0.5)  # neither kind
+        with pytest.raises(ValueError):
+            Objective(
+                name="x", description="", budget=0.5,
+                sample=lambda: (0, 0), value=lambda: 1.0, limit=2.0,
+            )
+
+    def test_engine_from_config_resolves_auto_limits(self):
+        from krr_tpu.core.config import Config
+
+        registry = MetricsRegistry()
+        config = Config(scan_interval_seconds=120.0)
+        engine = engine_from_config(registry, config)
+        by_name = {o.name: o for o in engine.objectives}
+        assert by_name["scan_latency"].limit == 120.0
+        assert by_name["freshness"].limit == 360.0
+        explicit = engine_from_config(
+            registry,
+            Config(scan_interval_seconds=120.0, slo_scan_latency_seconds=7.0,
+                   slo_freshness_seconds=11.0, slo_fast_burn=2.0),
+        )
+        by_name = {o.name: o for o in explicit.objectives}
+        assert by_name["scan_latency"].limit == 7.0
+        assert by_name["freshness"].limit == 11.0
+        assert explicit.fast_burn_threshold == 2.0
+
+
+# ----------------------------------------------------- serve acceptance loop
+class TestServeSloLoop:
+    def test_failure_regime_burns_degrades_and_recovers(self, serve_env):  # noqa: F811
+        """The full loop of ISSUE 5's acceptance criteria: a healthy tick
+        leaves compute sub-spans (quantile/round) in /debug/trace and the
+        device/compile metric families on /metrics; an induced fetch-failure
+        regime burns the scan-failure objective (GET /statusz), flips
+        /healthz to ``degraded``; recovery resolves the alert."""
+
+        async def main():
+            from krr_tpu.server.app import KrrServer
+
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(serve_config(serve_env), clock=lambda: now[0])
+            await ks.start(run_scheduler=False)
+            try:
+                # ---- healthy tick --------------------------------------
+                assert await ks.scheduler.run_once()
+
+                trace = (await http_get(ks.port, "/debug/trace")).json()
+                events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+                compute = next(e for e in events if e["name"] == "compute")
+                children = {
+                    e["name"] for e in events
+                    if e["args"]["parent_id"] == compute["args"]["span_id"]
+                }
+                assert {"quantile", "round"} <= children
+
+                metrics_text = (await http_get(ks.port, "/metrics")).text
+                # The device-observability families are declared on every
+                # exposition (fired values ride CLI/bench compute paths —
+                # serve's digest-ingest ticks never pack a matrix).
+                for family in (
+                    "krr_tpu_compile_cache_hits_total",
+                    "krr_tpu_compile_cache_misses_total",
+                    "krr_tpu_compile_seconds",
+                    "krr_tpu_pad_waste_pct",
+                    "krr_tpu_device_memory_bytes",
+                ):
+                    assert f"# TYPE {family} " in metrics_text
+                assert metric_value(metrics_text, "krr_tpu_fetch_rows_total") == 2
+                # Process self-metrics refresh on scrape.
+                assert metric_value(metrics_text, "krr_tpu_process_open_fds") > 0
+
+                r = await http_get(ks.port, "/statusz")
+                assert r.status_code == 200
+                status = r.json()
+                assert [o["name"] for o in status["objectives"]] == [
+                    "scan_failures", "fetch_failed_rows", "scan_latency", "freshness",
+                ]
+                assert status["firing"] == []
+                r = await http_get(ks.port, "/statusz", {"format": "text"})
+                assert r.status_code == 200 and "scan_failures" in r.text
+                assert (await http_get(ks.port, "/statusz", {"format": "nope"})).status_code == 400
+
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["status"] == "ok" and health["slo_firing"] == []
+
+                # ---- induced failure regime ----------------------------
+                serve_env["metrics"].fail_queries = True
+                try:
+                    for _ in range(4):
+                        now[0] += 60.0
+                        assert await ks.scheduler.run_once() is None  # tick failed
+                finally:
+                    serve_env["metrics"].fail_queries = False
+
+                r = await http_get(ks.port, "/healthz")
+                assert r.status_code == 200  # degraded is a verdict, not a liveness failure
+                health = r.json()
+                assert health["status"] == "degraded"
+                assert health["slo_firing"] == ["scan_failures"]
+
+                status = (await http_get(ks.port, "/statusz")).json()
+                scan = next(o for o in status["objectives"] if o["name"] == "scan_failures")
+                assert scan["firing"] and scan["burn_rate"]["fast"] >= 10.0
+                assert scan["error_budget_remaining"] < 0
+                assert status["firing"] == ["scan_failures"]
+
+                metrics_text = (await http_get(ks.port, "/metrics")).text
+                assert metric_value(
+                    metrics_text, "krr_tpu_slo_alert_firing", objective="scan_failures"
+                ) == 1
+                assert metric_value(
+                    metrics_text, "krr_tpu_slo_alert_transitions_total",
+                    objective="scan_failures", to="firing",
+                ) == 1
+
+                # ---- recovery ------------------------------------------
+                for _ in range(8):
+                    now[0] += 60.0
+                    assert await ks.scheduler.run_once()
+
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["status"] == "ok" and health["slo_firing"] == []
+                metrics_text = (await http_get(ks.port, "/metrics")).text
+                assert metric_value(
+                    metrics_text, "krr_tpu_slo_alert_firing", objective="scan_failures"
+                ) == 0
+                assert metric_value(
+                    metrics_text, "krr_tpu_slo_alert_transitions_total",
+                    objective="scan_failures", to="resolved",
+                ) == 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_statusz_404_without_engine(self):
+        from krr_tpu.server.app import HttpApp
+        from krr_tpu.server.state import ServerState
+        from krr_tpu.utils.logging import NULL_LOGGER
+
+        class FakeStore:
+            keys: list = []
+
+        app = HttpApp(ServerState(FakeStore()), NULL_LOGGER)
+        status, _ct, body = asyncio.run(app.route("GET", "/statusz", {}))
+        assert status == 404 and b"no SLO engine" in body

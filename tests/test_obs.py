@@ -252,7 +252,7 @@ def parse_exposition(text: str) -> dict:
                 labels = []
                 value = float(value_part)
             family = name
-            for suffix in ("_sum", "_count"):
+            for suffix in ("_sum", "_count", "_bucket"):
                 if name.endswith(suffix) and name[: -len(suffix)] in families:
                     family = name[: -len(suffix)]
             assert family in families, f"sample {name} with no TYPE/HELP header"
@@ -268,7 +268,11 @@ class TestExposition:
         families = parse_exposition(registry.render())
         assert "krr_tpu_scans_total" in families
         assert families["krr_tpu_scans_total"]["type"] == "counter"
-        assert families["krr_tpu_prom_query_seconds"]["type"] == "summary"
+        # The latency metrics are native histograms now; the summary kind
+        # stays available (compile telemetry uses it).
+        assert families["krr_tpu_prom_query_seconds"]["type"] == "histogram"
+        assert families["krr_tpu_http_request_seconds"]["type"] == "histogram"
+        assert families["krr_tpu_compile_seconds"]["type"] == "summary"
         assert all(meta["type"] is not None for meta in families.values())
         assert all(not meta["samples"] for meta in families.values())
 
@@ -284,19 +288,43 @@ class TestExposition:
 
     def test_summary_sum_count_pairing(self):
         registry = MetricsRegistry()
-        registry.observe("krr_tpu_prom_query_seconds", 0.25, route="buffered")
-        registry.observe("krr_tpu_prom_query_seconds", 0.75, route="buffered")
-        registry.observe("krr_tpu_prom_query_seconds", 1.5, route="streamed")
+        registry.observe("krr_tpu_compile_seconds", 0.25, phase="trace")
+        registry.observe("krr_tpu_compile_seconds", 0.75, phase="trace")
+        registry.observe("krr_tpu_compile_seconds", 1.5, phase="lower")
         families = parse_exposition(registry.render())
-        samples = families["krr_tpu_prom_query_seconds"]["samples"]
-        for route, want_sum, want_count in (("buffered", 1.0, 2), ("streamed", 1.5, 1)):
-            labels = (("route", route),)
-            assert samples[("krr_tpu_prom_query_seconds_sum", labels)] == want_sum
-            assert samples[("krr_tpu_prom_query_seconds_count", labels)] == want_count
+        samples = families["krr_tpu_compile_seconds"]["samples"]
+        for phase, want_sum, want_count in (("trace", 1.0, 2), ("lower", 1.5, 1)):
+            labels = (("phase", phase),)
+            assert samples[("krr_tpu_compile_seconds_sum", labels)] == want_sum
+            assert samples[("krr_tpu_compile_seconds_count", labels)] == want_count
         # Pairing invariant: every _sum series has its _count twin.
         sums = {k[1] for k in samples if k[0].endswith("_sum")}
         counts = {k[1] for k in samples if k[0].endswith("_count")}
         assert sums == counts
+
+    def test_histogram_buckets_cumulative_and_paired(self):
+        """Native histograms: cumulative le buckets, +Inf == _count, the le
+        label honors exact-boundary inclusivity, and the in-process bucket
+        view (what the SLO engine shares with Prometheus) matches."""
+        registry = MetricsRegistry()
+        registry.declare("t_seconds", "histogram", "test", buckets=(0.1, 1.0, 5.0))
+        for value in (0.05, 0.1, 0.5, 2.0, 99.0):  # 0.1 lands IN le="0.1"
+            registry.observe("t_seconds", value, route="r")
+        families = parse_exposition(registry.render())
+        samples = families["t_seconds"]["samples"]
+        labels = (("route", "r"),)
+        by_le = {
+            dict(k[1])["le"]: v for k, v in samples.items() if k[0] == "t_seconds_bucket"
+        }
+        assert by_le == {"0.1": 2, "1": 3, "5": 4, "+Inf": 5}
+        assert samples[("t_seconds_count", labels)] == 5
+        assert samples[("t_seconds_sum", labels)] == pytest.approx(101.65)
+        # Cumulative monotone by construction.
+        assert list(by_le.values()) == sorted(by_le.values())
+        assert registry.histogram_buckets("t_seconds", route="r") == [
+            (0.1, 2), (1.0, 3), (5.0, 4), (float("inf"), 5)
+        ]
+        assert registry.histogram_buckets("t_seconds", route="missing") is None
 
     def test_build_info(self):
         registry = MetricsRegistry()
@@ -384,6 +412,16 @@ class TestCLIWiring:
         events = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
         names = {e["name"] for e in events}
         assert {"scan", "discover", "fetch", "compute", "prom_query"} <= names
+        # Device-level compute sub-spans (`krr_tpu.obs.device`): the simple
+        # strategy's run_batch stages, nested under compute.
+        compute = next(e for e in events if e["name"] == "compute")
+        stage_parents = {
+            e["name"]: e["args"]["parent_id"]
+            for e in events
+            if e["name"] in ("pack", "quantile", "round")
+        }
+        assert set(stage_parents) == {"pack", "quantile", "round"}
+        assert set(stage_parents.values()) == {compute["args"]["span_id"]}
         root = next(e for e in events if e["name"] == "scan")
         assert root["args"]["kind"] == "cli" and root["args"]["objects"] == 4
         queries = [e for e in events if e["name"] == "prom_query"]
@@ -400,8 +438,79 @@ class TestCLIWiring:
             v for (name, _labels), v in samples.items() if name.endswith("_count")
         )
         assert total_queries == len(queries)
+        # Native histogram: every query lands in a bucket, +Inf == count.
+        inf_buckets = sum(
+            v for (name, labels), v in samples.items()
+            if name.endswith("_bucket") and dict(labels)["le"] == "+Inf"
+        )
+        assert inf_buckets == len(queries)
         assert sum(families["krr_tpu_prom_points_total"]["samples"].values()) > 0
         assert families["krr_tpu_build_info"]["samples"]
+        # Padding-efficiency gauges fired by the pack stage, and the
+        # process self-metrics refreshed into the dump.
+        pad = {
+            dict(labels)["resource"]: v
+            for (_n, labels), v in families["krr_tpu_pad_waste_pct"]["samples"].items()
+        }
+        assert set(pad) == {"cpu", "memory"} and all(0.0 <= v < 100.0 for v in pad.values())
+        assert families["krr_tpu_packed_elements"]["samples"]
+        assert families["krr_tpu_process_uptime_seconds"]["samples"]
+        assert families["krr_tpu_process_gc_collections_total"]["samples"]
+
+    def test_statusz_one_shot_dump(self, fake_env, tmp_path):  # noqa: F811
+        """--statusz on a one-shot scan writes a single SLO evaluation over
+        the scan's registry: the serve /statusz shape, with the fetch
+        objective fed by the cumulative row counters."""
+        statusz_path = tmp_path / "statusz.json"
+        result = _scan_cli(fake_env, "--statusz", str(statusz_path))
+        assert result.exit_code == 0, result.output
+        payload = json.loads(statusz_path.read_text())
+        by_name = {o["name"]: o for o in payload["objectives"]}
+        assert set(by_name) == {
+            "scan_failures", "fetch_failed_rows", "scan_latency", "freshness",
+        }
+        assert payload["firing"] == []
+        fetch = by_name["fetch_failed_rows"]
+        assert fetch["events"] == {"bad": 0.0, "total": 4.0}  # the 4-object fake fleet
+        assert fetch["error_budget_remaining"] == 1.0
+        # Every objective is LIVE for a one-shot scan, not vacuously green:
+        # the Runner fires the scan-level series the engine reads.
+        assert by_name["scan_failures"]["events"]["total"] == 1.0  # this scan
+        assert by_name["scan_latency"]["last_value"] > 0.0
+        assert by_name["freshness"]["last_value"] is not None
+
+    def test_statusz_fires_on_failed_fetches_and_lands_in_metrics_dump(
+        self, fake_env, tmp_path
+    ):  # noqa: F811
+        """A one-shot evaluation has no tick stream to damp blips over: a
+        fully failed fetch must report as FIRING (min-bad floor is 1 in
+        one-shot mode), the --slo-* knobs are settable on scan commands,
+        and the --metrics-dump exposition carries the slo samples the same
+        evaluation fired (statusz runs before the dump renders)."""
+        statusz_path = tmp_path / "statusz.json"
+        dump_path = tmp_path / "m.prom"
+        fake_env["metrics"].fail_queries = True
+        try:
+            result = _scan_cli(
+                fake_env, "--statusz", str(statusz_path), "--metrics-dump",
+                str(dump_path), "--slo-fetch-failure-budget", "0.01",
+            )
+        finally:
+            fake_env["metrics"].fail_queries = False
+        assert result.exit_code == 0, result.output  # degraded scan, no --strict
+        payload = json.loads(statusz_path.read_text())
+        assert payload["firing"] == ["fetch_failed_rows"]
+        fetch = next(
+            o for o in payload["objectives"] if o["name"] == "fetch_failed_rows"
+        )
+        assert fetch["budget"] == 0.01  # the knob reached the engine
+        assert fetch["events"]["bad"] == 4.0
+        families = parse_exposition(dump_path.read_text())
+        firing = {
+            dict(labels)["objective"]: v
+            for (_n, labels), v in families["krr_tpu_slo_alert_firing"]["samples"].items()
+        }
+        assert firing["fetch_failed_rows"] == 1.0
 
     def test_strict_exits_nonzero_on_failed_rows(self, fake_env):  # noqa: F811
         fake_env["metrics"].fail_queries = True
@@ -469,6 +578,244 @@ class TestCLIWiring:
             + runner.stats["compute_seconds"]
         )
         assert root.duration >= total_legs * 0.95
+
+
+# ----------------------------------------------------- device observability
+class TestDeviceObs:
+    def test_stage_spans_nest_and_fence_is_identity_when_disabled(self):
+        from krr_tpu.obs.device import NULL_DEVICE_OBS, DeviceObs
+
+        tracer = Tracer()
+        obs = DeviceObs(tracer, MetricsRegistry())
+        with tracer.span("compute") as compute:
+            with obs.stage("pack", rows=3) as span:
+                assert span.parent_id == compute.span_id
+        [spans] = tracer.traces()
+        assert [s.name for s in spans] == ["pack", "compute"]
+        # Disabled path: the shared null context, fence is identity.
+        sentinel = object()
+        assert NULL_DEVICE_OBS.fence(sentinel) is sentinel
+        with NULL_DEVICE_OBS.stage("pack") as null_span:
+            assert null_span.span_id is None
+        assert NULL_DEVICE_OBS.tracer.traces() == []
+
+    def test_compile_split_and_cache_counters(self, tmp_path):
+        """A fresh jitted entry point run inside a stage: the span gains the
+        compile-vs-execute split, the registry observes per-phase compile
+        seconds, and the persistent compilation cache counts a miss (first
+        build) then a hit (same program, fresh jit)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from krr_tpu.obs.device import DeviceObs, install_compile_hooks
+        from krr_tpu.utils.compile_cache import enabled_dir, enable_compilation_cache
+
+        # Enable twice: the second call force-resets jax's pinned cache
+        # state, so the cache engages even if earlier tests jitted before
+        # any cache directory existed.
+        enable_compilation_cache(str(tmp_path / "warm"))
+        assert enable_compilation_cache(str(tmp_path / "cache")) == enabled_dir()
+        registry = MetricsRegistry()
+        install_compile_hooks(registry)
+        tracer = Tracer()
+        obs = DeviceObs(tracer, registry)
+
+        # A program no other test compiles. Lambdas on purpose: the
+        # persistent cache key includes the jitted function's NAME, and two
+        # identically-bodied lambdas share "<lambda>" — which is what lets
+        # the second, distinct function object below hit the cache.
+        fresh = lambda x: x * 9183.25 + 41.0625  # noqa: E731
+
+        with tracer.span("compute"):
+            with obs.stage("quantile", path="test"):
+                obs.fence(jax.jit(fresh)(jnp.ones((16, 256), jnp.float32)))
+        [spans] = tracer.traces()
+        quantile = next(s for s in spans if s.name == "quantile")
+        assert quantile.attributes["compile_seconds"] > 0
+        assert quantile.attributes["execute_seconds"] >= 0
+        assert (registry.value("krr_tpu_compile_seconds_count", phase="backend_compile") or 0) > 0
+        misses = registry.value("krr_tpu_compile_cache_misses_total")
+        assert misses is not None and misses >= 1
+
+        # The same PROGRAM from a distinct function (identical body and
+        # name → same persistent cache key; a distinct object so jax's
+        # in-memory jit cache can't short-circuit): a cache HIT.
+        fresh_twin = lambda x: x * 9183.25 + 41.0625  # noqa: E731
+        hits_before = registry.value("krr_tpu_compile_cache_hits_total") or 0
+        _ = np.asarray(jax.jit(fresh_twin)(jnp.ones((16, 256), jnp.float32)))
+        assert (registry.value("krr_tpu_compile_cache_hits_total") or 0) > hits_before
+
+    def test_padding_stats_and_gauges(self):
+        import numpy as np
+
+        from krr_tpu.obs.device import DeviceObs
+        from krr_tpu.ops.packing import pack_ragged, padding_stats
+
+        values, counts = pack_ragged([[np.ones(5)], [np.ones(200)], [np.ones(0)]])
+        real, padded = padding_stats(counts, values.shape[1])
+        assert real == 205 and padded == 3 * 256  # lane-rounded capacity
+
+        class Packed:
+            pass
+
+        packed = Packed()
+        packed.counts, packed.capacity = counts, values.shape[1]
+        registry = MetricsRegistry()
+        DeviceObs(NULL_TRACER, registry).record_padding("cpu", packed)
+        # real + padding partition the [rows x capacity] matrix.
+        assert registry.value("krr_tpu_packed_elements", resource="cpu", kind="real") == 205
+        assert registry.value("krr_tpu_packed_elements", resource="cpu", kind="padding") == 563
+        assert registry.value("krr_tpu_pad_waste_pct", resource="cpu") == pytest.approx(
+            100.0 * 563 / 768
+        )
+
+    def test_device_memory_watermarks_noop_on_cpu(self):
+        from krr_tpu.obs.device import DeviceObs
+
+        registry = MetricsRegistry()
+        DeviceObs(NULL_TRACER, registry).record_device_memory()  # must not raise
+        rendered = registry.render()
+        assert "# TYPE krr_tpu_device_memory_bytes gauge" in rendered
+
+
+# ------------------------------------------------------ registry self-check
+class TestRegistrySelfCheck:
+    def test_every_fired_metric_is_declared(self):
+        """Grep krr_tpu/ for every metric name passed to .inc/.set/.observe
+        and assert each is declared in SERVER_METRICS — an undeclared fire
+        would KeyError at runtime on whatever path first hits it."""
+        import pathlib
+        import re
+
+        from krr_tpu.obs.metrics import SERVER_METRICS
+
+        declared = {d[0] for d in SERVER_METRICS}
+        package = pathlib.Path(__file__).resolve().parent.parent / "krr_tpu"
+        pattern = re.compile(
+            r"\.(?:inc|set|observe)\(\s*\n?\s*\"(krr_tpu_[a-z0-9_]+)\"", re.MULTILINE
+        )
+        fired: dict[str, set] = {}
+        for path in sorted(package.rglob("*.py")):
+            for name in pattern.findall(path.read_text()):
+                fired.setdefault(name, set()).add(path.name)
+        assert fired, "self-check regex found no metric fires — pattern rotted?"
+        undeclared = {
+            name: files for name, files in fired.items() if name not in declared
+        }
+        assert not undeclared, f"metrics fired but not declared: {undeclared}"
+
+
+# ------------------------------------------------------------- debug dumps
+class TestDebugDump:
+    def test_debug_dump_writes_timestamped_files_next_to_targets(self, tmp_path, capsys):
+        from krr_tpu.obs.dump import debug_dump
+        from krr_tpu.utils.logging import KrrLogger
+
+        tracer = Tracer()
+        with tracer.span("scan", kind="test"):
+            pass
+        registry = MetricsRegistry()
+        trace_target = tmp_path / "out" / "scan.json"
+        trace_target.parent.mkdir()
+        logger = KrrLogger(log_format="json")
+        trace_path, metrics_path = debug_dump(
+            tracer, registry, trace_target=str(trace_target), logger=logger
+        )
+        # Next to the --trace target; metrics fall back to the cwd stem.
+        assert trace_path.startswith(str(trace_target))
+        assert json.loads(open(trace_path).read())["traceEvents"]
+        exposition = open(metrics_path).read()
+        assert "krr_tpu_debug_dumps_total 1" in exposition
+        assert "krr_tpu_process_uptime_seconds" in exposition
+        assert "krr_tpu_build_info{" in exposition
+        record = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert trace_path in record["message"] and metrics_path in record["message"]
+        # A second dump in the same second must not overwrite the first.
+        trace2, metrics2 = debug_dump(tracer, registry, trace_target=str(trace_target))
+        assert trace2 != trace_path and metrics2 != metrics_path
+        import os
+
+        os.unlink(metrics_path), os.unlink(metrics2)  # cwd fallbacks: clean up
+
+    def test_sigusr2_handler_fires(self, tmp_path):
+        import signal
+
+        from krr_tpu.obs.dump import install_signal_dump
+
+        if not hasattr(signal, "SIGUSR2"):
+            pytest.skip("no SIGUSR2 on this platform")
+        tracer = Tracer()
+        registry = MetricsRegistry()
+        previous = signal.getsignal(signal.SIGUSR2)
+        try:
+            assert install_signal_dump(
+                tracer,
+                registry,
+                trace_target=str(tmp_path / "t.json"),
+                metrics_target=str(tmp_path / "m.prom"),
+            )
+            signal.raise_signal(signal.SIGUSR2)
+            dumps = sorted(tmp_path.glob("m.prom.*"))
+            assert len(dumps) == 1 and "krr_tpu_debug_dumps_total 1" in dumps[0].read_text()
+            assert sorted(tmp_path.glob("t.json.*"))
+        finally:
+            signal.signal(signal.SIGUSR2, previous)
+
+
+# -------------------------------------------------------- slow-query edges
+class TestSlowQueryLog:
+    def _loader(self, threshold, monkeypatch, walls):
+        """A PrometheusLoader stub exercising ONLY the _instrumented leg,
+        with the wall clock scripted so the threshold boundary is exact."""
+        import collections
+
+        from krr_tpu.integrations import prometheus as prom
+
+        loader = prom.PrometheusLoader.__new__(prom.PrometheusLoader)
+        loader.tracer = NULL_TRACER
+        loader.metrics = None
+        loader.slow_query_seconds = threshold
+        warnings: list[str] = []
+
+        class Recorder:
+            def warning(self, message=""):
+                warnings.append(message)
+
+        loader.logger = Recorder()
+
+        async def retrying(attempt_fn, meter=None):
+            return b"{}"
+
+        loader._retrying = retrying
+        script = collections.deque(walls)
+        real = prom.time.perf_counter
+        monkeypatch.setattr(
+            prom.time, "perf_counter", lambda: script.popleft() if script else real()
+        )
+        return loader, warnings
+
+    def _run(self, loader):
+        from krr_tpu.integrations.prometheus import _QueryMeter
+
+        asyncio.run(
+            loader._instrumented("up", 0.0, 600.0, "60s", "buffered", None, _QueryMeter())
+        )
+
+    def test_exactly_at_threshold_logs(self, monkeypatch):
+        loader, warnings = self._loader(10.0, monkeypatch, [100.0, 110.0])
+        self._run(loader)
+        assert len(warnings) == 1 and "Slow Prometheus query: 10.0s" in warnings[0]
+
+    def test_just_under_threshold_is_silent(self, monkeypatch):
+        loader, warnings = self._loader(10.0, monkeypatch, [100.0, 109.999])
+        self._run(loader)
+        assert warnings == []
+
+    def test_zero_disables_the_log(self, monkeypatch):
+        loader, warnings = self._loader(0.0, monkeypatch, [100.0, 5000.0])
+        self._run(loader)
+        assert warnings == []
 
 
 # ------------------------------------------------------------ serve wiring
