@@ -39,7 +39,8 @@ Prints ONE JSON line:
      "raw_containers_per_sec": N, "raw_spread_pct": N, "raw_vs_baseline": N,
      "dispatch_floor_ms": N, "pipelined_depth": N, "pipelined_spread_pct": N,
      "floor_corrected_containers_per_sec": N|null, "vs_previous_round": N|null,
-     "regression_vs_previous": bool, "secondary": {...}}
+     "regression_vs_previous": bool, "fetch_vs_previous_round": N|null,
+     "fetch_regression_vs_previous": bool, "secondary": {...}}
 The headline ``value`` is the PIPELINED rate (round-4 verdict item 4): R
 dispatches, ONE sync — the tunnel RTT amortizes R-fold and the rate converges
 to the kernel's own, stable to ~1% across runs, so round-over-round deltas
@@ -289,6 +290,7 @@ def obs_leg(secondary: dict, check) -> None:
     secondary["obs_traced_scan_seconds"] = round(traced_best, 4)
     secondary["obs_trace_overhead_pct"] = round(max(0.0, overhead_pct), 2)
     secondary["obs_spans_per_scan"] = span_count
+    analyze_smoke_leg(tracer, secondary, check)
     print(
         f"bench: obs overhead plain {plain_best:.4f}s vs traced {traced_best:.4f}s "
         f"({max(0.0, overhead_pct):.2f}% over {runs} interleaved runs, "
@@ -304,6 +306,57 @@ def obs_leg(secondary: dict, check) -> None:
         "obs_bitexact",
         plain_result.model_dump_json() == traced_result.model_dump_json(),
         "tracing changed the recommendations",
+    )
+
+
+def analyze_smoke_leg(tracer, secondary: dict, check) -> None:
+    """`krr-tpu analyze` smoke: dump the obs leg's recorded ring as a
+    Chrome trace file, run the real CLI subprocess over it, and assert the
+    attribution report comes back (rc 0, ≥1 scan, categories partition the
+    wall). A break anywhere in trace export → chrome re-import → sweep →
+    CLI wiring fails the round like a parity break. Reported under
+    ``secondary.analyze_*``."""
+    import subprocess
+    import tempfile
+
+    from krr_tpu.obs.trace import write_chrome_trace
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        trace_path = os.path.join(tmp, "scan-trace.json")
+        write_chrome_trace(tracer, trace_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "krr_tpu", "analyze", "--trace", trace_path, "--format", "json"],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=here,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    report: dict = {}
+    if proc.returncode == 0:
+        try:
+            report = json.loads(proc.stdout)
+        except ValueError:
+            pass
+    scans = report.get("scans", [])
+    partitioned = all(
+        abs(sum(s["categories"].values()) - s["wall_seconds"])
+        <= max(0.01 * s["wall_seconds"], 1e-3)
+        for s in scans
+    )
+    ok = proc.returncode == 0 and bool(scans) and partitioned
+    secondary["analyze_smoke"] = "ok" if ok else f"failed rc={proc.returncode}"
+    secondary["analyze_scans"] = len(scans)
+    print(
+        f"bench: analyze smoke -> rc {proc.returncode}, {len(scans)} scan(s) attributed",
+        file=sys.stderr,
+    )
+    check(
+        "analyze_smoke",
+        ok,
+        f"rc={proc.returncode}, scans={len(scans)}, partitioned={partitioned}: "
+        f"{proc.stderr[-300:]}",
     )
 
 
@@ -779,6 +832,10 @@ def main() -> None:
                     round(floor_corrected, 1) if floor_corrected is not None else None
                 ),
                 **previous_fields,
+                # The fetch-wall twin of the kernel gate: warm fleet-scan
+                # fetch seconds vs the previous recorded round (same fleet
+                # width only), >15% slower flags a regression.
+                **_fetch_trendline_fields(secondary),
                 "secondary": secondary,
             }
         )
@@ -788,10 +845,9 @@ def main() -> None:
         sys.exit(1)
 
 
-def _previous_round_stable():
-    """(filename, stable rate) from the newest recorded BENCH_r*.json, or
-    None. Older rounds carried the raw rate as `value` with the pipelined
-    rate in a secondary field; prefer the pipelined one wherever present."""
+def _previous_round_payload():
+    """(filename, parsed payload) of the newest recorded BENCH_r*.json, or
+    None — the shared source of every round-over-round gate."""
     import glob
     import re
 
@@ -807,11 +863,67 @@ def _previous_round_stable():
         with open(newest) as f:
             payload = json.load(f)
         # The driver wraps the bench's own JSON line under "parsed".
-        payload = payload.get("parsed", payload)
-        stable = payload.get("pipelined_containers_per_sec") or payload.get("value")
-        return os.path.basename(newest), float(stable)
+        return os.path.basename(newest), payload.get("parsed", payload)
     except Exception:
         return None
+
+
+def _previous_round_stable():
+    """(filename, stable rate) from the newest recorded BENCH_r*.json, or
+    None. Older rounds carried the raw rate as `value` with the pipelined
+    rate in a secondary field; prefer the pipelined one wherever present."""
+    previous = _previous_round_payload()
+    if previous is None:
+        return None
+    prev_file, payload = previous
+    try:
+        stable = payload.get("pipelined_containers_per_sec") or payload.get("value")
+        return prev_file, float(stable)
+    except Exception:
+        return None
+
+
+def _fetch_trendline_fields(secondary: dict) -> dict:
+    """The fleet-scan fetch-wall gate, mirroring the kernel-rate gate: this
+    run's warm ``fleet_e2e_fetch_seconds`` vs the newest recorded round's.
+    The threshold is 15% (wall-clock fetch on the shared rig wobbles more
+    than the pipelined kernel rate's ~1%); a trip means the fetch leg —
+    the ROADMAP's #1 wall — regressed and the round must not be recorded
+    as healthy. Fields are emitted unconditionally so gate scripts can
+    read them without probing."""
+    fields = {
+        "fetch_vs_previous_round": None,
+        "previous_round_fetch_seconds": None,
+        "fetch_regression_vs_previous": False,
+    }
+    current = secondary.get("fleet_e2e_fetch_seconds")
+    previous = _previous_round_payload()
+    if previous is None or not isinstance(current, (int, float)) or current <= 0:
+        return fields
+    prev_file, payload = previous
+    prev_secondary = payload.get("secondary") or {}
+    prev_fetch = prev_secondary.get("fleet_e2e_fetch_seconds")
+    if not isinstance(prev_fetch, (int, float)) or prev_fetch <= 0:
+        return fields
+    if prev_secondary.get("fleet_e2e_containers") != secondary.get("fleet_e2e_containers"):
+        # Different fleet widths (e.g. a --smoke run vs a full round):
+        # the ratio would read the scale, not the transport.
+        return fields
+    vs = current / prev_fetch  # >1 = slower than the previous round
+    regression = vs > 1.15
+    print(
+        f"bench: fleet fetch {current}s vs {prev_file} {prev_fetch}s -> x{vs:.3f}"
+        + (" FETCH REGRESSION (>15% above previous round)" if regression else ""),
+        file=sys.stderr,
+    )
+    fields.update(
+        {
+            "fetch_vs_previous_round": round(vs, 3),
+            "previous_round_fetch_seconds": prev_fetch,
+            "fetch_regression_vs_previous": regression,
+        }
+    )
+    return fields
 
 
 if __name__ == "__main__":

@@ -182,6 +182,8 @@ def _fixture_env(n_containers: int, samples: int, shared: int = 0):
                 )
 
             def one_scan(config) -> tuple[float, dict]:
+                from krr_tpu.integrations.prometheus import TRANSPORT_PHASES
+
                 runner = Runner(config)
                 server_cpu = _proc_cpu_seconds(proc.pid)
                 start = time.perf_counter()
@@ -190,6 +192,17 @@ def _fixture_env(n_containers: int, samples: int, shared: int = 0):
                 elapsed = time.perf_counter() - start
                 assert runner.stats["objects"] == n_containers, runner.stats
                 runner.stats["server_cpu_seconds"] = _proc_cpu_seconds(proc.pid) - server_cpu
+                # Transport-phase attribution of THIS scan's fetch leg, from
+                # the runner's own registry (summed across every range
+                # query; phases that never occurred read 0).
+                for phase in TRANSPORT_PHASES:
+                    runner.stats[f"prom_phase_{phase}_seconds"] = (
+                        runner.metrics.value("krr_tpu_prom_phase_seconds_sum", phase=phase)
+                        or 0.0
+                    )
+                runner.stats["prom_wire_bytes"] = runner.metrics.total(
+                    "krr_tpu_prom_wire_bytes_total"
+                )
                 return elapsed, runner.stats
 
             yield make_config, one_scan
@@ -303,6 +316,20 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
         "fleet_e2e_overlap_pct": round(stats.get("pipeline_overlap_pct", 0.0), 1),
         "fleet_e2e_pipeline_fetch_seconds": round(stats.get("pipeline_fetch_seconds", 0.0), 3),
         "fleet_e2e_pipeline_fold_seconds": round(stats.get("pipeline_fold_seconds", 0.0), 3),
+        # Pipeline wait attribution (PR 6): producer put-blocked = fold-
+        # bound, consumer get-starved = fetch-bound — the fetch-vs-fold
+        # verdict as a measured pair, not an inference from overlap.
+        "fleet_e2e_put_blocked_seconds": round(stats.get("pipeline_put_blocked_seconds", 0.0), 3),
+        "fleet_e2e_get_starved_seconds": round(stats.get("pipeline_get_starved_seconds", 0.0), 3),
+        # Transport-phase split of the warm fetch leg (summed per-query
+        # seconds from krr_tpu_prom_phase_seconds — concurrency means these
+        # can exceed the fetch wall; ratios are the signal).
+        **{
+            f"fleet_e2e_phase_{key.split('prom_phase_')[1]}": round(value, 3)
+            for key, value in stats.items()
+            if key.startswith("prom_phase_")
+        },
+        "fleet_e2e_wire_mb": round(stats.get("prom_wire_bytes", 0.0) / 1e6, 1),
         "fleet_e2e_discover_seconds": round(stats["discover_seconds"], 3),
         "fleet_e2e_fetch_seconds": round(stats["fetch_seconds"], 3),
         "fleet_e2e_compute_seconds": round(stats["compute_seconds"], 3),
@@ -485,7 +512,11 @@ def main() -> None:
             f"({out['fleet_e2e_seconds']}s: discover {out['fleet_e2e_discover_seconds']}s, "
             f"fetch {out['fleet_e2e_fetch_seconds']}s, compute {out['fleet_e2e_compute_seconds']}s; "
             f"staged control {out['fleet_e2e_staged_seconds']}s -> x{out['fleet_e2e_vs_staged']}, "
-            f"pipeline overlap {out['fleet_e2e_overlap_pct']}%; "
+            f"pipeline overlap {out['fleet_e2e_overlap_pct']}%, "
+            f"waits put {out['fleet_e2e_put_blocked_seconds']}s / "
+            f"get {out['fleet_e2e_get_starved_seconds']}s, "
+            f"ttfb {out.get('fleet_e2e_phase_ttfb_seconds', 0)}s body {out.get('fleet_e2e_phase_body_read_seconds', 0)}s "
+            f"sink {out.get('fleet_e2e_phase_sink_seconds', 0)}s over {out['fleet_e2e_wire_mb']} MB wire; "
             f"cold {out['fleet_e2e_cold_seconds']}s; warm CPU split: client fetch "
             f"{out['fleet_e2e_fetch_cpu_seconds']}s, server {out['fleet_e2e_server_cpu_seconds']}s)",
             file=sys.stderr,

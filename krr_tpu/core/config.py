@@ -94,6 +94,11 @@ class Config(pd.BaseModel):
     #: objectives `krr-tpu serve` exposes on GET /statusz, evaluated once
     #: over this scan's registry) as JSON to this file at exit.
     statusz_path: Optional[str] = None
+    #: Write the scan's critical-path attribution report
+    #: (`krr_tpu.obs.profile` — the JSON `krr-tpu analyze` and serve's
+    #: GET /debug/profile produce) to this file at exit. Implies a
+    #: recording tracer, like --trace.
+    profile_path: Optional[str] = None
 
     # SLO engine (`krr_tpu.obs.health`) — serve evaluates per scheduler
     # tick; one-shot scans evaluate once for --statusz.
@@ -240,12 +245,13 @@ class Config(pd.BaseModel):
         )
 
     def create_tracer(self):
-        """A recording tracer when ``--trace`` asked for one, else the no-op
+        """A recording tracer when ``--trace`` or ``--profile`` asked for
+        one (both consume the recorded ring at exit), else the no-op
         tracer — the disabled path must stay free (`krr_tpu.obs.trace`).
         Serve swaps in a recording tracer unconditionally (its ring backs
         ``GET /debug/trace``)."""
         from krr_tpu.obs.trace import NULL_TRACER, Tracer
 
-        if self.trace_path:
+        if self.trace_path or self.profile_path:
             return Tracer(ring_scans=self.trace_ring_scans)
         return NULL_TRACER

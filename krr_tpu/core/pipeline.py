@@ -35,6 +35,17 @@ wall time both stages were genuinely concurrent, and ``overlap_pct``
 normalizes it by the shorter stage — 100 % means the cheaper stage was fully
 hidden under the other, the ``wall ≈ max(fetch, compute)`` target of a
 perfectly pipelined scan.
+
+Wait accounting answers the question overlap alone can't: WHICH stage is
+the bottleneck. ``put_blocked_seconds`` (producers stalled in a full
+queue's ``put``) says the consumer can't keep up — the scan is FOLD-bound;
+``get_starved_seconds`` (the consumer parked in ``get`` with an empty
+queue) says producers can't feed it — FETCH-bound. Queue occupancy is
+sampled at every put AND get (a put-only peak systematically misses the
+drain side: a consumer that always dequeues before the next put would
+report depth 1 forever while the producer was actually blocked), and the
+live ``krr_tpu_scan_pipeline_queue_depth`` gauge tracks the same samples
+on /metrics.
 """
 
 from __future__ import annotations
@@ -44,6 +55,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from krr_tpu.obs.metrics import MetricsRegistry
 from krr_tpu.obs.trace import NULL_TRACER, NullTracer
 
 #: Default bounded-queue depth (`Config.pipeline_depth` overrides; 0 there
@@ -69,12 +81,27 @@ class PipelineStats:
     #: caller staged discovery itself).
     discover_seconds: float = 0.0
     batches: int = 0
+    #: Queue occupancy high-water mark, sampled at every put AND get.
     peak_queue_depth: int = 0
+    #: Sum of wall seconds producers spent blocked in ``put`` on a full
+    #: queue (summed across concurrent producers: 2 producers blocked for
+    #: 1 s each = 2 s). > 0 means the fold side was the bottleneck.
+    put_blocked_seconds: float = 0.0
+    #: Wall seconds the single consumer spent parked in ``get`` on an empty
+    #: queue (including the tail wait for the close sentinel while the last
+    #: fetches ran). Large values mean the scan is fetch-bound.
+    get_starved_seconds: float = 0.0
+    #: Mean queue occupancy over all put/get samples.
+    mean_queue_depth: float = 0.0
+    #: Internal occupancy accumulators behind ``mean_queue_depth``.
+    depth_samples: int = 0
+    depth_sum: int = 0
 
     def finalize(self) -> "PipelineStats":
         self.overlap_seconds = max(0.0, self.fetch_seconds + self.fold_seconds - self.wall_seconds)
         shorter = min(self.fetch_seconds, self.fold_seconds)
         self.overlap_pct = 100.0 * self.overlap_seconds / shorter if shorter > 1e-9 else 0.0
+        self.mean_queue_depth = self.depth_sum / self.depth_samples if self.depth_samples else 0.0
         return self
 
 
@@ -109,6 +136,7 @@ class ScanPipeline:
         *,
         depth: int = DEFAULT_PIPELINE_DEPTH,
         tracer: NullTracer = NULL_TRACER,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         self._fold = fold
         #: Each fold call gets a ``fold`` span (no-op by default). The
@@ -116,12 +144,25 @@ class ScanPipeline:
         #: caller's context, so fold spans parent to whatever span was
         #: active when the pipeline opened — the scan root.
         self._tracer = tracer
+        #: Live occupancy gauge target (``krr_tpu_scan_pipeline_queue_depth``).
+        self._metrics = metrics
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, depth))
         self._consumer: Optional[asyncio.Task] = None
         self._error: Optional[BaseException] = None
         self._started_at = 0.0
         self._last_put_at = 0.0
         self.stats = PipelineStats()
+
+    def _sample_depth(self, depth: int) -> None:
+        """One occupancy sample (called from put and get): peak, mean
+        accumulators, and the live gauge."""
+        stats = self.stats
+        if depth > stats.peak_queue_depth:
+            stats.peak_queue_depth = depth
+        stats.depth_samples += 1
+        stats.depth_sum += depth
+        if self._metrics is not None:
+            self._metrics.set("krr_tpu_scan_pipeline_queue_depth", depth)
 
     async def __aenter__(self) -> "ScanPipeline":
         self._started_at = time.perf_counter()
@@ -135,16 +176,30 @@ class ScanPipeline:
         longer be folded."""
         if self._error is not None:
             raise self._error
+        t0 = time.perf_counter()
         await self._queue.put(batch)
         self._last_put_at = time.perf_counter()
+        # Any wall inside put() is backpressure: put only parks on a full
+        # queue, so a non-blocking put contributes ~a clock tick.
+        self.stats.put_blocked_seconds += self._last_put_at - t0
         self.stats.batches += 1
-        self.stats.peak_queue_depth = max(self.stats.peak_queue_depth, self._queue.qsize())
+        self._sample_depth(self._queue.qsize())
 
     async def _consume(self) -> None:
         while True:
+            t0 = time.perf_counter()
             batch = await self._queue.get()
+            # Symmetric to put: get only parks on an empty queue, so this is
+            # consumer starvation (the tail wait for _DONE included — that
+            # is real starvation while the last fetches run).
+            self.stats.get_starved_seconds += time.perf_counter() - t0
             if batch is _DONE:
                 return
+            # Sample occupancy on the DRAIN side too: +1 counts the batch
+            # just dequeued, so a put-then-immediate-get cadence reads its
+            # true depth instead of the put-only view (which misses drains
+            # entirely when the consumer always wins the race).
+            self._sample_depth(self._queue.qsize() + 1)
             if self._error is not None:
                 continue  # drain mode: unblock producers, discard batches
             fold_start = time.perf_counter()

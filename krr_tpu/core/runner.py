@@ -598,7 +598,9 @@ class ScanSession:
                         payload = None
                 await pipeline.put((key, subset, payload))
 
-        async with ScanPipeline(fold, depth=depth, tracer=self.tracer) as pipeline:
+        async with ScanPipeline(
+            fold, depth=depth, tracer=self.tracer, metrics=self.metrics
+        ) as pipeline:
             if staged_inventory:
                 results = await asyncio.gather(
                     *[
@@ -835,7 +837,21 @@ class Runner:
                     "pipeline_overlap_seconds": pipeline_stats.overlap_seconds,
                     "pipeline_overlap_pct": pipeline_stats.overlap_pct,
                     "pipeline_batches": float(pipeline_stats.batches),
+                    # Bottleneck attribution: producers blocked in put =
+                    # fold-bound, consumer starved in get = fetch-bound.
+                    "pipeline_put_blocked_seconds": pipeline_stats.put_blocked_seconds,
+                    "pipeline_get_starved_seconds": pipeline_stats.get_starved_seconds,
+                    "pipeline_peak_queue_depth": float(pipeline_stats.peak_queue_depth),
+                    "pipeline_mean_queue_depth": pipeline_stats.mean_queue_depth,
                 }
+            )
+            self.metrics.set(
+                "krr_tpu_scan_pipeline_wait_seconds",
+                pipeline_stats.put_blocked_seconds, side="producer_blocked",
+            )
+            self.metrics.set(
+                "krr_tpu_scan_pipeline_wait_seconds",
+                pipeline_stats.get_starved_seconds, side="consumer_starved",
             )
         end_to_end = (len(objects) / (t3 - t0)) if t3 > t0 and objects else 0.0
         retries = int(self.stats["fetch_retries"])

@@ -123,7 +123,9 @@ class _RawTransport:
             )
         return http.client.HTTPConnection(self._host, self._port, timeout=self._timeout)
 
-    def request(self, method: str, path: str, body: Optional[str], headers: dict[str, str]) -> tuple[int, bytes]:
+    def request(
+        self, method: str, path: str, body: Optional[str], headers: dict[str, str], meter=None
+    ) -> tuple[int, bytes]:
         """One request on a pooled connection (sync — run in a worker
         thread). Returns (status, body bytes); the connection returns to the
         pool only after a fully-read response.
@@ -133,34 +135,60 @@ class _RawTransport:
         idle keep-alive (RemoteDisconnected/BadStatusLine), and burning one
         of the caller's real retry attempts (with backoff) on a stale socket
         would let a pool full of dead sockets fail a query outright."""
-        return self.request_streaming(method, path, body, headers, sink=None)
+        return self.request_streaming(method, path, body, headers, sink=None, meter=meter)
 
     def request_streaming(
-        self, method: str, path: str, body: Optional[str], headers: dict[str, str], sink
+        self, method: str, path: str, body: Optional[str], headers: dict[str, str], sink, meter=None
     ) -> tuple[int, bytes]:
         """Like :meth:`request`, but on a 2xx the response body is fed to
         ``sink(chunk)`` in ~1 MB pieces as it arrives — never materialized —
         and the returned bytes are empty. Non-2xx bodies (small error
         payloads) are returned for diagnostics either way. ``sink=None``
-        degrades to the buffered behavior."""
+        degrades to the buffered behavior.
+
+        ``meter`` (a `_QueryMeter`) splits the request into transport
+        phases: connect/TLS (explicit ``conn.connect()`` — http.client would
+        otherwise fold the handshake invisibly into the first send; pooled
+        keep-alive connections record none), request-write, time-to-first-
+        byte, and body-read (socket-blocked time only — sink feed time is
+        the caller's ``sink`` phase). A couple of clock reads per MB chunk:
+        noise next to the recv itself."""
         with self._lock:
             conn, fresh = (self._idle.pop(), False) if self._idle else (self._connect(), True)
         while True:
             fed = False  # once the sink has bytes, a transparent retry would duplicate them
             try:
+                if meter is not None and conn.sock is None:
+                    t0 = time.perf_counter()
+                    conn.connect()
+                    meter.add_phase("connect", time.perf_counter() - t0)
+                t0 = time.perf_counter()
                 conn.request(method, self._prefix + path, body=body, headers={**self._headers, **headers})
+                t1 = time.perf_counter()
                 response = conn.getresponse()
+                t2 = time.perf_counter()
+                if meter is not None:
+                    meter.add_phase("request_write", t1 - t0)
+                    meter.add_phase("ttfb", t2 - t1)
                 status = response.status
                 if sink is None or status >= 300:
+                    t0 = time.perf_counter()
                     data = response.read()
+                    if meter is not None:
+                        meter.add_phase("body_read", time.perf_counter() - t0)
                 else:
                     data = b""
+                    read_seconds = 0.0
                     while True:
+                        t0 = time.perf_counter()
                         chunk = response.read(1 << 20)
+                        read_seconds += time.perf_counter() - t0
                         if not chunk:
                             break
                         fed = True
                         sink(chunk)
+                    if meter is not None:
+                        meter.add_phase("body_read", read_seconds)
             except (http.client.HTTPException, ConnectionError):
                 conn.close()
                 if not fresh and not fed:
@@ -333,20 +361,44 @@ def subwindows(
     return windows
 
 
+#: Transport phases a range query decomposes into (the attribution unit of
+#: `krr_tpu.obs.profile` and the ``krr_tpu_prom_phase_seconds`` histogram):
+#: ``queue_wait`` (connection-semaphore wait before the attempt starts),
+#: ``connect`` (TCP + TLS handshake — absent on a pooled keep-alive
+#: connection), ``request_write`` (request line/headers/body send),
+#: ``ttfb`` (request sent → first status-line byte), ``body_read`` (blocked
+#: in socket reads), ``sink`` (feeding streamed chunks into the native
+#: ingest), ``decode`` (buffered-body parse, or the streamed finalize/readout).
+#: Retry backoff sleeps are deliberately NOT a phase — they are recorded
+#: separately (``krr_tpu_prom_retry_backoff_seconds``, span ``retry_wait``)
+#: so a query that spent its wall waiting out 5xx backoff cannot masquerade
+#: as slow transport.
+TRANSPORT_PHASES = (
+    "queue_wait", "connect", "request_write", "ttfb", "body_read", "sink", "decode",
+)
+
+
 class _QueryMeter:
-    """Per-query instrumentation accumulator: attempts made and response
-    bytes seen, across retries. One query runs one attempt at a time, so
-    plain int adds suffice (worker-thread attempts hand the meter back
+    """Per-query instrumentation accumulator: attempts made, response bytes
+    seen, per-phase transport seconds, decoded-array bytes, and backoff
+    wait, across retries. One query runs one attempt at a time, so plain
+    int/float adds suffice (worker-thread attempts hand the meter back
     before the next attempt starts)."""
 
-    __slots__ = ("attempts", "bytes")
+    __slots__ = ("attempts", "bytes", "decoded_bytes", "backoff", "phases")
 
     def __init__(self) -> None:
         self.attempts = 0
         self.bytes = 0
+        self.decoded_bytes = 0
+        self.backoff = 0.0
+        self.phases: dict[str, float] = {}
 
     def add_bytes(self, n: int) -> None:
         self.bytes += n
+
+    def add_phase(self, phase: str, seconds: float) -> None:
+        self.phases[phase] = self.phases.get(phase, 0.0) + seconds
 
 
 class PrometheusLoader:
@@ -513,11 +565,13 @@ class PrometheusLoader:
             {"Content-Type": "application/x-www-form-urlencoded"},
         )
 
-    def _raw_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
+    def _raw_range_query(
+        self, query: str, start: float, end: float, step: str, meter=None
+    ) -> tuple[int, bytes]:
         """One buffered range request on the raw transport (sync — run in a
         worker thread)."""
         assert self._raw is not None
-        return self._raw.request(*self._range_request_parts(query, start, end, step))
+        return self._raw.request(*self._range_request_parts(query, start, end, step), meter=meter)
 
     def _stream_attempt(
         self, query: str, start: float, end: float, step: str, make_stream, finalize, meter=None
@@ -539,15 +593,21 @@ class PrometheusLoader:
         else:
             def sink(chunk: bytes) -> None:
                 meter.add_bytes(len(chunk))
+                t0 = time.perf_counter()
                 stream.feed(chunk)
+                meter.add_phase("sink", time.perf_counter() - t0)
         try:
             status, err = self._raw.request_streaming(
-                *self._range_request_parts(query, start, end, step), sink=sink
+                *self._range_request_parts(query, start, end, step), sink=sink, meter=meter
             )
             if status >= 300:
                 stream.abort()
                 return status, None, err
-            return status, finalize(stream), b""
+            t0 = time.perf_counter()
+            out = finalize(stream)
+            if meter is not None:
+                meter.add_phase("decode", time.perf_counter() - t0)
+            return status, out, b""
         except BaseException:
             stream.abort()
             raise
@@ -561,11 +621,49 @@ class PrometheusLoader:
             return "GET", {"params": params}
         return "POST", {"data": params}
 
-    async def _httpx_range_query(self, query: str, start: float, end: float, step: str) -> tuple[int, bytes]:
+    #: httpcore trace-extension event prefixes → transport phase. Unknown
+    #: events (and body events on the streamed path, which times its own
+    #: chunk loop) are ignored, so an httpcore rename degrades to missing
+    #: phases, never an error.
+    _HTTPX_PHASE_EVENTS = {
+        "connection.connect_tcp": "connect",
+        "connection.start_tls": "connect",
+        "http11.send_request_headers": "request_write",
+        "http11.send_request_body": "request_write",
+        "http11.receive_response_headers": "ttfb",
+        "http11.receive_response_body": "body_read",
+    }
+
+    @classmethod
+    def _httpx_phase_trace(cls, meter: _QueryMeter, *, map_body: bool):
+        """An httpcore ``trace`` request-extension callable that folds the
+        transport's own events into the meter's phase split — the httpx
+        plane's equivalent of the raw transport's explicit timing. Pooled
+        keep-alive connections emit no connect events, matching the raw
+        pool's semantics."""
+        pending: dict[str, float] = {}
+
+        async def trace(event_name: str, info: dict) -> None:
+            prefix, _, stage = event_name.rpartition(".")
+            phase = cls._HTTPX_PHASE_EVENTS.get(prefix)
+            if phase is None or (phase == "body_read" and not map_body):
+                return
+            if stage == "started":
+                pending[prefix] = time.perf_counter()
+            elif stage in ("complete", "failed") and prefix in pending:
+                meter.add_phase(phase, time.perf_counter() - pending.pop(prefix))
+
+        return trace
+
+    async def _httpx_range_query(
+        self, query: str, start: float, end: float, step: str, meter: "Optional[_QueryMeter]" = None
+    ) -> tuple[int, bytes]:
         """Range request via the httpx client — the fallback data plane for
         environments the raw transport can't honor (see _make_raw_transport)."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        if meter is not None:
+            kwargs["extensions"] = {"trace": self._httpx_phase_trace(meter, map_body=True)}
         response = await self._client.request(method, "/api/v1/query_range", **kwargs)
         return response.status_code, response.content
 
@@ -583,6 +681,11 @@ class PrometheusLoader:
         (round-4 advisor finding)."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        if meter is not None:
+            # map_body=False: the chunk loop below times body_read itself so
+            # sink (feed) time can be carved out of it — the transport's own
+            # receive_response_body span would lump the two together.
+            kwargs["extensions"] = {"trace": self._httpx_phase_trace(meter, map_body=False)}
         request = self._client.stream(method, "/api/v1/query_range", **kwargs)
         stream = make_stream()
         try:
@@ -591,11 +694,25 @@ class PrometheusLoader:
                     err = await response.aread()
                     stream.abort()
                     return response.status_code, None, err
+                read_seconds = sink_seconds = 0.0
+                t_wait = time.perf_counter()
                 async for chunk in response.aiter_bytes(1 << 20):
+                    t_got = time.perf_counter()
+                    read_seconds += t_got - t_wait
                     if meter is not None:
                         meter.add_bytes(len(chunk))
                     await asyncio.to_thread(stream.feed, chunk)
-            return response.status_code, await asyncio.to_thread(finalize, stream), b""
+                    t_wait = time.perf_counter()
+                    sink_seconds += t_wait - t_got
+                read_seconds += time.perf_counter() - t_wait  # the exhausted-iterator round
+                if meter is not None:
+                    meter.add_phase("body_read", read_seconds)
+                    meter.add_phase("sink", sink_seconds)
+            t0 = time.perf_counter()
+            out = await asyncio.to_thread(finalize, stream)
+            if meter is not None:
+                meter.add_phase("decode", time.perf_counter() - t0)
+            return response.status_code, out, b""
         except BaseException:
             # Off the loop: abort blocks on the stream's op lock until any
             # in-flight feed/finalize thread returns — inline it would stall
@@ -666,7 +783,11 @@ class PrometheusLoader:
         fan-out, and free so a 401 on the last transient attempt still gets
         its refreshed retry; a second 401 is a real authz failure).
         ``meter`` counts attempts actually made (retries = attempts − 1 in
-        the per-query telemetry).
+        the per-query telemetry), connection-semaphore wait (the
+        ``queue_wait`` phase — time the query was queued behind the fan-out
+        width, not transported), and backoff sleeps (``retry_wait`` on the
+        span, ``krr_tpu_prom_retry_backoff_seconds`` in the registry) so a
+        query slowed by retries is distinguishable from slow transport.
         """
         last_error: Optional[Exception] = None
         auth_refreshed = False
@@ -676,7 +797,10 @@ class PrometheusLoader:
             try:
                 if meter is not None:
                     meter.attempts += 1
+                t_queued = time.perf_counter()
                 async with self._semaphore:
+                    if meter is not None:
+                        meter.add_phase("queue_wait", time.perf_counter() - t_queued)
                     status, result, detail_bytes = await attempt_fn()
             except (http.client.HTTPException, httpx.TransportError, OSError) as e:
                 last_error = e
@@ -700,23 +824,63 @@ class PrometheusLoader:
                 # server in lockstep — each retry wave as synchronized as
                 # the failure that caused it. ±50% jitter decorrelates the
                 # herd while keeping the expected backoff unchanged.
-                await asyncio.sleep(0.25 * 2 ** (attempt - 1) * random.uniform(0.5, 1.5))
+                wait = 0.25 * 2 ** (attempt - 1) * random.uniform(0.5, 1.5)
+                if meter is not None:
+                    meter.backoff += wait
+                if self.metrics is not None:
+                    self.metrics.observe("krr_tpu_prom_retry_backoff_seconds", wait)
+                await asyncio.sleep(wait)
         assert last_error is not None
         raise last_error
 
-    async def _instrumented(self, query: str, start: float, end: float, step: str, route: str, attempt_fn, meter: _QueryMeter):
+    def _decode_timed(self, decode, body: bytes, meter: _QueryMeter):
+        """Run a buffered-body parse inside the query's instrumentation
+        window (sync — worker thread): the parse IS the query's decode
+        phase, and its output arrays are the decoded-bytes side of the
+        wire-vs-decoded comparison."""
+        t0 = time.perf_counter()
+        out = decode(body)
+        meter.add_phase("decode", time.perf_counter() - t0)
+        meter.decoded_bytes += self._decoded_nbytes(out)
+        return out
+
+    @staticmethod
+    def _decoded_nbytes(entries) -> int:
+        """Bytes of numpy payload in a parse result — the decoded twin of
+        the wire byte counter (entries whose payloads are scalars, e.g. the
+        stats route's (count, max), contribute nothing by design)."""
+        total = 0
+        if isinstance(entries, list):
+            for entry in entries:
+                if isinstance(entry, tuple):
+                    for part in entry:
+                        nbytes = getattr(part, "nbytes", None)
+                        if nbytes is not None:
+                            total += int(nbytes)
+        return total
+
+    async def _instrumented(
+        self, query: str, start: float, end: float, step: str, route: str, attempt_fn,
+        meter: _QueryMeter, decode=None,
+    ):
         """One range query through the retry policy, with per-query
         observability around it: a ``prom_query`` span (child of the active
-        fetch span) carrying retries/points/bytes, the shared
-        ``krr_tpu_prom_query_*`` metrics, and the slow-query log. All of it
-        is downstream of the no-op checks — with the null tracer and no
-        registry the cost is one time read and two attribute tests."""
+        fetch span) carrying retries/points/bytes plus the per-phase
+        transport split (``phase_*`` attributes, see `TRANSPORT_PHASES`),
+        the shared ``krr_tpu_prom_query_*``/``krr_tpu_prom_phase_seconds``
+        metrics, and the slow-query log. ``decode`` (buffered routes) parses
+        the fetched body off the loop INSIDE this window so decode time and
+        decoded bytes land on the same span as the transport that fed them.
+        All of it is downstream of the no-op checks — with the null tracer
+        and no registry the cost is one time read and two attribute tests."""
         points = int((end - start) // step_string_seconds(step)) + 1
         span = self.tracer.start_span("prom_query", route=route, points=points, query=query[:160])
         t0 = time.perf_counter()
         status = "error"
         try:
             result = await self._retrying(attempt_fn, meter=meter)
+            if decode is not None:
+                result = await asyncio.to_thread(self._decode_timed, decode, result, meter)
             status = "ok"
             return result
         except BaseException as e:
@@ -726,23 +890,40 @@ class PrometheusLoader:
             elapsed = time.perf_counter() - t0
             retries = max(0, meter.attempts - 1)
             span.set(status=status, retries=retries, bytes=meter.bytes)
+            if meter.decoded_bytes:
+                span.set(decoded_bytes=meter.decoded_bytes)
+            if meter.backoff:
+                span.set(retry_wait=round(meter.backoff, 6))
+            for phase, seconds in meter.phases.items():
+                span.set(**{f"phase_{phase}": round(seconds, 6)})
             self.tracer.finish_span(span)
             if self.metrics is not None:
                 self.metrics.observe("krr_tpu_prom_query_seconds", elapsed, route=route)
+                for phase, seconds in meter.phases.items():
+                    self.metrics.observe("krr_tpu_prom_phase_seconds", seconds, phase=phase)
+                if meter.bytes:
+                    self.metrics.inc("krr_tpu_prom_wire_bytes_total", meter.bytes, route=route)
+                if meter.decoded_bytes:
+                    self.metrics.inc("krr_tpu_prom_decoded_bytes_total", meter.decoded_bytes)
                 if retries:
                     self.metrics.inc("krr_tpu_prom_query_retries_total", retries)
                 if status == "ok":
                     self.metrics.inc("krr_tpu_prom_points_total", points)
             if self.slow_query_seconds and elapsed >= self.slow_query_seconds:
+                backoff_note = f", {meter.backoff:.1f}s in retry backoff" if meter.backoff else ""
                 self.logger.warning(
                     f"Slow Prometheus query: {elapsed:.1f}s ({route}, window "
                     f"[{start:.0f}, {end:.0f}] step {step}, {points} points, "
-                    f"{retries} retries, {status}): {query[:200]}"
+                    f"{retries} retries{backoff_note}, {status}): {query[:200]}"
                 )
 
-    async def _fetch_range_body(self, query: str, start: float, end: float, step: str) -> bytes:
+    async def _fetch_range_body(
+        self, query: str, start: float, end: float, step: str, parse=None
+    ) -> bytes:
         """Range query with the shared retry policy; returns the raw response
-        body (callers pick their parser).
+        body — or, with ``parse``, the parsed entries (the parse runs in a
+        worker thread INSIDE the query's instrumentation window, so decode
+        time/bytes attribute to the query that fetched the body).
 
         Our per-workload fallback queries carry a pod-name regex that grows
         with the pod count: short queries go as GET (works under read-only
@@ -756,14 +937,16 @@ class PrometheusLoader:
         async def attempt():
             if self._raw is not None:
                 status, body = await asyncio.to_thread(
-                    self._raw_range_query, query, start, end, step
+                    self._raw_range_query, query, start, end, step, meter
                 )
             else:  # proxied environment: ride the httpx client
-                status, body = await self._httpx_range_query(query, start, end, step)
+                status, body = await self._httpx_range_query(query, start, end, step, meter)
             meter.add_bytes(len(body))
             return status, body, body
 
-        return await self._instrumented(query, start, end, step, "buffered", attempt, meter)
+        return await self._instrumented(
+            query, start, end, step, "buffered", attempt, meter, decode=parse
+        )
 
     async def _fetch_streamed_series(
         self, query: str, start: float, end: float, step: str, make_stream, finalize
@@ -972,12 +1155,13 @@ class PrometheusLoader:
 
     def _buffered_fetch_entries(self, query: str, step_seconds: float, parse):
         """fetch_entries for the buffered route: fetch the whole window body,
-        then parse it off the event loop (CPU-bound, up to ~MBs)."""
+        then parse it off the event loop (CPU-bound, up to ~MBs) — inside
+        the query's instrumentation window, so the parse is the query's
+        decode phase."""
         step = step_string(step_seconds)
 
         async def fetch_entries(w_start: float, w_end: float) -> list:
-            body = await self._fetch_range_body(query, w_start, w_end, step)
-            return await asyncio.to_thread(parse, body)
+            return await self._fetch_range_body(query, w_start, w_end, step, parse=parse)
 
         return fetch_entries
 

@@ -268,6 +268,17 @@ def _common_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--profile", "profile_path"],
+            default=None,
+            help=(
+                "Write the scan's critical-path attribution report (per-category "
+                "wall split incl. fetch transport/decode phases, what-if-fetch-"
+                "were-free estimate, critical path) as JSON to this file at exit. "
+                "Implies recording spans like --trace; `krr-tpu analyze` renders "
+                "the same report from a --trace file."
+            ),
+        ),
+        PanelOption(
             ["--strict"],
             is_flag=True,
             default=False,
@@ -744,6 +755,10 @@ def _finish_observability(config: Any, session: Any) -> None:
         from krr_tpu.obs.trace import write_chrome_trace
 
         write_chrome_trace(session.tracer, config.trace_path)
+    if config.profile_path:
+        from krr_tpu.obs.profile import write_profile_report
+
+        write_profile_report(session.tracer, config.profile_path)
     if config.statusz_path:
         import json
 
@@ -768,6 +783,94 @@ def _finish_observability(config: Any, session: Any) -> None:
         refresh_process_metrics(session.metrics)
         with open(config.metrics_dump_path, "w") as f:
             f.write(session.metrics.render())
+
+
+def _make_analyze_command() -> click.Command:
+    """``krr-tpu analyze``: critical-path attribution over a recorded scan
+    trace (`krr_tpu.obs.profile`) — where the wall went (fetch transport vs
+    decode vs fold vs compute vs idle), the what-if-fetch-were-free
+    estimate, and the critical path itself. Input is a ``--trace`` Chrome
+    JSON file from any scan/serve run, or ``--url`` against a live server
+    (fetches its ``/debug/trace`` ring)."""
+
+    def callback(trace: Any, url: Any, n: int, fmt: str, output: Any) -> None:
+        import json
+
+        from krr_tpu.obs.profile import profile_chrome_payload, render_text
+
+        if (trace is None) == (url is None):
+            raise click.UsageError("pass exactly one of --trace FILE or --url URL")
+        if trace is not None:
+            try:
+                with open(trace) as f:
+                    payload = json.load(f)
+            except OSError as e:
+                raise click.UsageError(f"cannot read trace file {trace}: {e}") from e
+            except json.JSONDecodeError as e:
+                raise click.UsageError(f"{trace} is not Chrome trace JSON: {e}") from e
+        else:
+            import urllib.error
+            import urllib.request
+
+            target = url.rstrip("/") + "/debug/trace" + (f"?n={n}" if n > 0 else "")
+            try:
+                with urllib.request.urlopen(target, timeout=30) as response:
+                    payload = json.load(response)
+            except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
+                raise click.UsageError(f"cannot fetch {target}: {e}") from e
+        report = profile_chrome_payload(payload, n=n)
+        rendered = (
+            json.dumps(report, indent=2) + "\n" if fmt == "json" else render_text(report)
+        )
+        if output:
+            with open(output, "w") as f:
+                f.write(rendered)
+        else:
+            click.echo(rendered, nl=False)
+        if not report["scans"]:
+            raise click.ClickException("trace holds no completed scan spans")
+
+    return PanelCommand(
+        "analyze",
+        callback=callback,
+        params=[
+            PanelOption(
+                ["--trace", "trace"],
+                default=None,
+                help="Chrome trace-event JSON file recorded by --trace (scan or serve).",
+            ),
+            PanelOption(
+                ["--url", "url"],
+                default=None,
+                help="Base URL of a live krr-tpu serve instance; reads its /debug/trace ring.",
+            ),
+            PanelOption(
+                ["-n", "n"],
+                type=int,
+                default=0,
+                show_default=True,
+                help="Analyze only the newest N scans (0 = all recorded).",
+            ),
+            PanelOption(
+                ["--format", "-f", "fmt"],
+                type=click.Choice(["text", "json"]),
+                default="text",
+                show_default=True,
+                help="Report rendering: human text or the JSON /debug/profile serves.",
+            ),
+            PanelOption(
+                ["--output", "-o", "output"],
+                default=None,
+                help="Write the report to this file instead of stdout.",
+            ),
+        ],
+        help=(
+            "Attribute a recorded scan's wall clock across fetch transport/decode, "
+            "fold, compute, publish, and idle; estimate the wall if fetch were "
+            "free; and print the critical path. Reads a --trace file or a live "
+            "server's /debug/trace ring."
+        ),
+    )
 
 
 def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Command:
@@ -845,6 +948,7 @@ def load_commands() -> None:
     if "tdigest" in strategies:  # the serve + history subsystems ride the digest strategy
         app.add_command(_make_serve_command("tdigest", strategies["tdigest"]))
         app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
+    app.add_command(_make_analyze_command())
 
 
 def run() -> None:

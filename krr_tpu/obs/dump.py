@@ -3,13 +3,15 @@
 A wedged serve process (or a long one-shot scan that is "taking forever")
 usually gets killed before anyone captures what it was doing. SIGUSR2
 turns that moment into artifacts instead: the handler writes the tracer's
-completed-scan ring as Chrome trace-event JSON and the shared registry as
+completed-scan ring as Chrome trace-event JSON, the shared registry as
 a Prometheus exposition snapshot (process self-metrics and build info
-refreshed) to TIMESTAMPED files — next to the configured ``--trace`` /
+refreshed), and the ring's critical-path attribution report
+(`krr_tpu.obs.profile` — the same JSON ``GET /debug/profile`` serves) to
+TIMESTAMPED files — next to the configured ``--trace`` /
 ``--metrics-dump`` targets when set, the working directory otherwise — and
-logs one structured line naming both paths, so the operator's ``kill
+logs one structured line naming the paths, so the operator's ``kill
 -USR2 <pid>`` shows up in the log stream with everything needed to open
-the trace.
+the trace AND an immediate answer to "where is the wall going".
 
 Two installation flavors, one per execution mode: serve installs through
 the event loop (``loop.add_signal_handler`` — the handler runs as a normal
@@ -53,13 +55,17 @@ def debug_dump(
     trace_target: Optional[str] = None,
     metrics_target: Optional[str] = None,
     logger=None,
-) -> tuple[str, str]:
-    """Write the trace ring + a metrics exposition snapshot; returns the two
-    paths. Never raises past logging — a debug aid must not take down the
-    process it is inspecting."""
+) -> tuple[str, str, str]:
+    """Write the trace ring + a metrics exposition snapshot + the ring's
+    critical-path attribution report; returns the three paths. Never raises
+    past logging — a debug aid must not take down the process it is
+    inspecting."""
+    from krr_tpu.obs.profile import write_profile_report
+
     stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
     trace_path = _dump_path(trace_target, "krr-tpu-trace", stamp, ".json")
     metrics_path = _dump_path(metrics_target, "krr-tpu-metrics", stamp, ".prom")
+    profile_path = _dump_path(trace_target, "krr-tpu-profile", stamp, ".profile.json")
     try:
         write_chrome_trace(tracer, trace_path)
         refresh_process_metrics(metrics)
@@ -67,14 +73,21 @@ def debug_dump(
         metrics.inc("krr_tpu_debug_dumps_total")
         with open(metrics_path, "w") as f:
             f.write(metrics.render())
+        write_profile_report(tracer, profile_path)
     except Exception:
         if logger is not None:
-            logger.warning(f"debug dump failed (trace={trace_path} metrics={metrics_path})")
+            logger.warning(
+                f"debug dump failed (trace={trace_path} metrics={metrics_path} "
+                f"profile={profile_path})"
+            )
             logger.debug_exception()
-        return trace_path, metrics_path
+        return trace_path, metrics_path, profile_path
     if logger is not None:
-        logger.info(f"debug dump written: trace={trace_path} metrics={metrics_path}")
-    return trace_path, metrics_path
+        logger.info(
+            f"debug dump written: trace={trace_path} metrics={metrics_path} "
+            f"profile={profile_path}"
+        )
+    return trace_path, metrics_path, profile_path
 
 
 def install_signal_dump(

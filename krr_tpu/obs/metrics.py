@@ -47,6 +47,8 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_scan_duration_seconds", "gauge", "Last scan's wall seconds by leg (discover|fetch|fold|compute)."),
     ("krr_tpu_scan_pipeline_seconds", "gauge", "Last scan's streamed-pipeline stage busy seconds (fetch = producer span, fold = consumer busy)."),
     ("krr_tpu_scan_overlap_pct", "gauge", "Fetch/fold overlap of the last scan's streamed pipeline as a percentage of the shorter stage (100 = fully hidden)."),
+    ("krr_tpu_scan_pipeline_wait_seconds", "gauge", "Last scan's streamed-pipeline wait time by side: producer_blocked = producers stalled in put() (fold-bound), consumer_starved = the consumer parked in get() (fetch-bound)."),
+    ("krr_tpu_scan_pipeline_queue_depth", "gauge", "Live streamed-pipeline queue occupancy, sampled at every put and get."),
     ("krr_tpu_scan_window_seconds", "gauge", "Width of the last scan's fetched time window."),
     ("krr_tpu_scan_failed_rows", "gauge", "Object fetches that failed terminally in the last scan (rows rendered UNKNOWN)."),
     ("krr_tpu_fetch_rows_total", "counter", "Cumulative object fetches attempted by completed scans (the denominator of the fetch failed-row SLO)."),
@@ -67,6 +69,12 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_prom_query_seconds", "histogram", "Prometheus range-query latency by data plane (buffered|streamed), retries included.", DEFAULT_SECONDS_BUCKETS),
     ("krr_tpu_prom_query_retries_total", "counter", "Prometheus range-query retry attempts beyond each query's first try."),
     ("krr_tpu_prom_points_total", "counter", "Evaluation-grid points covered by successful Prometheus range queries."),
+    # Transport phase attribution (`krr_tpu.obs.profile` reads the same split
+    # from the prom_query span attributes).
+    ("krr_tpu_prom_phase_seconds", "histogram", "Prometheus range-query time by transport phase (queue_wait|connect|request_write|ttfb|body_read|decode|sink), one observation per query per phase that occurred.", DEFAULT_SECONDS_BUCKETS),
+    ("krr_tpu_prom_retry_backoff_seconds", "histogram", "Backoff sleeps between Prometheus range-query retry attempts — kept out of the phase split so retries can't masquerade as slow transport.", DEFAULT_SECONDS_BUCKETS),
+    ("krr_tpu_prom_wire_bytes_total", "counter", "Response body bytes read off the Prometheus transport by data plane (buffered|streamed)."),
+    ("krr_tpu_prom_decoded_bytes_total", "counter", "Bytes of decoded sample arrays produced by buffered-route parses (streamed ingest never materializes decoded arrays; compare against wire bytes for JSON overhead)."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
     ("krr_tpu_http_request_seconds", "histogram", "HTTP request latency by route.", DEFAULT_SECONDS_BUCKETS),
     # Device-level compute observability (`krr_tpu.obs.device`).

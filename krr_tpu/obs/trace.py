@@ -342,3 +342,37 @@ def write_chrome_trace(tracer: NullTracer, path: str) -> None:
     with open(path, "w") as f:
         json.dump(tracer.export_chrome(), f)
         f.write("\n")
+
+
+def traces_from_chrome(payload: dict) -> "list[list[Span]]":
+    """Rebuild per-scan span lists from Chrome trace-event JSON previously
+    produced by :meth:`Tracer.export_chrome` — the inverse the offline
+    analysis path (``krr-tpu analyze --trace FILE``,
+    `krr_tpu.obs.profile`) rides. Only complete (``"X"``) events are
+    considered; ``ts``/``dur`` come back as seconds relative to the
+    exporting tracer's epoch, and the ``args`` ids/attributes are restored
+    onto :class:`Span` objects. Foreign trace JSON without the exporter's
+    ``args`` degrades gracefully: spans still carry name/start/end, grouped
+    by ``pid``."""
+    by_trace: dict[tuple, list[Span]] = {}
+    for event in payload.get("traceEvents", ()):
+        if event.get("ph") != "X":
+            continue
+        args = dict(event.get("args") or {})
+        trace_id = args.pop("trace_id", None) or f"pid-{event.get('pid', 0)}"
+        span_id = args.pop("span_id", None)
+        parent_id = args.pop("parent_id", None)
+        args.pop("wall_start", None)
+        span = Span(str(event.get("name", "")), str(trace_id), None, args)
+        try:
+            span.span_id = int(span_id, 16)
+        except (TypeError, ValueError):
+            pass  # keep the freshly-allocated id
+        try:
+            span.parent_id = int(parent_id, 16) if parent_id else None
+        except (TypeError, ValueError):
+            span.parent_id = None
+        span.start = float(event.get("ts", 0.0)) / 1e6
+        span.end = span.start + float(event.get("dur", 0.0)) / 1e6
+        by_trace.setdefault((event.get("pid", 0), str(trace_id)), []).append(span)
+    return [spans for _key, spans in sorted(by_trace.items(), key=lambda kv: kv[0][0])]

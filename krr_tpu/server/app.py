@@ -27,6 +27,11 @@ Routes:
   ``?format=text`` for humans.
 * ``GET /debug/trace`` — the last N scan ticks' spans as Chrome trace-event
   JSON (`krr_tpu.obs.trace` ring; load in ``chrome://tracing``/Perfetto).
+* ``GET /debug/profile`` — critical-path attribution over the same ring
+  (`krr_tpu.obs.profile`): per-category wall split (fetch-transport /
+  fetch-decode / fold / compute / …), the what-if-fetch-were-free
+  estimate, and the critical path per scan. JSON by default,
+  ``?format=text`` for humans, ``?n=`` limits scans.
 """
 
 from __future__ import annotations
@@ -146,6 +151,8 @@ class HttpApp:
             return await self._drift()
         if path == "/debug/trace":
             return await self._debug_trace(query)
+        if path == "/debug/profile":
+            return await self._debug_profile(query)
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
 
     async def _debug_trace(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
@@ -161,6 +168,31 @@ class HttpApp:
             return _json_body(self.tracer.export_chrome(n if n > 0 else None))
 
         return 200, "application/json", await asyncio.to_thread(render)
+
+    async def _debug_profile(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """Critical-path attribution of the last N completed scan ticks
+        (`krr_tpu.obs.profile` over the trace ring). Worker-thread rendered:
+        the sweep walks every span of every ringed scan."""
+        try:
+            n = int((query.get("n") or ["0"])[-1])
+        except ValueError:
+            return 400, "application/json", _json_body({"error": "n must be an integer"})
+        fmt = (query.get("format") or ["json"])[-1]
+        if fmt not in ("json", "text"):
+            return 400, "application/json", _json_body(
+                {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
+            )
+
+        def render() -> bytes:
+            from krr_tpu.obs.profile import profile_traces, render_text
+
+            report = profile_traces(self.tracer.traces(n if n > 0 else None))
+            if fmt == "text":
+                return render_text(report).encode()
+            return _json_body(report)
+
+        content_type = "text/plain; charset=utf-8" if fmt == "text" else "application/json"
+        return 200, content_type, await asyncio.to_thread(render)
 
     async def _statusz(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
         """The SLO engine's posture. READ-ONLY: burn rates recompute at the
@@ -439,7 +471,7 @@ class HttpApp:
         route_label = (
             split.path
             if split.path
-            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace")
+            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace", "/debug/profile")
             else "other"
         )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
@@ -625,3 +657,10 @@ async def run_server(config: Config, *, logger: Optional[KrrLogger] = None) -> N
             from krr_tpu.obs.trace import write_chrome_trace
 
             write_chrome_trace(server.session.tracer, config.trace_path)
+        if config.profile_path:
+            # The ring's critical-path attribution (the same report GET
+            # /debug/profile serves live) — so a terminated server leaves
+            # its bottleneck analysis behind, not just raw spans.
+            from krr_tpu.obs.profile import write_profile_report
+
+            write_profile_report(server.session.tracer, config.profile_path)

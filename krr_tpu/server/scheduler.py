@@ -464,6 +464,19 @@ class ScanScheduler:
                 "krr_tpu_scan_overlap_pct",
                 max(s.overlap_pct for s in pipeline_stats),
             )
+            # Wait attribution: which pipeline side gated this tick
+            # (producers blocked in put = fold-bound, consumer starved in
+            # get = fetch-bound), summed like the stage busy times.
+            metrics.set(
+                "krr_tpu_scan_pipeline_wait_seconds",
+                sum(s.put_blocked_seconds for s in pipeline_stats),
+                side="producer_blocked",
+            )
+            metrics.set(
+                "krr_tpu_scan_pipeline_wait_seconds",
+                sum(s.get_starved_seconds for s in pipeline_stats),
+                side="consumer_starved",
+            )
         metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
         metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
         scan_span.set(

@@ -61,3 +61,20 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("obs_device_traced_seconds", 0) > 0, secondary
     assert secondary.get("obs_device_stage_spans", 0) > 0, secondary
     assert "obs_device_overhead_pct" in secondary, secondary
+    # The analyze smoke ran: trace file in -> attribution report out, rc 0,
+    # categories partition the wall (a failure is a parity break — rc 1 —
+    # but assert the fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("analyze_smoke") == "ok", secondary
+    assert secondary.get("analyze_scans", 0) > 0, secondary
+    # The fleet leg's transport-phase split and pipeline wait accounting
+    # made it into the record (the real PrometheusLoader against the fake
+    # backend: TTFB and body-read must have been observed).
+    assert "fleet_e2e_phase_ttfb_seconds" in secondary, secondary
+    assert "fleet_e2e_phase_body_read_seconds" in secondary, secondary
+    assert "fleet_e2e_wire_mb" in secondary, secondary
+    assert "fleet_e2e_put_blocked_seconds" in secondary, secondary
+    assert "fleet_e2e_get_starved_seconds" in secondary, secondary
+    # The fetch trendline gate fields are emitted unconditionally (null /
+    # False when the previous round ran at a different fleet width).
+    assert "fetch_vs_previous_round" in payload
+    assert "fetch_regression_vs_previous" in payload

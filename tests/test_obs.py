@@ -431,6 +431,9 @@ class TestCLIWiring:
             assert q["args"]["status"] == "ok"
             assert q["args"]["points"] > 0 and q["args"]["bytes"] > 0
             assert q["args"]["retries"] == 0
+            # Transport phase split stamped per query (ttfb is always
+            # measurable whichever data plane served the query).
+            assert any(key.startswith("phase_") for key in q["args"]), q["args"]
 
         families = parse_exposition(dump_path.read_text())
         samples = families["krr_tpu_prom_query_seconds"]["samples"]
@@ -456,6 +459,28 @@ class TestCLIWiring:
         assert families["krr_tpu_packed_elements"]["samples"]
         assert families["krr_tpu_process_uptime_seconds"]["samples"]
         assert families["krr_tpu_process_gc_collections_total"]["samples"]
+
+    def test_profile_one_shot_report(self, fake_env, tmp_path):  # noqa: F811
+        """--profile on a one-shot scan writes the critical-path attribution
+        report (and implies a recording tracer without --trace)."""
+        profile_path = tmp_path / "profile.json"
+        result = _scan_cli(fake_env, "--profile", str(profile_path))
+        assert result.exit_code == 0, result.output
+        report = json.loads(profile_path.read_text())
+        assert report["aggregate"]["scan_count"] == 1
+        scan = report["scans"][0]
+        assert scan["kind"] == "cli" and scan["fetch"]["queries"] > 0
+        # Categories partition the wall; a real fetch leaves real
+        # transport attribution behind.
+        assert sum(scan["categories"].values()) == pytest.approx(
+            scan["wall_seconds"], abs=1e-3
+        )
+        fetch_attr = sum(
+            scan["categories"][k]
+            for k in ("fetch_transport", "fetch_decode", "fetch_backoff", "fetch_other")
+        )
+        assert fetch_attr > 0
+        assert scan["critical_path"]
 
     def test_statusz_one_shot_dump(self, fake_env, tmp_path):  # noqa: F811
         """--statusz on a one-shot scan writes a single SLO evaluation over
@@ -719,7 +744,7 @@ class TestDebugDump:
         trace_target = tmp_path / "out" / "scan.json"
         trace_target.parent.mkdir()
         logger = KrrLogger(log_format="json")
-        trace_path, metrics_path = debug_dump(
+        trace_path, metrics_path, profile_path = debug_dump(
             tracer, registry, trace_target=str(trace_target), logger=logger
         )
         # Next to the --trace target; metrics fall back to the cwd stem.
@@ -729,11 +754,17 @@ class TestDebugDump:
         assert "krr_tpu_debug_dumps_total 1" in exposition
         assert "krr_tpu_process_uptime_seconds" in exposition
         assert "krr_tpu_build_info{" in exposition
+        # The attribution report rides along (next to the trace target) so
+        # the dump answers "where is the wall going" without a reimport.
+        assert profile_path.startswith(str(trace_target.parent))
+        profile = json.loads(open(profile_path).read())
+        assert profile["aggregate"]["scan_count"] == 1
         record = json.loads(capsys.readouterr().out.splitlines()[-1])
         assert trace_path in record["message"] and metrics_path in record["message"]
+        assert profile_path in record["message"]
         # A second dump in the same second must not overwrite the first.
-        trace2, metrics2 = debug_dump(tracer, registry, trace_target=str(trace_target))
-        assert trace2 != trace_path and metrics2 != metrics_path
+        trace2, metrics2, profile2 = debug_dump(tracer, registry, trace_target=str(trace_target))
+        assert trace2 != trace_path and metrics2 != metrics_path and profile2 != profile_path
         import os
 
         os.unlink(metrics_path), os.unlink(metrics2)  # cwd fallbacks: clean up

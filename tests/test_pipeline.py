@@ -240,6 +240,73 @@ class TestScanPipeline:
         assert stats.overlap_seconds > 0
         assert 0 < stats.overlap_pct <= 100.0
 
+    def test_fold_bound_pipeline_reports_put_blocked(self):
+        """A slow consumer (fold-bound scan) must show up as producer
+        put-blocked time — the number that says "the fetch is NOT the
+        bottleneck" — while the consumer registers no meaningful
+        starvation beyond its waits between batches."""
+
+        async def main():
+            async with ScanPipeline(lambda _b: time.sleep(0.05), depth=1) as pipeline:
+                for i in range(4):
+                    await pipeline.put(i)  # instant producer
+            return pipeline.stats
+
+        stats = asyncio.run(main())
+        assert stats.put_blocked_seconds >= 0.05  # blocked behind the slow folds
+        assert stats.put_blocked_seconds > stats.get_starved_seconds
+
+    def test_fetch_bound_pipeline_reports_get_starved(self):
+        """A slow producer (fetch-bound scan — the BENCH_r05 regime) must
+        show up as consumer get-starved time, with producers never
+        blocking."""
+
+        async def main():
+            async with ScanPipeline(lambda _b: None, depth=4) as pipeline:
+                for i in range(3):
+                    await asyncio.sleep(0.05)  # the "fetch"
+                    await pipeline.put(i)
+            return pipeline.stats
+
+        stats = asyncio.run(main())
+        assert stats.get_starved_seconds >= 0.1
+        assert stats.put_blocked_seconds < 0.05
+        assert stats.get_starved_seconds > stats.put_blocked_seconds
+
+    def test_peak_queue_depth_sampled_on_get_side_too(self):
+        """The put-only peak sampling bug: with a consumer that always wins
+        the dequeue race, qsize() right after put can read 0 forever. The
+        get-side sample (+1 for the batch just taken) guarantees a
+        non-zero peak whenever anything flowed at all."""
+
+        async def main():
+            async with ScanPipeline(lambda _b: None, depth=4) as pipeline:
+                for i in range(5):
+                    await pipeline.put(i)
+                    await asyncio.sleep(0.01)  # let the consumer drain each put
+            return pipeline.stats
+
+        stats = asyncio.run(main())
+        assert stats.peak_queue_depth >= 1
+        assert stats.depth_samples >= 10  # sampled on both sides
+        assert 0 < stats.mean_queue_depth <= 4 + 1
+
+    def test_live_queue_depth_gauge_fires(self):
+        from krr_tpu.obs.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+
+        async def main():
+            async with ScanPipeline(
+                lambda _b: None, depth=2, metrics=registry
+            ) as pipeline:
+                for i in range(3):
+                    await pipeline.put(i)
+            return pipeline.stats
+
+        asyncio.run(main())
+        assert registry.value("krr_tpu_scan_pipeline_queue_depth") is not None
+
 
 # ------------------------------------------------- session-level exactness
 class TestStreamFleetDigests:

@@ -1,0 +1,367 @@
+"""Critical-path attribution tests (`krr_tpu.obs.profile`).
+
+The golden test hand-builds a synthetic scan trace with KNOWN geometry and
+asserts the exact attribution, the what-if estimate, and the critical
+path — the algorithm is verified against a worked answer, not against
+itself. The taxonomy lint extends the registry self-check pattern: every
+span name and every ``krr_tpu_*`` metric the code emits must be documented
+in ARCHITECTURE.md, so the observability surface can't silently outgrow
+its documentation.
+"""
+
+import asyncio
+import json
+import pathlib
+import re
+
+import pytest
+
+from krr_tpu.obs.profile import (
+    CATEGORIES,
+    profile_chrome_payload,
+    profile_trace,
+    profile_traces,
+    render_text,
+)
+from krr_tpu.obs.trace import Span, Tracer, traces_from_chrome
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def make_span(name, trace_id, parent, start, end, **attributes) -> Span:
+    span = Span(name, trace_id, parent.span_id if parent is not None else None, attributes)
+    span.start = float(start)
+    span.end = float(end)
+    return span
+
+
+def golden_trace() -> list[Span]:
+    """scan [0,10]: discover [0,1], fetch [1,9] with one prom_query
+    [1.5,8.5] carrying a fully-measured phase split, fold [8,9.5]
+    (overlapping the fetch tail 8–9), compute [9.5,10] with a device
+    quantile sub-span. Worked attribution (priority fetch-side > fold >
+    compute, categories partition the wall):
+
+      discover [0,1] = 1.0; fetch-only [1,1.5] + fetch-over-fold [8.5,9]
+      = fetch_other's timeline share 1.0; prom [1.5,8.5] = 7.0 splitting
+      by phase sums (transport 4.5, decode 1.5, backoff 0.5, rest 0.5);
+      exposed fold [9,9.5] = 0.5; compute [9.5,10] = 0.5; idle 0.
+    """
+    root = make_span("scan", "t-golden", None, 0.0, 10.0, kind="serve")
+    discover = make_span("discover", "t-golden", root, 0.0, 1.0)
+    fetch = make_span("fetch", "t-golden", root, 1.0, 9.0, namespace="default")
+    prom = make_span(
+        "prom_query", "t-golden", fetch, 1.5, 8.5,
+        route="streamed", status="ok", retries=1, bytes=1_000_000, decoded_bytes=250_000,
+        retry_wait=0.5,
+        phase_connect=0.5, phase_ttfb=2.0, phase_body_read=2.0,
+        phase_decode=0.5, phase_sink=1.0, phase_queue_wait=0.25,
+    )
+    fold = make_span("fold", "t-golden", root, 8.0, 9.5)
+    compute = make_span("compute", "t-golden", root, 9.5, 10.0)
+    quantile = make_span("quantile", "t-golden", compute, 9.6, 9.9, path="store")
+    # Completion order, root last — the ring's shape.
+    return [discover, prom, fetch, fold, quantile, compute, root]
+
+
+class TestGoldenAttribution:
+    def test_categories_match_worked_answer(self):
+        report = profile_trace(golden_trace())
+        assert report is not None
+        assert report["scan_id"] == "t-golden" and report["kind"] == "serve"
+        assert report["wall_seconds"] == pytest.approx(10.0)
+        categories = report["categories"]
+        assert categories["discover"] == pytest.approx(1.0, abs=1e-6)
+        assert categories["fetch_transport"] == pytest.approx(4.5, abs=1e-6)
+        assert categories["fetch_decode"] == pytest.approx(1.5, abs=1e-6)
+        assert categories["fetch_backoff"] == pytest.approx(0.5, abs=1e-6)
+        # 0.5 unaccounted/queue-wait inside the query + 1.0 fetch-span
+        # timeline time not covered by any query.
+        assert categories["fetch_other"] == pytest.approx(1.5, abs=1e-6)
+        assert categories["fold"] == pytest.approx(0.5, abs=1e-6)
+        assert categories["compute"] == pytest.approx(0.5, abs=1e-6)
+        assert categories["publish"] == pytest.approx(0.0, abs=1e-6)
+        assert categories["idle"] == pytest.approx(0.0, abs=1e-6)
+        # The categories PARTITION the wall.
+        assert sum(categories.values()) == pytest.approx(10.0, abs=1e-5)
+
+    def test_what_if_estimate(self):
+        report = profile_trace(golden_trace())
+        what_if = report["what_if"]
+        # Fetch-exclusive: [1, 8] (fetch/prom active, nothing else);
+        # [8, 9] overlaps the fold, so it survives a free fetch.
+        assert what_if["fetch_exclusive_seconds"] == pytest.approx(7.0, abs=1e-6)
+        assert what_if["wall_if_fetch_free_seconds"] == pytest.approx(3.0, abs=1e-6)
+        assert what_if["speedup_if_fetch_free"] == pytest.approx(10.0 / 3.0, abs=1e-3)
+
+    def test_critical_path_names_the_gating_chain(self):
+        report = profile_trace(golden_trace())
+        path = report["critical_path"]
+        names = [segment["name"] for segment in path]
+        assert names[:4] == ["discover", "fetch", "prom_query", "fold"]
+        by_name = {}
+        for segment in path:
+            by_name[segment["name"]] = by_name.get(segment["name"], 0.0) + segment["seconds"]
+        # Deepest-active-span wins an overlapped instant: the query owns its
+        # whole [1.5, 8.5] interval; the fold owns only its tail past the
+        # query's end.
+        assert by_name["prom_query"] == pytest.approx(7.0, abs=1e-6)
+        assert by_name["fold"] == pytest.approx(1.0, abs=1e-6)
+        assert by_name["quantile"] == pytest.approx(0.3, abs=1e-6)
+        # Segments tile the whole wall.
+        assert sum(by_name.values()) == pytest.approx(10.0, abs=1e-5)
+
+    def test_fetch_rollup_and_render(self):
+        report = profile_traces([golden_trace()])
+        scan = report["scans"][0]
+        assert scan["fetch"]["queries"] == 1
+        assert scan["fetch"]["retries"] == 1
+        assert scan["fetch"]["wire_bytes"] == 1_000_000
+        assert scan["fetch"]["decoded_bytes"] == 250_000
+        assert scan["fetch"]["phase_seconds"]["ttfb"] == pytest.approx(2.0)
+        aggregate = report["aggregate"]
+        assert aggregate["scan_count"] == 1
+        # fetch-dominance: (4.5 + 1.5 + 0.5 + 1.5) / 10 = 80%
+        assert aggregate["fetch_pct"] == pytest.approx(80.0, abs=0.1)
+        text = render_text(report)
+        assert "fetch_transport" in text and "what-if fetch were free" in text
+        assert "critical path:" in text
+
+    def test_phaseless_prom_defaults_to_transport(self):
+        """A trace recorded before phase instrumentation (no phase_* attrs)
+        attributes opaque query time to transport — the reference's
+        black-box view, stated explicitly."""
+        root = make_span("scan", "t-old", None, 0.0, 4.0)
+        fetch = make_span("fetch", "t-old", root, 0.0, 4.0)
+        prom = make_span("prom_query", "t-old", fetch, 1.0, 3.0)
+        report = profile_trace([fetch, prom, root])
+        assert report["categories"]["fetch_transport"] == pytest.approx(2.0, abs=1e-6)
+        assert report["categories"]["fetch_other"] == pytest.approx(2.0, abs=1e-6)
+
+    def test_empty_and_rootless_traces_are_skipped(self):
+        assert profile_trace([]) is None
+        report = profile_traces([[], golden_trace()])
+        assert report["aggregate"]["scan_count"] == 1
+
+
+class TestChromeRoundTrip:
+    def test_live_and_reimported_traces_agree(self):
+        """export_chrome → traces_from_chrome must preserve the attribution
+        (timestamps round to µs in the export; tolerance covers that)."""
+        import time
+
+        tracer = Tracer()
+        with tracer.span("scan", kind="cli"):
+            with tracer.span("fetch", namespace="default"):
+                q = tracer.start_span("prom_query", route="streamed", points=10)
+                time.sleep(0.03)
+                q.set(status="ok", retries=0, bytes=1234, phase_ttfb=0.01, phase_body_read=0.01)
+                tracer.finish_span(q)
+            with tracer.span("fold"):
+                time.sleep(0.01)
+        live = profile_traces(tracer.traces())
+        reimported = profile_chrome_payload(tracer.export_chrome())
+        assert len(reimported["scans"]) == 1
+        a = live["scans"][0]["categories"]
+        b = reimported["scans"][0]["categories"]
+        for key in CATEGORIES:
+            assert a[key] == pytest.approx(b[key], abs=2e-3), key
+        assert reimported["scans"][0]["fetch"]["wire_bytes"] == 1234
+
+    def test_traces_from_chrome_groups_by_trace(self):
+        tracer = Tracer()
+        for _ in range(2):
+            with tracer.span("scan"):
+                with tracer.span("fetch"):
+                    pass
+        traces = traces_from_chrome(tracer.export_chrome())
+        assert len(traces) == 2
+        assert all(len(spans) == 2 for spans in traces)
+        # Parent/child ids survive the round trip.
+        for spans in traces:
+            root = next(s for s in spans if s.parent_id is None)
+            child = next(s for s in spans if s is not root)
+            assert child.parent_id == root.span_id
+
+
+class TestAnalyzeCli:
+    def _trace_file(self, tmp_path) -> str:
+        tracer = Tracer()
+        with tracer.span("scan", kind="cli"):
+            with tracer.span("fetch", namespace="default"):
+                pass
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps(tracer.export_chrome()))
+        return str(path)
+
+    def test_analyze_trace_file_json(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        result = CliRunner().invoke(
+            _make_analyze_command(), ["--trace", self._trace_file(tmp_path), "--format", "json"]
+        )
+        assert result.exit_code == 0, result.output
+        report = json.loads(result.output)
+        assert report["aggregate"]["scan_count"] == 1
+        scan = report["scans"][0]
+        assert sum(scan["categories"].values()) == pytest.approx(
+            scan["wall_seconds"], abs=1e-3
+        )
+
+    def test_analyze_text_and_output_file(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        out = tmp_path / "report.txt"
+        result = CliRunner().invoke(
+            _make_analyze_command(),
+            ["--trace", self._trace_file(tmp_path), "--output", str(out)],
+        )
+        assert result.exit_code == 0, result.output
+        assert "critical-path attribution" in out.read_text()
+
+    def test_analyze_n_trims_before_aggregating(self, tmp_path):
+        """-n must trim the TRACES before profiling: the aggregate has to
+        cover exactly the scans reported, not the whole ring."""
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        tracer = Tracer()
+        for _ in range(3):
+            with tracer.span("scan"):
+                with tracer.span("fetch"):
+                    pass
+        path = tmp_path / "ring.json"
+        path.write_text(json.dumps(tracer.export_chrome()))
+        result = CliRunner().invoke(
+            _make_analyze_command(), ["--trace", str(path), "-n", "1", "--format", "json"]
+        )
+        assert result.exit_code == 0, result.output
+        report = json.loads(result.output)
+        assert len(report["scans"]) == 1
+        assert report["aggregate"]["scan_count"] == 1
+        assert report["aggregate"]["wall_seconds"] == pytest.approx(
+            report["scans"][0]["wall_seconds"], abs=1e-6
+        )
+
+    def test_analyze_requires_exactly_one_input(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        command = _make_analyze_command()
+        assert CliRunner().invoke(command, []).exit_code != 0
+        assert (
+            CliRunner()
+            .invoke(command, ["--trace", "x", "--url", "http://localhost"])
+            .exit_code
+            != 0
+        )
+
+    def test_analyze_rejects_non_trace_files(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        bad = tmp_path / "not-json.txt"
+        bad.write_text("hello")
+        result = CliRunner().invoke(_make_analyze_command(), ["--trace", str(bad)])
+        assert result.exit_code != 0
+
+
+class TestDebugProfileRoute:
+    def _app(self, tracer):
+        from krr_tpu.server.app import HttpApp
+        from krr_tpu.server.state import ServerState
+        from krr_tpu.utils.logging import NULL_LOGGER
+
+        class FakeStore:
+            keys: list = []
+
+        return HttpApp(ServerState(FakeStore()), NULL_LOGGER, tracer=tracer)
+
+    def test_debug_profile_json_and_text(self):
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan", kind="serve"):
+            with tracer.span("fetch", namespace="default"):
+                pass
+        app = self._app(tracer)
+        status, content_type, body = asyncio.run(app.route("GET", "/debug/profile", {}))
+        assert status == 200 and content_type == "application/json"
+        report = json.loads(body)
+        assert report["aggregate"]["scan_count"] == 1
+        assert set(report["scans"][0]["categories"]) == set(CATEGORIES)
+
+        status, content_type, body = asyncio.run(
+            app.route("GET", "/debug/profile", {"format": ["text"]})
+        )
+        assert status == 200 and content_type.startswith("text/plain")
+        assert b"critical-path attribution" in body
+
+        status, _ct, _body = asyncio.run(
+            app.route("GET", "/debug/profile", {"format": ["xml"]})
+        )
+        assert status == 400
+        status, _ct, _body = asyncio.run(app.route("GET", "/debug/profile", {"n": ["x"]}))
+        assert status == 400
+
+    def test_debug_profile_n_limits_scans(self):
+        tracer = Tracer(ring_scans=8)
+        for _ in range(3):
+            with tracer.span("scan"):
+                pass
+        app = self._app(tracer)
+        status, _ct, body = asyncio.run(app.route("GET", "/debug/profile", {"n": ["1"]}))
+        assert status == 200 and json.loads(body)["aggregate"]["scan_count"] == 1
+
+
+# ------------------------------------------------------------ taxonomy lint
+class TestTaxonomyLint:
+    """The registry self-check pattern, extended to documentation: every
+    span name and every declared ``krr_tpu_*`` metric must appear in
+    ARCHITECTURE.md — an undocumented series is invisible to the operator
+    who needs it, which defeats the point of emitting it."""
+
+    def _architecture(self) -> str:
+        return (REPO / "ARCHITECTURE.md").read_text()
+
+    def test_every_span_name_is_documented(self):
+        package = REPO / "krr_tpu"
+        pattern = re.compile(
+            r"(?:\.span|\.start_span|\.stage)\(\s*\n?\s*\"([a-z_]+)\"", re.MULTILINE
+        )
+        names: set[str] = set()
+        for path in sorted(package.rglob("*.py")):
+            names.update(pattern.findall(path.read_text()))
+        assert names >= {"scan", "discover", "fetch", "prom_query", "fold", "compute"}, (
+            "span-name regex rotted?"
+        )
+        # Span names must appear inside a backtick code fragment somewhere
+        # in ARCHITECTURE.md (bare prose mentions of words like "round"
+        # don't count as documentation of a span).
+        fragments = re.findall(r"`+([^`]+)`+", self._architecture())
+        documented = set()
+        for fragment in fragments:
+            for name in names:
+                if re.search(rf"\b{re.escape(name)}\b", fragment):
+                    documented.add(name)
+        missing = names - documented
+        assert not missing, f"span names emitted but not documented in ARCHITECTURE.md: {sorted(missing)}"
+
+    def test_every_declared_metric_is_documented(self):
+        from krr_tpu.obs.metrics import SERVER_METRICS
+
+        text = self._architecture()
+        missing = [d[0] for d in SERVER_METRICS if d[0] not in text]
+        assert not missing, f"metrics declared but not documented in ARCHITECTURE.md: {missing}"
+
+    def test_transport_phases_are_documented(self):
+        from krr_tpu.integrations.prometheus import TRANSPORT_PHASES
+
+        text = self._architecture()
+        missing = [phase for phase in TRANSPORT_PHASES if phase not in text]
+        assert not missing, f"transport phases not documented in ARCHITECTURE.md: {missing}"

@@ -1,0 +1,376 @@
+"""Transport phase-timing tests: a hermetic socket-level fake Prometheus
+injects MEASURABLE delays per phase (slow first byte, dribbled body) and
+the tests assert the recorded split on both data planes — the raw
+http.client transport and the httpx fallback — plus the retry-backoff
+accounting that keeps backoff wait out of the transport phases.
+
+The fake speaks raw HTTP/1.1 over a listening socket (no aiohttp, no
+framework): one request per connection, Connection: close, so every range
+query pays a visible connect and the injected sleeps land exactly where
+the phase taxonomy says they should (TTFB_DELAY between request receipt
+and the status line; DRIBBLE_DELAY between body chunks).
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.config import Config
+from krr_tpu.integrations.prometheus import (
+    TRANSPORT_PHASES,
+    PrometheusLoader,
+    _QueryMeter,
+)
+from krr_tpu.obs.metrics import MetricsRegistry
+from krr_tpu.obs.trace import Tracer
+
+TTFB_DELAY = 0.12
+DRIBBLE_DELAY = 0.04
+DRIBBLE_CHUNKS = 3
+
+
+class PhaseFakePrometheus:
+    """Socket-level fake: /api/v1/query (probe) answers instantly;
+    /api/v1/query_range sleeps ``ttfb_delay`` before the status line, then
+    dribbles the body in ``chunks`` pieces ``dribble_delay`` apart.
+    ``fail_first`` N range queries return 500 (retry/backoff tests)."""
+
+    RANGE_BODY = json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "matrix",
+                "result": [
+                    {
+                        "metric": {"pod": "w-0", "container": "main"},
+                        "values": [[1700000000 + 60 * i, "0.5"] for i in range(8)],
+                    }
+                ],
+            },
+        }
+    ).encode()
+
+    def __init__(self, ttfb_delay=0.0, dribble_delay=0.0, chunks=1, fail_first=0):
+        self.ttfb_delay = ttfb_delay
+        self.dribble_delay = dribble_delay
+        self.chunks = max(1, chunks)
+        self.fail_first = fail_first
+        self.range_requests = 0
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self._sock.settimeout(0.2)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+    # ------------------------------------------------------------- serving
+    def _serve(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5)
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                data += chunk
+            head, _, rest = data.partition(b"\r\n\r\n")
+            request_line = head.split(b"\r\n")[0].decode("latin-1")
+            method, target, _ = request_line.split()
+            length = 0
+            for line in head.split(b"\r\n")[1:]:
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":")[1])
+            while len(rest) < length:
+                rest += conn.recv(65536)
+            if target.startswith("/api/v1/query_range"):
+                self._range_response(conn)
+            else:  # the connect probe / instant queries
+                self._respond(conn, 200, b'{"status":"success","data":{"result":[]}}')
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _respond(self, conn: socket.socket, status: int, body: bytes) -> None:
+        reason = {200: "OK", 500: "Internal Server Error"}[status]
+        conn.sendall(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body
+        )
+
+    def _range_response(self, conn: socket.socket) -> None:
+        self.range_requests += 1
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            self._respond(conn, 500, b'{"status":"error","error":"induced"}')
+            return
+        if self.ttfb_delay:
+            time.sleep(self.ttfb_delay)
+        body = self.RANGE_BODY
+        conn.sendall(
+            f"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+        )
+        step = (len(body) + self.chunks - 1) // self.chunks
+        for i in range(self.chunks):
+            if i and self.dribble_delay:
+                time.sleep(self.dribble_delay)
+            conn.sendall(body[i * step:(i + 1) * step])
+
+
+@pytest.fixture
+def no_proxy_env(monkeypatch):
+    """The raw transport declines under proxy env vars; pin a clean env so
+    the tests pick their plane explicitly."""
+    for var in ("HTTP_PROXY", "HTTPS_PROXY", "http_proxy", "https_proxy", "ALL_PROXY"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def make_loader(server: PhaseFakePrometheus) -> tuple[PrometheusLoader, MetricsRegistry, Tracer]:
+    registry = MetricsRegistry()
+    tracer = Tracer()
+    config = Config(prometheus_url=server.url, quiet=True, format="json")
+    loader = PrometheusLoader(config, tracer=tracer, metrics=registry)
+    loader.retries = 3
+    return loader, registry, tracer
+
+
+def fetch_body(loader: PrometheusLoader, parse=None):
+    async def run():
+        try:
+            return await loader._fetch_range_body("up", 1700000000, 1700000420, "1m", parse=parse)
+        finally:
+            await loader.close()
+
+    return asyncio.run(run())
+
+
+def phase_sum(registry: MetricsRegistry, phase: str) -> float:
+    return registry.value("krr_tpu_prom_phase_seconds_sum", phase=phase) or 0.0
+
+
+def query_span(tracer: Tracer):
+    spans = [s for trace in tracer.traces() for s in trace if s.name == "prom_query"]
+    assert spans, "no prom_query span recorded"
+    return spans[-1]
+
+
+class TestRawTransportPhases:
+    def test_injected_delays_land_in_their_phases(self, no_proxy_env):
+        server = PhaseFakePrometheus(
+            ttfb_delay=TTFB_DELAY, dribble_delay=DRIBBLE_DELAY, chunks=DRIBBLE_CHUNKS
+        )
+        try:
+            loader, registry, tracer = make_loader(server)
+            body = fetch_body(loader)
+            assert body == server.RANGE_BODY
+        finally:
+            server.close()
+
+        # The injected first-byte delay is TTFB, not connect/body time.
+        assert phase_sum(registry, "ttfb") >= TTFB_DELAY * 0.8
+        # The dribbled body shows up as socket-blocked read time.
+        dribble_total = (DRIBBLE_CHUNKS - 1) * DRIBBLE_DELAY
+        assert phase_sum(registry, "body_read") >= dribble_total * 0.8
+        # Connection-per-request server: the connect phase is visible.
+        assert phase_sum(registry, "connect") > 0
+        assert phase_sum(registry, "request_write") >= 0
+        # The semaphore wait is accounted (uncontended here, but present).
+        assert "phase_queue_wait" in query_span(tracer).attributes
+        # Wire bytes = the body that crossed the socket.
+        assert registry.value("krr_tpu_prom_wire_bytes_total", route="buffered") == len(
+            server.RANGE_BODY
+        )
+        span = query_span(tracer)
+        assert span.attributes["phase_ttfb"] >= TTFB_DELAY * 0.8
+        assert span.attributes["bytes"] == len(server.RANGE_BODY)
+        # Phases are a sane decomposition: none exceeds the span's wall.
+        for phase in TRANSPORT_PHASES:
+            recorded = span.attributes.get(f"phase_{phase}", 0.0)
+            assert recorded <= span.duration + 0.01, (phase, recorded, span.duration)
+
+    def test_buffered_parse_is_the_decode_phase(self, no_proxy_env):
+        decoded = [("w-0", np.zeros(64))]
+
+        def parse(body: bytes):
+            time.sleep(0.05)
+            return decoded
+
+        server = PhaseFakePrometheus()
+        try:
+            loader, registry, tracer = make_loader(server)
+            entries = fetch_body(loader, parse=parse)
+            assert entries is decoded
+        finally:
+            server.close()
+        assert phase_sum(registry, "decode") >= 0.04
+        assert registry.value("krr_tpu_prom_decoded_bytes_total") == 64 * 8
+        assert query_span(tracer).attributes["decoded_bytes"] == 64 * 8
+
+    def test_streamed_sink_and_decode_phases(self, no_proxy_env):
+        """The streamed route's sink (feed) and finalize (decode) time is
+        carved out of body-read: a slow native sink must not read as slow
+        transport."""
+
+        class SlowStream:
+            def __init__(self):
+                self.fed = b""
+
+            def feed(self, chunk: bytes) -> None:
+                time.sleep(0.03)
+                self.fed += chunk
+
+            def abort(self) -> None:
+                pass
+
+        server = PhaseFakePrometheus(dribble_delay=DRIBBLE_DELAY, chunks=2)
+        try:
+            loader, registry, tracer = make_loader(server)
+
+            def finalize(stream):
+                time.sleep(0.02)
+                return stream.fed
+
+            async def run():
+                try:
+                    return await loader._fetch_streamed_series(
+                        "up", 1700000000, 1700000420, "1m", SlowStream, finalize
+                    )
+                finally:
+                    await loader.close()
+
+            fed = asyncio.run(run())
+            assert fed == server.RANGE_BODY
+        finally:
+            server.close()
+        assert phase_sum(registry, "sink") >= 0.02
+        assert phase_sum(registry, "decode") >= 0.015
+        assert phase_sum(registry, "body_read") >= DRIBBLE_DELAY * 0.8
+        assert registry.value("krr_tpu_prom_wire_bytes_total", route="streamed") == len(
+            server.RANGE_BODY
+        )
+
+
+class TestHttpxTransportPhases:
+    @pytest.fixture
+    def httpx_plane(self, monkeypatch, no_proxy_env):
+        """Force the httpx data plane the way proxied environments do."""
+        monkeypatch.setattr(
+            PrometheusLoader, "_make_raw_transport", staticmethod(lambda url, headers, verify: None)
+        )
+
+    def test_injected_delays_land_in_their_phases(self, httpx_plane):
+        server = PhaseFakePrometheus(
+            ttfb_delay=TTFB_DELAY, dribble_delay=DRIBBLE_DELAY, chunks=DRIBBLE_CHUNKS
+        )
+        try:
+            loader, registry, tracer = make_loader(server)
+            body = fetch_body(loader)
+            assert body == server.RANGE_BODY
+        finally:
+            server.close()
+        # httpcore's own trace events drive the split: connect visible
+        # (connection-per-request server), TTFB carries the injected
+        # first-byte delay, body_read the dribble.
+        assert phase_sum(registry, "connect") > 0
+        assert phase_sum(registry, "request_write") > 0
+        assert phase_sum(registry, "ttfb") >= TTFB_DELAY * 0.8
+        assert phase_sum(registry, "body_read") >= (DRIBBLE_CHUNKS - 1) * DRIBBLE_DELAY * 0.8
+        span = query_span(tracer)
+        assert span.attributes["phase_ttfb"] >= TTFB_DELAY * 0.8
+
+    def test_streamed_httpx_sink_is_not_body_read(self, httpx_plane):
+        class SlowStream:
+            def __init__(self):
+                self.fed = b""
+
+            def feed(self, chunk: bytes) -> None:
+                time.sleep(0.05)
+                self.fed += chunk
+
+            def abort(self) -> None:
+                pass
+
+        server = PhaseFakePrometheus(chunks=2)
+        try:
+            loader, registry, _tracer = make_loader(server)
+
+            async def run():
+                try:
+                    return await loader._fetch_streamed_series(
+                        "up", 1700000000, 1700000420, "1m", SlowStream, lambda s: s.fed
+                    )
+                finally:
+                    await loader.close()
+
+            fed = asyncio.run(run())
+            assert fed == server.RANGE_BODY
+        finally:
+            server.close()
+        sink = phase_sum(registry, "sink")
+        body_read = phase_sum(registry, "body_read")
+        assert sink >= 0.04
+        # The slow feed must NOT be blamed on the wire.
+        assert body_read < sink
+
+
+class TestRetryBackoffAccounting:
+    def test_backoff_is_recorded_and_separated(self, no_proxy_env):
+        server = PhaseFakePrometheus(fail_first=1)
+        try:
+            loader, registry, tracer = make_loader(server)
+            body = fetch_body(loader)
+            assert body == server.RANGE_BODY
+            assert server.range_requests == 2
+        finally:
+            server.close()
+        span = query_span(tracer)
+        # The retried query carries its backoff on the span...
+        assert span.attributes["retries"] == 1
+        assert span.attributes["retry_wait"] > 0
+        # ...and in the dedicated histogram (one sleep between two attempts),
+        # NOT inside any transport phase.
+        assert registry.value("krr_tpu_prom_retry_backoff_seconds_count") == 1
+        backoff = registry.value("krr_tpu_prom_retry_backoff_seconds_sum")
+        assert backoff == pytest.approx(span.attributes["retry_wait"], abs=1e-6)
+        assert registry.value("krr_tpu_prom_query_retries_total") == 1
+        transport = sum(
+            span.attributes.get(f"phase_{p}", 0.0)
+            for p in ("connect", "request_write", "ttfb", "body_read")
+        )
+        # Span wall ≈ transport + backoff (+ small slack); the phases alone
+        # must NOT absorb the backoff wait.
+        assert transport < span.duration - span.attributes["retry_wait"] + 0.05
+
+    def test_meter_accumulates_phases_across_attempts(self):
+        meter = _QueryMeter()
+        meter.add_phase("ttfb", 0.1)
+        meter.add_phase("ttfb", 0.2)
+        meter.add_bytes(10)
+        meter.backoff += 0.25
+        assert meter.phases["ttfb"] == pytest.approx(0.3)
+        assert meter.bytes == 10 and meter.backoff == 0.25
