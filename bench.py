@@ -67,8 +67,13 @@ vs a recording tracer, gated at <2% wall overhead and bit-exact results,
 carried under ``secondary.obs_*``; plus the device-observability leg —
 the same ``run_batch`` compute with staged pack/quantile/round sub-spans,
 fencing, and padding gauges vs the inert default, same gates, carried
-under ``secondary.obs_device_*``). The e2e leg runs `bench_e2e.py` in a subprocess with
-BENCH_E2E_CONTAINERS defaulted to 10000 (fleet scale) unless already set.
+under ``secondary.obs_device_*``), BENCH_SKIP_CHAOS, BENCH_CHAOS_TICKS
+(default 8), BENCH_CHAOS_WORKLOADS (default 2 — the chaos soak leg: an
+archetype fleet through real serve ticks under a scripted fault timeline,
+gated on no crash, recovery bit-exactness vs a never-faulted control, and
+a bounded hard-down tick wall, carried under ``secondary.chaos_*``). The
+e2e leg runs `bench_e2e.py` in a subprocess with BENCH_E2E_CONTAINERS
+defaulted to 10000 (fleet scale) unless already set.
 
 ``--smoke``: the same harness at toy scale (tiny fleet, 1 run, e2e legs
 included) — a CI-speed end-to-end regression gate, not a measurement. Every
@@ -137,6 +142,10 @@ SMOKE_DEFAULTS = {
     "BENCH_OBS_ROWS": "48",
     "BENCH_OBS_SAMPLES": "1024",
     "BENCH_OBS_RUNS": "3",
+    # Chaos leg: archetype fleet + scripted fault timeline through real
+    # serve ticks, at toy scale but with every gate EXECUTED.
+    "BENCH_CHAOS_TICKS": "8",
+    "BENCH_CHAOS_WORKLOADS": "2",
 }
 
 
@@ -197,6 +206,136 @@ def journal_leg(secondary: dict) -> None:
         f"({total / append_seconds:.0f} rec/s), compaction of {before} recs "
         f"{compact_seconds * 1e3:.1f} ms, diff render {rows} objects {diff_seconds:.3f}s",
         file=sys.stderr,
+    )
+
+
+def chaos_leg(secondary: dict, check) -> None:
+    """Chaos soak gates (`tests.fakes.chaos`): an archetype fleet served by
+    the REAL composition (real PrometheusLoader over HTTP against the
+    fakes) rides a scripted fault timeline — two degraded (partial-outage)
+    ticks, one hard-down tick, then recovery. Three gates, all parity-style
+    (a failure exits nonzero):
+
+    * no crash — every tick returns (scanned, degraded, or cleanly aborted);
+    * recovery bit-exactness — after the faults clear, the soaked resident
+      store is BIT-identical to a never-faulted control run's (the degraded
+      path's streamed==staged-grade discipline);
+    * bounded degraded wall — the hard-down tick's wall stays within an
+      absolute ceiling (breaker fail-fast + the retry deadline budget, not
+      a full backoff ladder per query).
+    """
+    import asyncio
+    import tempfile
+
+    from krr_tpu.core.config import Config
+    from tests.fakes.chaos import (
+        ArchetypeSpec,
+        FaultSpec,
+        FaultTimeline,
+        ServerThread,
+        build_fleet,
+        run_soak,
+        stores_bitexact,
+        write_kubeconfig,
+    )
+
+    ticks = max(8, int(os.environ.get("BENCH_CHAOS_TICKS", 8)))
+    workloads = int(os.environ.get("BENCH_CHAOS_WORKLOADS", 2))
+    fleet = build_fleet(
+        tuple(
+            ArchetypeSpec(kind, workloads=workloads, pods=1)
+            for kind in ("diurnal", "bursty-batch", "oom-loop", "mixed-qos")
+        ),
+        samples=240,
+        seed=29,
+    )
+    server = ServerThread(fleet.backend).start()
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = write_kubeconfig(os.path.join(tmp, "kubeconfig"), server.url)
+
+            def config() -> Config:
+                return Config(
+                    kubeconfig=kubeconfig,
+                    prometheus_url=server.url,
+                    strategy="tdigest",
+                    quiet=True,
+                    server_port=0,
+                    scan_interval_seconds=300.0,
+                    hysteresis_enabled=False,
+                    # Ticks run back-to-back in wall time while the scan
+                    # clock jumps a cadence: a microscopic cooldown keeps
+                    # recovery immediate, the small budget keeps faulted
+                    # ticks fast, and the threshold scales with the fleet
+                    # knob so one namespace's tail fallback wave (2 ladders
+                    # per workload after its healthy siblings finish, which
+                    # the success-epoch guard can no longer discount) can't
+                    # open the breaker during the PARTIAL phase — only the
+                    # hard-down tick (every query failing) trips it.
+                    prometheus_breaker_threshold=max(10, 4 * workloads + 2),
+                    prometheus_breaker_cooldown_seconds=0.02,
+                    prometheus_retry_deadline_seconds=1.0,
+                    prometheus_backoff_cap_seconds=0.2,
+                    other_args={"history_duration": 1, "timeframe_duration": 1},
+                )
+
+            timeline = FaultTimeline(
+                [
+                    (2, 3, FaultSpec(fail_namespaces=frozenset({"diurnal"}))),
+                    (4, 4, FaultSpec(down=True)),
+                ]
+            )
+            report = asyncio.run(
+                run_soak(config(), fleet.backend, timeline, ticks=ticks, tick_seconds=300.0)
+            )
+            control = asyncio.run(
+                run_soak(config(), fleet.backend, None, ticks=ticks, tick_seconds=300.0)
+            )
+    finally:
+        server.stop()
+
+    counts = report.counts()
+    clean_wall = max(t.wall_seconds for t in report.ticks[:2])
+    down_wall = report.ticks[4].wall_seconds
+    equal, detail = stores_bitexact(report.store, control.store)
+    breaker_opens = (
+        report.metrics.value(
+            "krr_tpu_prom_breaker_transitions_total", cluster="fake", to="open"
+        )
+        or 0.0
+    )
+    secondary["chaos_ticks"] = float(len(report.ticks))
+    secondary["chaos_degraded_ticks"] = float(counts["degraded"])
+    secondary["chaos_aborted_ticks"] = float(counts["aborted"])
+    secondary["chaos_clean_tick_seconds"] = round(clean_wall, 4)
+    secondary["chaos_down_tick_seconds"] = round(down_wall, 4)
+    secondary["chaos_breaker_opens"] = breaker_opens
+    secondary["chaos_recovered_bitexact"] = 1.0 if equal else 0.0
+    print(
+        f"bench: chaos soak {len(report.ticks)} ticks "
+        f"({counts['degraded']} degraded, {counts['aborted']} aborted, "
+        f"{breaker_opens:.0f} breaker opens): clean tick {clean_wall:.3f}s, "
+        f"hard-down tick {down_wall:.3f}s, recovery bit-exact: {equal}",
+        file=sys.stderr,
+    )
+    check(
+        "chaos_no_starvation",
+        counts["degraded"] == 2 and all(t.ok for t in report.ticks[:4]),
+        f"expected 2 degraded published ticks, got {counts}",
+    )
+    check(
+        "chaos_down_tick_aborts",
+        report.ticks[4].ok is None and counts["aborted"] == 1,
+        f"hard-down tick outcome {report.ticks[4].ok}, counts {counts}",
+    )
+    check("chaos_recovery_bitexact", equal, detail)
+    # Absolute ceiling, generous for CI noise: the budget allows 1 s of
+    # backoff and the breaker fail-fasts the rest — without them this tick
+    # would burn a retry ladder per query and blow far past it.
+    check(
+        "chaos_down_tick_wall_bounded",
+        down_wall < 10.0,
+        f"hard-down tick took {down_wall:.2f}s (clean tick {clean_wall:.2f}s)",
     )
 
 
@@ -722,6 +861,12 @@ def main() -> None:
         # sub-spans + fencing added by `krr_tpu.obs.device`.
         obs_leg(secondary, check)
         obs_device_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_CHAOS"):
+        # Chaos soak gates: degraded-publish semantics, recovery
+        # bit-exactness, and the breaker-bounded hard-down tick wall — the
+        # standing regression gate for the fault-isolation machinery.
+        chaos_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

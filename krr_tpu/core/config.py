@@ -58,6 +58,28 @@ class Config(pd.BaseModel):
     #: Prometheus's default --query.max-samples=50e6; raise it alongside a
     #: raised server limit to fetch wide fleets in fewer windows.
     prometheus_max_streamed_samples: int = pd.Field(DEFAULT_MAX_STREAMED_SAMPLES, ge=1)
+    #: Cap on one jittered exponential backoff sleep between range-query
+    #: retry attempts: the 0.25 * 2^(n-1) ladder is bounded so deep ladders
+    #: cannot balloon a scan's wall into minutes of sleeping.
+    prometheus_backoff_cap_seconds: float = pd.Field(5.0, gt=0)
+    #: Per-SCAN retry deadline budget: total seconds of retry-backoff sleep
+    #: all of a scan's range queries may burn combined. Once spent, further
+    #: transient failures fail terminally instead of retrying — a scan's
+    #: wall stays bounded under a flapping backend. 0 disables the budget.
+    prometheus_retry_deadline_seconds: float = pd.Field(60.0, ge=0)
+    #: Circuit breaker around each Prometheus target: this many CONSECUTIVE
+    #: retry-ladder exhaustions (transport errors / 5xx, never 4xx — a 4xx
+    #: proves the target is alive; exhaustions whose ladder overlapped a
+    #: sibling's success don't count either) open the breaker, after which
+    #: queries fail in microseconds instead of burning a full backoff
+    #: ladder each. The default sits above the exhaustion burst one broken
+    #: namespace's fallback wave can produce, so only target-wide outages
+    #: open it. 0 disables the breaker.
+    prometheus_breaker_threshold: int = pd.Field(10, ge=0)
+    #: Seconds an OPEN breaker fails fast before letting ONE probe query
+    #: through (half-open): probe success closes the breaker, failure
+    #: re-opens it for another cooldown.
+    prometheus_breaker_cooldown_seconds: float = pd.Field(30.0, gt=0)
 
     # Kubernetes settings
     kubeconfig: Optional[str] = None  # path override; default resolution in integrations
@@ -167,6 +189,22 @@ class Config(pd.BaseModel):
     #: compaction); effectively rounded up to the scan cadence, since
     #: discovery staleness is checked at each scan tick.
     discovery_interval_seconds: float = pd.Field(3600.0, gt=0)
+    #: Degraded-tick floor: a serve tick whose fetch-success fraction falls
+    #: BELOW this percentage aborts (nothing folds, the window refetches
+    #: next tick) instead of publishing a mostly-empty fleet — a mostly-dead
+    #: Prometheus must not publish garbage. At or above it, failed workloads
+    #: quarantine (carry forward last-good digests, marked stale) and the
+    #: successful remainder still folds and publishes. 100 restores the
+    #: all-or-nothing pre-quarantine behavior.
+    min_fetch_success_pct: float = pd.Field(50.0, ge=0, le=100)
+    #: Staleness budget for quarantined workloads: how old a quarantined
+    #: workload's last folded sample may grow while its digests carry
+    #: forward. Past the budget the workload's accumulated row is dropped
+    #: and it re-enters as fresh (full-window backfill on the next
+    #: successful fetch) — incremental catch-up that far back would exceed
+    #: what the operator is willing to serve as "last known good".
+    #: 0 = auto: ten scan cadences.
+    max_staleness_seconds: float = pd.Field(0.0, ge=0)
 
     # Recommendation history + hysteresis (`krr_tpu.history`, serve publish path)
     #: Journal file recording every recompute's raw recommendations (the

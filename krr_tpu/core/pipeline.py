@@ -81,6 +81,11 @@ class PipelineStats:
     #: caller staged discovery itself).
     discover_seconds: float = 0.0
     batches: int = 0
+    #: Batches whose fetch failed terminally and degraded to empty marked
+    #: rows (quarantine fodder for the serve scheduler's degraded ticks);
+    #: 0 on a clean run and on ``raise_on_failure`` callers, which abort
+    #: instead of degrading.
+    failed_batches: int = 0
     #: Queue occupancy high-water mark, sampled at every put AND get.
     peak_queue_depth: int = 0
     #: Sum of wall seconds producers spent blocked in ``put`` on a full

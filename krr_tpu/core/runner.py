@@ -180,6 +180,18 @@ class ScanSession:
         self._inventory = inventory
         self._history_factory = history_factory
         self._history_sources: dict[Optional[str], Union[HistorySource, Exception]] = {}
+        #: Per-scan retry deadline pool shared by every Prometheus loader of
+        #: this session (`krr_tpu.integrations.prometheus.RetryBudget`) —
+        #: built lazily alongside the first real loader so fake-injected
+        #: sessions never import the transport stack.
+        self._retry_budget = None
+
+    def begin_scan(self) -> None:
+        """Reset the per-scan fetch budgets — called by the scan owners
+        (the one-shot Runner, the serve scheduler tick) at each scan's
+        start, so one scan's retry spending can't starve the next."""
+        if self._retry_budget is not None:
+            self._retry_budget.reset()
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -217,14 +229,19 @@ class ScanSession:
                 if self._history_factory is not None:
                     self._history_sources[cluster] = self._history_factory(cluster)
                 else:
-                    from krr_tpu.integrations.prometheus import PrometheusLoader
+                    from krr_tpu.integrations.prometheus import PrometheusLoader, RetryBudget
 
+                    if self._retry_budget is None:
+                        self._retry_budget = RetryBudget(
+                            getattr(self.config, "prometheus_retry_deadline_seconds", 0.0)
+                        )
                     self._history_sources[cluster] = PrometheusLoader(
                         self.config,
                         cluster=cluster,
                         logger=self.logger,
                         tracer=self.tracer,
                         metrics=self.metrics,
+                        retry_budget=self._retry_budget,
                     )
             except Exception as e:  # cache the failure: fail fast per cluster
                 self._history_sources[cluster] = e
@@ -552,9 +569,13 @@ class ScanSession:
                 self.logger.debug_exception()
             return sub
 
+        failed_batch_count = [0]
+
         def fold(batch) -> None:
             key, subset, payload = batch
             sub = digest_payload(subset, payload)
+            if sub.failed_rows:
+                failed_batch_count[0] += 1
             if fleet is not None:
                 fleet.merge_from(sub, key)
             else:
@@ -636,6 +657,7 @@ class ScanSession:
                     results = await asyncio.gather(*fetch_tasks, return_exceptions=True)
         # Pipeline closed: every accepted batch has folded. Surface fetch
         # failures only now, after siblings settled (the fan-out contract).
+        pipeline.stats.failed_batches = failed_batch_count[0]
         for r in results:
             if isinstance(r, BaseException):
                 raise r
@@ -761,6 +783,7 @@ class Runner:
 
     async def _collect_result_traced(self, scan_span) -> Result:
         tracer = self.session.tracer
+        self.session.begin_scan()
         t0, c0 = time.perf_counter(), time.process_time()
         digest_ingest = bool(getattr(self._strategy.settings, "digest_ingest", False)) and hasattr(
             self._strategy, "run_digested"

@@ -370,7 +370,11 @@ class StreamIngest:
             if n < 0:
                 self._handle = None
                 self._lib.krr_stream_free(handle)
-                raise ValueError("malformed Prometheus stream (no result array)")
+                raise ValueError(
+                    "truncated Prometheus stream (body ended mid-series)"
+                    if n == -3
+                    else "malformed Prometheus stream (no result array)"
+                )
             self._count = int(n)
             return self
 
@@ -457,7 +461,11 @@ class StreamIngest:
         try:
             n = self._lib.krr_stream_finish(handle)
             if n < 0:
-                raise ValueError("malformed Prometheus stream (no result array)")
+                raise ValueError(
+                    "truncated Prometheus stream (body ended mid-series)"
+                    if n == -3
+                    else "malformed Prometheus stream (no result array)"
+                )
             if n == 0:
                 if self._num_buckets:
                     empty = np.zeros((0, self._num_buckets), dtype=np.float64)

@@ -62,6 +62,236 @@ class PrometheusNotFound(Exception):
     pass
 
 
+class BreakerOpenError(Exception):
+    """Raised WITHOUT any network I/O while a target's circuit breaker is
+    open: the query fails in microseconds instead of burning a connect
+    timeout plus a full retry ladder against a target already known dead."""
+
+
+#: ``krr_tpu_prom_breaker_state`` gauge encoding.
+BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class CircuitBreaker:
+    """Per-target circuit breaker around the range-query retry ladder.
+
+    One breaker per :class:`PrometheusLoader` (= per Prometheus target).
+    State machine:
+
+    * **closed** — queries flow. Each terminal retry-ladder EXHAUSTION
+      (transport errors / 5xx through every attempt) counts one consecutive
+      failure; ``threshold`` of them open the breaker. Any completed HTTP
+      exchange — a 2xx result or even a non-retryable 4xx — proves the
+      target alive and resets the count (a 400 is a bad query, not a dead
+      target). Counting is additionally SUCCESS-EPOCH guarded: an
+      exhaustion whose ladder overlapped a completed success (the epoch
+      advanced between its admit and its failure) does not count — a dead
+      target yields no concurrent successes, while a single broken
+      namespace's slow failing ladders always overlap its healthy
+      siblings' fast successes, and counting those would open the breaker
+      against a target that is demonstrably alive.
+    * **open** — every query raises :class:`BreakerOpenError` immediately
+      (no I/O) until ``cooldown`` elapses. A dead target then costs
+      microseconds per query instead of a backoff ladder each: the
+      degraded-tick wall stays bounded.
+    * **half-open** — after the cooldown, exactly ONE query is admitted as
+      the probe; concurrent queries PARK on the probe's outcome instead of
+      failing instantly (failing them would sacrifice a whole wave of
+      healthy work to probe timing on the first tick after recovery).
+      Probe success closes the breaker and releases the waiters to run;
+      probe failure re-opens it and fails them fast — the wait is bounded
+      by one retry ladder either way. An abandoned probe (cancellation
+      mid-ladder) releases the waiters as failures and leaves the breaker
+      open, so the next query after the cooldown probes again.
+
+    All transitions happen on the event loop (``admit``/``record_*`` are
+    called from the async retry policy), so no locking is needed. A
+    ``threshold`` of 0 disables the breaker entirely — ``admit`` becomes a
+    constant-False no-op.
+    """
+
+    def __init__(
+        self,
+        threshold: int,
+        cooldown: float,
+        *,
+        cluster: Optional[str] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        logger: KrrLogger = NULL_LOGGER,
+        clock=time.monotonic,
+    ) -> None:
+        self.threshold = int(threshold)
+        self.cooldown = float(cooldown)
+        self.cluster = cluster or "default"
+        self.metrics = metrics
+        self.logger = logger
+        self.clock = clock
+        self.state = "closed"
+        #: Consecutive ladder exhaustions since the last completed exchange.
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probing = False
+        #: Bumped on every success; a failure whose ladder saw the epoch
+        #: move (a sibling succeeded while it ran) does not count toward
+        #: opening — the target answered someone.
+        self.success_epoch = 0
+        #: Queries parked on the in-flight probe's outcome (half-open).
+        self._waiters: "list[asyncio.Future]" = []
+        if self.metrics is not None and self.enabled:
+            self.metrics.set(
+                "krr_tpu_prom_breaker_state", BREAKER_STATES["closed"], cluster=self.cluster
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        if self.metrics is not None:
+            self.metrics.set(
+                "krr_tpu_prom_breaker_state", BREAKER_STATES[state], cluster=self.cluster
+            )
+            self.metrics.inc(
+                "krr_tpu_prom_breaker_transitions_total", cluster=self.cluster, to=state
+            )
+
+    def _fail_fast(self) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_prom_breaker_fast_failures_total", cluster=self.cluster
+            )
+        raise BreakerOpenError(
+            f"circuit breaker open for Prometheus target {self.cluster} "
+            f"({self.failures} consecutive query failures; probing after cooldown)"
+        )
+
+    async def admit(self) -> bool:
+        """Gate one query BEFORE any I/O (even before the connection
+        semaphore — an open breaker must not occupy a fan-out slot).
+        Returns True when this query is the half-open PROBE whose outcome
+        settles the breaker, False for an ordinary admitted query. Raises
+        :class:`BreakerOpenError` (zero I/O) while open inside the
+        cooldown; while a probe is in flight, parks until it settles —
+        proceeding if it closed the breaker, failing fast if it didn't."""
+        if not self.enabled or self.state == "closed":
+            return False
+        if self.state == "open" and self.clock() - self.opened_at >= self.cooldown:
+            self._transition("half_open")
+        if self.state == "half_open":
+            if not self._probing:
+                self._probing = True
+                return True
+            waiter: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._waiters.append(waiter)
+            if await waiter:
+                return False  # the probe closed the breaker: run normally
+            self._fail_fast()
+        self._fail_fast()
+        raise AssertionError("unreachable")  # _fail_fast always raises
+
+    def _settle_probe(self, ok: bool) -> None:
+        self._probing = False
+        waiters, self._waiters = self._waiters, []
+        for waiter in waiters:
+            if not waiter.done():  # a parked query may itself be cancelled
+                waiter.set_result(ok)
+
+    def abandon_probe(self) -> None:
+        """The probe query died without an HTTP verdict (cancellation
+        mid-ladder): release the waiters as failures and RE-OPEN with a
+        fresh cooldown — the target's health is still unknown, and leaving
+        the half-open slot dangling would both misreport the state gauge
+        and let the next query probe without waiting out the cooldown.
+        Without the settle, parked queries would hang forever on a future
+        nobody resolves."""
+        if self._probing:
+            self._settle_probe(False)
+            self.opened_at = self.clock()
+            self._transition("open")
+
+    def record_success(self, probe: bool) -> None:
+        """Any completed HTTP exchange (2xx result, or a non-retryable 4xx —
+        the target answered, so it is alive)."""
+        self.failures = 0
+        self.success_epoch += 1
+        if probe:
+            self._settle_probe(True)
+        if self.state != "closed":
+            self.logger.info(
+                f"Circuit breaker for Prometheus target {self.cluster} closed "
+                f"(probe query succeeded)"
+            )
+            self._transition("closed")
+
+    def record_failure(self, probe: bool, epoch: Optional[int] = None) -> None:
+        """One terminal retry-ladder exhaustion (transport error / 5xx on
+        every attempt). ``epoch`` is the ``success_epoch`` the caller
+        captured at admit time: if it has moved, a sibling query SUCCEEDED
+        while this ladder ran — the target is alive, so the exhaustion
+        doesn't count toward opening (probe failures always count: during
+        half-open everyone else is parked, so nothing can race it)."""
+        if not self.enabled:
+            return
+        if not probe and epoch is not None and epoch != self.success_epoch:
+            return
+        self.failures += 1
+        if probe:
+            self.opened_at = self.clock()
+            self.logger.warning(
+                f"Circuit breaker for Prometheus target {self.cluster} re-opened "
+                f"(probe query failed); retrying in {self.cooldown:.0f}s"
+            )
+            self._transition("open")
+            self._settle_probe(False)
+        elif self.state == "closed" and self.failures >= self.threshold:
+            self.opened_at = self.clock()
+            self.logger.warning(
+                f"Circuit breaker for Prometheus target {self.cluster} opened after "
+                f"{self.failures} consecutive query failures; failing fast for "
+                f"{self.cooldown:.0f}s before probing"
+            )
+            self._transition("open")
+
+
+class RetryBudget:
+    """Per-SCAN retry deadline budget, shared by every loader of a scan.
+
+    Each backoff sleep the retry ladders want to take is charged here first;
+    once the combined spend would exceed the budget, the charging query
+    fails terminally instead of sleeping — so a flapping backend can delay a
+    scan by at most ``seconds`` of backoff total, no matter how many queries
+    are retrying. :meth:`reset` is called at each scan's start (the
+    scheduler tick / Runner scan); a limit of 0 disables the budget. Plain
+    float arithmetic on the event loop — no locking."""
+
+    def __init__(self, seconds: float = 0.0) -> None:
+        self.limit = float(seconds)
+        self.spent = 0.0
+        self._exhausted_logged = False
+
+    def reset(self) -> None:
+        self.spent = 0.0
+        self._exhausted_logged = False
+
+    def consume(self, wait: float) -> bool:
+        """Charge one backoff sleep; False when the budget cannot cover it
+        (the caller must fail terminally instead of sleeping)."""
+        if self.limit <= 0:
+            return True
+        if self.spent + wait > self.limit:
+            return False
+        self.spent += wait
+        return True
+
+    def note_exhausted(self) -> bool:
+        """True exactly once per scan — the one warning log."""
+        if self._exhausted_logged:
+            return False
+        self._exhausted_logged = True
+        return True
+
+
 class PrometheusQueryError(Exception):
     """Non-2xx response to a range query; carries the HTTP status and the
     (truncated) error body for policy decisions like the halved-window
@@ -412,6 +642,7 @@ class PrometheusLoader:
         logger: KrrLogger = NULL_LOGGER,
         tracer: NullTracer = NULL_TRACER,
         metrics: Optional[MetricsRegistry] = None,
+        retry_budget: Optional[RetryBudget] = None,
     ):
         self.config = config
         self.cluster = cluster
@@ -436,6 +667,29 @@ class PrometheusLoader:
         self._connect_lock = asyncio.Lock()
         self._semaphore = asyncio.Semaphore(config.prometheus_max_connections)
         self.retries = 3
+        #: Backoff sleeps are capped (pre-jitter) so deep ladders can't
+        #: balloon a scan's wall, and charged against the per-scan retry
+        #: deadline budget — injected by the owning ScanSession so every
+        #: loader of a scan draws from ONE pool; standalone loaders get a
+        #: private budget from the config.
+        self.backoff_cap = float(
+            getattr(config, "prometheus_backoff_cap_seconds", 5.0) or 5.0
+        )
+        self.retry_budget = (
+            retry_budget
+            if retry_budget is not None
+            else RetryBudget(getattr(config, "prometheus_retry_deadline_seconds", 0.0))
+        )
+        #: Per-target circuit breaker (see :class:`CircuitBreaker`): opens on
+        #: consecutive retry-ladder exhaustions, fails queries fast while
+        #: open, half-open probes after the cooldown.
+        self.breaker = CircuitBreaker(
+            getattr(config, "prometheus_breaker_threshold", 0),
+            getattr(config, "prometheus_breaker_cooldown_seconds", 30.0),
+            cluster=cluster,
+            metrics=metrics,
+            logger=logger,
+        )
 
     # -------------------------------------------------------------- connect
     async def _discover_url(self) -> tuple[Optional[str], Optional[KubeApi]]:
@@ -788,50 +1042,89 @@ class PrometheusLoader:
         width, not transported), and backoff sleeps (``retry_wait`` on the
         span, ``krr_tpu_prom_retry_backoff_seconds`` in the registry) so a
         query slowed by retries is distinguishable from slow transport.
+
+        Around the whole ladder sits the per-target circuit breaker: an
+        open breaker raises :class:`BreakerOpenError` here with zero I/O
+        (before even the semaphore — a dead target must not occupy fan-out
+        slots); a ladder that exhausts (transport errors / 5xx through
+        every attempt) records a breaker failure, while any completed HTTP
+        exchange — success OR a non-retryable 4xx — records liveness.
+        Backoff sleeps are capped (``prometheus_backoff_cap_seconds``,
+        pre-jitter) and charged against the shared per-scan
+        :class:`RetryBudget`; a sleep the budget can't cover turns the
+        failure terminal immediately, bounding the scan's wall.
         """
-        last_error: Optional[Exception] = None
-        auth_refreshed = False
-        attempt = 0
-        while attempt < self.retries:
-            generation = self._auth_generation
-            try:
-                if meter is not None:
-                    meter.attempts += 1
-                t_queued = time.perf_counter()
-                async with self._semaphore:
+        probe = await self.breaker.admit()  # BreakerOpenError while open: no I/O
+        admit_epoch = self.breaker.success_epoch
+        settled = False
+        try:
+            last_error: Optional[Exception] = None
+            auth_refreshed = False
+            attempt = 0
+            while attempt < self.retries:
+                generation = self._auth_generation
+                try:
                     if meter is not None:
-                        meter.add_phase("queue_wait", time.perf_counter() - t_queued)
-                    status, result, detail_bytes = await attempt_fn()
-            except (http.client.HTTPException, httpx.TransportError, OSError) as e:
-                last_error = e
-            else:
-                if status < 300:
-                    return result
-                detail = detail_bytes[:200].decode("utf-8", errors="replace")
-                if status in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
-                    auth_refreshed = True
-                    await self._refresh_auth(generation)
+                        meter.attempts += 1
+                    t_queued = time.perf_counter()
+                    async with self._semaphore:
+                        if meter is not None:
+                            meter.add_phase("queue_wait", time.perf_counter() - t_queued)
+                        status, result, detail_bytes = await attempt_fn()
+                except (http.client.HTTPException, httpx.TransportError, OSError) as e:
+                    last_error = e
+                else:
+                    if status < 300:
+                        settled = True
+                        self.breaker.record_success(probe)
+                        return result
+                    detail = detail_bytes[:200].decode("utf-8", errors="replace")
+                    if status in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
+                        auth_refreshed = True
+                        await self._refresh_auth(generation)
+                        last_error = PrometheusQueryError(status, detail)
+                        continue  # no backoff: the failure was auth, not load
+                    if status < 500:
+                        # The target ANSWERED — a 4xx is a bad query or bad
+                        # auth, not a dead target: liveness for the breaker.
+                        settled = True
+                        self.breaker.record_success(probe)
+                        raise PrometheusQueryError(status, detail)
                     last_error = PrometheusQueryError(status, detail)
-                    continue  # no backoff: the failure was auth, not load
-                if status < 500:
-                    raise PrometheusQueryError(status, detail)
-                last_error = PrometheusQueryError(status, detail)
-            attempt += 1
-            if attempt < self.retries:
-                # Jittered exponential backoff: dozens of concurrent window
-                # queries see a 5xx at the same instant, and a bare 2^n
-                # schedule would march them all back onto a recovering
-                # server in lockstep — each retry wave as synchronized as
-                # the failure that caused it. ±50% jitter decorrelates the
-                # herd while keeping the expected backoff unchanged.
-                wait = 0.25 * 2 ** (attempt - 1) * random.uniform(0.5, 1.5)
-                if meter is not None:
-                    meter.backoff += wait
-                if self.metrics is not None:
-                    self.metrics.observe("krr_tpu_prom_retry_backoff_seconds", wait)
-                await asyncio.sleep(wait)
-        assert last_error is not None
-        raise last_error
+                attempt += 1
+                if attempt < self.retries:
+                    # Jittered exponential backoff: dozens of concurrent window
+                    # queries see a 5xx at the same instant, and a bare 2^n
+                    # schedule would march them all back onto a recovering
+                    # server in lockstep — each retry wave as synchronized as
+                    # the failure that caused it. ±50% jitter decorrelates the
+                    # herd while keeping the expected backoff unchanged. The
+                    # pre-jitter cap bounds deep ladders; the budget charge
+                    # bounds the SCAN (all queries combined).
+                    wait = min(0.25 * 2 ** (attempt - 1), self.backoff_cap) * random.uniform(0.5, 1.5)
+                    if not self.retry_budget.consume(wait):
+                        if self.retry_budget.note_exhausted():
+                            self.logger.warning(
+                                f"Prometheus retry deadline budget "
+                                f"({self.retry_budget.limit:.0f}s of backoff) exhausted "
+                                f"for this scan — further transient failures fail "
+                                f"terminally without retrying"
+                            )
+                        break  # terminal: the scan may not sleep any longer
+                    if meter is not None:
+                        meter.backoff += wait
+                    if self.metrics is not None:
+                        self.metrics.observe("krr_tpu_prom_retry_backoff_seconds", wait)
+                    await asyncio.sleep(wait)
+            settled = True
+            self.breaker.record_failure(probe, epoch=admit_epoch)
+            assert last_error is not None
+            raise last_error
+        finally:
+            if probe and not settled:
+                # The ladder died without an HTTP verdict (cancellation):
+                # queries parked on this probe must not hang forever.
+                self.breaker.abandon_probe()
 
     def _decode_timed(self, decode, body: bytes, meter: _QueryMeter):
         """Run a buffered-body parse inside the query's instrumentation

@@ -194,6 +194,52 @@ def _common_options() -> list[click.Option]:
                 "server limit to fetch wide fleets in fewer windows."
             ),
         ),
+        PanelOption(
+            ["--backoff-cap-seconds", "prometheus_backoff_cap_seconds"],
+            type=float,
+            default=5.0,
+            show_default=True,
+            help=(
+                "Cap on one jittered exponential backoff sleep between "
+                "Prometheus retry attempts (deep ladders cannot balloon a "
+                "scan's wall into minutes of sleeping)."
+            ),
+        ),
+        PanelOption(
+            ["--retry-deadline-seconds", "prometheus_retry_deadline_seconds"],
+            type=float,
+            default=60.0,
+            show_default=True,
+            help=(
+                "Per-scan retry deadline budget: total backoff seconds all of "
+                "a scan's Prometheus queries may burn combined; once spent, "
+                "transient failures fail terminally instead of retrying. 0 disables."
+            ),
+        ),
+        PanelOption(
+            ["--breaker-threshold", "prometheus_breaker_threshold"],
+            type=int,
+            default=10,
+            show_default=True,
+            help=(
+                "Circuit breaker: consecutive retry-ladder exhaustions "
+                "(transport errors / 5xx; exhaustions overlapping a sibling's "
+                "success don't count) that open the breaker on a Prometheus "
+                "target, after which its queries fail fast instead of burning a "
+                "backoff ladder each. 0 disables the breaker."
+            ),
+        ),
+        PanelOption(
+            ["--breaker-cooldown-seconds", "prometheus_breaker_cooldown_seconds"],
+            type=float,
+            default=30.0,
+            show_default=True,
+            help=(
+                "Seconds an open breaker fails fast before letting one "
+                "half-open probe query through (success closes it, failure "
+                "re-opens for another cooldown)."
+            ),
+        ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
         PanelOption(
             ["--batched-fleet-queries"],
@@ -402,6 +448,32 @@ def _server_options() -> list[click.Option]:
             show_default=True,
             panel="Server Settings",
             help="Seconds between fleet re-discoveries (workload churn pickup + digest store compaction).",
+        ),
+        PanelOption(
+            ["--min-fetch-success-pct", "min_fetch_success_pct"],
+            type=float,
+            default=50.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Degraded-tick floor: abort a serve tick (refetch next tick) "
+                "when fewer than this percentage of workload fetches succeed; "
+                "at or above it, failed workloads quarantine with stale marks "
+                "while the rest publish. 100 = all-or-nothing."
+            ),
+        ),
+        PanelOption(
+            ["--max-staleness", "max_staleness_seconds"],
+            type=float,
+            default=0.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Freshness budget for quarantined workloads' carried-forward "
+                "recommendations: past this age their accumulated digests drop "
+                "and they re-enter with a full-window backfill. 0 = auto "
+                "(ten scan cadences)."
+            ),
         ),
         PanelOption(
             ["--history-path", "history_path"],

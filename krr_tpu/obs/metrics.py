@@ -50,7 +50,11 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_scan_pipeline_wait_seconds", "gauge", "Last scan's streamed-pipeline wait time by side: producer_blocked = producers stalled in put() (fold-bound), consumer_starved = the consumer parked in get() (fetch-bound)."),
     ("krr_tpu_scan_pipeline_queue_depth", "gauge", "Live streamed-pipeline queue occupancy, sampled at every put and get."),
     ("krr_tpu_scan_window_seconds", "gauge", "Width of the last scan's fetched time window."),
-    ("krr_tpu_scan_failed_rows", "gauge", "Object fetches that failed terminally in the last scan (rows rendered UNKNOWN)."),
+    ("krr_tpu_scan_failed_rows", "gauge", "Object fetches that failed terminally in the last scan (rows rendered UNKNOWN; on serve ticks, quarantined)."),
+    ("krr_tpu_scans_degraded_total", "counter", "Serve ticks that published with quarantined workloads: partial fetch failure above the --min-fetch-success-pct abort floor."),
+    ("krr_tpu_scan_failed_batches", "gauge", "Pipeline fetch batches that failed terminally in the last streamed serve tick (the batch-granular view between failed rows and the degraded-tick counter)."),
+    ("krr_tpu_stale_workloads", "gauge", "Workloads currently quarantined by degraded ticks — their published recommendations carry forward last-good digests with stale_since marks."),
+    ("krr_tpu_quarantine_expired_total", "counter", "Quarantined workloads whose staleness exceeded --max-staleness: their accumulated store rows were dropped and they re-enter with a full-window backfill."),
     ("krr_tpu_fetch_rows_total", "counter", "Cumulative object fetches attempted by completed scans (the denominator of the fetch failed-row SLO)."),
     ("krr_tpu_fetch_failed_rows_total", "counter", "Cumulative object fetches that failed terminally (the numerator of the fetch failed-row SLO)."),
     ("krr_tpu_fetch_window_seconds_total", "counter", "Cumulative fetched window seconds by kind — a delta-scan server grows this by the delta width per tick, a re-fetching one by the full history width."),
@@ -73,6 +77,9 @@ SERVER_METRICS: tuple[tuple, ...] = (
     # from the prom_query span attributes).
     ("krr_tpu_prom_phase_seconds", "histogram", "Prometheus range-query time by transport phase (queue_wait|connect|request_write|ttfb|body_read|decode|sink), one observation per query per phase that occurred.", DEFAULT_SECONDS_BUCKETS),
     ("krr_tpu_prom_retry_backoff_seconds", "histogram", "Backoff sleeps between Prometheus range-query retry attempts — kept out of the phase split so retries can't masquerade as slow transport.", DEFAULT_SECONDS_BUCKETS),
+    ("krr_tpu_prom_breaker_state", "gauge", "Per-target Prometheus circuit-breaker state: 0 closed, 1 half-open (probe in flight), 2 open (failing fast)."),
+    ("krr_tpu_prom_breaker_transitions_total", "counter", "Prometheus circuit-breaker state transitions by target and destination state (open|half_open|closed)."),
+    ("krr_tpu_prom_breaker_fast_failures_total", "counter", "Range queries failed fast (zero I/O) by an open Prometheus circuit breaker."),
     ("krr_tpu_prom_wire_bytes_total", "counter", "Response body bytes read off the Prometheus transport by data plane (buffered|streamed)."),
     ("krr_tpu_prom_decoded_bytes_total", "counter", "Bytes of decoded sample arrays produced by buffered-route parses (streamed ingest never materializes decoded arrays; compare against wire bytes for JSON overhead)."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),

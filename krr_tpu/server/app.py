@@ -210,7 +210,15 @@ class HttpApp:
             return 400, "application/json", _json_body(
                 {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
             )
-        return 200, "application/json", _json_body(engine.status())
+        payload = engine.status()
+        # The serve-side degraded-state summary rides along (the one-shot
+        # --statusz dump has no server, so this section is serve-only).
+        payload["server"] = {
+            "stale_workloads": len(self.state.stale_workloads),
+            "consecutive_scan_failures": self.state.consecutive_scan_failures,
+            "last_scan_error": self.state.last_scan_error,
+        }
+        return 200, "application/json", _json_body(payload)
 
     async def _healthz(self) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
@@ -246,6 +254,12 @@ class HttpApp:
                 if journal_newest is not None
                 else None
             ),
+            # Degraded-state visibility without grepping logs: quarantined
+            # workloads serving carried-forward values, how many ticks in a
+            # row have aborted, and the last abort's error.
+            "stale_workloads": len(self.state.stale_workloads),
+            "consecutive_scan_failures": self.state.consecutive_scan_failures,
+            "last_scan_error": self.state.last_scan_error,
             "slo_firing": firing,
         }
         return (200 if status in ("ok", "degraded") else 503), "application/json", _json_body(body)

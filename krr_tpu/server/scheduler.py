@@ -19,11 +19,24 @@ build a private window, the recommendation compute reads the store from a
 worker thread — and publishes with one atomic snapshot swap at the end, so
 queries serve the previous result throughout. ``state.last_end`` advances
 only after a fold completes: a scan cancelled mid-fetch (shutdown, restart)
-simply refetches its window on the next tick. A FAILED cluster fetch aborts
-the whole tick for the same reason (``raise_on_failure``): the one-shot
-CLI's degrade-to-UNKNOWN would here fold an empty window and advance past
-it, silently losing those samples from the accumulated store — instead the
-tick counts a failure and the window is refetched next tick.
+simply refetches its window on the next tick.
+
+Failure domains (fault-isolated degraded ticks): a workload whose fetch
+fails TERMINALLY this tick is QUARANTINED — its rows stay unfolded (the
+one-shot CLI's degrade-to-UNKNOWN would here fold an empty window and
+advance past it, silently losing those samples from the accumulated store),
+its last-good digests keep serving with a ``stale_since`` mark, and on a
+later tick a CATCH-UP leg refetches the union of every window it missed
+from its own cursor — digest mergeability makes the recovered store
+bit-identical to one that never missed a window. The quarantine cursor
+persists in the store's extra_meta (same atomic save as the window cursor),
+a workload stale past ``--max-staleness`` drops its row and re-enters as
+fresh (full backfill), and a tick whose fetch-success fraction falls below
+``--min-fetch-success-pct`` still hard-aborts — folding and publishing a
+mostly-empty fleet would be worse than serving the previous result. The
+whole tick also still aborts on infrastructure errors (cancellation,
+discovery failures mid-flight), which leave store, cursor, and quarantine
+untouched for a clean refetch.
 
 Window edges are clamped to the Prometheus evaluation grid: a range query
 evaluates at ``start, start + step, …``, so the fetched window's true right
@@ -102,11 +115,31 @@ class ScanScheduler:
                     f"Digest state at {self.state_path} carries no serve window cursor — "
                     f"the first scan re-folds the full window on top of the resumed store"
                 )
+        # Degraded-tick policy (fault isolation): failed workload fetches
+        # QUARANTINE — their windows stay unfolded, their last-good digests
+        # carry forward with stale marks — instead of aborting the whole
+        # tick, unless the fetch-success fraction falls below the floor.
+        config = session.config
+        self.min_fetch_success_pct = float(getattr(config, "min_fetch_success_pct", 100.0))
+        #: Staleness budget: past it a quarantined workload's accumulated
+        #: row drops and it re-enters as fresh (full-window backfill).
+        self.max_staleness = (
+            float(getattr(config, "max_staleness_seconds", 0.0)) or 10.0 * self.scan_interval
+        )
+        #: key → grid-aligned start of the first window its fetch missed:
+        #: the catch-up fetch's left edge. Persisted in the store's
+        #: extra_meta (same atomic save as the cursor) — a restart must
+        #: refetch the missed windows, not silently skip them.
+        self._quarantine: dict[str, float] = {}
+        if self.state_path and self.state.store.keys and self.state.last_end is not None:
+            saved = self.state.store.extra_meta.get("serve_quarantine")
+            if saved:
+                self._quarantine = {str(k): float(v) for k, v in saved.items()}
+        self._publish_stale_state()
         # The hysteresis gate on the publish path (`krr_tpu.history.policy`).
         # A resumed journal re-seeds the trailing published baselines, so a
         # restart keeps gating against the pre-restart published values
         # instead of re-publishing the whole fleet as "new".
-        config = session.config
         self.gate = HysteresisGate(
             dead_band_pct=config.hysteresis_dead_band_pct,
             confirm_ticks=config.hysteresis_confirm_ticks,
@@ -168,8 +201,58 @@ class ScanScheduler:
         from krr_tpu.core.streaming import DigestStore
 
         self.state.store.extra_meta["serve_last_end"] = self.state.last_end
+        # The quarantine rides the same atomic save as the cursor: a restart
+        # that resumed the cursor without it would fold plain deltas for
+        # quarantined workloads and silently lose their missed windows.
+        if self._quarantine:
+            self.state.store.extra_meta["serve_quarantine"] = dict(self._quarantine)
+        else:
+            self.state.store.extra_meta.pop("serve_quarantine", None)
         with DigestStore.locked(self.state_path):
             self.state.store.save(self.state_path)
+
+    # ------------------------------------------------- degraded-tick helpers
+    def _step(self) -> float:
+        return float(self._step_seconds())
+
+    def _publish_stale_state(self) -> None:
+        """Reflect the quarantine into the read side: ``stale_since`` per
+        key (the last grid point actually folded) and the gauge."""
+        step = self._step()
+        self.state.stale_workloads = {
+            key: start - step for key, start in self._quarantine.items()
+        }
+        self.state.metrics.set("krr_tpu_stale_workloads", len(self._quarantine))
+
+    async def _expire_quarantine(self, now: float) -> None:
+        """Drop quarantined workloads whose staleness exceeded the budget:
+        their accumulated rows leave the store, so they re-enter as FRESH
+        (full-window backfill on the next successful fetch) instead of
+        carrying an incremental catch-up window the operator no longer
+        trusts as "last known good". The compaction copies the [N x B]
+        matrix — off the loop, like the discovery compaction."""
+        step = self._step()
+        expired = [
+            key for key, start in self._quarantine.items()
+            if now - (start - step) > self.max_staleness
+        ]
+        if not expired:
+            return
+        for key in expired:
+            del self._quarantine[key]
+        dropped = await asyncio.to_thread(
+            self.state.store.compact,
+            frozenset(self.state.store.keys) - frozenset(expired),
+        )
+        # Refresh the read side NOW: if this tick later aborts, /healthz and
+        # the gauge must not keep counting workloads whose rows are gone.
+        self._publish_stale_state()
+        self.state.metrics.inc("krr_tpu_quarantine_expired_total", len(expired))
+        self.logger.warning(
+            f"{len(expired)} quarantined workload(s) exceeded the "
+            f"{self.max_staleness:.0f}s staleness budget — dropped {dropped} "
+            f"store row(s); they re-enter with a full-window backfill"
+        )
 
     async def _recompute_and_publish(
         self,
@@ -259,6 +342,16 @@ class ScanScheduler:
                     )
                     for obj, raw in zip(objects, raw_results)
                 ]
+                # Degraded-tick stale marks: a quarantined workload's scan
+                # carries the age of its last folded window, so consumers
+                # of /recommendations can tell a carried-forward value
+                # from a fresh one.
+                stale = self.state.stale_workloads
+                if stale:
+                    for key, scan in zip(keys, scans):
+                        since = stale.get(key)
+                        if since is not None:
+                            scan.stale_since = since
                 result = Result(scans=scans)
             return result, result.format("json").encode(), decision
 
@@ -310,6 +403,8 @@ class ScanScheduler:
         metrics = self.state.metrics
         settings = self.session.strategy.settings
         step = self._step_seconds()
+        # Fresh per-scan fetch budgets (the Prometheus retry deadline pool).
+        self.session.begin_scan()
 
         t0 = time.perf_counter()
         if self._objects is None or now - self._discovered_at >= self.discovery_interval:
@@ -361,18 +456,40 @@ class ScanScheduler:
         # one step past the last point actually fetched.
         end = start + ((now - start) // step) * step
 
-        # Workloads that appeared since the last scan have no store row
-        # yet; a delta-width fetch would skip everything between their
-        # creation and last_end (startup spikes included — peak-based
+        # A full scan refetches everything from scratch — any quarantine
+        # inherited from stale metadata is covered by it.
+        if kind == "full" and self._quarantine:
+            self._quarantine.clear()
+            self._publish_stale_state()
+        # Quarantined workloads past the staleness budget drop their rows
+        # and re-enter as fresh (full backfill) — BEFORE the leg split, so
+        # they land in `fresh` below.
+        await self._expire_quarantine(now)
+
+        # Leg split. Workloads that appeared since the last scan have no
+        # store row yet; a delta-width fetch would skip everything between
+        # their creation and last_end (startup spikes included — peak-based
         # memory recommendations would miss them forever). They get a
-        # FULL-window backfill alongside the fleet's delta.
-        fresh: list[K8sObjectData] = []
-        seasoned = objects
-        if kind == "delta":
-            fresh = [obj for obj in objects if object_key(obj) not in self.state.store]
-            if fresh:
-                seasoned = [obj for obj in objects if object_key(obj) in self.state.store]
+        # FULL-window backfill alongside the fleet's delta. QUARANTINED
+        # workloads (an earlier degraded tick lost their window) instead get
+        # a CATCH-UP leg from their own cursor — the union of every window
+        # they missed plus this delta, which the digest's exact mergeability
+        # folds bit-identically to having never missed them.
         backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
+        fresh: list[K8sObjectData] = []
+        seasoned: list[K8sObjectData] = []
+        catchup: dict[float, list[K8sObjectData]] = {}
+        if kind == "delta":
+            for obj in objects:
+                key = object_key(obj)
+                if key in self._quarantine:
+                    catchup.setdefault(self._quarantine[key], []).append(obj)
+                elif key not in self.state.store:
+                    fresh.append(obj)
+                else:
+                    seasoned.append(obj)
+        else:
+            seasoned = objects
 
         use_pipeline = self.session.config.pipeline_depth > 0
         pipeline_stats = []
@@ -382,15 +499,16 @@ class ScanScheduler:
                 # Streamed pipeline: per-namespace batches fold into the
                 # tick's PRIVATE window fleet while the rest still fetch
                 # (`ScanSession.stream_fleet_digests`). The resident
-                # store is only touched by the single fold below, after
-                # every fetch succeeded — a failed tick still leaves it
-                # untouched, exactly like the staged path.
+                # store is only touched by the single fold below — a
+                # failed BATCH degrades to empty rows marked in
+                # failed_rows (quarantine fodder), and an aborted tick
+                # still leaves the store untouched.
                 _objs, fleet, stats = await self.session.stream_fleet_digests(
                     objs,
                     history_seconds=end - w_start,
                     step_seconds=settings.timeframe_timedelta.total_seconds(),
                     end_time=end,
-                    raise_on_failure=True,
+                    raise_on_failure=False,
                 )
                 pipeline_stats.append(stats)
                 return fleet
@@ -399,28 +517,94 @@ class ScanScheduler:
                 history_seconds=end - w_start,
                 step_seconds=settings.timeframe_timedelta.total_seconds(),
                 end_time=end,
-                raise_on_failure=True,
+                raise_on_failure=False,
             )
 
-        fetches = [fetch(seasoned, start)]
+        legs: list[tuple[list[K8sObjectData], float, str]] = []
+        has_seasoned_leg = bool(seasoned) or not (fresh or catchup)
+        if has_seasoned_leg:
+            legs.append((seasoned, start, kind))
         if fresh:
-            fetches.append(fetch(fresh, backfill_start))
+            legs.append((fresh, backfill_start, "backfill"))
+        for q_start in sorted(catchup):
+            legs.append((catchup[q_start], q_start, "catchup"))
         # return_exceptions so a failing fetch doesn't orphan its
         # sibling mid-download (same rationale as the session's own
-        # cluster fan-out).
-        fleets = await asyncio.gather(*fetches, return_exceptions=True)
+        # cluster fan-out). Only infrastructure errors arrive here now —
+        # fetch failures degrade to failed_rows.
+        fleets = await asyncio.gather(
+            *[fetch(leg_objects, w_start) for leg_objects, w_start, _ in legs],
+            return_exceptions=True,
+        )
         for fleet in fleets:
             if isinstance(fleet, BaseException):
                 raise fleet
         t2 = time.perf_counter()
 
+        # Fault isolation: failed workloads QUARANTINE (their windows stay
+        # unfolded; last-good digests carry forward below) — unless the
+        # fetch-success fraction falls under the floor, where publishing
+        # the mostly-empty remainder would be worse than serving the
+        # previous result.
+        failed_keys: set[str] = set()
+        for fleet in fleets:
+            for i in fleet.failed_rows:
+                failed_keys.add(object_key(fleet.objects[i]))
+        if objects and failed_keys:
+            success_pct = 100.0 * (1.0 - len(failed_keys) / len(objects))
+            if success_pct < self.min_fetch_success_pct:
+                raise RuntimeError(
+                    f"{len(failed_keys)} of {len(objects)} object fetches failed "
+                    f"terminally (fetch success {success_pct:.0f}% below the "
+                    f"--min-fetch-success-pct floor {self.min_fetch_success_pct:g}%)"
+                )
+
         with self.session.tracer.span("fold", rows=len(objects)):
             for fleet in fleets:
+                if fleet.failed_rows:
+                    # A failed row may still carry ONE resource's successful
+                    # samples (its sibling query failed). Zero it entirely:
+                    # the catch-up leg refetches BOTH resources over the
+                    # missed windows, and a half-folded row would
+                    # double-count the surviving half.
+                    rows_to_clear = sorted(fleet.failed_rows)
+                    fleet.clear_cpu_rows(rows_to_clear)
+                    fleet.clear_mem_rows(rows_to_clear)
                 await asyncio.to_thread(self.state.store.fold_fleet, fleet, MEMORY_SCALE)
             rows = await asyncio.to_thread(
                 self.state.store.rows_for, [object_key(obj) for obj in objects]
             )
         self.state.last_end = end
+
+        # Quarantine bookkeeping: recovered workloads (their catch-up leg
+        # folded through `end`) leave; newly failed ones enter at their
+        # leg's window start; repeat offenders keep their ORIGINAL cursor —
+        # the catch-up window keeps growing until it succeeds or expires.
+        for leg_objects, w_start, _ in legs:
+            for obj in leg_objects:
+                key = object_key(obj)
+                if key in failed_keys:
+                    self._quarantine.setdefault(key, w_start)
+                else:
+                    self._quarantine.pop(key, None)
+        self._publish_stale_state()
+        if failed_keys:
+            metrics.inc("krr_tpu_scans_degraded_total")
+            metrics.inc("krr_tpu_fetch_failed_rows_total", len(failed_keys))
+            self.logger.warning(
+                f"Degraded tick: {len(failed_keys)} of {len(objects)} workload "
+                f"fetches failed — quarantined with stale marks "
+                f"({len(self._quarantine)} total in quarantine)"
+            )
+        metrics.set("krr_tpu_scan_failed_rows", len(failed_keys))
+        if pipeline_stats:
+            # Batch-granular failure view (between per-row failed_keys and
+            # the per-tick degraded counter): how many namespace batches
+            # came back dead this tick.
+            metrics.set(
+                "krr_tpu_scan_failed_batches",
+                sum(s.failed_batches for s in pipeline_stats),
+            )
         t3 = time.perf_counter()
 
         await self._recompute_and_publish(objects, rows, end)
@@ -430,15 +614,24 @@ class ScanScheduler:
             await asyncio.to_thread(self._save_store)
 
         metrics.inc("krr_tpu_scans_total", kind=kind)
-        # A completed tick fetched every object (raise_on_failure: partial
-        # fetches abort the tick) — the SLO fetch objective's denominator.
+        # Every object's fetch was ATTEMPTED this tick — the SLO fetch
+        # objective's denominator (failed ones landed in
+        # krr_tpu_fetch_failed_rows_total above).
         if objects:
             metrics.inc("krr_tpu_fetch_rows_total", len(objects))
-        metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
+        if has_seasoned_leg:
+            # Only when the delta/full leg actually fetched: a tick whose
+            # every object rode a backfill or catch-up leg counts those
+            # windows under their own kinds, not a phantom delta.
+            metrics.inc("krr_tpu_fetch_window_seconds_total", end - start, kind=kind)
         if fresh:
             metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
             metrics.inc(
                 "krr_tpu_fetch_window_seconds_total", end - backfill_start, kind="backfill"
+            )
+        for q_start in catchup:
+            metrics.inc(
+                "krr_tpu_fetch_window_seconds_total", end - q_start, kind="catchup"
             )
         metrics.set("krr_tpu_scan_window_seconds", end - start)
         metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
@@ -485,6 +678,8 @@ class ScanScheduler:
             window_end=end,
             objects=len(objects),
             backfilled=len(fresh),
+            failed_rows=len(failed_keys),
+            quarantined=len(self._quarantine),
         )
         self.state.last_scan_id = scan_span.trace_id
         self.logger.info(
@@ -509,8 +704,12 @@ class ScanScheduler:
             raise
         except Exception as e:
             self.state.metrics.inc("krr_tpu_scan_failures_total")
+            self.state.consecutive_scan_failures += 1
+            self.state.last_scan_error = f"{type(e).__name__}: {e}"[:300]
             self.logger.warning(f"Scan failed: {e} — serving the previous result")
             self.logger.debug_exception()
+        else:
+            self.state.consecutive_scan_failures = 0
         if self.state.slo is not None:
             self.state.slo.evaluate()
         return did_scan

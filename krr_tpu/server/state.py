@@ -122,6 +122,21 @@ class ServerState:
         #: Trace id of the last completed scan tick — the join key between
         #: /healthz, structured log lines, and /debug/trace spans.
         self.last_scan_id: Optional[str] = None
+        #: Quarantined workloads (degraded ticks): object key → unix time of
+        #: the last window actually folded for it. Their published
+        #: recommendations carry forward last-good digests; /recommendations
+        #: marks each scan with this timestamp (``stale_since``), /healthz
+        #: and ``krr_tpu_stale_workloads`` count them. Owned by the
+        #: scheduler; handlers only read.
+        self.stale_workloads: dict[str, float] = {}
+        #: Consecutive failed (aborted) scheduler ticks — 0 while healthy;
+        #: visible on /healthz and /statusz so degraded state doesn't
+        #: require grepping logs.
+        self.consecutive_scan_failures: int = 0
+        #: The most recent scan abort's error (survives recovery as a
+        #: post-mortem breadcrumb; consecutive_scan_failures == 0 says
+        #: whether it is current).
+        self.last_scan_error: Optional[str] = None
         #: The SLO engine (`krr_tpu.obs.health`): the scheduler evaluates it
         #: per tick, GET /statusz renders it, /healthz downgrades to
         #: ``degraded`` while it has firing alerts. None for states built

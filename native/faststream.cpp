@@ -566,13 +566,18 @@ long krr_stream_feed(void* handle, const char* chunk, long len) {
   return 0;
 }
 
-// End of body: returns the series count, or -2 if the stream errored or
-// never saw a "result" array (e.g. an error payload).
+// End of body: returns the series count, -2 if the stream errored or never
+// saw a "result" array (e.g. an error payload), or -3 if the body ended
+// MID-SERIES — a truncated response (a proxy or server cut the body with
+// consistent framing). Accepting the partial fold would silently lose the
+// tail's samples behind a "successful" parse; callers must fail the query
+// and refetch instead.
 long krr_stream_finish(void* handle) {
   Stream& s = *static_cast<Stream*>(handle);
   if (s.state == State::kError || s.state == State::kSeekResult) return -2;
-  // A trailing carry is fine: it can only hold a partial anchor between
-  // series (never part of an accepted sample).
+  if (s.state != State::kSeekMetric) return -3;
+  // A trailing carry is fine in kSeekMetric: it can only hold a partial
+  // anchor between series (never part of an accepted sample).
   return s.series_count;
 }
 

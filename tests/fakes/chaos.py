@@ -1,0 +1,363 @@
+"""Chaos scenario harness: archetype fleets, scripted faults, serve soaks.
+
+Three pieces, composable from tests and from ``bench.py --smoke``:
+
+* :func:`build_fleet` — a workload-archetype fleet generator. Each archetype
+  (diurnal, bursty batch, OOM-loop, high-churn, mixed QoS) gets its own
+  namespace of deployments whose per-pod series are generated
+  deterministically from one seeded RNG, so every soak (and its never-faulted
+  control twin) sees byte-identical ground truth.
+* :class:`FaultTimeline` — a scripted fault injector over the in-process
+  fakes (`tests.fakes.servers`): per-tick spans of hard-down targets,
+  per-namespace outages, probabilistic 5xx storms, injected latency,
+  truncated bodies, and frozen (stale) discovery. Applied BEFORE each
+  scheduler tick, cleared after the soak.
+* :func:`run_soak` — drives a real ``KrrServer`` (fake clock, real
+  PrometheusLoader against the fake backend over real HTTP) through N
+  scheduler ticks, sampling per tick: tick outcome and wall, quarantine
+  size, consecutive failures, SLO alerts, circuit-breaker state, and
+  whether the tick published degraded. The returned report carries the
+  final resident store for bit-exactness comparisons against a control run
+  (:func:`stores_bitexact` — the degraded path's streamed==staged-grade
+  discipline).
+
+Everything here is test infrastructure: the product ships none of it, and
+``bench.py`` imports it the same way ``bench_e2e.py`` imports the fakes.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+import yaml
+
+from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+ORIGIN = FakeBackend.SERIES_ORIGIN
+STEP = 60.0  # the fake series grid (timeframe_duration = 1 minute)
+
+
+# ------------------------------------------------------------ archetype series
+def _diurnal(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Sinusoidal day/night load: the pattern cycles twice inside the series
+    so percentiles genuinely move as the scan window grows."""
+    t = np.arange(n)
+    phase = rng.uniform(0, 2 * np.pi)
+    base = rng.uniform(0.2, 0.5)
+    cpu = base * (1.0 + 0.6 * np.sin(2 * np.pi * t / (n / 2) + phase))
+    cpu = np.clip(cpu + rng.normal(0, 0.01, n), 1e-3, None)
+    mem = 2e8 * (1.0 + 0.3 * np.sin(2 * np.pi * t / (n / 2) + phase)) + rng.uniform(0, 1e7, n)
+    return cpu, mem
+
+
+def _bursty_batch(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Idle baseline with periodic tall bursts (cron-style batch): sizing to
+    the burst vs the baseline is exactly what percentile strategies disagree
+    about."""
+    cpu = np.full(n, 0.03) + rng.normal(0, 0.005, n)
+    mem = np.full(n, 8e7) + rng.uniform(0, 5e6, n)
+    period = max(8, n // 6)
+    width = max(2, period // 8)
+    for start in range(rng.integers(0, period), n, period):
+        height = rng.uniform(1.5, 3.0)
+        cpu[start : start + width] += height
+        mem[start : start + width] += 6e8
+    return np.clip(cpu, 1e-3, None), mem
+
+
+def _oom_loop(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Memory sawtooth climbing to a ceiling and resetting (an OOM-killed
+    container in a restart loop); CPU stays low."""
+    cpu = np.clip(np.full(n, 0.05) + rng.normal(0, 0.01, n), 1e-3, None)
+    ramp = max(6, n // 8)
+    t = np.arange(n)
+    mem = 1e8 + (9e8 - 1e8) * ((t % ramp) / ramp)
+    mem = mem + rng.uniform(0, 5e6, n)
+    return cpu, mem
+
+
+def _high_churn(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Moderate lognormal noise — the archetype's character is DISCOVERY
+    churn (pods and deployments replaced mid-soak via ``on_tick``), not the
+    series shape."""
+    cpu = rng.lognormal(mean=-2.0, sigma=0.4, size=n)
+    mem = rng.uniform(1e8, 2.5e8, n)
+    return cpu, mem
+
+
+def _mixed_qos(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Alternating QoS classes: even workloads run flat and hot
+    (guaranteed), odd ones idle with rare spikes (burstable)."""
+    if i % 2 == 0:
+        cpu = np.clip(np.full(n, 0.5) + rng.normal(0, 0.01, n), 1e-3, None)
+        mem = np.full(n, 4e8) + rng.uniform(0, 1e7, n)
+    else:
+        cpu = np.clip(np.full(n, 0.04) + rng.normal(0, 0.008, n), 1e-3, None)
+        spikes = rng.random(n) < 0.03
+        cpu = cpu + np.where(spikes, rng.uniform(0.5, 1.0, n), 0.0)
+        mem = np.full(n, 9e7) + rng.uniform(0, 8e6, n)
+    return cpu, mem
+
+
+ARCHETYPES: "dict[str, Callable]" = {
+    "diurnal": _diurnal,
+    "bursty-batch": _bursty_batch,
+    "oom-loop": _oom_loop,
+    "high-churn": _high_churn,
+    "mixed-qos": _mixed_qos,
+}
+
+
+@dataclass(frozen=True)
+class ArchetypeSpec:
+    """One archetype's slice of the fleet: ``workloads`` deployments of
+    ``pods`` pods each, in their own namespace (default: the archetype
+    name) — which is what lets the fault injector target archetypes."""
+
+    kind: str
+    workloads: int = 2
+    pods: int = 2
+    namespace: Optional[str] = None
+
+
+DEFAULT_FLEET = tuple(ArchetypeSpec(kind) for kind in ARCHETYPES)
+
+
+@dataclass
+class ChaosFleet:
+    """A generated fleet plus its backing fakes, ready to serve."""
+
+    cluster: FakeCluster
+    metrics: FakeMetrics
+    backend: FakeBackend
+    #: namespace → workload names, for targeting faults and assertions.
+    namespaces: "dict[str, list[str]]"
+
+
+def build_fleet(
+    specs: "tuple[ArchetypeSpec, ...]" = DEFAULT_FLEET,
+    *,
+    samples: int = 240,
+    seed: int = 0,
+) -> ChaosFleet:
+    """Deterministic archetype fleet: same specs + seed ⇒ byte-identical
+    series, so a faulted soak and its control run share ground truth."""
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True  # window slicing: the delta-fetch contract
+    rng = np.random.default_rng(seed)
+    namespaces: "dict[str, list[str]]" = {}
+    for spec in specs:
+        generate = ARCHETYPES[spec.kind]
+        namespace = spec.namespace or spec.kind
+        for w in range(spec.workloads):
+            name = f"{spec.kind}-{w}"
+            pods = cluster.add_workload_with_pods(
+                "Deployment", name, namespace, pod_count=spec.pods
+            )
+            for pod in pods:
+                cpu, mem = generate(rng, samples, w)
+                metrics.set_series(namespace, "main", pod, cpu=cpu, memory=mem)
+            namespaces.setdefault(namespace, []).append(name)
+    return ChaosFleet(
+        cluster=cluster,
+        metrics=metrics,
+        backend=FakeBackend(cluster, metrics),
+        namespaces=namespaces,
+    )
+
+
+def write_kubeconfig(path, url: str) -> str:
+    """The single-cluster kubeconfig the serve fixtures use, pointed at a
+    running fake backend."""
+    with open(path, "w") as f:
+        yaml.dump(
+            {
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+                "clusters": [{"name": "fake", "cluster": {"server": url}}],
+                "users": [{"name": "fake", "user": {"token": "t"}}],
+            },
+            f,
+        )
+    return str(path)
+
+
+# ------------------------------------------------------------- fault injector
+@dataclass(frozen=True)
+class FaultSpec:
+    """One tick's fault regime (everything defaults to healthy)."""
+
+    down: bool = False
+    fail_namespaces: "frozenset[str]" = frozenset()
+    fail_rate: float = 0.0
+    fault_seed: int = 0
+    latency_seconds: float = 0.0
+    truncate_bodies: bool = False
+    freeze_discovery: bool = False
+
+    @property
+    def clean(self) -> bool:
+        return self == CLEAN
+
+
+CLEAN = FaultSpec()
+
+
+class FaultTimeline:
+    """Scripted faults: ``(first_tick, last_tick, FaultSpec)`` spans, first
+    match wins, everything else healthy. ``apply`` mutates the fake's knobs
+    for the coming tick — deterministic replay by construction."""
+
+    def __init__(self, spans: "list[tuple[int, int, FaultSpec]]" = ()):  # type: ignore[assignment]
+        self.spans = list(spans)
+
+    def at(self, tick: int) -> FaultSpec:
+        for first, last, spec in self.spans:
+            if first <= tick <= last:
+                return spec
+        return CLEAN
+
+    def apply(self, backend: FakeBackend, tick: int) -> FaultSpec:
+        spec = self.at(tick)
+        metrics = backend.metrics
+        metrics.down = spec.down
+        metrics.fail_namespaces = frozenset(spec.fail_namespaces)
+        metrics.fail_rate = spec.fail_rate
+        if spec.fail_rate > 0:
+            # A fresh seeded stream per storm span keeps storms reproducible
+            # regardless of how many requests earlier ticks made.
+            metrics.fault_seed = spec.fault_seed
+            metrics._fault_rng = None
+        metrics.latency_seconds = spec.latency_seconds
+        metrics.truncate_bodies = spec.truncate_bodies
+        backend.freeze_discovery(spec.freeze_discovery)
+        return spec
+
+
+# ---------------------------------------------------------------- soak driver
+@dataclass
+class TickSample:
+    """Everything the assertions need about one scheduler tick."""
+
+    tick: int
+    fault: FaultSpec
+    #: run_once result: True scanned, False skipped, None aborted.
+    ok: "Optional[bool]"
+    wall_seconds: float
+    stale_workloads: int
+    consecutive_failures: int
+    slo_firing: "list[str]"
+    #: krr_tpu_prom_breaker_state for the fake cluster (None before the
+    #: loader exists): 0 closed, 1 half-open, 2 open.
+    breaker_state: "Optional[float]"
+    #: This tick published with quarantined workloads (partial failure).
+    degraded: bool
+
+
+@dataclass
+class SoakReport:
+    ticks: "list[TickSample]"
+    store: Any
+    state: Any
+    metrics: Any
+
+    def counts(self) -> "dict[str, int]":
+        return {
+            "scanned": sum(1 for t in self.ticks if t.ok),
+            "aborted": sum(1 for t in self.ticks if t.ok is None),
+            "degraded": sum(1 for t in self.ticks if t.degraded),
+        }
+
+
+async def run_soak(
+    config,
+    backend: FakeBackend,
+    timeline: Optional[FaultTimeline] = None,
+    *,
+    ticks: int,
+    tick_seconds: float = 300.0,
+    start: float = ORIGIN + 3600.0,
+    on_tick: Optional[Callable] = None,
+) -> SoakReport:
+    """Drive a real serve composition (fake clock) through ``ticks``
+    scheduler rounds, applying the fault timeline before each. ``on_tick``
+    (sync or async, called AFTER each round with ``(server, sample)``) is
+    the hook for HTTP-level assertions and for deterministic mid-soak
+    cluster mutation (churn scenarios) — give the control run the same hook.
+    The fakes are returned to the healthy regime before the server shuts
+    down, so a shared fixture can't leak faults into the next scenario."""
+    from krr_tpu.server.app import KrrServer
+
+    timeline = timeline or FaultTimeline()
+    now = [start]
+    server = KrrServer(config, clock=lambda: now[0])
+    await server.start(run_scheduler=False)
+    samples: "list[TickSample]" = []
+    try:
+        for tick in range(ticks):
+            now[0] = start + tick * tick_seconds
+            spec = timeline.apply(backend, tick)
+            metrics = server.state.metrics
+            degraded_before = metrics.value("krr_tpu_scans_degraded_total") or 0.0
+            t0 = time.perf_counter()
+            ok = await server.scheduler.run_once()
+            wall = time.perf_counter() - t0
+            sample = TickSample(
+                tick=tick,
+                fault=spec,
+                ok=ok,
+                wall_seconds=wall,
+                stale_workloads=len(server.state.stale_workloads),
+                consecutive_failures=server.state.consecutive_scan_failures,
+                slo_firing=list(server.state.slo.firing()) if server.state.slo else [],
+                breaker_state=metrics.value("krr_tpu_prom_breaker_state", cluster="fake"),
+                degraded=(metrics.value("krr_tpu_scans_degraded_total") or 0.0) > degraded_before,
+            )
+            samples.append(sample)
+            if on_tick is not None:
+                outcome = on_tick(server, sample)
+                if inspect.isawaitable(outcome):
+                    await outcome
+    finally:
+        FaultTimeline().apply(backend, 0)  # heal the fakes for the next user
+        await server.shutdown()
+    return SoakReport(
+        ticks=samples, store=server.state.store, state=server.state, metrics=server.state.metrics
+    )
+
+
+def stores_bitexact(a, b) -> "tuple[bool, str]":
+    """(equal, detail) across keys and every digest array — the degraded
+    path's recovery discipline: after faults clear and catch-up folds, the
+    soaked store must be BIT-identical to the never-faulted control's."""
+    if a.keys != b.keys:
+        return False, f"keys differ: {len(a.keys)} vs {len(b.keys)} rows"
+    for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak"):
+        if not np.array_equal(getattr(a, attr), getattr(b, attr)):
+            return False, f"{attr} differs"
+    return True, ""
+
+
+__all__ = [
+    "ARCHETYPES",
+    "ArchetypeSpec",
+    "CLEAN",
+    "ChaosFleet",
+    "DEFAULT_FLEET",
+    "FaultSpec",
+    "FaultTimeline",
+    "ORIGIN",
+    "STEP",
+    "ServerThread",
+    "SoakReport",
+    "TickSample",
+    "build_fleet",
+    "run_soak",
+    "stores_bitexact",
+    "write_kubeconfig",
+]

@@ -129,6 +129,32 @@ class FakeMetrics:
     #: When set, range queries require `Authorization: Bearer <this>` and
     #: 401 otherwise — exercising the loader's mid-scan credential refresh.
     require_bearer: Optional[str] = None
+    # ---- scripted fault-injection knobs (the chaos harness, fakes/chaos.py,
+    # ---- flips these per soak tick; every one defaults off) --------------
+    #: Hard-down target: EVERY Prometheus endpoint (instant queries included)
+    #: answers 503 — the circuit-breaker scenario. Unlike ``fail_queries``
+    #: (range queries only), a down target can't even answer probes.
+    down: bool = False
+    #: Per-namespace outage: range queries whose namespace is in this set
+    #: (batched or per-workload) get a 500 while other namespaces succeed —
+    #: the deterministic partial-failure regime behind quarantine tests.
+    fail_namespaces: "frozenset[str]" = frozenset()
+    #: Probabilistic 5xx storm: each range query fails with this probability,
+    #: drawn from ``fault_rng`` (seed it for reproducible storms).
+    fail_rate: float = 0.0
+    fault_seed: int = 0
+    #: Injected latency before every range-query response (slow backend).
+    latency_seconds: float = 0.0
+    #: Serve the first half of each range-query body (valid HTTP framing,
+    #: truncated JSON): the parser must fail the query, never fold half a
+    #: window.
+    truncate_bodies: bool = False
+    _fault_rng: Any = None
+
+    def fault_rng(self):
+        if self._fault_rng is None:
+            self._fault_rng = np.random.default_rng(self.fault_seed)
+        return self._fault_rng
     duplicate_pods: bool = False  # emit each pod's series twice, dupe shifted +1000
     #: When set, series are anchored at SERIES_ORIGIN with the requested step
     #: and sliced to the requested [start, end] — the contract the loader's
@@ -213,6 +239,26 @@ class FakeBackend:
         self.cluster = cluster
         self.metrics = metrics
         self.pod_request_count = 0
+        #: Stale-discovery fault: while set (``freeze_discovery``), workload
+        #: and pod listings serve this snapshot instead of the live cluster,
+        #: so inventory mutations stay invisible — the apiserver cache gone
+        #: stale.
+        self.frozen_cluster: Optional[FakeCluster] = None
+
+    def freeze_discovery(self, frozen: bool) -> None:
+        """Toggle the stale-discovery fault: freeze captures a deep copy of
+        the current cluster state that listings serve until thawed."""
+        import copy
+
+        if frozen:
+            if self.frozen_cluster is None:
+                self.frozen_cluster = copy.deepcopy(self.cluster)
+        else:
+            self.frozen_cluster = None
+
+    @property
+    def _inventory(self) -> FakeCluster:
+        return self.frozen_cluster if self.frozen_cluster is not None else self.cluster
 
     # ---------------------------------------------------------- k8s handlers
     async def _list(
@@ -243,14 +289,14 @@ class FakeBackend:
 
     def _workload_handler(self, attr: str):
         async def handler(request: web.Request) -> web.Response:
-            return await self._list(getattr(self.cluster, attr), request.match_info.get("namespace"))
+            return await self._list(getattr(self._inventory, attr), request.match_info.get("namespace"))
 
         return handler
 
     async def list_pods(self, request: web.Request) -> web.Response:
         self.pod_request_count += 1
         namespace = request.match_info["namespace"]
-        pods = [p for p in self.cluster.pods if p["metadata"]["namespace"] == namespace]
+        pods = [p for p in self._inventory.pods if p["metadata"]["namespace"] == namespace]
         return await self._list(pods, request=request, selector=request.query.get("labelSelector"))
 
     async def list_services(self, request: web.Request) -> web.Response:
@@ -265,6 +311,8 @@ class FakeBackend:
 
     # --------------------------------------------------------- prom handlers
     async def query(self, request: web.Request) -> web.Response:
+        if self.metrics.down:
+            return web.json_response({"status": "error", "error": "target down"}, status=503)
         q = request.query.get("query", "")
         # `count(<batched range query>)` — the loader's series-count probe
         # for sizing sub-windows: answer with the TRUE number of series the
@@ -294,6 +342,14 @@ class FakeBackend:
     #: static timestamp base in the pre-rendered fragments).
     SERIES_ORIGIN = 1_700_000_000.0
 
+    def _range_response(self, body: bytes) -> web.Response:
+        """Assemble a range-query response, applying the truncated-body
+        fault: valid HTTP framing around the FIRST HALF of the JSON — the
+        parser must fail the query cleanly, never fold half a window."""
+        if self.metrics.truncate_bodies:
+            body = body[: max(1, len(body) // 2)]
+        return web.Response(body=body, content_type="application/json")
+
     @staticmethod
     def _step_seconds(step: str) -> float:
         if step.endswith("m"):
@@ -306,6 +362,14 @@ class FakeBackend:
         self.metrics.request_count += 1
         if len(str(request.rel_url)) > self.MAX_URL_BYTES:
             return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
+        if self.metrics.down:
+            return web.json_response({"status": "error", "error": "target down"}, status=503)
+        if self.metrics.latency_seconds > 0:
+            await asyncio.sleep(self.metrics.latency_seconds)
+        if self.metrics.fail_rate > 0 and self.metrics.fault_rng().random() < self.metrics.fail_rate:
+            return web.json_response(
+                {"status": "error", "error": "injected storm failure"}, status=500
+            )
         if self.metrics.redirect_queries:
             return web.Response(
                 status=302, headers={"Location": "https://sso.example/login"}, text="<html>login</html>"
@@ -387,6 +451,10 @@ class FakeBackend:
             def metric_dict(cont: str, pod: str) -> dict:
                 return {"pod": pod}
 
+        if namespace in self.metrics.fail_namespaces:
+            return web.json_response(
+                {"status": "error", "error": "injected namespace outage"}, status=500
+            )
         start = float(params.get("start", 0))
         step = 60.0
         if self.metrics.enforce_range:
@@ -400,9 +468,7 @@ class FakeBackend:
             t0 = self.SERIES_ORIGIN
             cache_key = (namespace, is_cpu, req_start, req_end, step_sec) if batched else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
-                return web.Response(
-                    body=self.metrics._batched_bodies[cache_key], content_type="application/json"
-                )
+                return self._range_response(self.metrics._batched_bodies[cache_key])
             fragments = []
             for ns, cont, pod in selected:
                 n = len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
@@ -418,13 +484,11 @@ class FakeBackend:
             ).encode()
             if cache_key is not None:
                 self.metrics._batched_bodies[cache_key] = body
-            return web.Response(body=body, content_type="application/json")
+            return self._range_response(body)
         if not self.metrics.duplicate_pods:
             cache_key = (namespace, is_cpu) if batched else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
-                return web.Response(
-                    body=self.metrics._batched_bodies[cache_key], content_type="application/json"
-                )
+                return self._range_response(self.metrics._batched_bodies[cache_key])
             # Fast path: assemble the body from pre-rendered values strings.
             fragments = [
                 '{"metric":%s,"values":[%s]}'
@@ -437,7 +501,7 @@ class FakeBackend:
             ).encode()
             if cache_key is not None:
                 self.metrics._batched_bodies[cache_key] = body
-            return web.Response(body=body, content_type="application/json")
+            return self._range_response(body)
         result = []
         for ns, cont, pod in selected:
             cpu, memory = self.metrics.series[(ns, cont, pod)]

@@ -74,6 +74,17 @@ def test_bench_smoke_end_to_end():
     assert "fleet_e2e_wire_mb" in secondary, secondary
     assert "fleet_e2e_put_blocked_seconds" in secondary, secondary
     assert "fleet_e2e_get_starved_seconds" in secondary, secondary
+    # The chaos soak leg ran end-to-end: degraded ticks published (no
+    # starvation), the hard-down tick aborted within its wall gate, the
+    # breaker opened, and recovery converged bit-exact with the control
+    # run (gate failures are rc 1; assert the fields so a leg-skipping
+    # refactor can't pass silently).
+    assert secondary.get("chaos_ticks", 0) >= 8, secondary
+    assert secondary.get("chaos_degraded_ticks") == 2, secondary
+    assert secondary.get("chaos_aborted_ticks") == 1, secondary
+    assert secondary.get("chaos_breaker_opens", 0) >= 1, secondary
+    assert secondary.get("chaos_recovered_bitexact") == 1.0, secondary
+    assert 0 < secondary.get("chaos_down_tick_seconds", 0) < 10.0, secondary
     # The fetch trendline gate fields are emitted unconditionally (null /
     # False when the previous round ran at a different fleet width).
     assert "fetch_vs_previous_round" in payload

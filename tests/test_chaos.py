@@ -1,0 +1,641 @@
+"""Chaos soaks: serve under scripted infrastructure failure.
+
+The acceptance matrix of the fault-isolation work, driven through the chaos
+harness (`tests.fakes.chaos`): an archetype fleet served by the real
+composition (real PrometheusLoader over real HTTP against the fakes) while a
+scripted fault timeline flips outages on and off —
+
+* partial namespace outage → degraded ticks publish the healthy remainder
+  with stale marks (no aborted-tick starvation), quarantined workloads carry
+  forward their last-good values, and after the faults clear the catch-up
+  legs converge the resident store BIT-exact with a never-faulted control
+  run;
+* hard-down target → ticks abort below the success floor, the circuit
+  breaker opens (bounding the degraded-tick wall) and half-open-recovers,
+  the scan-failure SLO burns and resolves;
+* probabilistic 5xx storms, injected latency, truncated bodies → no crash,
+  and recovery is still bit-exact;
+* frozen (stale) discovery → inventory changes stay invisible until thaw.
+
+Plus unit tests for the circuit breaker's state machine, the retry budget,
+and the capped backoff ladder.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.config import Config
+from krr_tpu.integrations.prometheus import (
+    BreakerOpenError,
+    CircuitBreaker,
+    PrometheusLoader,
+    RetryBudget,
+)
+from krr_tpu.obs.metrics import MetricsRegistry
+
+from .fakes.chaos import (
+    ORIGIN,
+    STEP,
+    ArchetypeSpec,
+    FaultSpec,
+    FaultTimeline,
+    ServerThread,
+    build_fleet,
+    run_soak,
+    stores_bitexact,
+    write_kubeconfig,
+)
+from .test_server import http_get, metric_value
+
+TICK = 300.0  # soak scan cadence (seconds of fake clock per scheduler round)
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(scope="module")
+def chaos_env(tmp_path_factory):
+    """One archetype fleet + fake backend shared by the soak scenarios that
+    do not mutate the cluster (run_soak heals all fault knobs afterwards)."""
+    fleet = build_fleet(samples=240, seed=7)
+    server = ServerThread(fleet.backend).start()
+    kubeconfig = write_kubeconfig(tmp_path_factory.mktemp("chaos") / "config", server.url)
+    yield {"fleet": fleet, "server": server, "kubeconfig": kubeconfig}
+    server.stop()
+
+
+def chaos_config(env, **overrides) -> Config:
+    other_args = {"history_duration": 1, "timeframe_duration": 1}
+    other_args.update(overrides.pop("other_args", {}))
+    defaults = dict(
+        kubeconfig=env["kubeconfig"],
+        prometheus_url=env["server"].url,
+        strategy="tdigest",
+        quiet=True,
+        server_port=0,
+        scan_interval_seconds=TICK,
+        # The soak ticks back-to-back in wall time while the scan clock
+        # jumps a full cadence: a microscopic breaker cooldown keeps the
+        # open → half-open → closed machine observable without wall sleeps,
+        # and a small retry budget keeps faulted ticks fast (ladders stop
+        # sleeping once it is spent).
+        prometheus_breaker_cooldown_seconds=0.02,
+        prometheus_retry_deadline_seconds=2.0,
+        prometheus_backoff_cap_seconds=0.25,
+        other_args=other_args,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ----------------------------------------------------- partial-failure soaks
+class TestPartialFailureQuarantine:
+    def test_namespace_outage_degrades_marks_stale_and_recovers_bitexact(self, chaos_env):
+        """THE flap-regime soak: a 3-tick outage of one namespace must not
+        starve the fleet — degraded ticks still publish (with stale marks
+        and carried-forward values for the quarantined workloads), and once
+        the fault clears, catch-up folds converge the store bit-exact with
+        a never-faulted control run."""
+        env = chaos_env
+        timeline = FaultTimeline([(2, 4, FaultSpec(fail_namespaces=frozenset({"diurnal"})))])
+        probes: dict = {}
+
+        async def sample_http(server, tick_sample):
+            if tick_sample.tick in (1, 3, 7):
+                recs = (await http_get(server.port, "/recommendations")).json()
+                health = (await http_get(server.port, "/healthz")).json()
+                statusz = (await http_get(server.port, "/statusz")).json()
+                metrics_text = (await http_get(server.port, "/metrics")).text
+                probes[tick_sample.tick] = {
+                    "recs": recs, "health": health, "statusz": statusz, "metrics": metrics_text,
+                }
+
+        # Hysteresis OFF: published values track the raw recompute, so a
+        # frozen quarantined value is carry-forward evidence, not gate
+        # behavior — and the final publish comparison below is meaningful.
+        # Breaker parked high: this scenario isolates QUARANTINE semantics,
+        # and whether the diurnal exhaustions open the breaker mid-outage
+        # depends on query interleaving (TestHardDownBreaker owns the
+        # breaker's behavior).
+        config = dict(hysteresis_enabled=False, prometheus_breaker_threshold=100)
+        report = run(
+            run_soak(
+                chaos_config(env, **config), env["fleet"].backend, timeline,
+                ticks=8, tick_seconds=TICK, on_tick=sample_http,
+            )
+        )
+        control = run(
+            run_soak(
+                chaos_config(env, **config), env["fleet"].backend, None,
+                ticks=8, tick_seconds=TICK,
+            )
+        )
+
+        # No aborted-tick starvation: every tick scanned, the faulted ones
+        # degraded (2 of 10 workloads quarantined — far above the floor).
+        assert [t.ok for t in report.ticks] == [True] * 8
+        assert [t.degraded for t in report.ticks] == [False, False, True, True, True, False, False, False]
+        assert [t.stale_workloads for t in report.ticks] == [0, 0, 2, 2, 2, 0, 0, 0]
+        assert report.counts()["aborted"] == 0
+
+        # Mid-outage HTTP surface: /healthz counts the quarantine and the
+        # tick still advanced the published window; /recommendations marks
+        # exactly the diurnal workloads stale, their values frozen at the
+        # last pre-fault publish.
+        health = probes[3]["health"]
+        assert health["status"] == "ok"
+        assert health["stale_workloads"] == 2
+        assert health["consecutive_scan_failures"] == 0
+        assert health["last_scan_unix"] == ORIGIN + 3600.0 + 3 * TICK
+        assert probes[3]["statusz"]["server"]["stale_workloads"] == 2
+        by_name_pre = {
+            s["object"]["name"]: s for s in probes[1]["recs"]["scans"]
+        }
+        stale_names = set()
+        for scan in probes[3]["recs"]["scans"]:
+            name = scan["object"]["name"]
+            if scan.get("stale_since") is not None:
+                stale_names.add(name)
+                # Carried forward: bit-identical to the pre-fault publish.
+                assert scan["recommended"] == by_name_pre[name]["recommended"]
+                # stale_since = the last grid point actually folded (the
+                # window end of tick 1).
+                assert scan["stale_since"] == ORIGIN + 3600.0 + 1 * TICK
+        assert stale_names == {"diurnal-0", "diurnal-1"}
+        # Recovery clears the marks (fresh scans OMIT the key entirely —
+        # the fleet-scale render pays nothing while healthy).
+        assert all("stale_since" not in s for s in probes[7]["recs"]["scans"])
+        # The batch-granular failure gauge fired during the outage.
+        assert 'krr_tpu_scan_failed_batches' in probes[3]["metrics"]
+        assert metric_value(probes[3]["metrics"], "krr_tpu_scan_failed_batches") >= 1
+
+        # Recovery bit-exactness: catch-up folded the union of the missed
+        # windows — the store is indistinguishable from never having missed
+        # them, and so is the published result.
+        equal, detail = stores_bitexact(report.store, control.store)
+        assert equal, detail
+        assert report.state.peek().body_json == control.state.peek().body_json
+
+        # The quarantine telemetry fired.
+        assert report.metrics.value("krr_tpu_scans_degraded_total") == 3
+        assert report.metrics.value("krr_tpu_stale_workloads") == 0
+        assert (report.metrics.value("krr_tpu_fetch_failed_rows_total") or 0) >= 6
+
+    def test_max_staleness_expires_quarantine_into_full_backfill(self, chaos_env):
+        """Carry-forward has a freshness budget: a workload quarantined past
+        --max-staleness drops its accumulated row and re-enters as FRESH —
+        a full-window backfill once its fetches heal — instead of serving
+        ever-older values as "last known good"."""
+        env = chaos_env
+        timeline = FaultTimeline([(2, 5, FaultSpec(fail_namespaces=frozenset({"oom-loop"})))])
+        config = chaos_config(
+            env,
+            hysteresis_enabled=False,
+            prometheus_breaker_threshold=100,  # isolate staleness semantics
+            max_staleness_seconds=2 * TICK,
+        )
+        report = run(run_soak(config, env["fleet"].backend, timeline, ticks=9, tick_seconds=TICK))
+        assert all(t.ok for t in report.ticks)
+        # Within budget the pair carries forward; the budget trips at tick 4
+        # ((i-1)·TICK > 2·TICK), after which the still-faulted pair cycles
+        # as failed fresh backfills until the fault clears at tick 6.
+        assert [t.stale_workloads for t in report.ticks] == [0, 0, 2, 2, 2, 2, 0, 0, 0]
+        assert (report.metrics.value("krr_tpu_quarantine_expired_total") or 0) >= 2
+        assert (report.metrics.value("krr_tpu_backfilled_objects_total") or 0) >= 2
+        # The recovered rows exist and serve fresh (unmarked) values again.
+        oom_keys = [k for k in report.store.keys if "oom-loop" in k]
+        assert len(oom_keys) == 2
+        final = report.state.peek()
+        assert final is not None
+        import json as _json
+
+        scans = _json.loads(final.body_json)["scans"]
+        assert all("stale_since" not in s for s in scans)
+
+    def test_success_floor_aborts_mostly_dead_ticks(self, chaos_env):
+        """Below --min-fetch-success-pct the tick must hard-abort: folding
+        and publishing the scraps of a mostly-dead Prometheus would be
+        worse than serving the previous result."""
+        env = chaos_env
+        # 4 of 5 namespaces out = 20% success, under the 50% floor.
+        dead = frozenset({"diurnal", "bursty-batch", "oom-loop", "high-churn"})
+        timeline = FaultTimeline([(1, 2, FaultSpec(fail_namespaces=dead))])
+        report = run(
+            run_soak(
+                chaos_config(env), env["fleet"].backend, timeline,
+                ticks=5, tick_seconds=TICK,
+            )
+        )
+        assert [t.ok for t in report.ticks] == [True, None, None, True, True]
+        # Aborted ticks quarantine nothing — the window simply refetches.
+        assert [t.stale_workloads for t in report.ticks] == [0, 0, 0, 0, 0]
+        assert [t.consecutive_failures for t in report.ticks] == [0, 1, 2, 0, 0]
+        assert report.state.last_scan_error is not None
+        assert "min-fetch-success-pct" in report.state.last_scan_error
+
+
+# ------------------------------------------------------- hard-down + breaker
+class TestHardDownBreaker:
+    def test_breaker_opens_bounds_wall_and_half_open_recovers(self, chaos_env):
+        """One Prometheus target hard-down: ticks abort below the floor, the
+        breaker opens (so degraded ticks complete within a bounded wall —
+        fail-fast, not a retry ladder per query), and once the target heals
+        a half-open probe closes it; the scan-failure SLO burns during the
+        outage and resolves after."""
+        env = chaos_env
+        timeline = FaultTimeline([(2, 5, FaultSpec(down=True))])
+        report = run(
+            run_soak(
+                chaos_config(env), env["fleet"].backend, timeline,
+                ticks=12, tick_seconds=TICK,
+            )
+        )
+        down = report.ticks[2:6]
+        recovered = report.ticks[6:]
+
+        # Outage ticks abort (0% success); recovery is immediate and clean —
+        # the first healthy tick's probe succeeds and the parked queries run
+        # behind it (no recovery wave sacrificed to probe timing).
+        assert [t.ok for t in down] == [None] * 4
+        assert [t.consecutive_failures for t in down] == [1, 2, 3, 4]
+        assert all(t.ok for t in recovered)
+        assert recovered[0].consecutive_failures == 0
+        assert recovered[-1].stale_workloads == 0
+
+        # Bounded wall: the retry budget plus breaker fail-fast keep every
+        # down tick's wall in seconds, not ladders x queries. (The budget
+        # alone allows 2s of backoff; everything past it is fail-fast.)
+        clean_wall = max(t.wall_seconds for t in report.ticks[:2])
+        for t in down:
+            assert t.wall_seconds < 8.0, (t.tick, t.wall_seconds)
+        # Fail-fast did engage: an open breaker turned queries away with
+        # zero I/O.
+        assert (report.metrics.value("krr_tpu_prom_breaker_fast_failures_total", cluster="fake") or 0) > 0
+
+        # Breaker lifecycle: opened during the outage, half-open probed,
+        # closed on recovery, and ended closed.
+        opens = report.metrics.value(
+            "krr_tpu_prom_breaker_transitions_total", cluster="fake", to="open"
+        )
+        half_opens = report.metrics.value(
+            "krr_tpu_prom_breaker_transitions_total", cluster="fake", to="half_open"
+        )
+        closes = report.metrics.value(
+            "krr_tpu_prom_breaker_transitions_total", cluster="fake", to="closed"
+        )
+        assert opens and opens >= 1
+        assert half_opens and half_opens >= 1
+        assert closes and closes >= 1
+        assert report.ticks[-1].breaker_state == 0.0
+        assert any(t.breaker_state == 2.0 for t in down)
+
+        # SLO loop: scan_failures fires during the outage, resolves after.
+        assert any("scan_failures" in t.slo_firing for t in down)
+        assert report.ticks[-1].slo_firing == []
+        # Sanity: the clean ticks were far faster than the bound we allow
+        # faulted ones (guards against the bound going vacuous).
+        assert clean_wall < 8.0
+
+
+# ----------------------------------------------- storms, latency, truncation
+class TestStormLatencyTruncation:
+    def test_mixed_regime_soak_recovers_bitexact(self, chaos_env):
+        """A scripted mixed regime — 5xx storm, injected latency, truncated
+        bodies — must never crash the scheduler, and whatever mix of
+        degraded and aborted ticks it produces, the post-recovery store
+        must still converge bit-exact with the never-faulted control."""
+        env = chaos_env
+        timeline = FaultTimeline(
+            [
+                (1, 2, FaultSpec(fail_rate=0.8, fault_seed=3)),
+                (3, 3, FaultSpec(latency_seconds=0.15)),
+                (4, 4, FaultSpec(truncate_bodies=True)),
+            ]
+        )
+        report = run(
+            run_soak(
+                chaos_config(env), env["fleet"].backend, timeline,
+                ticks=9, tick_seconds=TICK,
+            )
+        )
+        control = run(
+            run_soak(
+                chaos_config(env), env["fleet"].backend, None,
+                ticks=9, tick_seconds=TICK,
+            )
+        )
+        # The latency tick merely slows the scan; the truncation tick fails
+        # every parse (terminal, no retry storm) and aborts below the floor.
+        assert report.ticks[3].ok is True
+        assert report.ticks[4].ok is None
+        # Clean tail: everything recovered and nothing is still stale.
+        assert all(t.ok for t in report.ticks[5:])
+        assert report.ticks[-1].stale_workloads == 0
+        equal, detail = stores_bitexact(report.store, control.store)
+        assert equal, detail
+
+    def test_frozen_discovery_hides_inventory_changes_until_thaw(self, tmp_path):
+        """Stale discovery: while the apiserver serves a frozen snapshot, a
+        new deployment stays invisible; the thawed discovery picks it up
+        and backfills it."""
+        fleet = build_fleet(
+            (ArchetypeSpec("mixed-qos", workloads=2, pods=1),), samples=240, seed=3
+        )
+        server = ServerThread(fleet.backend).start()
+        try:
+            kubeconfig = write_kubeconfig(tmp_path / "config", server.url)
+            env = {"kubeconfig": kubeconfig, "server": server}
+            # Freeze spans ticks 0-2: the snapshot is taken BEFORE tick 0
+            # runs, so the mutation at the end of tick 0 stays invisible
+            # through tick 2 and surfaces at the tick-3 rediscovery.
+            timeline = FaultTimeline([(0, 2, FaultSpec(freeze_discovery=True))])
+
+            def mutate(server_obj, tick_sample):
+                if tick_sample.tick == 0:
+                    # Appears AFTER the freeze snapshot was captured.
+                    pods = fleet.cluster.add_workload_with_pods(
+                        "Deployment", "late-arrival", "mixed-qos", pod_count=1
+                    )
+                    rng = np.random.default_rng(11)
+                    for pod in pods:
+                        fleet.metrics.set_series(
+                            "mixed-qos", "main", pod,
+                            cpu=rng.uniform(0.1, 0.2, 240), memory=rng.uniform(1e8, 2e8, 240),
+                        )
+
+            report = run(
+                run_soak(
+                    chaos_config(env, discovery_interval_seconds=1.0),
+                    fleet.backend,
+                    timeline,
+                    ticks=5,
+                    tick_seconds=TICK,
+                    on_tick=mutate,
+                )
+            )
+            assert all(t.ok for t in report.ticks)
+            # Frozen ticks (1, 2) kept serving the 2-workload inventory;
+            # the thawed tick discovered and backfilled the third.
+            assert len(report.store.keys) == 3
+            assert (report.metrics.value("krr_tpu_backfilled_objects_total") or 0) >= 1
+            assert report.metrics.value("krr_tpu_fleet_objects") == 3
+        finally:
+            server.stop()
+
+    def test_churn_rotation_compacts_and_backfills(self, tmp_path):
+        """High-churn archetype: deployments replaced mid-soak — the old
+        rows compact away, the replacements backfill, and the soak stays
+        healthy throughout."""
+        fleet = build_fleet(
+            (ArchetypeSpec("high-churn", workloads=3, pods=1),), samples=240, seed=5
+        )
+        server = ServerThread(fleet.backend).start()
+        try:
+            kubeconfig = write_kubeconfig(tmp_path / "config", server.url)
+            env = {"kubeconfig": kubeconfig, "server": server}
+            rng = np.random.default_rng(13)
+
+            def rotate(server_obj, tick_sample):
+                if tick_sample.tick == 1:
+                    # Replace high-churn-0 with high-churn-3.
+                    fleet.cluster.deployments = [
+                        d for d in fleet.cluster.deployments
+                        if d["metadata"]["name"] != "high-churn-0"
+                    ]
+                    fleet.cluster.pods = [
+                        p for p in fleet.cluster.pods
+                        if not p["metadata"]["name"].startswith("high-churn-0-")
+                    ]
+                    pods = fleet.cluster.add_workload_with_pods(
+                        "Deployment", "high-churn-3", "high-churn", pod_count=1
+                    )
+                    for pod in pods:
+                        fleet.metrics.set_series(
+                            "high-churn", "main", pod,
+                            cpu=rng.uniform(0.05, 0.3, 240), memory=rng.uniform(1e8, 2e8, 240),
+                        )
+
+            report = run(
+                run_soak(
+                    chaos_config(env, discovery_interval_seconds=1.0),
+                    fleet.backend,
+                    None,
+                    ticks=4,
+                    tick_seconds=TICK,
+                    on_tick=rotate,
+                )
+            )
+            assert all(t.ok for t in report.ticks)
+            keys = set(report.store.keys)
+            assert not any("/high-churn-0/" in k for k in keys)
+            assert any("/high-churn-3/" in k for k in keys)
+            assert (report.metrics.value("krr_tpu_store_compacted_rows_total") or 0) >= 1
+        finally:
+            server.stop()
+
+
+# -------------------------------------------------- breaker/budget unit tests
+class TestCircuitBreakerUnit:
+    def make(self, **overrides):
+        now = [1000.0]
+        defaults = dict(threshold=3, cooldown=30.0, cluster="c", clock=lambda: now[0])
+        defaults.update(overrides)
+        registry = defaults.setdefault("metrics", MetricsRegistry())
+        return CircuitBreaker(defaults.pop("threshold"), defaults.pop("cooldown"), **defaults), now, registry
+
+    def test_opens_after_threshold_and_fails_fast(self):
+        async def main():
+            breaker, now, registry = self.make()
+            for _ in range(3):
+                assert await breaker.admit() is False
+                breaker.record_failure(False)
+            assert breaker.state == "open"
+            with pytest.raises(BreakerOpenError):
+                await breaker.admit()
+            assert registry.value("krr_tpu_prom_breaker_state", cluster="c") == 2.0
+            assert registry.value("krr_tpu_prom_breaker_fast_failures_total", cluster="c") == 1.0
+
+        asyncio.run(main())
+
+    def test_half_open_probe_parks_waiters_then_closes(self):
+        async def main():
+            breaker, now, registry = self.make()
+            for _ in range(3):
+                breaker.record_failure(False)
+            now[0] += 31.0  # cooldown elapsed: next admit is THE probe
+            probe = await breaker.admit()
+            assert probe is True and breaker.state == "half_open"
+            # A concurrent query PARKS on the probe instead of failing fast…
+            waiter = asyncio.ensure_future(breaker.admit())
+            await asyncio.sleep(0)
+            assert not waiter.done()
+            # …and proceeds as an ordinary query once the probe succeeds.
+            breaker.record_success(probe)
+            assert await waiter is False
+            assert breaker.state == "closed" and breaker.failures == 0
+            assert await breaker.admit() is False  # flow restored
+            assert registry.value(
+                "krr_tpu_prom_breaker_transitions_total", cluster="c", to="closed"
+            ) == 1.0
+
+        asyncio.run(main())
+
+    def test_probe_failure_reopens_and_fails_waiters(self):
+        async def main():
+            breaker, now, _ = self.make()
+            for _ in range(3):
+                breaker.record_failure(False)
+            now[0] += 31.0
+            probe = await breaker.admit()
+            waiter = asyncio.ensure_future(breaker.admit())
+            await asyncio.sleep(0)
+            breaker.record_failure(probe)
+            assert breaker.state == "open"
+            with pytest.raises(BreakerOpenError):  # the parked query fails fast
+                await waiter
+            with pytest.raises(BreakerOpenError):  # new cooldown from the probe
+                await breaker.admit()
+            now[0] += 31.0
+            assert await breaker.admit() is True  # probes again
+
+        asyncio.run(main())
+
+    def test_abandoned_probe_releases_waiters_and_reopens(self):
+        """A probe cancelled mid-ladder must not strand parked queries on a
+        future nobody settles — they fail fast, the breaker re-opens with a
+        fresh cooldown, and only after it elapses does the next query probe."""
+
+        async def main():
+            breaker, now, _ = self.make()
+            for _ in range(3):
+                breaker.record_failure(False)
+            now[0] += 31.0
+            probe = await breaker.admit()
+            assert probe is True
+            waiter = asyncio.ensure_future(breaker.admit())
+            await asyncio.sleep(0)
+            breaker.abandon_probe()
+            with pytest.raises(BreakerOpenError):
+                await waiter
+            assert breaker.state == "open"
+            with pytest.raises(BreakerOpenError):  # cooldown restarted
+                await breaker.admit()
+            now[0] += 31.0
+            assert await breaker.admit() is True  # a fresh probe slot
+
+        asyncio.run(main())
+
+    def test_success_epoch_discounts_overlapped_failures(self):
+        """A failing ladder that overlapped a sibling's SUCCESS (the epoch
+        moved between admit and failure) must not count toward opening —
+        one broken namespace's slow ladders always overlap its healthy
+        siblings' fast successes, and a live target must stay admitted."""
+        breaker, _, _ = self.make()
+        for _ in range(20):
+            epoch = breaker.success_epoch
+            breaker.record_success(False)  # a healthy sibling completes
+            breaker.record_failure(False, epoch=epoch)  # stale epoch: discounted
+        assert breaker.state == "closed" and breaker.failures == 0
+        # Without interleaved successes the same epochs count and open it.
+        for _ in range(3):
+            breaker.record_failure(False, epoch=breaker.success_epoch)
+        assert breaker.state == "open"
+
+    def test_any_http_answer_resets_consecutive_failures(self):
+        """A 4xx means the target is alive: the breaker must not open on
+        bad queries interleaved with transport blips."""
+        breaker, _, _ = self.make()
+        for _ in range(10):
+            breaker.record_failure(False)
+            breaker.record_success(False)  # e.g. a 400 on the next query
+        assert breaker.state == "closed"
+
+    def test_threshold_zero_disables(self):
+        async def main():
+            breaker, _, _ = self.make(threshold=0)
+            for _ in range(50):
+                assert await breaker.admit() is False
+                breaker.record_failure(False)
+            assert breaker.state == "closed"
+
+        asyncio.run(main())
+
+
+class TestRetryBudgetUnit:
+    def test_budget_charges_and_exhausts(self):
+        budget = RetryBudget(1.0)
+        assert budget.consume(0.4) and budget.consume(0.4)
+        assert not budget.consume(0.4)  # 1.2 > 1.0
+        assert budget.note_exhausted() and not budget.note_exhausted()
+        budget.reset()
+        assert budget.consume(0.9) and budget.note_exhausted()
+
+    def test_zero_budget_is_unlimited(self):
+        budget = RetryBudget(0.0)
+        assert all(budget.consume(10.0) for _ in range(100))
+        assert budget.spent == 0.0
+
+
+class TestBackoffCapAndBudgetLadder:
+    def test_backoff_sleeps_are_capped_and_budgeted(self, monkeypatch):
+        """Drive the real retry ladder against an always-500 endpoint with
+        a deep retry count: every backoff sleep must respect the pre-jitter
+        cap, and the ladder must stop sleeping once the scan budget is
+        spent (the failure then surfaces terminally)."""
+        from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics
+
+        metrics_fake = FakeMetrics()
+        metrics_fake.fail_queries = True
+        server = ServerThread(FakeBackend(FakeCluster(), metrics_fake)).start()
+        try:
+            config = Config(
+                prometheus_url=server.url,
+                prometheus_backoff_cap_seconds=0.05,
+                prometheus_retry_deadline_seconds=0.2,
+                prometheus_breaker_threshold=0,  # isolate the ladder
+            )
+            sleeps: list = []
+            real_sleep = asyncio.sleep
+
+            class _AsyncioProxy:
+                """asyncio with a recording sleep — swapped into the prom
+                module's globals only, so the fake server's event loop (a
+                different thread using the REAL asyncio) is untouched."""
+
+                def __getattr__(self, name):
+                    return getattr(asyncio, name)
+
+                @staticmethod
+                async def sleep(wait, *args, **kwargs):
+                    sleeps.append(wait)
+                    await real_sleep(0)
+
+            import krr_tpu.integrations.prometheus as prom_module
+
+            monkeypatch.setattr(prom_module, "asyncio", _AsyncioProxy())
+
+            async def go():
+                loader = PrometheusLoader(config)
+                loader.retries = 12
+                try:
+                    with pytest.raises(Exception):
+                        await loader._fetch_range_body("q", 0.0, 60.0, "1m")
+                finally:
+                    await loader.close()
+                return loader
+
+            loader = asyncio.run(go())
+            # Jitter tops out at 1.5x the capped base.
+            assert sleeps, "ladder never slept"
+            assert max(sleeps) <= 0.05 * 1.5 + 1e-9
+            # The budget stopped the ladder long before 11 retries.
+            assert sum(sleeps) <= 0.2
+            assert len(sleeps) < 11
+            assert loader.retry_budget.spent <= 0.2
+        finally:
+            server.stop()

@@ -365,9 +365,22 @@ class TestMachineFastPaths:
         assert fallbacks < 12  # the fast path engages for most seeds
 
     def test_fast_paths_are_fast_at_fleet_scale(self):
-        from krr_tpu.formatters.machine import PPrintFormatter, YAMLFormatter
+        import json
+
+        from krr_tpu.formatters.machine import (
+            PPrintFormatter,
+            YAMLFormatter,
+            fast_pformat,
+            fast_yaml,
+        )
 
         result = make_result(10_000)
+        # The structural property the gate exists for: the direct emitters
+        # ENGAGE on the fleet-scale result shape (a shape change that forces
+        # the library fallback is the regression this test catches — the
+        # library paths measured 4-5 s per 10k scans).
+        assert fast_yaml(json.loads(result.model_dump_json())) is not None
+        assert fast_pformat(result.model_dump()) is not None
         start = time.perf_counter()
         out = YAMLFormatter().format(result)
         yaml_seconds = time.perf_counter() - start
@@ -376,6 +389,9 @@ class TestMachineFastPaths:
         out = PPrintFormatter().format(result)
         pprint_seconds = time.perf_counter() - start
         assert out.startswith("{'resources'")
-        # ~0.6 s / ~1.1 s measured; generous bound for rig noise.
-        assert yaml_seconds < 3.0, yaml_seconds
-        assert pprint_seconds < 3.0, pprint_seconds
+        # Wall backstop only: ~0.6 s / ~1.1 s measured on an idle rig, but
+        # identical code has measured 2-4x that under ambient box load
+        # (1 CPU core), so the bound is sized to catch library-path
+        # magnitudes, not rig weather.
+        assert yaml_seconds < 8.0, yaml_seconds
+        assert pprint_seconds < 8.0, pprint_seconds

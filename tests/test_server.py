@@ -73,6 +73,11 @@ def serve_config(serve_env, **overrides) -> Config:
         strategy="tdigest",
         quiet=True,
         server_port=0,
+        # The breaker cooldown is wall-clock (monotonic) while these tests
+        # tick on a FAKE scan clock: a microscopic cooldown keeps the
+        # breaker's state machine live without stalling instant-retry tests
+        # on a 30 s wall wait (tests/test_chaos.py pins the real cadence).
+        prometheus_breaker_cooldown_seconds=0.02,
         # Most tests here prove publish/incrementality semantics that predate
         # the hysteresis gate — running them with the gate OFF pins the
         # --no-hysteresis acceptance criterion: the legacy publish behavior
