@@ -71,7 +71,12 @@ under ``secondary.obs_device_*``), BENCH_SKIP_CHAOS, BENCH_CHAOS_TICKS
 (default 8), BENCH_CHAOS_WORKLOADS (default 2 — the chaos soak leg: an
 archetype fleet through real serve ticks under a scripted fault timeline,
 gated on no crash, recovery bit-exactness vs a never-faulted control, and
-a bounded hard-down tick wall, carried under ``secondary.chaos_*``). The
+a bounded hard-down tick wall, carried under ``secondary.chaos_*``),
+BENCH_SKIP_FETCHPLAN, BENCH_FETCHPLAN_WORKLOADS (default 3 — the adaptive
+fetch-engine leg: a real-loader fetch over HTTP where the planner coalesces
+AND shards, gated on plan-counter engagement, bit-exactness vs the
+``--fetch-plan fixed`` control, and the AIMD autotuner seeing per-query
+verdicts, carried under ``secondary.fetchplan_*``). The
 e2e leg runs `bench_e2e.py` in a subprocess with BENCH_E2E_CONTAINERS
 defaulted to 10000 (fleet scale) unless already set.
 
@@ -336,6 +341,126 @@ def chaos_leg(secondary: dict, check) -> None:
         "chaos_down_tick_wall_bounded",
         down_wall < 10.0,
         f"hard-down tick took {down_wall:.2f}s (clean tick {clean_wall:.2f}s)",
+    )
+
+
+def fetchplan_leg(secondary: dict, check) -> None:
+    """Adaptive fetch-engine gates (`krr_tpu.core.fetchplan` + the
+    prometheus loader's plan/pump/limiter wiring), at toy scale with every
+    gate EXECUTED: a fleet shaped so BOTH planner transforms fire (one
+    giant namespace shards, three small ones coalesce) is fetched through
+    the real PrometheusLoader over HTTP twice — adaptive plan vs the
+    ``--fetch-plan fixed`` escape-hatch control. Three parity-style gates:
+
+    * engagement — the plan counters are non-zero (coalesced >= 1 query
+      group, sharded >= 2) so a planner wiring break can't pass silently;
+    * bit-exactness — the adaptive fleet digest arrays are BIT-identical
+      to the fixed-plan control's;
+    * autotuner — the AIMD limiter saw per-query TTFB verdicts and
+      exported its live in-flight limit gauge.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.integrations.kubernetes import KubernetesLoader
+    from krr_tpu.integrations.prometheus import PrometheusLoader
+    from krr_tpu.obs.metrics import MetricsRegistry
+    from tests.fakes.chaos import write_kubeconfig
+    from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    workloads = int(os.environ.get("BENCH_FETCHPLAN_WORKLOADS", 3))
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    rng = np.random.default_rng(31)
+
+    def add(namespace: str, name: str, pod_count: int) -> None:
+        for pod in cluster.add_workload_with_pods(
+            "Deployment", name, namespace, pod_count=pod_count
+        ):
+            metrics.set_series(
+                namespace, "main", pod,
+                cpu=rng.gamma(2.0, 0.05, 48), memory=rng.uniform(5e7, 4e8, 48),
+            )
+
+    for w in range(workloads):
+        add("big", f"bigwl-{w}", pod_count=4)
+    for ns in ("s1", "s2", "s3"):
+        add(ns, f"{ns}-app", pod_count=1)
+
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = write_kubeconfig(os.path.join(tmp, "kubeconfig"), server.url)
+
+            def config(**overrides) -> Config:
+                return Config(
+                    kubeconfig=kubeconfig,
+                    prometheus_url=server.url,
+                    quiet=True,
+                    # Tiny plan targets so the toy fleet exercises BOTH
+                    # transforms (sharding needs >= 2x this many series).
+                    fetch_plan_target_series=6,
+                    **overrides,
+                )
+
+            objects = asyncio.run(
+                KubernetesLoader(config()).list_scannable_objects(["fake"])
+            )
+
+            def gather(cfg, registry=None):
+                async def fetch():
+                    prom = PrometheusLoader(cfg, cluster="fake", metrics=registry)
+                    try:
+                        fleet = await prom.gather_fleet_digests(
+                            objects, 3600, 60, gamma=1.01, min_value=1e-7, num_buckets=128
+                        )
+                        return fleet, prom._limiter
+                    finally:
+                        await prom.close()
+
+                return asyncio.run(fetch())
+
+            registry = MetricsRegistry()
+            start = time.perf_counter()
+            adaptive, limiter = gather(config(), registry)
+            adaptive_seconds = time.perf_counter() - start
+            fixed, _ = gather(config(fetch_plan="fixed"))
+    finally:
+        server.stop()
+
+    coalesced = registry.total("krr_tpu_fetch_plan_coalesced_total")
+    sharded = registry.total("krr_tpu_fetch_plan_sharded_total")
+    bitexact = all(
+        np.array_equal(getattr(adaptive, attr), getattr(fixed, attr))
+        for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak")
+    )
+    limit_gauge = registry.value("krr_tpu_prom_inflight_limit", cluster="fake")
+    autotuned = limiter.enabled and limiter.baseline_ttfb is not None and limit_gauge
+    secondary["fetchplan_scan_seconds"] = round(adaptive_seconds, 4)
+    secondary["fetchplan_coalesced"] = coalesced
+    secondary["fetchplan_sharded"] = sharded
+    secondary["fetchplan_bitexact"] = 1.0 if bitexact else 0.0
+    secondary["fetchplan_autotune_engaged"] = 1.0 if autotuned else 0.0
+    print(
+        f"bench: fetchplan {len(objects)} workloads in {adaptive_seconds:.3f}s "
+        f"({coalesced:.0f} coalesced + {sharded:.0f} sharded groups, "
+        f"inflight limit {limit_gauge}, bit-exact vs fixed plan: {bitexact})",
+        file=sys.stderr,
+    )
+    check(
+        "fetchplan_engaged",
+        coalesced >= 1 and sharded >= 2,
+        f"plan counters coalesced={coalesced} sharded={sharded}",
+    )
+    check("fetchplan_bitexact", bitexact, "adaptive plan diverged from the fixed plan")
+    check(
+        "fetchplan_autotuner",
+        bool(autotuned),
+        f"limiter enabled={limiter.enabled} baseline={limiter.baseline_ttfb} gauge={limit_gauge}",
     )
 
 
@@ -867,6 +992,12 @@ def main() -> None:
         # bit-exactness, and the breaker-bounded hard-down tick wall — the
         # standing regression gate for the fault-isolation machinery.
         chaos_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_FETCHPLAN"):
+        # Adaptive fetch-engine gates: planner engagement (coalesce + shard
+        # counters non-zero), bit-exactness vs the fixed-plan control, and
+        # the AIMD autotuner seeing per-query verdicts.
+        fetchplan_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

@@ -203,6 +203,12 @@ def _fixture_env(n_containers: int, samples: int, shared: int = 0):
                 runner.stats["prom_wire_bytes"] = runner.metrics.total(
                     "krr_tpu_prom_wire_bytes_total"
                 )
+                # Adaptive-fetch-plan engagement for the round record: how
+                # many coalesced/sharded query groups the planner issued.
+                for kind in ("coalesced", "sharded"):
+                    runner.stats[f"fetch_plan_{kind}"] = (
+                        runner.metrics.total(f"krr_tpu_fetch_plan_{kind}_total")
+                    )
                 return elapsed, runner.stats
 
             yield make_config, one_scan
@@ -333,6 +339,17 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
         "fleet_e2e_discover_seconds": round(stats["discover_seconds"], 3),
         "fleet_e2e_fetch_seconds": round(stats["fetch_seconds"], 3),
         "fleet_e2e_compute_seconds": round(stats["compute_seconds"], 3),
+        # The ROADMAP target in one number: fetch / (discover + compute).
+        # "Fetch within ~2x of discover+compute" means this reads <= ~2.
+        "fleet_e2e_fetch_ratio": round(
+            stats["fetch_seconds"]
+            / max(stats["discover_seconds"] + stats["compute_seconds"], 1e-9),
+            3,
+        ),
+        # Adaptive-plan engagement at fleet width (the 100k single-namespace
+        # fixture shards; nothing to coalesce).
+        "fleet_e2e_plan_coalesced": stats.get("fetch_plan_coalesced", 0.0),
+        "fleet_e2e_plan_sharded": stats.get("fetch_plan_sharded", 0.0),
         # Attribution of the warm wall (round-4 verdict: every second needs
         # an owner): client CPU per phase vs the fake server's CPU. On this
         # 1-core rig the two serialize, so wall ≈ client + server + idle.
@@ -510,7 +527,8 @@ def main() -> None:
             f"bench_e2e: FULL fleet scan at {out['fleet_e2e_containers']} containers -> "
             f"{out['fleet_e2e_objects_per_sec']:.0f} objects/s warm "
             f"({out['fleet_e2e_seconds']}s: discover {out['fleet_e2e_discover_seconds']}s, "
-            f"fetch {out['fleet_e2e_fetch_seconds']}s, compute {out['fleet_e2e_compute_seconds']}s; "
+            f"fetch {out['fleet_e2e_fetch_seconds']}s (ratio {out['fleet_e2e_fetch_ratio']}), "
+            f"compute {out['fleet_e2e_compute_seconds']}s; "
             f"staged control {out['fleet_e2e_staged_seconds']}s -> x{out['fleet_e2e_vs_staged']}, "
             f"pipeline overlap {out['fleet_e2e_overlap_pct']}%, "
             f"waits put {out['fleet_e2e_put_blocked_seconds']}s / "

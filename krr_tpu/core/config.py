@@ -81,6 +81,31 @@ class Config(pd.BaseModel):
     #: re-opens it for another cooldown.
     prometheus_breaker_cooldown_seconds: float = pd.Field(30.0, gt=0)
 
+    # Adaptive fetch engine (`krr_tpu.core.fetchplan`)
+    #: Query-plan shape for batched fleet fetches: "adaptive" coalesces
+    #: small namespaces into one multi-namespace matcher query and shards
+    #: giant ones across pod-regex partitions, shaped by the previous scan's
+    #: per-query telemetry; "fixed" pins the classic one-query-per-
+    #: (namespace, resource) shape — the escape hatch and the bit-exactness
+    #: control (adaptive plans must match it exactly).
+    fetch_plan: Literal["adaptive", "fixed"] = "adaptive"
+    #: Series-count target for one planned query: a namespace expected to
+    #: return ≥ 2× this many series shards; namespaces under a quarter of
+    #: it become coalescing candidates. 0 (default) = auto: one sample-
+    #: budget's worth per query (the route's samples budget ÷ the scan's
+    #: window points), so a giant namespace shards into about the number of
+    #: whole-range queries the sub-window fan-out would have split it into
+    #: anyway — never more queries than the fixed plan.
+    fetch_plan_target_series: int = pd.Field(0, ge=0)
+    #: Most shards one giant namespace may split into.
+    fetch_plan_max_shards: int = pd.Field(16, ge=1)
+    #: AIMD-autotune the in-flight range-query limit between 1 and
+    #: --prometheus-max-connections from live queue-wait/TTFB/failure
+    #: signals (additive increase on healthy queued completions, halving on
+    #: degraded TTFB or failed ladders); false pins the fixed-width
+    #: semaphore at --prometheus-max-connections.
+    fetch_autotune: bool = True
+
     # Kubernetes settings
     kubeconfig: Optional[str] = None  # path override; default resolution in integrations
 

@@ -185,6 +185,12 @@ class ScanSession:
         #: built lazily alongside the first real loader so fake-injected
         #: sessions never import the transport stack.
         self._retry_budget = None
+        #: Adaptive fetch-plan telemetry to seed per-cluster loaders with
+        #: (`seed_fetch_plans`): the serve scheduler persists the previous
+        #: scan's per-namespace series/bytes observations beside the window
+        #: cursor and restores them here on restart, so the first tick plans
+        #: from real telemetry instead of cold routed counts.
+        self._plan_seeds: dict[str, dict] = {}
 
     def begin_scan(self) -> None:
         """Reset the per-scan fetch budgets — called by the scan owners
@@ -192,6 +198,28 @@ class ScanSession:
         start, so one scan's retry spending can't starve the next."""
         if self._retry_budget is not None:
             self._retry_budget.reset()
+
+    def seed_fetch_plans(self, seeds: Optional[dict]) -> None:
+        """Install persisted fetch-plan telemetry (cluster key → planner
+        snapshot, as returned by :meth:`fetch_plan_states`) for loaders
+        built later. Must run before the first fetch — loaders are cached,
+        and an already-built loader keeps its live telemetry."""
+        if seeds:
+            self._plan_seeds = {
+                str(k): v for k, v in seeds.items() if isinstance(v, dict)
+            }
+
+    def fetch_plan_states(self) -> dict:
+        """Snapshot every built loader's fetch-plan telemetry (cluster key →
+        planner state), for persistence beside the serve window cursor.
+        Sources without a planner (fakes, third-party backends) contribute
+        nothing."""
+        states: dict[str, dict] = {}
+        for cluster, source in self._history_sources.items():
+            planner = getattr(source, "planner", None)
+            if planner is not None and getattr(planner, "telemetry", None):
+                states[cluster or "default"] = planner.state()
+        return states
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -242,6 +270,7 @@ class ScanSession:
                         tracer=self.tracer,
                         metrics=self.metrics,
                         retry_budget=self._retry_budget,
+                        plan_seed=self._plan_seeds.get(cluster or "default"),
                     )
             except Exception as e:  # cache the failure: fail fast per cluster
                 self._history_sources[cluster] = e

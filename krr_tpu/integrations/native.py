@@ -154,9 +154,13 @@ def _load_library() -> Optional[ctypes.CDLL]:
     return _lib
 
 
-#: Series identity: the (pod, container) label pair. Either component is ""
-#: when the query's grouping omits that label.
-SeriesKey = tuple[str, str]
+#: Series identity: the (pod, container) label pair — or, on multi-namespace
+#: coalesced queries whose grouping includes the namespace label,
+#: (pod, container, namespace). Either of the first two components is ""
+#: when the query's grouping omits that label; the namespace component is
+#: present exactly when the response carried a non-empty namespace label, so
+#: single-namespace queries keep their historical 2-tuple keys.
+SeriesKey = tuple[str, ...]
 
 
 def parse_matrix_python(body: bytes) -> list[tuple[SeriesKey, np.ndarray]]:
@@ -176,6 +180,8 @@ def parse_matrix_python(body: bytes) -> list[tuple[SeriesKey, np.ndarray]]:
     for entry in result:
         metric = entry.get("metric", {})
         key = (metric.get("pod", ""), metric.get("container", ""))
+        if metric.get("namespace"):
+            key = (*key, metric["namespace"])
         values = entry.get("values") or []
         samples = np.asarray([float(v) for _, v in values], dtype=np.float64)
         # Stale markers ("NaN") / division artifacts ("+Inf") carry no usage
@@ -194,14 +200,16 @@ def _names_cap(body: bytes, series_count: int) -> int:
 
 
 def _split_keys(names_value: bytes, n: int) -> list[SeriesKey]:
-    """Decode the native names buffer: '\\n'-joined "pod\\tcontainer" records."""
+    """Decode the native names buffer: '\\n'-joined "pod\\tcontainer" records,
+    extended to "pod\\tcontainer\\tnamespace" for series carrying a namespace
+    label (multi-namespace coalesced queries) — the key arity mirrors the
+    record's."""
     if not n:
         return []
-    keys = []
-    for record in names_value.decode("utf-8", errors="replace").split("\n")[:n]:
-        pod, _, container = record.partition("\t")
-        keys.append((pod, container))
-    return keys
+    return [
+        tuple(record.split("\t"))
+        for record in names_value.decode("utf-8", errors="replace").split("\n")[:n]
+    ]
 
 
 def parse_matrix_native(body: bytes) -> Optional[list[tuple[SeriesKey, np.ndarray]]]:
@@ -354,6 +362,19 @@ class StreamIngest:
             if self._handle is None:
                 raise ValueError("stream already finished")
             if self._lib.krr_stream_feed(self._handle, chunk, len(chunk)) != 0:
+                raise ValueError("malformed Prometheus stream")
+
+    def feed_view(self, buf, n: int) -> None:
+        """Feed the first ``n`` bytes of a REUSABLE writable buffer (a pooled
+        ``bytearray``) without materializing a ``bytes`` copy per chunk — the
+        zero-hop sink path's fast lane. The native parser consumes the bytes
+        before returning (anything unconsumed is copied into its own carry),
+        so the caller may refill ``buf`` as soon as this returns."""
+        with self._op_lock:
+            if self._handle is None:
+                raise ValueError("stream already finished")
+            ptr = ctypes.cast((ctypes.c_char * n).from_buffer(buf), ctypes.c_char_p)
+            if self._lib.krr_stream_feed(self._handle, ptr, n) != 0:
                 raise ValueError("malformed Prometheus stream")
 
     def finish_parse(self) -> "StreamIngest":

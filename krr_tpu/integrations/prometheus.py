@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import datetime
 import http.client
+import queue
 import random
 import re
 import ssl
@@ -41,12 +42,13 @@ import threading
 import time
 import urllib.parse
 import urllib.request
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 import httpx
 import numpy as np
 
 from krr_tpu.core.config import Config
+from krr_tpu.core.fetchplan import AdaptiveLimiter, FetchPlanner, PlanGroup
 from krr_tpu.integrations.kubeconfig import resolve_credentials
 from krr_tpu.integrations.kubernetes import KubeApi
 from krr_tpu.integrations.service_discovery import PROMETHEUS_SELECTORS, ServiceDiscovery
@@ -320,6 +322,13 @@ class _RawTransport:
     handshake per request would dominate it.
     """
 
+    #: Observability handles, attached by the owning loader AFTER
+    #: construction (the factory's (url, headers, verify) signature is
+    #: load-bearing — bench/tests monkeypatch it): connection churn fires
+    #: ``krr_tpu_prom_connections_{opened,reused}_total``.
+    metrics: "Optional[MetricsRegistry]" = None
+    cluster: str = "default"
+
     def __init__(self, base_url: str, headers: dict[str, str], verify: Any, timeout: float = 300.0):
         parsed = urllib.parse.urlsplit(base_url)
         self._https = parsed.scheme == "https"
@@ -382,9 +391,16 @@ class _RawTransport:
         keep-alive connections record none), request-write, time-to-first-
         byte, and body-read (socket-blocked time only — sink feed time is
         the caller's ``sink`` phase). A couple of clock reads per MB chunk:
-        noise next to the recv itself."""
+        noise next to the recv itself.
+
+        A ``sink`` exposing ``acquire_buffer``/``commit`` (a `_SinkPump`)
+        takes the ZERO-COPY lane: the body reads via ``readinto`` straight
+        into the pump's pooled buffers — no per-chunk ``bytes`` allocation,
+        no memcpy out of http.client's internal buffer — and parses on the
+        pump's worker concurrently with the next ``recv``."""
         with self._lock:
             conn, fresh = (self._idle.pop(), False) if self._idle else (self._connect(), True)
+        self._count_connection(fresh)
         while True:
             fed = False  # once the sink has bytes, a transparent retry would duplicate them
             try:
@@ -409,20 +425,44 @@ class _RawTransport:
                 else:
                     data = b""
                     read_seconds = 0.0
-                    while True:
-                        t0 = time.perf_counter()
-                        chunk = response.read(1 << 20)
-                        read_seconds += time.perf_counter() - t0
-                        if not chunk:
-                            break
-                        fed = True
-                        sink(chunk)
+                    if hasattr(sink, "acquire_buffer"):
+                        # Zero-copy pump lane: readinto a pooled buffer, hand
+                        # it to the sink worker, read the next while it
+                        # parses. ``fed`` turns True at the first commit —
+                        # bytes MAY have reached the native stream, so a
+                        # transparent retry could duplicate them.
+                        while True:
+                            buf = sink.acquire_buffer()
+                            t0 = time.perf_counter()
+                            try:
+                                n = response.readinto(buf)
+                            except BaseException:
+                                # Not committed: return it to the pool, or a
+                                # keep-alive retry pumps with one fewer buffer.
+                                sink.recycle(buf)
+                                raise
+                            read_seconds += time.perf_counter() - t0
+                            if not n:
+                                sink.recycle(buf)
+                                break
+                            fed = True
+                            sink.commit(buf, n)
+                    else:
+                        while True:
+                            t0 = time.perf_counter()
+                            chunk = response.read(1 << 20)
+                            read_seconds += time.perf_counter() - t0
+                            if not chunk:
+                                break
+                            fed = True
+                            sink(chunk)
                     if meter is not None:
                         meter.add_phase("body_read", read_seconds)
             except (http.client.HTTPException, ConnectionError):
                 conn.close()
                 if not fresh and not fed:
                     conn, fresh = self._connect(), True
+                    self._count_connection(True)
                     continue
                 raise
             except BaseException:
@@ -436,6 +476,15 @@ class _RawTransport:
                 else:
                     self._idle.append(conn)
             return status, data
+
+    def _count_connection(self, fresh: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_prom_connections_opened_total"
+                if fresh
+                else "krr_tpu_prom_connections_reused_total",
+                cluster=self.cluster,
+            )
 
     def update_headers(self, headers: dict[str, str]) -> None:
         """Merge refreshed headers (e.g. a re-resolved bearer token) into the
@@ -492,6 +541,61 @@ def memory_namespace_query(namespace: str) -> str:
 NAMESPACE_QUERY_BUILDERS = {
     ResourceType.CPU: cpu_namespace_query,
     ResourceType.Memory: memory_namespace_query,
+}
+
+
+def _namespace_pattern(namespaces: "tuple[str, ...]") -> str:
+    return "|".join(re.escape(ns) for ns in namespaces)
+
+
+def cpu_namespaces_query(namespaces: "tuple[str, ...]") -> str:
+    # The coalesced (multi-namespace) shape of `cpu_namespace_query`: one
+    # request covers every workload of SEVERAL small namespaces. Grouping
+    # includes the namespace label so two same-named pods in different
+    # coalesced namespaces stay distinct series — the native parser carries
+    # the label through the series key ((pod, container, namespace)), which
+    # is what keeps the coalesced plan bit-exact vs per-namespace queries.
+    return (
+        "sum by (namespace, pod, container) (node_namespace_pod_container:container_cpu_usage_seconds_total:sum_irate"
+        f'{{namespace=~"{_namespace_pattern(namespaces)}"}})'
+    )
+
+
+def memory_namespaces_query(namespaces: "tuple[str, ...]") -> str:
+    return (
+        'sum by (namespace, pod, container) (container_memory_working_set_bytes{job="kubelet", '
+        f'metrics_path="/metrics/cadvisor", image!="", namespace=~"{_namespace_pattern(namespaces)}"}})'
+    )
+
+
+COALESCED_QUERY_BUILDERS = {
+    ResourceType.CPU: cpu_namespaces_query,
+    ResourceType.Memory: memory_namespaces_query,
+}
+
+
+def cpu_namespace_shard_query(namespace: str, pod_regex: str) -> str:
+    # One SHARD of a giant namespace: the namespace query restricted to a
+    # workload partition's pods. Shards partition the namespace's routed
+    # pods, so their union returns exactly the series the whole-namespace
+    # query's `keep` filter would have retained (unscanned/bare-pod series
+    # are excluded server-side instead of dropped client-side).
+    return (
+        "sum by (pod, container) (node_namespace_pod_container:container_cpu_usage_seconds_total:sum_irate"
+        f'{{namespace="{namespace}", pod=~"{pod_regex}"}})'
+    )
+
+
+def memory_namespace_shard_query(namespace: str, pod_regex: str) -> str:
+    return (
+        'sum by (pod, container) (container_memory_working_set_bytes{job="kubelet", '
+        f'metrics_path="/metrics/cadvisor", image!="", namespace="{namespace}", pod=~"{pod_regex}"}})'
+    )
+
+
+SHARD_QUERY_BUILDERS = {
+    ResourceType.CPU: cpu_namespace_shard_query,
+    ResourceType.Memory: memory_namespace_shard_query,
 }
 
 
@@ -615,10 +719,15 @@ class _QueryMeter:
     int/float adds suffice (worker-thread attempts hand the meter back
     before the next attempt starts)."""
 
-    __slots__ = ("attempts", "bytes", "decoded_bytes", "backoff", "phases")
+    __slots__ = ("attempts", "auth_attempts", "bytes", "decoded_bytes", "backoff", "phases")
 
     def __init__(self) -> None:
         self.attempts = 0
+        #: Attempts consumed by the free 401/403 auth-refresh retry — an
+        #: expired token, not backend distress; excluded from the AIMD
+        #: limiter's congestion verdict (still counted in `attempts` for
+        #: the span/metrics retry telemetry).
+        self.auth_attempts = 0
         self.bytes = 0
         self.decoded_bytes = 0
         self.backoff = 0.0
@@ -629,6 +738,155 @@ class _QueryMeter:
 
     def add_phase(self, phase: str, seconds: float) -> None:
         self.phases[phase] = self.phases.get(phase, 0.0) + seconds
+
+
+#: Sentinel closing a `_SinkPump`'s worker queue.
+_PUMP_CLOSE = object()
+
+
+class _SinkPump:
+    """Zero-hop sink path: a bounded byte-buffer queue between a response
+    reader and the native ingest stream, drained by ONE dedicated worker
+    thread per in-flight query.
+
+    Replaces two costs of the previous streamed routes: the httpx plane's
+    ``asyncio.to_thread(stream.feed, chunk)`` round-trip PER CHUNK (an
+    executor dispatch every MB — thousands per GB-scale body), and the raw
+    plane's serial read→feed→read loop (socket and parser each idle while
+    the other worked). With the pump, the reader never parses and the
+    parser never waits on the socket: per-query ingest approaches the
+    native sink's own rate instead of the read+parse sum.
+
+    Two feeding lanes share the same bounded queue (default 4 × 1 MB —
+    ≤ ~4 MB buffered per in-flight query, the backpressure bound):
+
+    * raw transport (worker thread): ``acquire_buffer`` → ``readinto`` →
+      ``commit`` cycles pooled bytearrays; the worker feeds them through
+      ``StreamIngest.feed_view`` with no ``bytes`` materialization at all.
+      ``acquire_buffer`` blocking on an empty free pool IS the
+      backpressure (the parser is behind; reading further would buffer
+      unboundedly).
+    * httpx plane (event loop): ``awrite`` enqueues ready ``bytes`` chunks
+      with ``put_nowait`` — NO executor hop — and parks on an asyncio event
+      only when the queue is full (sink-bound, where waiting is correct).
+
+    A sink error (malformed stream) is captured on the worker, surfaces to
+    the reader at its next pump call and again at ``close()``; the worker
+    keeps draining (discarding) so the reader can never deadlock on a full
+    queue. ``close()`` waits for the drain and re-raises; ``abort()`` stops
+    the worker without raising (failure paths). Both are idempotent; on the
+    event loop call them via ``asyncio.to_thread`` (they join the worker).
+    """
+
+    def __init__(self, stream, meter: "Optional[_QueryMeter]" = None, *,
+                 buffers: int = 4, buffer_bytes: int = 1 << 20, loop=None) -> None:
+        self._stream = stream
+        self._feed_view = getattr(stream, "feed_view", None)
+        self._meter = meter
+        self._buffers = max(2, int(buffers))
+        self._buffer_bytes = int(buffer_bytes)
+        self._filled: "queue.Queue" = queue.Queue(maxsize=self._buffers)
+        self._free: "queue.Queue" = queue.Queue()
+        self._pool_built = False
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._loop = loop
+        self._space: Optional[asyncio.Event] = asyncio.Event() if loop is not None else None
+
+    # ------------------------------------------------- raw (buffer) lane
+    def acquire_buffer(self) -> bytearray:
+        """A free pooled buffer for ``readinto`` (blocks when the sink is
+        behind — the bounded-queue backpressure)."""
+        self._raise_if_failed()
+        if not self._pool_built:
+            self._pool_built = True
+            for _ in range(self._buffers):
+                self._free.put(bytearray(self._buffer_bytes))
+        return self._free.get()
+
+    def recycle(self, buf: bytearray) -> None:
+        """Return an acquired-but-unfilled buffer (EOF race)."""
+        self._free.put(buf)
+
+    def commit(self, buf: bytearray, n: int) -> None:
+        """Queue the first ``n`` bytes of an acquired buffer for the sink."""
+        self._raise_if_failed()
+        if self._meter is not None:
+            self._meter.add_bytes(n)
+        self._ensure_worker()
+        self._filled.put((buf, n))
+
+    # ------------------------------------------------ httpx (bytes) lane
+    async def awrite(self, chunk: bytes) -> None:
+        """Enqueue one ready chunk from the event loop — ``put_nowait`` on
+        the fast path (zero executor hops), parking on the space event only
+        under sink backpressure."""
+        self._raise_if_failed()
+        if self._meter is not None:
+            self._meter.add_bytes(len(chunk))
+        self._ensure_worker()
+        while True:
+            try:
+                self._filled.put_nowait((chunk, len(chunk)))
+                return
+            except queue.Full:
+                self._space.clear()
+                await self._space.wait()
+                self._raise_if_failed()
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        """Drain, join the worker, and re-raise any sink error (success
+        path — call before ``finalize``)."""
+        self._join()
+        if self._error is not None:
+            raise self._error
+
+    def abort(self) -> None:
+        """Stop the worker without raising (failure/cancel path)."""
+        self._join()
+
+    def _join(self) -> None:
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            self._filled.put(_PUMP_CLOSE)
+            worker.join()
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise self._error
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="krr-sink-pump", daemon=True
+            )
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._filled.get()
+            if item is _PUMP_CLOSE:
+                return
+            buf, n = item
+            try:
+                if self._error is None:
+                    t0 = time.perf_counter()
+                    if isinstance(buf, bytes):
+                        self._stream.feed(buf)
+                    elif self._feed_view is not None:
+                        self._feed_view(buf, n)
+                    else:  # sinks without the zero-copy entry point
+                        self._stream.feed(bytes(memoryview(buf)[:n]))
+                    if self._meter is not None:
+                        self._meter.add_phase("sink", time.perf_counter() - t0)
+            except BaseException as e:  # captured; reader re-raises
+                self._error = e
+            finally:
+                if isinstance(buf, bytearray):
+                    self._free.put(buf)
+                if self._loop is not None:
+                    self._loop.call_soon_threadsafe(self._space.set)
 
 
 class PrometheusLoader:
@@ -643,6 +901,7 @@ class PrometheusLoader:
         tracer: NullTracer = NULL_TRACER,
         metrics: Optional[MetricsRegistry] = None,
         retry_budget: Optional[RetryBudget] = None,
+        plan_seed: Optional[dict] = None,
     ):
         self.config = config
         self.cluster = cluster
@@ -665,7 +924,31 @@ class PrometheusLoader:
         self._auth_generation = 0
         self._refresh_lock = asyncio.Lock()
         self._connect_lock = asyncio.Lock()
-        self._semaphore = asyncio.Semaphore(config.prometheus_max_connections)
+        #: Pre-encoded query-string cache (`_encoded_query`): a scan issues
+        #: the same ~plan-group-count PromQL strings for every sub-window.
+        self._encoded_queries: dict[str, str] = {}
+        self._encoded_query_bytes = 0
+        #: Per-scan shard pod-regex cache (`_group_query`): keyed by
+        #: (namespace, indices), cleared at plan time in `_fan_out`.
+        self._shard_regexes: dict[tuple, str] = {}
+        #: Concurrency gate over in-flight range queries: AIMD-autotuned
+        #: between 1 and --prometheus-max-connections when --fetch-autotune
+        #: is on (`krr_tpu.core.fetchplan.AdaptiveLimiter`), a plain
+        #: fixed-width semaphore otherwise.
+        self._limiter = AdaptiveLimiter(
+            config.prometheus_max_connections,
+            enabled=config.fetch_autotune,
+        )
+        #: Adaptive query planner (`krr_tpu.core.fetchplan.FetchPlanner`):
+        #: coalesces small namespaces, shards giant ones, from the previous
+        #: scan's telemetry (``plan_seed`` restores a persisted snapshot —
+        #: the serve scheduler keeps it beside the window cursor).
+        self.planner = FetchPlanner(
+            enabled=config.fetch_plan != "fixed",
+            target_series=config.fetch_plan_target_series,
+            max_shards=config.fetch_plan_max_shards,
+        )
+        self.planner.seed(plan_seed)
         self.retries = 3
         #: Backoff sleeps are capped (pre-jitter) so deep ladders can't
         #: balloon a scan's wall, and charged against the per-scan retry
@@ -743,6 +1026,11 @@ class PrometheusLoader:
                 )
                 await self._probe(client)
                 self._raw = self._make_raw_transport(self.url.rstrip("/"), headers, verify)
+                if self._raw is not None:
+                    # Attached after construction: the factory signature is
+                    # monkeypatched by tests/bench to force the httpx plane.
+                    self._raw.metrics = self.metrics
+                    self._raw.cluster = self.cluster or "default"
             except BaseException:
                 if client is not None:
                     await client.aclose()
@@ -771,6 +1059,13 @@ class PrometheusLoader:
     #: Prometheus and most proxies at exactly this pod-count scale, so
     #: nothing is lost).
     GET_QUERY_LIMIT = 6144
+
+    #: Byte bound on the pre-encoded query-string cache (`_encoded_query`,
+    #: raw + encoded forms combined): shard and per-workload-fallback
+    #: queries carry pod regexes that can run to hundreds of KB each and
+    #: churn to fresh strings every scan, so a count-only bound would let a
+    #: long-lived serve loader pin ~GB of dead strings.
+    ENCODED_QUERY_CACHE_BYTES = 64 << 20
 
     @staticmethod
     def _make_raw_transport(url: str, headers: dict[str, str], verify: Any) -> Optional[_RawTransport]:
@@ -802,13 +1097,40 @@ class PrometheusLoader:
             }
         return _RawTransport(url, headers, verify)
 
+    def _encoded_query(self, query: str) -> str:
+        """URL-encoded form of ``query``, computed ONCE and cached: a scan
+        re-issues the same ~plan-group-count query strings for every
+        sub-window (and every retry), and re-quoting a multi-KB PromQL
+        string per request was measurable at 100k-row fan-outs. The cache
+        is bounded by entry count AND bytes: shard and per-workload-fallback
+        queries carry pod regexes that can run to hundreds of KB each and
+        churn to new strings every scan, so a count-only bound would let a
+        long-lived serve loader retain ~GB of dead strings between clears."""
+        encoded = self._encoded_queries.get(query)
+        if encoded is None:
+            if (
+                len(self._encoded_queries) >= 4096
+                or self._encoded_query_bytes >= self.ENCODED_QUERY_CACHE_BYTES
+            ):
+                self._encoded_queries.clear()
+                self._encoded_query_bytes = 0
+            encoded = urllib.parse.quote_plus(query)
+            self._encoded_queries[query] = encoded
+            self._encoded_query_bytes += len(query) + len(encoded)
+        return encoded
+
     def _range_request_parts(self, query: str, start: float, end: float, step: str):
         """(method, path, body, headers) for a range request: GET below the
         URL-cap threshold (safe past read-only RBAC on the apiserver service
         proxy, where POST maps to the `create` verb), form-encoded POST
-        above it."""
-        encoded = urllib.parse.urlencode(
-            {"query": query, "start": start, "end": end, "step": step}
+        above it. The query string's encoding is cached per scan session
+        (`_encoded_query`); start/end/step quote per call (they vary per
+        sub-window, and exotic float reprs like ``1e+18`` need escaping)."""
+        encoded = (
+            f"query={self._encoded_query(query)}"
+            f"&start={urllib.parse.quote_plus(str(start))}"
+            f"&end={urllib.parse.quote_plus(str(end))}"
+            f"&step={urllib.parse.quote_plus(str(step))}"
         )
         if len(query) <= self.GET_QUERY_LIMIT:
             return "GET", "/api/v1/query_range?" + encoded, None, {}
@@ -839,30 +1161,31 @@ class PrometheusLoader:
         ``finish_parse`` (hand the live stream back for a native fold).
         ``meter`` counts the fed bytes for the query span/telemetry — the
         body is never materialized, so the sink is the only place its size
-        is observable."""
+        is observable.
+
+        The body rides the zero-hop `_SinkPump`: this worker thread reads
+        the socket (``readinto`` into pooled buffers) while the pump's
+        dedicated sink worker feeds the native stream concurrently — read
+        and parse overlap per query instead of alternating."""
         assert self._raw is not None
         stream = make_stream()
-        if meter is None:
-            sink = stream.feed
-        else:
-            def sink(chunk: bytes) -> None:
-                meter.add_bytes(len(chunk))
-                t0 = time.perf_counter()
-                stream.feed(chunk)
-                meter.add_phase("sink", time.perf_counter() - t0)
+        pump = _SinkPump(stream, meter=meter)
         try:
             status, err = self._raw.request_streaming(
-                *self._range_request_parts(query, start, end, step), sink=sink, meter=meter
+                *self._range_request_parts(query, start, end, step), sink=pump, meter=meter
             )
             if status >= 300:
+                pump.abort()
                 stream.abort()
                 return status, None, err
+            pump.close()  # drain; a malformed-stream feed error raises here
             t0 = time.perf_counter()
             out = finalize(stream)
             if meter is not None:
                 meter.add_phase("decode", time.perf_counter() - t0)
             return status, out, b""
         except BaseException:
+            pump.abort()
             stream.abort()
             raise
 
@@ -929,10 +1252,12 @@ class PrometheusLoader:
         they arrive via ``aiter_bytes`` — no body materialization, matching
         `_stream_attempt`'s contract ((status, ``finalize(stream)`` or None,
         error body); fresh stream per attempt, aborted on any failure).
-        ``feed`` and ``finalize`` run off the loop: both are CPU-bound at
-        fleet width (feed at MB-chunk scale, finalize up to a GB-scale
-        readout), and on the loop they would stall every concurrent fetch
-        (round-4 advisor finding)."""
+        The body rides the zero-hop `_SinkPump`: chunks enqueue with
+        ``put_nowait`` and ONE dedicated worker feeds the native stream —
+        the previous per-chunk ``asyncio.to_thread(stream.feed, chunk)``
+        paid an executor dispatch every MB (thousands per GB-scale body)
+        and serialized read against parse. ``finalize`` still runs off the
+        loop (a GB-scale readout would stall every concurrent fetch)."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
         if meter is not None:
@@ -942,26 +1267,27 @@ class PrometheusLoader:
             kwargs["extensions"] = {"trace": self._httpx_phase_trace(meter, map_body=False)}
         request = self._client.stream(method, "/api/v1/query_range", **kwargs)
         stream = make_stream()
+        pump = _SinkPump(stream, meter=meter, loop=asyncio.get_running_loop())
         try:
             async with request as response:
                 if response.status_code >= 300:
                     err = await response.aread()
+                    pump.abort()  # worker never started: no join cost
                     stream.abort()
                     return response.status_code, None, err
-                read_seconds = sink_seconds = 0.0
+                read_seconds = 0.0
                 t_wait = time.perf_counter()
                 async for chunk in response.aiter_bytes(1 << 20):
                     t_got = time.perf_counter()
                     read_seconds += t_got - t_wait
-                    if meter is not None:
-                        meter.add_bytes(len(chunk))
-                    await asyncio.to_thread(stream.feed, chunk)
+                    await pump.awrite(chunk)
                     t_wait = time.perf_counter()
-                    sink_seconds += t_wait - t_got
                 read_seconds += time.perf_counter() - t_wait  # the exhausted-iterator round
                 if meter is not None:
                     meter.add_phase("body_read", read_seconds)
-                    meter.add_phase("sink", sink_seconds)
+            # Off the loop: close/finalize join the sink worker and can block
+            # for a GB-scale drain/readout.
+            await asyncio.to_thread(pump.close)
             t0 = time.perf_counter()
             out = await asyncio.to_thread(finalize, stream)
             if meter is not None:
@@ -973,6 +1299,7 @@ class PrometheusLoader:
             # every concurrent fetch for the remainder of a GB-scale readout.
             # (A repeat cancellation mid-cleanup falls back to the GC
             # finalizer — StreamIngest.__del__ frees a still-live handle.)
+            await asyncio.to_thread(pump.abort)
             await asyncio.to_thread(stream.abort)
             raise
 
@@ -995,12 +1322,19 @@ class PrometheusLoader:
             return None
         attempt = 0
         auth_refreshed = False
+        probe = {"query": f"count({range_query})", "time": str(at_time)}
         while attempt < 2:
             generation = self._auth_generation
             try:
-                response = await self._client.get(
-                    "/api/v1/query", params={"query": f"count({range_query})", "time": at_time}
-                )
+                # Same GET/POST cut-over as the range path: shard pod-regexes
+                # and fat coalesced patterns push the probe URL past the ~8 KB
+                # request-line caps of Prometheus and most proxies — a GET
+                # there earns a 414/400 every scan and silently forfeits the
+                # window-sizing bound the probe exists to provide.
+                if len(range_query) <= self.GET_QUERY_LIMIT:
+                    response = await self._client.get("/api/v1/query", params=probe)
+                else:
+                    response = await self._client.post("/api/v1/query", data=probe)
                 if response.status_code == 200:
                     result = (response.json().get("data") or {}).get("result") or []
                     if not result:
@@ -1023,6 +1357,17 @@ class PrometheusLoader:
             "pod count only — unscanned series in the namespace may enlarge responses"
         )
         return None
+
+    def _sample_inflight(self) -> None:
+        """Publish the limiter's live in-flight count — sampled as queries
+        clear the gate AND as they release it, so the gauge decays to 0
+        between scans instead of freezing at the last acquire-time count."""
+        if self.metrics is not None:
+            self.metrics.set(
+                "krr_tpu_prom_inflight",
+                self._limiter.inflight,
+                cluster=self.cluster or "default",
+            )
 
     async def _retrying(self, attempt_fn, meter: "Optional[_QueryMeter]" = None):
         """Shared retry/auth policy around one range-request attempt.
@@ -1067,10 +1412,17 @@ class PrometheusLoader:
                     if meter is not None:
                         meter.attempts += 1
                     t_queued = time.perf_counter()
-                    async with self._semaphore:
-                        if meter is not None:
-                            meter.add_phase("queue_wait", time.perf_counter() - t_queued)
-                        status, result, detail_bytes = await attempt_fn()
+                    try:
+                        async with self._limiter:
+                            if meter is not None:
+                                meter.add_phase("queue_wait", time.perf_counter() - t_queued)
+                            self._sample_inflight()
+                            status, result, detail_bytes = await attempt_fn()
+                    finally:
+                        # Resample after release so the gauge decays to 0
+                        # between scans instead of freezing at the last
+                        # acquire-time count.
+                        self._sample_inflight()
                 except (http.client.HTTPException, httpx.TransportError, OSError) as e:
                     last_error = e
                 else:
@@ -1081,6 +1433,8 @@ class PrometheusLoader:
                     detail = detail_bytes[:200].decode("utf-8", errors="replace")
                     if status in (401, 403) and self._auth_refresh is not None and not auth_refreshed:
                         auth_refreshed = True
+                        if meter is not None:
+                            meter.auth_attempts += 1
                         await self._refresh_auth(generation)
                         last_error = PrometheusQueryError(status, detail)
                         continue  # no backoff: the failure was auth, not load
@@ -1170,6 +1524,7 @@ class PrometheusLoader:
         span = self.tracer.start_span("prom_query", route=route, points=points, query=query[:160])
         t0 = time.perf_counter()
         status = "error"
+        congestion = True
         try:
             result = await self._retrying(attempt_fn, meter=meter)
             if decode is not None:
@@ -1177,11 +1532,41 @@ class PrometheusLoader:
             status = "ok"
             return result
         except BaseException as e:
+            # Liveness, not congestion: an open breaker raised with ZERO
+            # I/O, and on a 4xx the target ANSWERED (the same distinction
+            # the breaker makes). Halving the in-flight limit on those
+            # would serialize the scan with no backend distress behind it —
+            # every 422 sample-limit rejection rides the designed
+            # halved-window retry, and a 30s outage of fast-fails would
+            # otherwise pin the limit at 1 for the recovery tick.
+            if isinstance(e, BreakerOpenError) or (
+                isinstance(e, PrometheusQueryError) and e.status < 500
+            ):
+                congestion = False
             span.set(error=f"{type(e).__name__}: {e}"[:200])
             raise
         finally:
             elapsed = time.perf_counter() - t0
             retries = max(0, meter.attempts - 1)
+            # Concurrency-autotuner feedback: one AIMD verdict per query —
+            # healthy queued completions grow the in-flight limit, degraded
+            # TTFB, a transport/5xx-failed ladder, or a retried one halves
+            # it (cooldown-limited). The free auth-refresh retry is NOT
+            # congestion (a token expired; every in-flight query takes it
+            # at once, and halving per query would serialize a healthy
+            # scan) — excluded here, still a retry in the span/metrics.
+            self._limiter.note(
+                ttfb=meter.phases.get("ttfb"),
+                queued=meter.phases.get("queue_wait", 0.0),
+                failed=(status != "ok" and congestion)
+                or retries > meter.auth_attempts,
+            )
+            if self.metrics is not None and self._limiter.enabled:
+                self.metrics.set(
+                    "krr_tpu_prom_inflight_limit",
+                    self._limiter.limit,
+                    cluster=self.cluster or "default",
+                )
             span.set(status=status, retries=retries, bytes=meter.bytes)
             if meter.decoded_bytes:
                 span.set(decoded_bytes=meter.decoded_bytes)
@@ -1211,7 +1596,7 @@ class PrometheusLoader:
                 )
 
     async def _fetch_range_body(
-        self, query: str, start: float, end: float, step: str, parse=None
+        self, query: str, start: float, end: float, step: str, parse=None, meters=None
     ) -> bytes:
         """Range query with the shared retry policy; returns the raw response
         body — or, with ``parse``, the parsed entries (the parse runs in a
@@ -1226,6 +1611,8 @@ class PrometheusLoader:
         """
         await self._ensure_connected()
         meter = _QueryMeter()
+        if meters is not None:
+            meters.append(meter)
 
         async def attempt():
             if self._raw is not None:
@@ -1242,7 +1629,8 @@ class PrometheusLoader:
         )
 
     async def _fetch_streamed_series(
-        self, query: str, start: float, end: float, step: str, make_stream, finalize
+        self, query: str, start: float, end: float, step: str, make_stream, finalize,
+        meters=None,
     ):
         """Range query whose response bytes feed a native ingest stream as
         they arrive (no body materialization); returns ``finalize(stream)``
@@ -1254,6 +1642,8 @@ class PrometheusLoader:
         stream (a partially-fed one cannot be resumed)."""
         await self._ensure_connected()
         meter = _QueryMeter()
+        if meters is not None:
+            meters.append(meter)
 
         if self._raw is not None:
             async def attempt():
@@ -1446,7 +1836,7 @@ class PrometheusLoader:
             if isinstance(r, BaseException):
                 raise r
 
-    def _buffered_fetch_entries(self, query: str, step_seconds: float, parse):
+    def _buffered_fetch_entries(self, query: str, step_seconds: float, parse, meters=None):
         """fetch_entries for the buffered route: fetch the whole window body,
         then parse it off the event loop (CPU-bound, up to ~MBs) — inside
         the query's instrumentation window, so the parse is the query's
@@ -1454,13 +1844,16 @@ class PrometheusLoader:
         step = step_string(step_seconds)
 
         async def fetch_entries(w_start: float, w_end: float) -> list:
-            return await self._fetch_range_body(query, w_start, w_end, step, parse=parse)
+            return await self._fetch_range_body(
+                query, w_start, w_end, step, parse=parse, meters=meters
+            )
 
         return fetch_entries
 
     async def _fetch_parsed_windows(
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
+        meters=None,
     ) -> "list[list]":
         """Sub-window fan-out returning per-window parse results in window
         (time) order — the raw path, whose cross-window concatenation is
@@ -1470,7 +1863,7 @@ class PrometheusLoader:
         by_index: dict[int, list] = {}
         await self._window_fan_out(
             start, end, step_seconds, expected_series,
-            self._buffered_fetch_entries(query, step_seconds, self._kept(parse, keep)),
+            self._buffered_fetch_entries(query, step_seconds, self._kept(parse, keep), meters),
             by_index.__setitem__,
             max_samples=RAW_MAX_RESPONSE_SAMPLES,  # read at call time
             points_divisor=points_divisor,
@@ -1481,7 +1874,7 @@ class PrometheusLoader:
         self, query: str, start: float, end: float, step_seconds: float, parse,
         expected_series: int, init, fold, keep: "Optional[set]" = None,
         stream_factory=None, stream_sink=None, stream_entries=None,
-        points_divisor: int = 1,
+        points_divisor: int = 1, meters=None,
     ) -> "Optional[list[tuple]]":
         """Sub-window fan-out with INCREMENTAL merging for order-independent
         folds (digest/stats — counts add, peaks max): each window's parse
@@ -1545,11 +1938,11 @@ class PrometheusLoader:
 
             async def fetch_entries(w_start: float, w_end: float):
                 return await self._fetch_streamed_series(
-                    query, w_start, w_end, step, stream_factory, finalize
+                    query, w_start, w_end, step, stream_factory, finalize, meters=meters
                 )
 
         else:
-            fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse)
+            fetch_entries = self._buffered_fetch_entries(query, step_seconds, parse, meters)
 
         if use_sink:
             # Off the loop: a window's consume is a Python routing pass plus
@@ -1615,17 +2008,20 @@ class PrometheusLoader:
     async def _query_range(
         self, query: str, start: float, end: float, step_seconds: float,
         expected_series: int = 0, keep: "Optional[set]" = None, points_divisor: int = 1,
-    ) -> "list[tuple[tuple[str, str], np.ndarray]]":
-        """Range query → parsed ((pod, container), samples) series via the
-        native matrix parser (`krr_tpu.integrations.native`, pure-Python
-        fallback); long fine-grained ranges split into sub-queries whose
-        per-series samples concatenate in time order. ``keep`` drops
-        non-routed series inside the parse stage (batched queries)."""
+        meters=None,
+    ) -> "list[tuple[SeriesKey, np.ndarray]]":
+        """Range query → parsed (key, samples) series via the native matrix
+        parser (`krr_tpu.integrations.native`, pure-Python fallback) — key is
+        (pod, container), extended to (pod, container, namespace) on
+        namespace-labeled (coalesced) responses; long fine-grained ranges
+        split into sub-queries whose per-series samples concatenate in time
+        order. ``keep`` drops non-routed series inside the parse stage
+        (batched queries)."""
         from krr_tpu.integrations.native import parse_matrix
 
         windows = await self._fetch_parsed_windows(
             query, start, end, step_seconds, parse_matrix, expected_series, keep,
-            points_divisor=points_divisor,
+            points_divisor=points_divisor, meters=meters,
         )
         if len(windows) == 1:
             return windows[0]
@@ -1639,18 +2035,23 @@ class PrometheusLoader:
     # -------------------------------------------------------- query routing
     @staticmethod
     def _series_route(
-        objects: list[K8sObjectData], indices: list[int]
-    ) -> dict[tuple[str, str], list[int]]:
+        objects: list[K8sObjectData], indices: "Iterable[int]", with_namespace: bool = False
+    ) -> dict[tuple, list[int]]:
         """(pod, container) → object indices, for routing a namespace-batched
         response's rows back to workloads. A pod can route to multiple objects
         when workload selectors overlap — each gets the series, matching what
         per-workload queries would have returned. Series whose key routes
-        nowhere (bare pods, unscanned workloads) are dropped."""
-        route: dict[tuple[str, str], list[int]] = {}
+        nowhere (bare pods, unscanned workloads) are dropped.
+        ``with_namespace`` keys by (pod, container, namespace) — the
+        coalesced multi-namespace query shape, whose grouping includes the
+        namespace label exactly so same-named pods in sibling namespaces
+        can't collide."""
+        route: dict[tuple, list[int]] = {}
         for i in indices:
             obj = objects[i]
             for pod in obj.pods:
-                targets = route.setdefault((pod, obj.container), [])
+                key = (pod, obj.container, obj.namespace) if with_namespace else (pod, obj.container)
+                targets = route.setdefault(key, [])
                 # Dedup per key: a duplicate pod name in obj.pods must not
                 # merge the series twice into the same object (the
                 # per-workload path dedups via its `seen` set — keep the two
@@ -1668,13 +2069,15 @@ class PrometheusLoader:
         return by_namespace
 
     @staticmethod
-    def _route_series(route: dict[tuple[str, str], list[int]], series, merge) -> None:
+    def _route_series(route: "dict[tuple, list[int]]", series, merge) -> None:
         """Deliver a batched response's rows to their objects via a
-        prebuilt ``_series_route`` map. First series per (pod, container)
-        wins (callers pre-filter empty series, so the defensive dedup matches
-        the per-workload "first series with samples" rule);
-        ``merge(object_index, key, *payload)`` folds one row in."""
-        seen: set[tuple[str, str]] = set()
+        prebuilt ``_series_route`` map — keys are (pod, container), or
+        (pod, container, namespace) for coalesced responses (both sides of
+        the lookup carry the same arity, built from the same group). First
+        series per key wins (callers pre-filter empty series, so the
+        defensive dedup matches the per-workload "first series with samples"
+        rule); ``merge(object_index, key, *payload)`` folds one row in."""
+        seen: set[tuple] = set()
         for key, *payload in series:
             if key in seen:
                 continue
@@ -1707,11 +2110,101 @@ class PrometheusLoader:
             error.status == 400 and "too many samples" in error.detail
         )
 
-    async def _fan_out(self, objects: list[K8sObjectData], per_workload, per_namespace) -> None:
-        """Shared fetch orchestration for both ingest forms: one batched query
-        per (namespace, resource) with automatic per-workload fallback when a
-        batched query fails (backends that reject or truncate namespace-sized
-        responses); ``--batched-fleet-queries false`` forces per-workload.
+    # ------------------------------------------------------- adaptive plan
+    def _group_query(self, resource: ResourceType, group: PlanGroup, objects) -> str:
+        """The PromQL for one plan group: the fixed per-namespace shape for
+        singles, the namespace-labeled multi-matcher for coalesced groups,
+        the pod-restricted shard shape for shards."""
+        if group.kind == "coalesced":
+            return COALESCED_QUERY_BUILDERS[resource](group.namespaces)
+        if group.kind == "sharded":
+            # The pod regex is fixed for the scan (derived purely from the
+            # group's indices over this fan-out's objects) but this method
+            # runs once per resource AND again on each halved retry — at
+            # fleet width a shard's regex is ~hundreds of KB, so memoize it.
+            # The cache clears when `_fan_out` plans (indices from an older
+            # fleet must never resolve to a stale regex).
+            key = (group.namespaces[0], group.indices)
+            pod_regex = self._shard_regexes.get(key)
+            if pod_regex is None:
+                pods = sorted({pod for i in group.indices for pod in objects[i].pods})
+                pod_regex = self._shard_regexes[key] = "|".join(
+                    re.escape(pod) for pod in pods
+                )
+            return SHARD_QUERY_BUILDERS[resource](group.namespaces[0], pod_regex)
+        return NAMESPACE_QUERY_BUILDERS[resource](group.namespaces[0])
+
+    def _group_route(self, objects, group: PlanGroup) -> dict:
+        """Series route for one plan group — namespace-keyed for coalesced
+        queries (their responses carry the namespace label in the series
+        key), classic (pod, container) otherwise."""
+        return self._series_route(
+            objects, group.indices, with_namespace=group.kind == "coalesced"
+        )
+
+    def _observe_group(self, group: PlanGroup, objects, result, resource, shard_totals) -> None:
+        """Fold one successful group fetch into the planner's telemetry:
+        per-namespace series counts (probed actuals apportioned by routed
+        share) and response bytes. Sharded groups accumulate into
+        ``shard_totals`` instead of observing directly — one shard is a
+        fraction of its namespace, and per-shard observations would
+        EWMA-decay the per-namespace total; the fan-out flushes the summed
+        shards as ONE observation per (namespace, resource) once the gather
+        settles (mirroring the non-sharded path's one observation per
+        (group, resource)), so a namespace that scales down re-observes a
+        smaller count and can leave the sharded shape."""
+        if result is None:
+            return
+        expected, meters = result
+        bytes_seen = float(sum(m.bytes for m in meters)) if meters else 0.0
+        if group.kind == "sharded":
+            totals = shard_totals.setdefault((group.namespaces[0], resource), [0.0, 0.0])
+            totals[0] += float(expected)
+            totals[1] += bytes_seen
+            return
+        routed: dict[str, int] = {ns: 0 for ns in group.namespaces}
+        for i in group.indices:
+            routed[objects[i].namespace] += len(objects[i].pods)
+        total = max(1, sum(routed.values()))
+        for ns in group.namespaces:
+            share = routed[ns] / total
+            self.planner.observe(
+                ns,
+                series=max(float(routed[ns]), float(expected) * share),
+                bytes_seen=bytes_seen * share,
+            )
+
+    async def _plan_auto_target(self, points: int) -> "Optional[float]":
+        """The budget-derived series target for this scan's plan (used when
+        ``--fetch-plan-target-series`` is 0 = auto): one planned query should
+        carry about one sample-budget's worth of series × points. Aligning
+        the plan with the window fan-out's own budget means sharding never
+        issues MORE queries than the fixed shape's sub-window split would
+        have — it converts N sub-windows × full width into ~N whole-range
+        shards — and coalescing packs small namespaces until a query is
+        budget-full."""
+        if points <= 0:
+            return None
+        from krr_tpu.integrations.native import stream_available
+
+        budget = (
+            self.config.prometheus_max_streamed_samples
+            if await asyncio.to_thread(stream_available)
+            else RAW_MAX_RESPONSE_SAMPLES
+        )
+        return budget / points
+
+    async def _fan_out(
+        self, objects: list[K8sObjectData], per_workload, per_group, points: int = 0
+    ) -> None:
+        """Shared fetch orchestration for both ingest forms: batched queries
+        shaped by the adaptive fetch plan (`krr_tpu.core.fetchplan`) — one
+        query per plan group, where a group is a whole namespace (the fixed
+        shape), several coalesced small namespaces, or one shard of a giant
+        namespace — with automatic per-workload fallback when a batched
+        query fails (backends that reject or truncate namespace-sized
+        responses); ``--batched-fleet-queries false`` forces per-workload
+        and ``--fetch-plan fixed`` pins one-group-per-namespace.
 
         A 4xx that can mean the server's sample limit (422/400/413) earns ONE
         batched retry with HALVED windows first: the window sizing trusts a
@@ -1719,40 +2212,114 @@ class PrometheusLoader:
         away mid-window escape it — with the streamed sample budget sitting
         ~1.25x under Prometheus's default --query.max-samples, a >25%
         undercount would otherwise trip the limit and push a fleet-wide
-        namespace onto the slow per-workload road."""
+        namespace onto the slow per-workload road.
 
-        async def one_namespace(namespace: str, indices: list[int], resource: ResourceType) -> None:
+        Successful group fetches feed the planner's telemetry (series
+        counts, response bytes), which shapes the NEXT scan's plan; the
+        serve scheduler persists it beside the window cursor."""
+
+        #: (namespace, resource) → [series, bytes] summed across that
+        #: namespace's successful shards this fan-out; flushed as one
+        #: planner observation per key after the gather.
+        shard_totals: dict[tuple, list[float]] = {}
+
+        async def one_group(group: PlanGroup, resource: ResourceType) -> None:
+            if self.metrics is not None and group.kind != "single":
+                # Counted at ISSUE time, once per (group, resource) — the
+                # decompose/fallback ladder re-enters with "single" groups,
+                # which never count.
+                self.metrics.inc(
+                    f"krr_tpu_fetch_plan_{group.kind}_total",
+                    cluster=self.cluster or "default",
+                )
             try:
-                await per_namespace(namespace, indices, resource)
-                return
+                result = await per_group(group, resource)
             except PrometheusQueryError as e:
                 error: Exception = e
                 if self._halved_retry_worthwhile(e):
                     self.logger.warning(
-                        f"Batched {resource} query for namespace {namespace} rejected "
+                        f"Batched {resource} query for {group.label} rejected "
                         f"({e}); retrying once with halved windows"
                     )
                     try:
-                        await per_namespace(namespace, indices, resource, points_divisor=2)
-                        return
+                        result = await per_group(group, resource, points_divisor=2)
                     except Exception as retry_error:
                         error = retry_error
+                    else:
+                        self._observe_group(group, objects, result, resource, shard_totals)
+                        return
             except Exception as e:
                 error = e
+            else:
+                self._observe_group(group, objects, result, resource, shard_totals)
+                return
+            if group.kind == "coalesced":
+                # Decompose to per-namespace singles first: one broken member
+                # must degrade like the fixed plan would — its own namespace
+                # only — not drag every coalesced sibling onto the
+                # per-workload road (a coalesced group can span dozens of
+                # namespaces, and the planner will rebuild the same group
+                # next scan). Singles that fail fall through to per-workload
+                # below, exactly the fixed plan's ladder.
+                self.logger.warning(
+                    f"Coalesced {resource} query failed for {group.label}: {error} — "
+                    f"decomposing into {len(group.namespaces)} per-namespace queries"
+                )
+                await asyncio.gather(
+                    *[
+                        one_group(
+                            PlanGroup(
+                                "single",
+                                (ns,),
+                                tuple(
+                                    i for i in group.indices
+                                    if objects[i].namespace == ns
+                                ),
+                            ),
+                            resource,
+                        )
+                        for ns in group.namespaces
+                    ]
+                )
+                return
+            if (
+                group.kind == "sharded"
+                and isinstance(error, PrometheusQueryError)
+                and error.status < 500
+                and not self._halved_retry_worthwhile(error)
+            ):
+                # The target ANSWERED no to the shard shape itself (e.g.
+                # 403: the shard's pod-regex forces POST, which read-only
+                # RBAC on the apiserver service proxy rejects). Re-planning
+                # the same shards next scan would repeat this rejection and
+                # the fallback storm every tick — pin the namespace to the
+                # fixed single shape. 422/413 stay shardable: those mean
+                # TOO BIG, which finer shapes fix, not coarser.
+                self.planner.forbid_shard(group.namespaces[0])
+                self.logger.warning(
+                    f"Sharded {resource} query for {group.label} rejected "
+                    f"non-transiently ({error}); pinning namespace "
+                    f"{group.namespaces[0]} to the fixed single-query shape"
+                )
             self.logger.warning(
-                f"Batched {resource} query failed for namespace {namespace}: {error} — "
-                f"falling back to per-workload queries for {len(indices)} objects"
+                f"Batched {resource} query failed for {group.label}: {error} — "
+                f"falling back to per-workload queries for {len(group.indices)} objects"
             )
-            await asyncio.gather(*[per_workload(i, objects[i], resource) for i in indices])
+            await asyncio.gather(
+                *[per_workload(i, objects[i], resource) for i in group.indices]
+            )
 
         if self.config.batched_fleet_queries:
-            await asyncio.gather(
-                *[
-                    one_namespace(namespace, indices, resource)
-                    for namespace, indices in self._by_namespace(objects).items()
-                    for resource in ResourceType
-                ]
+            plan = self.planner.plan(
+                self._by_namespace(objects), [len(obj.pods) for obj in objects],
+                auto_target=await self._plan_auto_target(points),
             )
+            self._shard_regexes.clear()  # new plan: indices re-key to THIS fleet
+            await asyncio.gather(
+                *[one_group(group, resource) for group in plan for resource in ResourceType]
+            )
+            for (namespace, _resource), (series, nbytes) in shard_totals.items():
+                self.planner.observe(namespace, series=series, bytes_seen=nbytes)
         else:
             await asyncio.gather(
                 *[
@@ -1842,19 +2409,25 @@ class PrometheusLoader:
                 return
             histories[resource][i] = history
 
-        async def per_namespace(
-            namespace: str, indices: list[int], resource: ResourceType, points_divisor: int = 1
-        ) -> None:
-            query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
-            route = self._series_route(objects, indices)
+        async def per_group(
+            group: PlanGroup, resource: ResourceType, points_divisor: int = 1
+        ):
+            query = self._group_query(resource, group, objects)
+            route = self._group_route(objects, group)
+            # Probed for every kind, shards included: a shard's pod regex
+            # also matches the pods' UNSCANNED sidecar containers, so the
+            # routed count alone undercounts and would oversize windows
+            # against the sample budget (422 → halved retry → per-workload
+            # fallback on every scan).
             expected = await self._expected_series(query, route, end)
+            meters: list = []
             if resource in stats_resources:
                 series: list = [
                     (key, np.asarray([peak], dtype=np.float64))
                     for key, total, peak in await self._query_range_stats(
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route),
-                        points_divisor=points_divisor,
+                        points_divisor=points_divisor, meters=meters,
                     )
                     if total > 0
                 ]
@@ -1864,7 +2437,7 @@ class PrometheusLoader:
                     for key, samples in await self._query_range(
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route),
-                        points_divisor=points_divisor,
+                        points_divisor=points_divisor, meters=meters,
                     )
                     if samples.size
                 ]
@@ -1873,8 +2446,12 @@ class PrometheusLoader:
                 series,
                 lambda i, key, samples: histories[resource][i].__setitem__(key[0], samples),
             )
+            return expected, meters
 
-        await self._fan_out(objects, per_workload, per_namespace)
+        await self._fan_out(
+            objects, per_workload, per_group,
+            points=int((end - start) // effective_step_seconds(step_seconds)) + 1,
+        )
         return histories
 
     async def _query_range_digest(
@@ -1890,7 +2467,8 @@ class PrometheusLoader:
         keep: "Optional[set]" = None,
         sink=None,
         points_divisor: int = 1,
-    ) -> "Optional[list[tuple[tuple[str, str], np.ndarray, float, float]]]":
+        meters=None,
+    ) -> "Optional[list[tuple[tuple, np.ndarray, float, float]]]":
         """Range query whose response folds straight into per-series digests
         (fused native parse+digest, `krr_tpu.integrations.native`) — raw
         sample arrays are never materialized. Split sub-windows merge exactly
@@ -1927,13 +2505,14 @@ class PrometheusLoader:
             stream_sink=sink,
             stream_entries=matrix_entries,  # sink-less callers get entries back
             points_divisor=points_divisor,
+            meters=meters,
         )
 
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float,
         expected_series: int = 0, keep: "Optional[set]" = None, sink=None,
-        points_divisor: int = 1,
-    ) -> "Optional[list[tuple[tuple[str, str], float, float]]]":
+        points_divisor: int = 1, meters=None,
+    ) -> "Optional[list[tuple[tuple, float, float]]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
         sub-windows merge exactly (counts add, peaks max). ``sink`` as in
@@ -1951,6 +2530,7 @@ class PrometheusLoader:
             stream_factory=partial(open_stream, 0.0, 0.0, 0, reserve_series=expected_series),
             stream_sink=sink,
             points_divisor=points_divisor,
+            meters=meters,
         )
 
     async def gather_fleet_digests(
@@ -1978,12 +2558,12 @@ class PrometheusLoader:
 
         async def fetch_cpu(
             query: str, expected_series: int, keep: "Optional[set]" = None,
-            sink=None, points_divisor: int = 1,
-        ) -> "Optional[list[tuple[tuple[str, str], np.ndarray, float, float]]]":
+            sink=None, points_divisor: int = 1, meters=None,
+        ) -> "Optional[list[tuple[tuple, np.ndarray, float, float]]]":
             return await self._query_range_digest(
                 query, start, end, step_seconds, gamma, min_value, num_buckets,
                 expected_series=expected_series, keep=keep, sink=sink,
-                points_divisor=points_divisor,
+                points_divisor=points_divisor, meters=meters,
             )
 
         async def per_workload(i: int, obj: K8sObjectData, resource: ResourceType) -> None:
@@ -2038,31 +2618,37 @@ class PrometheusLoader:
                 self.logger.warning(f"Query failed for {obj} {resource}: {e}")
                 return
 
-        async def per_namespace(
-            namespace: str, indices: list[int], resource: ResourceType, points_divisor: int = 1
-        ) -> None:
-            query = NAMESPACE_QUERY_BUILDERS[resource](namespace)
-            route = self._series_route(objects, indices)
+        async def per_group(
+            group: PlanGroup, resource: ResourceType, points_divisor: int = 1
+        ):
+            query = self._group_query(resource, group, objects)
+            route = self._group_route(objects, group)
+            # Probed for every kind, shards included: a shard's pod regex
+            # also matches the pods' UNSCANNED sidecar containers, so the
+            # routed count alone undercounts and would oversize windows
+            # against the sample budget (422 → halved retry → per-workload
+            # fallback on every scan).
             expected = await self._expected_series(query, route, end)
             sink = self._FleetFoldSink(fleet, route, resource)
+            meters: list = []
             try:
                 if resource is ResourceType.CPU:
                     fetched = await fetch_cpu(
                         query, expected, keep=set(route), sink=sink,
-                        points_divisor=points_divisor,
+                        points_divisor=points_divisor, meters=meters,
                     )
                     if fetched is None:  # streamed: folded straight into fleet rows
-                        return
+                        return expected, meters
                     series: list = [row for row in fetched if row[2] > 0]
                     merge = fleet.merge_cpu_row
                 else:
                     fetched = await self._query_range_stats(
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route), sink=sink,
-                        points_divisor=points_divisor,
+                        points_divisor=points_divisor, meters=meters,
                     )
                     if fetched is None:
-                        return
+                        return expected, meters
                     series = [row for row in fetched if row[1] > 0]
                     merge = fleet.merge_mem_row
             except BaseException:
@@ -2071,13 +2657,17 @@ class PrometheusLoader:
                 # or per-workload fallback starts from zero — anything else
                 # double-counts every sample the failed attempt delivered.
                 if resource is ResourceType.CPU:
-                    fleet.clear_cpu_rows(indices)
+                    fleet.clear_cpu_rows(group.indices)
                 else:
-                    fleet.clear_mem_rows(indices)
+                    fleet.clear_mem_rows(group.indices)
                 raise
             self._route_series(route, series, lambda i, key, *payload: merge(i, *payload))
+            return expected, meters
 
-        await self._fan_out(objects, per_workload, per_namespace)
+        await self._fan_out(
+            objects, per_workload, per_group,
+            points=int((end - start) // effective_step_seconds(step_seconds)) + 1,
+        )
         return fleet
 
     async def close(self) -> None:

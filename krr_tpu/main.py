@@ -240,6 +240,50 @@ def _common_options() -> list[click.Option]:
                 "re-opens for another cooldown)."
             ),
         ),
+        PanelOption(
+            ["--fetch-plan"],
+            type=click.Choice(["adaptive", "fixed"]),
+            default="adaptive",
+            show_default=True,
+            help=(
+                "Query-plan shape for batched fleet fetches: 'adaptive' "
+                "coalesces small namespaces into one multi-namespace query and "
+                "shards giant ones by pod regex, from the previous scan's "
+                "telemetry; 'fixed' pins one query per (namespace, resource) — "
+                "the escape hatch (results are bit-exact either way)."
+            ),
+        ),
+        PanelOption(
+            ["--fetch-plan-target-series", "fetch_plan_target_series"],
+            type=int,
+            default=0,
+            show_default=True,
+            help=(
+                "Series-count target for one planned query: namespaces expected "
+                "to return at least twice this shard, namespaces under a quarter "
+                "of it coalesce. 0 = auto (one sample-budget's worth of series "
+                "per query, derived from the route's samples budget and the "
+                "scan's window points)."
+            ),
+        ),
+        PanelOption(
+            ["--fetch-plan-max-shards", "fetch_plan_max_shards"],
+            type=int,
+            default=16,
+            show_default=True,
+            help="Most shards one giant namespace may split into under the adaptive plan.",
+        ),
+        PanelOption(
+            ["--fetch-autotune"],
+            type=bool,
+            default=True,
+            show_default=True,
+            help=(
+                "AIMD-autotune the in-flight Prometheus query limit between 1 "
+                "and --prometheus-max-connections from live queue-wait/TTFB/"
+                "failure signals; false pins the fixed-width semaphore."
+            ),
+        ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
         PanelOption(
             ["--batched-fleet-queries"],

@@ -80,6 +80,14 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_prom_breaker_state", "gauge", "Per-target Prometheus circuit-breaker state: 0 closed, 1 half-open (probe in flight), 2 open (failing fast)."),
     ("krr_tpu_prom_breaker_transitions_total", "counter", "Prometheus circuit-breaker state transitions by target and destination state (open|half_open|closed)."),
     ("krr_tpu_prom_breaker_fast_failures_total", "counter", "Range queries failed fast (zero I/O) by an open Prometheus circuit breaker."),
+    # Adaptive fetch engine (`krr_tpu.core.fetchplan`): planner + autotuner
+    # decisions, and the raw transport's connection churn.
+    ("krr_tpu_prom_inflight", "gauge", "In-flight Prometheus range queries per target, sampled as queries clear the concurrency gate."),
+    ("krr_tpu_prom_inflight_limit", "gauge", "Live AIMD in-flight query limit per target (--fetch-autotune), floating between 1 and --prometheus-max-connections."),
+    ("krr_tpu_prom_connections_opened_total", "counter", "Fresh TCP/TLS connections opened by the raw Prometheus transport (pool misses and keep-alive replacements)."),
+    ("krr_tpu_prom_connections_reused_total", "counter", "Keep-alive connections reused from the raw Prometheus transport's idle pool."),
+    ("krr_tpu_fetch_plan_coalesced_total", "counter", "Coalesced (multi-namespace) batched queries issued by adaptive fetch plans, per cluster (one per plan group per resource, counted at issue time)."),
+    ("krr_tpu_fetch_plan_sharded_total", "counter", "Shard queries issued by adaptive fetch plans over giant namespaces, per cluster (one per shard group per resource, counted at issue time)."),
     ("krr_tpu_prom_wire_bytes_total", "counter", "Response body bytes read off the Prometheus transport by data plane (buffered|streamed)."),
     ("krr_tpu_prom_decoded_bytes_total", "counter", "Bytes of decoded sample arrays produced by buffered-route parses (streamed ingest never materializes decoded arrays; compare against wire bytes for JSON overhead)."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),

@@ -135,6 +135,11 @@ class ScanScheduler:
             saved = self.state.store.extra_meta.get("serve_quarantine")
             if saved:
                 self._quarantine = {str(k): float(v) for k, v in saved.items()}
+        # Adaptive fetch-plan telemetry rides the same atomic save: a restart
+        # seeds the per-cluster planners with the previous scan's observed
+        # series/bytes so the first tick's query shapes match the last one's
+        # instead of re-deriving from cold routed counts.
+        session.seed_fetch_plans(self.state.store.extra_meta.get("serve_fetch_plan"))
         self._publish_stale_state()
         # The hysteresis gate on the publish path (`krr_tpu.history.policy`).
         # A resumed journal re-seeds the trailing published baselines, so a
@@ -208,6 +213,13 @@ class ScanScheduler:
             self.state.store.extra_meta["serve_quarantine"] = dict(self._quarantine)
         else:
             self.state.store.extra_meta.pop("serve_quarantine", None)
+        # Planner telemetry persists beside the cursor so the NEXT process's
+        # first scan plans from this one's observations.
+        plan_states = self.session.fetch_plan_states()
+        if plan_states:
+            self.state.store.extra_meta["serve_fetch_plan"] = plan_states
+        else:
+            self.state.store.extra_meta.pop("serve_fetch_plan", None)
         with DigestStore.locked(self.state_path):
             self.state.store.save(self.state_path)
 

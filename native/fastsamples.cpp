@@ -75,10 +75,14 @@ const char* find_label_value(Cursor c, const char* limit, const char* quoted_key
 // Walk every series in `body`, invoking the sink once per series and once per
 // sample. Sink contract:
 //   bool begin_series(long series_index, const char* pod, long pod_len,
-//                     const char* container, long container_len)
+//                     const char* container, long container_len,
+//                     const char* ns, long ns_len)
 //       -> false aborts with -1 (capacity exhausted)
 //   void sample(long series_index, double value)
 // Returns the number of series parsed, or -1 (capacity) / -2 (malformed).
+// The namespace label is present only on multi-namespace (coalesced) queries
+// whose grouping includes it; single-namespace queries emit pod/container
+// only, so their series keys are byte-identical to the historical format.
 template <typename Sink>
 long scan_matrix(const char* body, long body_len, Sink& sink) {
     Cursor c{body, body + body_len};
@@ -109,12 +113,14 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
         }
         if (!values_key_at) break;
 
-        long pod_len = 0, container_len = 0;
+        long pod_len = 0, container_len = 0, ns_len = 0;
         const char* pod = find_label_value(c, values_key_at, "\"pod\"", &pod_len);
         const char* container =
             find_label_value(c, values_key_at, "\"container\"", &container_len);
+        const char* ns = find_label_value(c, values_key_at, "\"namespace\"", &ns_len);
 
-        if (!sink.begin_series(num_series, pod, pod_len, container, container_len)) return -1;
+        if (!sink.begin_series(num_series, pod, pod_len, container, container_len, ns, ns_len))
+            return -1;
 
         // Samples: sequence of [ts, "value"] pairs until the closing ']]'.
         c.p = values_key_at;
@@ -152,16 +158,20 @@ long scan_matrix(const char* body, long body_len, Sink& sink) {
     return num_series;
 }
 
-// Shared names-buffer emission: one "pod\tcontainer" record per series,
-// '\n'-joined ('\t' cannot appear inside either label — k8s names are
-// DNS-1123). Either label may be empty when the query's grouping omits it.
+// Shared names-buffer emission: one "pod\tcontainer" record per series —
+// extended to "pod\tcontainer\tnamespace" when the namespace label is present
+// (multi-namespace coalesced queries group by it) — '\n'-joined ('\t' cannot
+// appear inside any label: k8s names are DNS-1123). pod/container may be
+// empty when the query's grouping omits them; the namespace field is emitted
+// only when non-empty so single-namespace records stay byte-identical.
 struct NameWriter {
     char* names;
     long names_cap;
     long names_used = 0;
 
-    bool write(const char* pod, long pod_len, const char* container, long container_len) {
-        if (names_used + pod_len + container_len + 2 > names_cap) return false;
+    bool write(const char* pod, long pod_len, const char* container, long container_len,
+               const char* ns, long ns_len) {
+        if (names_used + pod_len + container_len + ns_len + 3 > names_cap) return false;
         if (pod_len > 0) {  // absent label: pod may be nullptr
             std::memcpy(names + names_used, pod, static_cast<size_t>(pod_len));
             names_used += pod_len;
@@ -170,6 +180,11 @@ struct NameWriter {
         if (container_len > 0) {
             std::memcpy(names + names_used, container, static_cast<size_t>(container_len));
             names_used += container_len;
+        }
+        if (ns_len > 0) {
+            names[names_used++] = '\t';
+            std::memcpy(names + names_used, ns, static_cast<size_t>(ns_len));
+            names_used += ns_len;
         }
         names[names_used++] = '\n';
         return true;
@@ -211,10 +226,11 @@ long krr_parse_matrix(const char* body, long body_len,
         NameWriter namew;
 
         bool begin_series(long i, const char* pod, long pod_len,
-                          const char* container, long container_len) {
+                          const char* container, long container_len,
+                          const char* ns, long ns_len) {
             if (i >= series_cap) return false;
             series_lens[i] = 0;
-            return namew.write(pod, pod_len, container, container_len);
+            return namew.write(pod, pod_len, container, container_len, ns, ns_len);
         }
         bool sample(long i, double v) {
             if (values_used >= values_cap) return false;
@@ -252,11 +268,12 @@ long krr_parse_matrix_digest(const char* body, long body_len,
         NameWriter namew;
 
         bool begin_series(long i, const char* pod, long pod_len,
-                          const char* container, long container_len) {
+                          const char* container, long container_len,
+                          const char* ns, long ns_len) {
             if (i >= series_cap) return false;
             totals[i] = 0.0;
             peaks[i] = -HUGE_VAL;
-            return namew.write(pod, pod_len, container, container_len);
+            return namew.write(pod, pod_len, container, container_len, ns, ns_len);
         }
         bool sample(long i, double v) {
             // Same bucketize as ops/digest.py: values <= min_value -> bucket 0.
@@ -292,11 +309,12 @@ long krr_parse_matrix_stats(const char* body, long body_len,
         NameWriter namew;
 
         bool begin_series(long i, const char* pod, long pod_len,
-                          const char* container, long container_len) {
+                          const char* container, long container_len,
+                          const char* ns, long ns_len) {
             if (i >= series_cap) return false;
             totals[i] = 0.0;
             peaks[i] = -HUGE_VAL;
-            return namew.write(pod, pod_len, container, container_len);
+            return namew.write(pod, pod_len, container, container_len, ns, ns_len);
         }
         bool sample(long i, double v) {
             totals[i] += 1.0;

@@ -266,9 +266,14 @@ const char* step(Stream& s, const char* p, const char* end) {
           if (kind == 1) break;    // genuine key
           scan = hit + 8;          // value occurrence — keep scanning
         }
-        long pod_len = 0, container_len = 0;
+        long pod_len = 0, container_len = 0, ns_len = 0;
         const char* pod = find_label(p, hit, "\"pod\"", 5, &pod_len);
         const char* container = find_label(p, hit, "\"container\"", 11, &container_len);
+        // Present only on multi-namespace (coalesced) queries grouped by
+        // namespace; single-namespace records stay byte-identical
+        // ("pod\tcontainer"), so cached row mappings keyed on the names
+        // bytes keep working.
+        const char* ns = find_label(p, hit, "\"namespace\"", 11, &ns_len);
         if (s.series_count == s.series_cap && !s.grow_series()) {
           s.state = State::kError;
           return nullptr;
@@ -276,7 +281,8 @@ const char* step(Stream& s, const char* p, const char* end) {
         SeriesMeta& m = s.series[s.series_count];
         m.name_off = s.names_len;
         bool ok = (pod_len == 0 || s.append_name(pod, pod_len)) && s.append_name("\t", 1) &&
-                  (container_len == 0 || s.append_name(container, container_len));
+                  (container_len == 0 || s.append_name(container, container_len)) &&
+                  (ns_len == 0 || (s.append_name("\t", 1) && s.append_name(ns, ns_len)));
         if (!ok) {
           s.state = State::kError;
           return nullptr;

@@ -226,10 +226,23 @@ _QUERY_RE = re.compile(
 )
 
 #: Namespace-batched query shape (`cpu_namespace_query`/`memory_namespace_query`):
-#: grouped by (pod, container), namespace is the only identity filter.
+#: grouped by (pod, container), namespace is the only identity filter. Also
+#: matches the SHARDED shape (`cpu_namespace_shard_query`), which adds a
+#: `pod=~` matcher — extracted separately by `_SHARD_PODS_RE`.
 _BATCHED_QUERY_RE = re.compile(
     r'sum by \(pod, container\) \([^{]*\{[^}]*namespace="(?P<namespace>[^"]*)"'
 )
+
+#: Coalesced multi-namespace shape (`cpu_namespaces_query`): grouped by
+#: (namespace, pod, container) with a namespace regex matcher — responses
+#: must carry the namespace label, exactly the `by (...)` set.
+_COALESCED_QUERY_RE = re.compile(
+    r'sum by \(namespace, pod, container\) \([^{]*\{[^}]*namespace=~"(?P<namespaces>[^"]*)"'
+)
+
+#: The shard shape's pod restriction (only ever present alongside a
+#: `_BATCHED_QUERY_RE` match — per-workload queries group by (pod) alone).
+_SHARD_PODS_RE = re.compile(r'pod=~"(?P<pods>[^"]*)"')
 
 
 class FakeBackend:
@@ -313,23 +326,46 @@ class FakeBackend:
     async def query(self, request: web.Request) -> web.Response:
         if self.metrics.down:
             return web.json_response({"status": "error", "error": "target down"}, status=503)
-        q = request.query.get("query", "")
+        # Same request-line cap real Prometheus/proxies enforce on every
+        # endpoint: giant probe queries (shard pod regexes) must ride POST.
+        if len(str(request.rel_url)) > self.MAX_URL_BYTES:
+            return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
+        form = await request.post()  # form-encoded POST, like real Prometheus
+        q = str(({**request.query, **form}).get("query", ""))
         # `count(<batched range query>)` — the loader's series-count probe
         # for sizing sub-windows: answer with the TRUE number of series the
-        # wrapped query would return (all series in the namespace).
-        inner = _BATCHED_QUERY_RE.search(q)
-        if q.startswith("count(") and inner:
-            namespace = inner["namespace"]
+        # wrapped query would return (all series the matcher selects), for
+        # both the single-namespace and the coalesced multi-namespace shape.
+        if q.startswith("count("):
             is_cpu = "cpu_usage" in q
-            n = sum(
-                1
-                for k in self.metrics.series
-                if k[0] == namespace and len(self.metrics.series[k][0 if is_cpu else 1])
-            )
-            return web.json_response(
-                {"status": "success", "data": {"resultType": "vector",
-                                               "result": [{"metric": {}, "value": [0, str(n)]}]}}
-            )
+            inner = _COALESCED_QUERY_RE.search(q) or _BATCHED_QUERY_RE.search(q)
+            if inner:
+                pattern = inner.groupdict().get("namespaces")
+                if pattern is not None:
+                    ns_match = re.compile(f"^(?:{pattern})$").match
+                else:
+                    ns_match = lambda ns: ns == inner["namespace"]  # noqa: E731
+                # A shard query's pod=~ matcher restricts the count too —
+                # real Prometheus honors every matcher inside count(); a
+                # whole-namespace answer would oversize the shard's
+                # sub-window fan-out ~shard-count-fold.
+                shard = _SHARD_PODS_RE.search(q)
+                pod_set = (
+                    {p.replace("\\", "") for p in shard["pods"].split("|")}
+                    if shard is not None
+                    else None
+                )
+                n = sum(
+                    1
+                    for k in self.metrics.series
+                    if ns_match(k[0])
+                    and (pod_set is None or k[2] in pod_set)
+                    and len(self.metrics.series[k][0 if is_cpu else 1])
+                )
+                return web.json_response(
+                    {"status": "success", "data": {"resultType": "vector",
+                                                   "result": [{"metric": {}, "value": [0, str(n)]}]}}
+                )
         return web.json_response({"status": "success", "data": {"resultType": "vector", "result": []}})
 
     #: Real Prometheus (and most reverse proxies) cap the request line around
@@ -405,31 +441,55 @@ class FakeBackend:
             )
         query = params.get("query", "")
         is_cpu = "cpu_usage" in query
-        batched = _BATCHED_QUERY_RE.search(query)
-        if batched and self.metrics.fail_batched:
+        coalesced = _COALESCED_QUERY_RE.search(query)
+        batched = None if coalesced else _BATCHED_QUERY_RE.search(query)
+        if (coalesced or batched) and self.metrics.fail_batched:
             return web.json_response(
                 {"status": "error", "error": "query result too large"}, status=422
             )
-        if batched and self.metrics.max_batch_samples is not None:
-            n_series = sum(1 for k in self.metrics.series if k[0] == batched["namespace"])
-            n_points = int((req_end - req_start) // step_sec) + 1
-            if n_series * n_points > self.metrics.max_batch_samples:
-                return web.json_response(
-                    {"status": "error",
-                     "error": "query processing would load too many samples into memory"},
-                    status=422,
-                )
-        if batched:
-            # Namespace-batched query: every series in the namespace, metric
-            # labels = the grouping set (pod AND container), like real
-            # Prometheus, which emits exactly the `by (...)` labels.
-            namespace = batched["namespace"]
-            selected = [k for k in self.metrics.series if k[0] == namespace]
+        #: ``scope`` identifies the response for the body cache — it must
+        #: distinguish shards of one namespace and coalesced groups, which
+        #: the namespace alone no longer does. None = per-workload (uncached).
+        scope: Optional[tuple] = None
+        if coalesced:
+            # Coalesced multi-namespace query (adaptive fetch plan): every
+            # series of every matched namespace, metric labels = the grouping
+            # set (namespace AND pod AND container), like real Prometheus,
+            # which emits exactly the `by (...)` labels.
+            ns_match = re.compile(f"^(?:{coalesced['namespaces']})$").match
+            selected = [k for k in self.metrics.series if ns_match(k[0])]
+            failing = any(ns_match(ns) for ns in self.metrics.fail_namespaces)
+            scope = ("coalesced", coalesced["namespaces"])
 
-            def metric_json(cont: str, pod: str) -> str:
+            def metric_json(ns: str, cont: str, pod: str) -> str:
+                return '{"namespace":"%s","pod":"%s","container":"%s"}' % (ns, pod, cont)
+
+            def metric_dict(ns: str, cont: str, pod: str) -> dict:
+                return {"namespace": ns, "pod": pod, "container": cont}
+        elif batched:
+            # Namespace-batched query: every series in the namespace, metric
+            # labels = the grouping set (pod AND container). A `pod=~`
+            # matcher (the SHARDED shape) restricts to the shard's pods.
+            namespace = batched["namespace"]
+            shard = _SHARD_PODS_RE.search(query)
+            if shard is not None:
+                # Shard pod matchers are pure alternations of escaped literals
+                # (thousands of pods at fleet scale) — set membership, like
+                # RE2's literal-set optimization in real Prometheus; a Python
+                # re alternation here would make the fake the benchmark.
+                pod_set = {p.replace("\\", "") for p in shard["pods"].split("|")}
+                selected = [
+                    k for k in self.metrics.series if k[0] == namespace and k[2] in pod_set
+                ]
+            else:
+                selected = [k for k in self.metrics.series if k[0] == namespace]
+            failing = namespace in self.metrics.fail_namespaces
+            scope = (namespace, shard["pods"] if shard is not None else None)
+
+            def metric_json(ns: str, cont: str, pod: str) -> str:
                 return '{"pod":"%s","container":"%s"}' % (pod, cont)
 
-            def metric_dict(cont: str, pod: str) -> dict:
+            def metric_dict(ns: str, cont: str, pod: str) -> dict:
                 return {"pod": pod, "container": cont}
         else:
             match = _QUERY_RE.search(query)
@@ -444,14 +504,23 @@ class FakeBackend:
                 for k in self.metrics.series
                 if k[0] == namespace and k[1] == container and pod_pattern.match(k[2])
             ]
+            failing = namespace in self.metrics.fail_namespaces
 
-            def metric_json(cont: str, pod: str) -> str:
+            def metric_json(ns: str, cont: str, pod: str) -> str:
                 return '{"pod":"%s"}' % pod
 
-            def metric_dict(cont: str, pod: str) -> dict:
+            def metric_dict(ns: str, cont: str, pod: str) -> dict:
                 return {"pod": pod}
 
-        if namespace in self.metrics.fail_namespaces:
+        if scope is not None and self.metrics.max_batch_samples is not None:
+            n_points = int((req_end - req_start) // step_sec) + 1
+            if len(selected) * n_points > self.metrics.max_batch_samples:
+                return web.json_response(
+                    {"status": "error",
+                     "error": "query processing would load too many samples into memory"},
+                    status=422,
+                )
+        if failing:
             return web.json_response(
                 {"status": "error", "error": "injected namespace outage"}, status=500
             )
@@ -466,7 +535,7 @@ class FakeBackend:
             # Timestamps inside the pre-rendered fragments are static; every
             # consumer discards them.
             t0 = self.SERIES_ORIGIN
-            cache_key = (namespace, is_cpu, req_start, req_end, step_sec) if batched else None
+            cache_key = (scope, is_cpu, req_start, req_end, step_sec) if scope else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
                 return self._range_response(self.metrics._batched_bodies[cache_key])
             fragments = []
@@ -477,7 +546,7 @@ class FakeBackend:
                 if i1 >= i0:
                     fragments.append(
                         '{"metric":%s,"values":[%s]}'
-                        % (metric_json(cont, pod), self.metrics.sliced_values((ns, cont, pod), is_cpu, i0, i1))
+                        % (metric_json(ns, cont, pod), self.metrics.sliced_values((ns, cont, pod), is_cpu, i0, i1))
                     )
             body = (
                 '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
@@ -486,13 +555,13 @@ class FakeBackend:
                 self.metrics._batched_bodies[cache_key] = body
             return self._range_response(body)
         if not self.metrics.duplicate_pods:
-            cache_key = (namespace, is_cpu) if batched else None
+            cache_key = (scope, is_cpu) if scope else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
                 return self._range_response(self.metrics._batched_bodies[cache_key])
             # Fast path: assemble the body from pre-rendered values strings.
             fragments = [
                 '{"metric":%s,"values":[%s]}'
-                % (metric_json(cont, pod), self.metrics._value_strs[(ns, cont, pod)][0 if is_cpu else 1])
+                % (metric_json(ns, cont, pod), self.metrics._value_strs[(ns, cont, pod)][0 if is_cpu else 1])
                 for ns, cont, pod in selected
                 if len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
             ]
@@ -508,9 +577,9 @@ class FakeBackend:
             samples = cpu if is_cpu else memory
             if len(samples):
                 values = [[start + i * step, repr(float(v))] for i, v in enumerate(samples)]
-                result.append({"metric": metric_dict(cont, pod), "values": values})
+                result.append({"metric": metric_dict(ns, cont, pod), "values": values})
                 dupe = [[t, repr(float(v) + 1000.0)] for t, v in values]
-                result.append({"metric": metric_dict(cont, pod), "values": dupe})
+                result.append({"metric": metric_dict(ns, cont, pod), "values": dupe})
         return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": result}})
 
     # ----------------------------------------------------------------- app
@@ -531,6 +600,7 @@ class FakeBackend:
         # Plain Prometheus endpoints (query_range also via POST, which is
         # what the loader uses — see PrometheusLoader._fetch_range_body)…
         app.router.add_get("/api/v1/query", self.query)
+        app.router.add_post("/api/v1/query", self.query)
         app.router.add_get("/api/v1/query_range", self.query_range)
         app.router.add_post("/api/v1/query_range", self.query_range)
         # …and the same API under the apiserver service-proxy prefix —

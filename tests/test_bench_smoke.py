@@ -85,6 +85,18 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("chaos_breaker_opens", 0) >= 1, secondary
     assert secondary.get("chaos_recovered_bitexact") == 1.0, secondary
     assert 0 < secondary.get("chaos_down_tick_seconds", 0) < 10.0, secondary
+    # The adaptive fetch-engine leg ran end-to-end: the planner coalesced
+    # AND sharded at toy scale, the result was bit-exact vs the fixed-plan
+    # control, and the AIMD autotuner saw per-query verdicts (gate failures
+    # are rc 1; assert the fields so a leg-skipping refactor can't pass
+    # silently).
+    assert secondary.get("fetchplan_coalesced", 0) >= 1, secondary
+    assert secondary.get("fetchplan_sharded", 0) >= 2, secondary
+    assert secondary.get("fetchplan_bitexact") == 1.0, secondary
+    assert secondary.get("fetchplan_autotune_engaged") == 1.0, secondary
+    # The fleet leg records the ROADMAP target ratio fetch/(discover+compute)
+    # beside the fetch seconds the regression gate reads.
+    assert "fleet_e2e_fetch_ratio" in secondary, secondary
     # The fetch trendline gate fields are emitted unconditionally (null /
     # False when the previous round ran at a different fleet width).
     assert "fetch_vs_previous_round" in payload

@@ -171,7 +171,7 @@ class TestRetryBackoff:
     def test_transient_500s_are_retried(self, fake_env):
         """SURVEY.md §5 failure detection: the bulk fetch retries transient
         server errors with backoff instead of degrading the scan."""
-        config = make_config(fake_env)
+        config = make_config(fake_env, fetch_plan="fixed")  # pins query counts
         loader = KubernetesLoader(config)
         objects = asyncio.run(loader.list_scannable_objects(["fake"]))
 
@@ -288,7 +288,10 @@ class TestBatchedFleetQueries:
         return asyncio.run(fetch())
 
     def test_request_count_is_per_namespace(self, fake_env):
-        config = make_config(fake_env)
+        # fetch_plan="fixed": this test pins the classic one-query-per-
+        # (namespace, resource) shape; the adaptive plan coalesces these
+        # small namespaces (asserted in test_adaptive_plan_* below).
+        config = make_config(fake_env, fetch_plan="fixed")
         objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
         base = fake_env["metrics"].request_count
         histories = self._gather(config, objects)
@@ -457,9 +460,15 @@ class TestBatchedFleetQueries:
             # The scan window is 3600s @ 60s = 61 points; "default" namespace
             # holds 4 series. Cap at 3 x 61: the full-range window (4 x 61)
             # trips 422, halved windows (<=30 points, 4 x 30 = 120) pass.
+            # fetch_plan="fixed" pins query counts: the adaptive plan
+            # coalesces these small namespaces, and a coalesced 422 rides a
+            # longer ladder (halved retry, then per-namespace decompose)
+            # whose counts this test isn't about.
             metrics.max_batch_samples = 3 * 61
             metrics.request_count = 0
-            histories = self._gather(make_config(fake_env), objects, end_time=scan_end)
+            histories = self._gather(
+                make_config(fake_env, fetch_plan="fixed"), objects, end_time=scan_end
+            )
             requests_used = metrics.request_count
         finally:
             metrics.max_batch_samples = None
@@ -797,7 +806,7 @@ class TestBatchedFleetQueries:
         """A backend that rejects namespace-sized responses (non-retryable
         4xx) must degrade to per-workload queries for that namespace, not to
         empty histories."""
-        config = make_config(fake_env)
+        config = make_config(fake_env, fetch_plan="fixed")  # pins query counts
         objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
         fake_env["metrics"].fail_batched = True
         base = fake_env["metrics"].request_count
@@ -824,7 +833,7 @@ class TestBatchedFleetQueries:
         """A 302 from an auth proxy must degrade the scan to UNKNOWN (failed
         queries, logged), never parse the redirect body as 'no series' — and
         it must not be retried (a redirect won't resolve by retrying)."""
-        config = make_config(fake_env)
+        config = make_config(fake_env, fetch_plan="fixed")  # pins query counts
         objects = asyncio.run(KubernetesLoader(config).list_scannable_objects(["fake"]))
         fake_env["metrics"].redirect_queries = True
         base = fake_env["metrics"].request_count

@@ -48,7 +48,10 @@ class TestNativeParser:
         got = native.parse_matrix_native(body)
         assert got is not None
         assert [key for key, _ in got] == [key for key, _ in expected]
-        assert [key for key, _ in got] == [(pod, "main") for pod, _ in series]
+        # The fixture response carries a namespace label, so series keys carry
+        # it too (the coalesced-query contract; single-namespace batched
+        # responses omit the label and keep 2-tuple keys).
+        assert [key for key, _ in got] == [(pod, "main", "ns") for pod, _ in series]
         for (_, g), (_, e) in zip(got, expected):
             np.testing.assert_array_equal(g, e)
 
@@ -93,7 +96,7 @@ class TestNativeParser:
         got = native.parse_matrix_native(body)
         # The "container" label's VALUE here really is "pod" — the key scan
         # must bind pod="web-1" (the "pod" KEY) and container="pod".
-        assert got is not None and got[0][0] == ("web-1", "pod")
+        assert got is not None and got[0][0] == ("web-1", "pod", "ns")
         np.testing.assert_array_equal(got[0][1], np.asarray([0.5, 0.75]))
 
     def test_error_status_raises_via_python_parser(self, library_available):
@@ -114,14 +117,14 @@ class TestNativeParser:
             b'"values":[[1700000000,"1.5"]]}]}}'
         )
         got = native.parse_matrix_native(body)
-        assert got is not None and [key for key, _ in got] == [("web-1", "values"), ("web-2", "main")]
+        assert got is not None and [key for key, _ in got] == [("web-1", "values", "ns"), ("web-2", "main", "ns")]
         np.testing.assert_array_equal(got[0][1], np.asarray([0.5, 0.75]))
         np.testing.assert_array_equal(got[1][1], np.asarray([1.5]))
         # Same body through the fused digest/stats sinks and the streaming
         # scanner (every chunk size, so the key-vs-value check also exercises
         # the carry/wait path when the colon is beyond the chunk edge).
         stats = native.parse_matrix_stats(body)
-        assert [e[0] for e in stats] == [("web-1", "values"), ("web-2", "main")]
+        assert [e[0] for e in stats] == [("web-1", "values", "ns"), ("web-2", "main", "ns")]
         assert stats[0][1:] == (2.0, 0.75) and stats[1][1:] == (1.0, 1.5)
         for chunk in (1, 3, 7, len(body)):
             stream = native.open_stream(0.0, 0.0, 0)
@@ -141,7 +144,7 @@ class TestNativeDigestIngest:
         ]
         body = make_response(series)
         got = native.parse_matrix_digest(body, self.GAMMA, self.MIN_VALUE, self.BUCKETS)
-        assert [key for key, *_ in got] == [("pod-a", "main"), ("pod-b", "main"), ("pod-empty", "main")]
+        assert [key for key, *_ in got] == [("pod-a", "main", "ns"), ("pod-b", "main", "ns"), ("pod-empty", "main", "ns")]
         for (pod, vals), (_, counts, total, peak) in zip(series, got):
             ref_counts, ref_total, ref_peak = native._digest_python(
                 np.asarray(vals, dtype=np.float64), self.GAMMA, self.MIN_VALUE, self.BUCKETS
@@ -192,7 +195,7 @@ class TestNativeStats:
         ]
         body = make_response(series)
         got = native.parse_matrix_stats(body)
-        assert [k for k, *_ in got] == [("pod-a", "main"), ("pod-empty", "main"), ("pod-b", "main")]
+        assert [k for k, *_ in got] == [("pod-a", "main", "ns"), ("pod-empty", "main", "ns"), ("pod-b", "main", "ns")]
         for (pod, vals), (_, total, peak) in zip(series, got):
             assert total == len(vals)
             if vals:

@@ -807,6 +807,7 @@ class TestSlowQueryLog:
         loader.tracer = NULL_TRACER
         loader.metrics = None
         loader.slow_query_seconds = threshold
+        loader._limiter = prom.AdaptiveLimiter(1, enabled=False)
         warnings: list[str] = []
 
         class Recorder:
