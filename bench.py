@@ -151,6 +151,12 @@ SMOKE_DEFAULTS = {
     # serve ticks, at toy scale but with every gate EXECUTED.
     "BENCH_CHAOS_TICKS": "8",
     "BENCH_CHAOS_WORKLOADS": "2",
+    # Durable-store legs: delta-append vs legacy full rewrite + recovery
+    # replay at toy row counts, and the kill-recover-verify soak (real
+    # SIGKILLed serve subprocesses) with a reduced kill budget.
+    "BENCH_STORE_ROWS": "512",
+    "BENCH_STORE_KILLS": "2",
+    "BENCH_STORE_KILL_TICKS": "6",
 }
 
 
@@ -341,6 +347,218 @@ def chaos_leg(secondary: dict, check) -> None:
         "chaos_down_tick_wall_bounded",
         down_wall < 10.0,
         f"hard-down tick took {down_wall:.2f}s (clean tick {clean_wall:.2f}s)",
+    )
+
+
+def store_leg(secondary: dict, check) -> None:
+    """Durable-store persistence legs (`krr_tpu.core.durastore`), host +
+    disk only: the per-tick delta APPEND vs the legacy full-store rewrite
+    at the configured row count, and the recovery replay wall. Two
+    parity-style gates:
+
+    * delta-beats-rewrite — a tick's ``store_persist_seconds`` (one WAL
+      record: sparse window + fsync) must undercut the legacy
+      ``store_legacy_save_seconds`` (whole-state atomic rewrite), which is
+      the whole point of the WAL;
+    * recovery bit-exactness — reopening the directory (checksummed bases
+      + WAL replay) reconstructs the persisted state bit-identically.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from krr_tpu.core.durastore import DurableStore
+    from krr_tpu.core.streaming import DigestStore
+    from krr_tpu.ops.digest import DigestSpec
+
+    rows = int(os.environ.get("BENCH_STORE_ROWS", 100_000))
+    spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=2560)
+    rng = np.random.default_rng(23)
+    keys = [f"bench/ns{i % 64}/w{i}/main/Deployment" for i in range(rows)]
+
+    def seasoned_store() -> DigestStore:
+        """A store with realistic occupancy: ~40 occupied buckets per row
+        (a series' samples land in tens of its 2,560 buckets)."""
+        store = DigestStore(spec=spec, keys=list(keys))
+        occupied = rng.integers(0, spec.num_buckets, size=(rows, 40))
+        vals = rng.integers(1, 50, size=(rows, 40)).astype(np.float32)
+        flat = occupied + (np.arange(rows)[:, None] * spec.num_buckets)
+        np.add.at(store.cpu_counts.ravel(), flat.ravel(), vals.ravel())
+        store.cpu_total[:] = store.cpu_counts.sum(axis=1)
+        store.cpu_peak[:] = rng.gamma(2.0, 0.3, rows).astype(np.float32)
+        store.mem_total[:] = store.cpu_total
+        store.mem_peak[:] = rng.uniform(50, 400, rows).astype(np.float32)
+        return store
+
+    def tick_window() -> "tuple[np.ndarray, ...]":
+        """One delta tick's whole-fleet contribution: every row touched,
+        ~4 occupied buckets each (a short window's samples)."""
+        counts = np.zeros((rows, spec.num_buckets), np.float32)
+        occupied = rng.integers(0, spec.num_buckets, size=(rows, 4))
+        np.add.at(
+            counts.ravel(),
+            (occupied + np.arange(rows)[:, None] * spec.num_buckets).ravel(),
+            1.0,
+        )
+        totals = counts.sum(axis=1)
+        return (
+            counts,
+            totals,
+            rng.gamma(2.0, 0.3, rows).astype(np.float32),
+            totals,
+            rng.uniform(50, 400, rows).astype(np.float32),
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # Legacy control: the monolithic atomic rewrite per tick.
+        legacy_path = os.path.join(tmp, "legacy.npz")
+        legacy = seasoned_store()
+        legacy.extra_meta["serve_last_end"] = 1.0
+        start = time.perf_counter()
+        legacy.save(legacy_path)
+        legacy_seconds = time.perf_counter() - start
+        legacy_bytes = os.path.getsize(legacy_path)
+
+        # Sharded store, seasoned identically, one delta tick appended.
+        state_path = os.path.join(tmp, "state")
+        durable = DurableStore.open(state_path, spec)
+        durable.store = seasoned_store()
+        durable.store.track_deltas = True
+        durable.maybe_compact(force=True)  # base snapshots of the seasoned state
+        window = tick_window()
+        durable.store.merge_window(keys, *window)
+        durable.store.extra_meta["serve_last_end"] = 2.0
+        start = time.perf_counter()
+        durable.save_delta()
+        persist_seconds = time.perf_counter() - start
+        wal_bytes = durable._wal_size
+        final_counts = durable.store.cpu_counts.copy()
+        final_extra = dict(durable.store.extra_meta)
+        durable.close()
+
+        start = time.perf_counter()
+        recovered = DurableStore.open(state_path, spec)
+        recovery_seconds = time.perf_counter() - start
+        bitexact = bool(
+            recovered.store.keys == keys
+            and np.array_equal(recovered.store.cpu_counts, final_counts)
+            and recovered.store.extra_meta == final_extra
+        )
+        recovered.close()
+
+    secondary["store_legacy_save_seconds"] = round(legacy_seconds, 4)
+    secondary["store_persist_seconds"] = round(persist_seconds, 4)
+    secondary["store_recovery_seconds"] = round(recovery_seconds, 4)
+    secondary["store_delta_vs_legacy"] = round(legacy_seconds / max(persist_seconds, 1e-9), 1)
+    secondary["store_wal_tick_bytes"] = wal_bytes - 8
+    print(
+        f"bench: durable store {rows} rows: delta append {persist_seconds * 1e3:.1f} ms "
+        f"({wal_bytes - 8} B) vs legacy rewrite {legacy_seconds * 1e3:.1f} ms "
+        f"({legacy_bytes} B) -> x{legacy_seconds / max(persist_seconds, 1e-9):.1f}; "
+        f"recovery {recovery_seconds * 1e3:.1f} ms, bit-exact: {bitexact}",
+        file=sys.stderr,
+    )
+    check(
+        "store_delta_beats_full_rewrite",
+        persist_seconds < legacy_seconds,
+        f"delta append {persist_seconds:.4f}s vs legacy rewrite {legacy_seconds:.4f}s",
+    )
+    check("store_recovery_bitexact", bitexact, "recovered state differs")
+
+
+def store_kill_leg(secondary: dict, check) -> None:
+    """Kill-recover-verify at toy scale: a REAL serve subprocess over the
+    chaos fakes, SIGKILLed at random points (mid-tick, mid-append,
+    mid-compaction — the compaction floor is forced tiny), restarted from
+    the same state directory, then compared BIT-exact against a
+    never-killed control run (`tests.fakes.chaos.run_kill_soak`)."""
+    import tempfile
+
+    from krr_tpu.core.durastore import DurableStore
+    from krr_tpu.strategies.tdigest import TDigestStrategySettings
+    from tests.fakes.chaos import (
+        ORIGIN,
+        ArchetypeSpec,
+        ServerThread,
+        build_fleet,
+        run_kill_soak,
+        stores_bitexact,
+        write_kubeconfig,
+    )
+
+    kills = int(os.environ.get("BENCH_STORE_KILLS", 2))
+    ticks_n = int(os.environ.get("BENCH_STORE_KILL_TICKS", 6))
+    fleet = build_fleet(
+        (ArchetypeSpec("diurnal", workloads=2, pods=1),
+         ArchetypeSpec("oom-loop", workloads=2, pods=1)),
+        samples=240,
+        seed=31,
+    )
+    server = ServerThread(fleet.backend).start()
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = write_kubeconfig(os.path.join(tmp, "kubeconfig"), server.url)
+
+            def payload(state_path: str) -> dict:
+                return dict(
+                    kubeconfig=kubeconfig,
+                    prometheus_url=server.url,
+                    strategy="tdigest",
+                    quiet=True,
+                    server_port=0,
+                    scan_interval_seconds=300.0,
+                    hysteresis_enabled=False,
+                    store_compact_min_wal_mb=0.002,
+                    prometheus_retry_deadline_seconds=1.0,
+                    prometheus_backoff_cap_seconds=0.2,
+                    other_args={
+                        "history_duration": 1,
+                        "timeframe_duration": 1,
+                        "state_path": state_path,
+                    },
+                )
+
+            ticks = [ORIGIN + 3600.0 + i * 300.0 for i in range(ticks_n)]
+            repo = os.path.dirname(os.path.abspath(__file__))
+            state = os.path.join(tmp, "state")
+            control = os.path.join(tmp, "control")
+            start = time.perf_counter()
+            report = run_kill_soak(
+                payload(state), ticks, kills=kills, seed=41,
+                cfg_path=os.path.join(tmp, "soak.json"), repo_root=repo,
+                env={**os.environ},
+            )
+            run_kill_soak(
+                payload(control), ticks, kills=0, seed=42,
+                cfg_path=os.path.join(tmp, "control.json"), repo_root=repo,
+                env={**os.environ},
+            )
+            wall = time.perf_counter() - start
+            spec = TDigestStrategySettings().cpu_spec()
+            soaked = DurableStore.open(state, spec)
+            clean = DurableStore.open(control, spec)
+            equal, detail = stores_bitexact(soaked.store, clean.store)
+            cursor_equal = (
+                soaked.store.extra_meta.get("serve_last_end")
+                == clean.store.extra_meta.get("serve_last_end")
+            )
+            soaked.close()
+            clean.close()
+    finally:
+        server.stop()
+
+    secondary["store_kill_recover_bitexact"] = 1.0 if (equal and cursor_equal) else 0.0
+    secondary["store_kill_runs"] = float(report["runs"])
+    secondary["store_kills"] = float(report["kills"])
+    print(
+        f"bench: kill-recover soak {report['kills']} SIGKILLs over {ticks_n} ticks "
+        f"({report['runs']} runs, {wall:.1f}s): bit-exact vs control: {equal and cursor_equal}",
+        file=sys.stderr,
+    )
+    check(
+        "store_kill_recover_bitexact",
+        equal and cursor_equal,
+        detail if not equal else "window cursor differs",
     )
 
 
@@ -998,6 +1216,12 @@ def main() -> None:
         # counters non-zero), bit-exactness vs the fixed-plan control, and
         # the AIMD autotuner seeing per-query verdicts.
         fetchplan_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_STORE"):
+        # Durable-store gates: delta append vs legacy full rewrite,
+        # recovery-replay bit-exactness, and the SIGKILL kill-recover soak.
+        store_leg(secondary, check)
+        store_kill_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_E2E"):
         # End-to-end pipeline numbers (real Runner against the in-process

@@ -222,6 +222,21 @@ class Config(pd.BaseModel):
     #: successful remainder still folds and publishes. 100 restores the
     #: all-or-nothing pre-quarantine behavior.
     min_fetch_success_pct: float = pd.Field(50.0, ge=0, le=100)
+    # Durable digest store (`krr_tpu.core.durastore`) — the sharded
+    # state-directory persistence behind the strategy's --state_path (the
+    # on-disk FORMAT is the strategy's --store_format; these tune the
+    # sharded engine).
+    #: Rows per base-snapshot shard file: compaction slices the store into
+    #: contiguous row ranges of this size.
+    store_shard_rows: int = pd.Field(32768, ge=1)
+    #: Compaction trigger: fold the delta WAL back into base shards once it
+    #: exceeds this fraction of the base snapshots' bytes (replay time
+    #: stays bounded while the per-tick persist stays one small append).
+    store_compact_wal_ratio: float = pd.Field(0.5, gt=0)
+    #: Compaction floor in MiB: below this WAL size, never compact — tiny
+    #: stores must not pay a base rewrite per handful of ticks.
+    store_compact_min_wal_mb: float = pd.Field(16.0, ge=0)
+
     #: Staleness budget for quarantined workloads: how old a quarantined
     #: workload's last folded sample may grow while its digests carry
     #: forward. Past the budget the workload's accumulated row is dropped

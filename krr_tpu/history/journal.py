@@ -57,6 +57,15 @@ MAGIC = b"KRRJRNL1"
 #: Flag bit: this tick's raw value became the published recommendation.
 FLAG_PUBLISHED = 1
 
+#: Flag bit: the record is a publish-EPOCH marker, not a recommendation —
+#: ``key_hash`` holds the durable store's epoch for the tick batch that
+#: FOLLOWS it (marker-first framing), ``ts`` the tick timestamp. Markers
+#: exist only on disk: readers filter them out of the in-memory arrays, so
+#: every records() consumer sees recommendation rows only. They are what
+#: lets a restart reconcile journal-ahead-of-store deterministically
+#: (``reconcile_epoch``) instead of heuristically.
+FLAG_EPOCH = 2
+
 
 def hash_key(key: str) -> int:
     """Stable 64-bit workload identity hash (BLAKE2b-8 of ``object_key``)."""
@@ -99,6 +108,12 @@ class RecommendationJournal:
         #: Records trimmed from memory but still on disk — the rewrite debt
         #: that triggers the next atomic file compaction (see ``compact``).
         self._stale_in_file = 0
+        #: On-disk epoch markers as ``(file record index, epoch)`` pairs,
+        #: ascending — populated at open, consumed by ``reconcile_epoch``.
+        self._markers: list[tuple[int, int]] = []
+        #: Newest epoch this journal has recorded (None: no markers — a
+        #: pre-epoch journal or a memory-only one).
+        self.last_epoch: Optional[int] = None
         #: Cached ts bounds (see ``_install``).
         self._min_ts: Optional[float] = None
         self._max_ts: Optional[float] = None
@@ -174,6 +189,8 @@ class RecommendationJournal:
                         f"journal at {self.path} is a {size}-byte stub "
                         f"(crash before the header write?) — starting fresh"
                     )
+                self._markers = []
+                self.last_epoch = None
                 self._install(np.empty(0, dtype=RECORD_DTYPE))
                 return size, 0, True
             if f.read(len(MAGIC)) != MAGIC:
@@ -184,7 +201,15 @@ class RecommendationJournal:
             payload = size - len(MAGIC)
             whole = payload // RECORD_DTYPE.itemsize
             data = np.fromfile(f, dtype=RECORD_DTYPE, count=whole)
-        self._install(data)
+        # Epoch markers live only on disk: strip them from the in-memory
+        # arrays (every records() consumer sees recommendation rows only)
+        # but remember their file positions for reconcile_epoch.
+        is_marker = (data["flags"] & FLAG_EPOCH) != 0
+        self._markers = [
+            (int(i), int(data["key_hash"][i])) for i in np.flatnonzero(is_marker)
+        ]
+        self.last_epoch = self._markers[-1][1] if self._markers else None
+        self._install(data[~is_marker] if self._markers else data)
         return size, payload - whole * RECORD_DTYPE.itemsize, False
 
     def _install(self, records: np.ndarray) -> None:
@@ -227,10 +252,19 @@ class RecommendationJournal:
         cpu: np.ndarray,
         mem: np.ndarray,
         published: np.ndarray,
+        *,
+        epoch: Optional[int] = None,
     ) -> None:
         """Record one recompute: the raw recommendation for every workload,
         with ``published`` marking rows whose raw value became the published
-        one. Appended to memory and (when persistent) fsync'd to disk."""
+        one. Appended to memory and (when persistent) fsync'd to disk.
+
+        ``epoch`` (the durable store's publish epoch for this tick) writes
+        an epoch MARKER record before the batch — marker-first, so records
+        following marker ``E`` belong to epoch ``E``'s tick and a restart
+        can truncate exactly the ticks the store never durably published
+        (``reconcile_epoch``). One write + one fsync covers marker and
+        batch together."""
         if self.readonly:
             raise RuntimeError("journal opened readonly")
         n = len(keys)
@@ -253,12 +287,77 @@ class RecommendationJournal:
             fresh = {int(h): k for h, k in zip(hashes, keys) if int(h) not in self._names}
             if fresh:
                 self._names.update(fresh)
+            if epoch is not None:
+                self.last_epoch = int(epoch)
             if self._file is not None:
-                self._file.write(batch.tobytes())
+                payload = batch.tobytes()
+                if epoch is not None:
+                    marker = np.zeros(1, dtype=RECORD_DTYPE)
+                    marker["ts"] = ts
+                    marker["key_hash"] = np.uint64(int(epoch))
+                    marker["flags"] = FLAG_EPOCH
+                    payload = marker.tobytes() + payload
+                self._file.write(payload)
                 self._file.flush()
                 os.fsync(self._file.fileno())
                 if fresh:
                     self._save_names()
+
+    def reconcile_epoch(self, store_epoch: int) -> Optional[str]:
+        """Deterministic journal↔store crash reconciliation at startup,
+        BEFORE any append. The serve tick journals first and persists the
+        store second, so a crash in between leaves the journal one epoch
+        ahead; restart refetches and re-journals that window, which would
+        duplicate its records. With epoch markers the resolution is exact:
+
+        * journal ahead (markers past ``store_epoch``) → truncate the file
+          back to just before the first unproven tick's marker — those
+          ticks were never durably published and will be re-journaled
+          verbatim by the refetch;
+        * store ahead (newest marker below ``store_epoch``) → the journal
+          lost ticks the store kept (deleted/rolled-back file): keep both,
+          warn — history is missing but nothing is inconsistent;
+        * no markers (pre-epoch or memory-only journal) → None: nothing to
+          reconcile against, legacy behavior stands.
+
+        Returns the verdict ("consistent" / "journal_ahead" /
+        "store_ahead") or None when markers are absent."""
+        if self.readonly:
+            raise RuntimeError("journal opened readonly")
+        with self._lock:
+            if not self.path or not self._markers:
+                return None
+            cut = next(
+                (idx for idx, epoch in self._markers if epoch > int(store_epoch)), None
+            )
+            if cut is None:
+                if self.last_epoch is not None and self.last_epoch < int(store_epoch):
+                    self._warn(
+                        f"journal at {self.path} is behind the digest store "
+                        f"(journal epoch {self.last_epoch}, store epoch "
+                        f"{int(store_epoch)}) — keeping both; the missing "
+                        f"ticks' history was lost with the journal"
+                    )
+                    return "store_ahead"
+                return "consistent"
+            from krr_tpu.core.streaming import DigestStore
+
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            with DigestStore.locked(self.path):
+                before = self._n
+                os.truncate(self.path, len(MAGIC) + cut * RECORD_DTYPE.itemsize)
+                self._read_records()
+                dropped = before - self._n
+                self._file = open(self.path, "ab")
+            self._warn(
+                f"journal at {self.path} ran ahead of the digest store "
+                f"(journal epoch past {int(store_epoch)}) — dropped {dropped} "
+                f"record(s) from tick(s) the store never durably published; "
+                f"they re-journal when the windows refetch"
+            )
+            return "journal_ahead"
 
     # ------------------------------------------------------------- compaction
     #: File rewrite triggers once this fraction of the on-disk records has
@@ -302,10 +401,16 @@ class RecommendationJournal:
             self._file = None
         try:
             with DigestStore.locked(self.path):
+                # Epoch markers are dropped by the rewrite (they interleave
+                # the raw file, not the in-memory arrays): a crash landing
+                # between this rewrite and the tick's store persist
+                # degrades reconcile_epoch to its no-marker no-op — the
+                # pre-epoch status quo — until the next append re-marks.
                 with atomic_write(self.path) as f:
                     f.write(MAGIC)
                     f.write(self._records[: self._n].tobytes())
                 self._save_names()
+            self._markers = []
         finally:
             # Reopen the append handle even when the rewrite failed (disk
             # full mid-compaction): atomic_write left the old file intact,

@@ -448,7 +448,8 @@ def _server_options() -> list[click.Option]:
     defaults = {name: Config.model_fields[name].default for name in (
         "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds",
         "history_retention_seconds", "hysteresis_dead_band_pct", "hysteresis_confirm_ticks",
-        "trace_ring_scans",
+        "trace_ring_scans", "store_shard_rows", "store_compact_wal_ratio",
+        "store_compact_min_wal_mb",
     )}
     return [
         PanelOption(
@@ -517,6 +518,42 @@ def _server_options() -> list[click.Option]:
                 "recommendations: past this age their accumulated digests drop "
                 "and they re-enter with a full-window backfill. 0 = auto "
                 "(ten scan cadences)."
+            ),
+        ),
+        PanelOption(
+            ["--store-shard-rows", "store_shard_rows"],
+            type=int,
+            default=defaults["store_shard_rows"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Rows per base-snapshot shard file in the sharded digest "
+                "state directory (compaction slices the store into "
+                "contiguous row ranges of this size)."
+            ),
+        ),
+        PanelOption(
+            ["--store-compact-wal-ratio", "store_compact_wal_ratio"],
+            type=float,
+            default=defaults["store_compact_wal_ratio"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Fold the digest store's delta WAL back into base shards "
+                "once it exceeds this fraction of the base snapshots' bytes "
+                "(bounds recovery replay time; per-tick persists stay one "
+                "small append)."
+            ),
+        ),
+        PanelOption(
+            ["--store-compact-min-wal-mb", "store_compact_min_wal_mb"],
+            type=float,
+            default=defaults["store_compact_min_wal_mb"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Never compact the digest store's WAL below this many MiB — "
+                "tiny stores must not pay a base rewrite per handful of ticks."
             ),
         ),
         PanelOption(

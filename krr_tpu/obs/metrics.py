@@ -64,6 +64,12 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_digest_store_rows", "gauge", "Rows (containers) resident in the digest store."),
     ("krr_tpu_digest_store_bytes", "gauge", "Resident bytes of the digest store's row arrays."),
     ("krr_tpu_store_compacted_rows_total", "counter", "Store rows dropped by churn compaction."),
+    # Durable sharded digest store (`krr_tpu.core.durastore`).
+    ("krr_tpu_persist_failures_total", "counter", "Digest state persist attempts that failed on a disk fault (ENOSPC/EIO) — serve keeps publishing from memory and retries with the backlog next tick."),
+    ("krr_tpu_store_wal_bytes", "gauge", "Bytes in the durable store's delta WAL since the last compaction (framing header included)."),
+    ("krr_tpu_store_wal_records", "gauge", "Delta records appended to the durable store's WAL since the last compaction."),
+    ("krr_tpu_store_compactions_total", "counter", "Durable-store compactions: the delta WAL folded back into fresh base shards and the manifest flipped."),
+    ("krr_tpu_store_recovery_seconds", "gauge", "Wall seconds the last durable-store open spent reconstructing state (base shard loads + checksum verification + WAL replay)."),
     ("krr_tpu_recommendation_churn_total", "counter", "Published recommendation changes: workloads whose published values moved this tick (first-time publishes excluded)."),
     ("krr_tpu_hysteresis_suppressed_total", "counter", "Workload-ticks where an out-of-dead-band recommendation change was withheld by the hysteresis gate."),
     ("krr_tpu_journal_records", "gauge", "Recommendation-tick records resident in the history journal."),

@@ -217,6 +217,9 @@ class HttpApp:
             "stale_workloads": len(self.state.stale_workloads),
             "consecutive_scan_failures": self.state.consecutive_scan_failures,
             "last_scan_error": self.state.last_scan_error,
+            "persist_failing": self.state.persist_failing,
+            "persist_failures": self.state.persist_failures,
+            "last_persist_error": self.state.last_persist_error,
         }
         return 200, "application/json", _json_body(payload)
 
@@ -227,10 +230,12 @@ class HttpApp:
             status = "starting"
         elif float(self.clock()) - snapshot.window_end > self.stale_after_seconds:
             status = "stale"
-        elif firing:
-            # SLO burn downgrades the verdict without failing liveness: the
-            # pod is alive and serving, but its error budget is burning —
-            # /statusz has the details. ``stale`` (503) outranks it.
+        elif firing or self.state.persist_failing:
+            # SLO burn — or a failing state persist (ENOSPC/EIO: serve
+            # keeps publishing from memory and retries each tick) —
+            # downgrades the verdict without failing liveness: the pod is
+            # alive and serving, but needs attention — /statusz has the
+            # details. ``stale`` (503) outranks it.
             status = "degraded"
         else:
             status = "ok"
@@ -260,6 +265,12 @@ class HttpApp:
             "stale_workloads": len(self.state.stale_workloads),
             "consecutive_scan_failures": self.state.consecutive_scan_failures,
             "last_scan_error": self.state.last_scan_error,
+            # Durable-store posture: a failing persist means restarts lose
+            # the unpersisted ticks (refetched, not corrupted) — degraded,
+            # not dead.
+            "persist_failing": self.state.persist_failing,
+            "persist_failures": self.state.persist_failures,
+            "last_persist_error": self.state.last_persist_error,
             "slo_firing": firing,
         }
         return (200 if status in ("ok", "degraded") else 503), "application/json", _json_body(body)
@@ -539,10 +550,14 @@ class KrrServer:
                 "incremental delta folds ride on the digest's mergeability"
             )
         # The resident store; with state_path configured it resumes the
-        # persisted digests (and the scheduler re-saves after every fold).
-        # The journal rides alongside: default path <state_path>.journal
-        # (memory-only when neither is set; --history-path "" forces
-        # memory-only even with a state_path).
+        # persisted digests through the durable engine
+        # (`krr_tpu.core.durastore`): sharded state DIRECTORY by default
+        # (legacy single-file state auto-migrates on first open; the
+        # strategy's --store_format legacy keeps the old single-file
+        # shape), per-tick delta WAL appends, threshold compaction, and
+        # kill-proof recovery. The journal rides alongside: default path
+        # <state_path>.journal (memory-only when neither is set;
+        # --history-path "" forces memory-only even with a state_path).
         from krr_tpu.history.journal import RecommendationJournal
 
         state_path = getattr(settings, "state_path", None)
@@ -557,8 +572,26 @@ class KrrServer:
         # respected.
         if not self.session.tracer.enabled:
             self.session.tracer = Tracer(ring_scans=config.trace_ring_scans)
+        if state_path:
+            from krr_tpu.core.durastore import DurableStore
+
+            with DigestStore.locked(state_path):
+                self.durable: "Optional[DurableStore]" = DurableStore.open(
+                    state_path,
+                    settings.cpu_spec(),
+                    store_format=getattr(settings, "store_format", "sharded"),
+                    shard_rows=config.store_shard_rows,
+                    compact_wal_ratio=config.store_compact_wal_ratio,
+                    compact_min_bytes=int(config.store_compact_min_wal_mb * (1 << 20)),
+                    metrics=self.session.metrics,
+                    logger=self.logger,
+                )
+            store = self.durable.store
+        else:
+            self.durable = None
+            store = DigestStore(spec=settings.cpu_spec())
         self.state = ServerState(
-            DigestStore.open_or_create(state_path, settings.cpu_spec()),
+            store,
             journal=RecommendationJournal(
                 journal_path or None,
                 retention_seconds=config.history_retention_seconds,
@@ -568,6 +601,17 @@ class KrrServer:
             # per-query telemetry into the same exposition /metrics serves.
             metrics=self.session.metrics,
         )
+        # Epoch reconciliation: a crash between the journal append and the
+        # store persist leaves the journal one publish ahead — truncate it
+        # back to the store's durable epoch (deterministic) before the
+        # scheduler seeds the hysteresis gate from it.
+        if (
+            self.durable is not None
+            and self.durable.fmt == "sharded"
+            and self.state.journal is not None
+            and self.state.journal.path
+        ):
+            self.state.journal.reconcile_epoch(self.durable.epoch)
         # The SLO engine rides the same registry and clock: the scheduler
         # evaluates per tick, /statusz renders it, /healthz downgrades to
         # ``degraded`` while it fires (`krr_tpu.obs.health`).
@@ -583,6 +627,7 @@ class KrrServer:
             discovery_interval=config.discovery_interval_seconds,
             clock=clock,
             logger=self.logger,
+            durable=self.durable,
         )
         self.app = HttpApp(
             self.state,
@@ -632,6 +677,8 @@ class KrrServer:
             self._server = None
         if self.state.journal is not None:
             self.state.journal.close()
+        if self.durable is not None:
+            self.durable.close()
         await self.session.close()
 
 

@@ -83,9 +83,16 @@ class ScanScheduler:
         discovery_interval: float,
         clock: Callable[[], float] = time.time,
         logger: Optional[KrrLogger] = None,
+        durable=None,
     ) -> None:
         self.session = session
         self.state = state
+        #: The durable persistence engine (`krr_tpu.core.durastore`) when
+        #: the serve composition opened one for state_path — per-tick delta
+        #: WAL appends, threshold compaction, and the publish epoch the
+        #: journal reconciles against. None (direct construction, no
+        #: state_path) falls back to the legacy whole-file save.
+        self.durable = durable
         self.scan_interval = float(scan_interval)
         self.discovery_interval = float(discovery_interval)
         self.clock = clock
@@ -221,7 +228,44 @@ class ScanScheduler:
         else:
             self.state.store.extra_meta.pop("serve_fetch_plan", None)
         with DigestStore.locked(self.state_path):
-            self.state.store.save(self.state_path)
+            if self.durable is not None:
+                # Sharded: one appended delta record carrying this tick's
+                # folded windows + the extra_meta above (cursor, quarantine,
+                # fetch plan) — the same atomicity contract as the
+                # monolithic save, at a fraction of the bytes. Legacy
+                # format: the classic full rewrite, unchanged on disk.
+                self.durable.save_delta()
+            else:
+                self.state.store.save(self.state_path)
+
+    async def _persist(self) -> None:
+        """Persist the store, degrading instead of killing the tick on disk
+        faults: ENOSPC/EIO leaves serve publishing from memory with
+        /healthz degraded and a retry (carrying the backlog of captured
+        deltas) on the next tick."""
+        metrics = self.state.metrics
+        try:
+            await asyncio.to_thread(self._save_store)
+        except OSError as e:
+            metrics.inc("krr_tpu_persist_failures_total")
+            self.state.persist_failures += 1
+            self.state.persist_failing = True
+            self.state.last_persist_error = f"{type(e).__name__}: {e}"[:300]
+            # Bound the backlog: queued fold captures reference each tick's
+            # DENSE window matrix — a disk that stays full must not pin one
+            # per tick until the degradation it survived becomes an OOM
+            # kill. Sparse re-encode is ~250x smaller and WAL-identical.
+            await asyncio.to_thread(self.state.store.compact_pending)
+            self.logger.warning(
+                f"Persisting digest state to {self.state_path} failed ({e}) — "
+                f"serving from memory; the next tick retries with the backlog"
+            )
+        else:
+            if self.state.persist_failing:
+                self.logger.info(
+                    f"Digest state persistence to {self.state_path} recovered"
+                )
+            self.state.persist_failing = False
 
     # ------------------------------------------------- degraded-tick helpers
     def _step(self) -> float:
@@ -300,9 +344,21 @@ class ScanScheduler:
                 )
             keys = [object_key(obj) for obj in objects]
             decision = self.gate.observe(keys, cpu_raw, mem_raw)
+            # The shared publish epoch: this tick's journal batch is marked
+            # with the epoch its store persist WILL commit as, so a crash
+            # between the two is detectable (and reconciled by truncation)
+            # at restart instead of heuristically.
+            pending_epoch = (
+                self.durable.epoch + 1
+                if self.durable is not None and self.durable.fmt == "sharded"
+                else None
+            )
             if journal is not None:
                 if record:
-                    journal.append_tick(window_end, keys, cpu_raw, mem_raw, decision.published)
+                    journal.append_tick(
+                        window_end, keys, cpu_raw, mem_raw, decision.published,
+                        epoch=pending_epoch,
+                    )
                     dropped = journal.compact(window_end)
                     if dropped:
                         metrics.inc("krr_tpu_journal_compacted_records_total", dropped)
@@ -338,6 +394,14 @@ class ScanScheduler:
                             cpu_raw[idx],
                             mem_raw[idx],
                             np.ones(len(idx), bool),
+                            # The resume re-publish persists nothing after:
+                            # these records belong to the CURRENT durable
+                            # epoch, not a pending one.
+                            epoch=(
+                                self.durable.epoch
+                                if self.durable is not None and self.durable.fmt == "sharded"
+                                else None
+                            ),
                         )
             with tracer.span("round", rows=len(objects)):
                 raw_results = finalize_fleet(
@@ -623,7 +687,7 @@ class ScanScheduler:
         t4 = time.perf_counter()
 
         if self.state_path:
-            await asyncio.to_thread(self._save_store)
+            await self._persist()
 
         metrics.inc("krr_tpu_scans_total", kind=kind)
         # Every object's fetch was ATTEMPTED this tick — the SLO fetch

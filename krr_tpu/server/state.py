@@ -142,6 +142,17 @@ class ServerState:
         #: ``degraded`` while it has firing alerts. None for states built
         #: without a server (unit tests, embedders).
         self.slo: "Optional[SloEngine]" = None
+        #: Persistence posture (durable store saves): True while the last
+        #: persist attempt failed (ENOSPC/EIO) — serve keeps publishing
+        #: from memory, /healthz downgrades to ``degraded``, and the next
+        #: tick retries with the backlog. Owned by the scheduler.
+        self.persist_failing: bool = False
+        #: Cumulative failed persist attempts this process (the in-process
+        #: twin of ``krr_tpu_persist_failures_total``).
+        self.persist_failures: int = 0
+        #: The most recent persist failure's error (survives recovery as a
+        #: breadcrumb; ``persist_failing`` says whether it is current).
+        self.last_persist_error: Optional[str] = None
         self._snapshot: Optional[Snapshot] = None
 
     async def publish(self, snapshot: Snapshot) -> None:

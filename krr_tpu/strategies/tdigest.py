@@ -30,7 +30,7 @@ later.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Literal, Optional
 
 import numpy as np
 import pydantic as pd
@@ -86,9 +86,20 @@ class TDigestStrategySettings(SimpleStrategySettings):
     state_path: Optional[str] = pd.Field(
         None,
         description=(
-            "Path to a digest state file for incremental/streaming scans: each run merges the "
+            "Path to the digest state for incremental/streaming scans: each run merges the "
             "fetched window into the stored per-container digests and recommends from the merged "
-            "history (multi-source scans against the same state commute)."
+            "history (multi-source scans against the same state commute). Sharded format makes "
+            "this a state DIRECTORY (manifest + base shards + delta WAL); legacy single-file "
+            "state auto-migrates on first open."
+        ),
+    )
+    store_format: Literal["sharded", "legacy"] = pd.Field(
+        "sharded",
+        description=(
+            "On-disk digest state format: 'sharded' (default) is the durable state directory — "
+            "checksummed base shards plus a delta WAL, persisting each merge as one appended "
+            "record with kill-proof recovery; 'legacy' keeps the classic single-file atomic "
+            "rewrite, byte-compatible with existing state files."
         ),
     )
     def cpu_spec(self) -> DigestSpec:
@@ -210,15 +221,23 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
         obs = self.obs
         with self.profile_span():
             if self.settings.state_path:
+                from krr_tpu.core.durastore import DurableStore
                 from krr_tpu.core.streaming import DigestStore
 
                 with DigestStore.locked(self.settings.state_path):
-                    store = DigestStore.open_or_create(self.settings.state_path, spec)
-                    with obs.stage("fold", rows=len(fleet.objects)):
-                        rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
-                    with obs.stage("quantile", rows=len(fleet.objects), path="store"):
-                        cpu_p, mem_max = store.query_recommendation(rows, q)
-                    store.save(self.settings.state_path)
+                    durable = DurableStore.open(
+                        self.settings.state_path, spec,
+                        store_format=self.settings.store_format,
+                    )
+                    try:
+                        store = durable.store
+                        with obs.stage("fold", rows=len(fleet.objects)):
+                            rows = store.fold_fleet(fleet, mem_scale=MEMORY_SCALE)
+                        with obs.stage("quantile", rows=len(fleet.objects), path="store"):
+                            cpu_p, mem_max = store.query_recommendation(rows, q)
+                        durable.save_delta()
+                    finally:
+                        durable.close()
             else:
                 with obs.stage("quantile", rows=len(fleet.objects), path="ingest"):
                     cpu_p = digest_ops.percentile_host(
@@ -248,19 +267,27 @@ class TDigestStrategy(BatchedStrategy[TDigestStrategySettings]):
             if self.settings.state_path:
                 # Incremental path: fold this window into the persistent store and
                 # recommend from the merged history (streaming / multi-source /
-                # resume — krr_tpu.core.streaming).
+                # resume — krr_tpu.core.streaming + krr_tpu.core.durastore).
+                from krr_tpu.core.durastore import DurableStore
                 from krr_tpu.core.streaming import DigestStore, object_key
 
                 with obs.stage("digest", rows=len(batch)):
                     counts, total, peak, mem_total, mem_peak = self._window_digest(batch, spec, mesh)
                 keys = [object_key(obj) for obj in batch.objects]
                 with DigestStore.locked(self.settings.state_path):
-                    store = DigestStore.open_or_create(self.settings.state_path, spec)
-                    with obs.stage("fold", rows=len(batch)):
-                        rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
-                    with obs.stage("quantile", rows=len(batch), path="store"):
-                        cpu_p, mem_max = store.query_recommendation(rows, q)
-                    store.save(self.settings.state_path)
+                    durable = DurableStore.open(
+                        self.settings.state_path, spec,
+                        store_format=self.settings.store_format,
+                    )
+                    try:
+                        store = durable.store
+                        with obs.stage("fold", rows=len(batch)):
+                            rows = store.merge_window(keys, counts, total, peak, mem_total, mem_peak)
+                        with obs.stage("quantile", rows=len(batch), path="store"):
+                            cpu_p, mem_max = store.query_recommendation(rows, q)
+                        durable.save_delta()
+                    finally:
+                        durable.close()
             elif self._use_host_stream(batch, mesh):
                 with obs.stage("quantile", rows=len(batch), path="host_stream"):
                     cpu_p, mem_max = obs.fence(self._streamed_sketch(batch, spec, q, mesh))

@@ -27,7 +27,15 @@ Everything here is test infrastructure: the product ships none of it, and
 
 from __future__ import annotations
 
+import errno
 import inspect
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
@@ -35,6 +43,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 import yaml
 
+from krr_tpu.core.streaming import FsOps
 from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
 
 ORIGIN = FakeBackend.SERIES_ORIGIN
@@ -239,6 +248,204 @@ class FaultTimeline:
         return spec
 
 
+# ---------------------------------------------------------- disk-fault fakes
+class FaultyFs(FsOps):
+    """Scripted disk faults over the durable store's fs-ops seam
+    (`krr_tpu.core.streaming.FsOps`): every listed op raises ``OSError``
+    with the scripted errno (ENOSPC by default, EIO for media faults),
+    optionally only after ``after`` matching calls succeed. Install on one
+    ``DurableStore`` instance (``durable.fs = FaultyFs(...)``) to fault
+    that store without touching the process-wide default."""
+
+    def __init__(
+        self,
+        ops: "tuple[str, ...]" = ("append", "fsync", "write", "replace", "fsync_dir"),
+        *,
+        error: int = errno.ENOSPC,
+        after: int = 0,
+    ) -> None:
+        self.ops = frozenset(ops)
+        self.error = error
+        self.after = int(after)
+        self.calls = 0
+        self.faults = 0
+
+    def _maybe_fault(self, op: str) -> None:
+        if op not in self.ops:
+            return
+        self.calls += 1
+        if self.calls > self.after:
+            self.faults += 1
+            raise OSError(self.error, os.strerror(self.error))
+
+    def write(self, f, data: bytes) -> None:
+        self._maybe_fault("write")
+        super().write(f, data)
+
+    def append(self, f, data: bytes) -> None:
+        self._maybe_fault("append")
+        super().append(f, data)
+
+    def fsync(self, f) -> None:
+        self._maybe_fault("fsync")
+        super().fsync(f)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._maybe_fault("replace")
+        super().replace(src, dst)
+
+    def fsync_dir(self, path: str) -> None:
+        self._maybe_fault("fsync_dir")
+        super().fsync_dir(path)
+
+    def truncate(self, f, size: int) -> None:
+        self._maybe_fault("truncate")
+        super().truncate(f, size)
+
+
+class SimulatedCrash(BaseException):
+    """Raised by :class:`CrashPointFs` at its scripted fault point.
+
+    A ``BaseException`` on purpose: persistence code must not catch it on
+    the way out, exactly like a real crash doesn't unwind through handlers."""
+
+
+class CrashPointFs(FsOps):
+    """Crash-injection at the Nth durability-critical syscall: counts every
+    fs op and raises :class:`SimulatedCrash` at op ``crash_at`` (0-based).
+    The crash-point matrix in the durability tests runs a persist once per
+    possible value of ``crash_at`` and asserts recovery lands on a durable
+    state after each — every fsync/rename/append boundary is a tested
+    crash window, not an assumed one."""
+
+    def __init__(self, crash_at: Optional[int] = None) -> None:
+        self.crash_at = crash_at
+        self.calls = 0
+
+    def _tick(self) -> None:
+        if self.crash_at is not None and self.calls == self.crash_at:
+            raise SimulatedCrash(f"injected crash at fs op {self.calls}")
+        self.calls += 1
+
+    def write(self, f, data: bytes) -> None:
+        self._tick()
+        super().write(f, data)
+
+    def append(self, f, data: bytes) -> None:
+        self._tick()
+        super().append(f, data)
+
+    def fsync(self, f) -> None:
+        self._tick()
+        super().fsync(f)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._tick()
+        super().replace(src, dst)
+
+    def fsync_dir(self, path: str) -> None:
+        self._tick()
+        super().fsync_dir(path)
+
+    def truncate(self, f, size: int) -> None:
+        self._tick()
+        super().truncate(f, size)
+
+
+# ------------------------------------------------------------ SIGKILL soaks
+def _pump_lines(proc: "subprocess.Popen", out: "queue.Queue") -> None:
+    for line in proc.stdout:
+        out.put(line)
+    out.put(None)
+
+
+def run_kill_soak(
+    config_payload: dict,
+    ticks: "list[float]",
+    *,
+    kills: int,
+    seed: int,
+    cfg_path: str,
+    repo_root: str,
+    run_timeout: float = 300.0,
+    env: Optional[dict] = None,
+) -> dict:
+    """Drive ``tests.fakes.soak_driver`` (a REAL serve composition in a
+    subprocess, ticking a scripted schedule against the fake backend) and
+    SIGKILL it at ``kills`` random points — sampled across the whole run:
+    a random tick index plus a sub-tick jitter, so kills land mid-fetch,
+    mid-fold, mid-journal-append, mid-WAL-append, and mid-compaction.
+    After each kill the driver restarts from the same state directory
+    (recovery is the restart itself: an unrecoverable store fails the
+    rerun loudly); once the kill budget is spent, a final run completes
+    the schedule. Returns run/kill bookkeeping for the assertions."""
+    rng = np.random.default_rng(seed)
+    with open(cfg_path, "w") as f:
+        json.dump({"config": config_payload, "ticks": ticks}, f)
+    runs = 0
+    kill_points: "list[tuple[int, float]]" = []
+    remaining = int(kills)
+    while True:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tests.fakes.soak_driver", cfg_path],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=repo_root,
+            env=env,
+        )
+        runs += 1
+        lines: "queue.Queue" = queue.Queue()
+        pump = threading.Thread(target=_pump_lines, args=(proc, lines), daemon=True)
+        pump.start()
+        kill_after = int(rng.integers(0, len(ticks))) if remaining > 0 else None
+        jitter = float(rng.uniform(0.0, 0.2))
+        deadline = time.monotonic() + run_timeout
+        done = False
+        killed_this_run = False
+        transcript: "list[str]" = []
+        try:
+            while True:
+                try:
+                    line = lines.get(timeout=max(0.01, deadline - time.monotonic()))
+                except queue.Empty:
+                    proc.kill()
+                    raise TimeoutError(
+                        f"soak driver run {runs} produced no output for "
+                        f"{run_timeout}s:\n{''.join(transcript[-50:])}"
+                    )
+                if line is None:
+                    break
+                transcript.append(line)
+                if line.startswith("DONE"):
+                    done = True
+                if kill_after is not None and line.startswith(f"TICK {kill_after} "):
+                    time.sleep(jitter)
+                    proc.send_signal(signal.SIGKILL)
+                    kill_points.append((kill_after, jitter))
+                    killed_this_run = True
+                    remaining -= 1
+                    break
+        finally:
+            proc.wait(timeout=60)
+            pump.join(timeout=10)
+        if done:
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"soak driver run {runs} exited rc={proc.returncode} after DONE:\n"
+                    + "".join(transcript[-50:])
+                )
+            break
+        if not killed_this_run:
+            # The run ended without DONE and without our kill: it crashed —
+            # which is exactly what an unrecoverable store would look like.
+            raise RuntimeError(
+                f"soak driver run {runs} died rc={proc.returncode} without finishing:\n"
+                + "".join(transcript[-50:])
+            )
+    return {"runs": runs, "kills": int(kills) - remaining, "kill_points": kill_points}
+
+
 # ---------------------------------------------------------------- soak driver
 @dataclass
 class TickSample:
@@ -348,15 +555,19 @@ __all__ = [
     "ArchetypeSpec",
     "CLEAN",
     "ChaosFleet",
+    "CrashPointFs",
     "DEFAULT_FLEET",
     "FaultSpec",
     "FaultTimeline",
+    "FaultyFs",
     "ORIGIN",
     "STEP",
     "ServerThread",
+    "SimulatedCrash",
     "SoakReport",
     "TickSample",
     "build_fleet",
+    "run_kill_soak",
     "run_soak",
     "stores_bitexact",
     "write_kubeconfig",

@@ -94,6 +94,18 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("fetchplan_sharded", 0) >= 2, secondary
     assert secondary.get("fetchplan_bitexact") == 1.0, secondary
     assert secondary.get("fetchplan_autotune_engaged") == 1.0, secondary
+    # The durable-store leg ran end-to-end: the per-tick delta append beat
+    # the legacy full rewrite, recovery replay was bit-exact, and the
+    # SIGKILL kill-recover soak (real serve subprocesses killed mid-run)
+    # converged bit-exact with its never-killed control (gate failures are
+    # rc 1; assert the fields so a leg-skipping refactor can't pass
+    # silently).
+    assert secondary.get("store_persist_seconds", 0) > 0, secondary
+    assert secondary.get("store_legacy_save_seconds", 0) > 0, secondary
+    assert "store_recovery_seconds" in secondary, secondary
+    assert secondary.get("store_delta_vs_legacy", 0) > 1.0, secondary
+    assert secondary.get("store_kill_recover_bitexact") == 1.0, secondary
+    assert secondary.get("store_kills", 0) >= 2, secondary
     # The fleet leg records the ROADMAP target ratio fetch/(discover+compute)
     # beside the fetch seconds the regression gate reads.
     assert "fleet_e2e_fetch_ratio" in secondary, secondary

@@ -639,3 +639,160 @@ class TestBackoffCapAndBudgetLadder:
             assert loader.retry_budget.spent <= 0.2
         finally:
             server.stop()
+
+
+# ------------------------------------------------------ durable-store chaos
+class TestPersistFaultDegrade:
+    def test_enospc_during_save_degrades_and_recovers(self, chaos_env, tmp_path):
+        """The ISSUE's ENOSPC acceptance scenario, end to end over the real
+        composition: disk faults during the store persist must NOT kill the
+        tick — serve keeps publishing from memory, /healthz reports
+        degraded with krr_tpu_persist_failures_total incrementing, and the
+        first fault-free tick persists the whole backlog."""
+        env = chaos_env
+        state_path = str(tmp_path / "state")
+        from tests.fakes.chaos import FaultyFs
+        from krr_tpu.core.streaming import FS
+
+        faulty = FaultyFs(("append", "fsync"))
+        probes: dict = {}
+
+        async def on_tick(server, sample):
+            if sample.tick == 0:
+                # Install the disk fault for ticks 1-2 on THIS store only.
+                server.scheduler.durable.fs = faulty
+            if sample.tick == 2:
+                server.scheduler.durable.fs = FS  # fault clears before tick 3
+            if sample.tick in (1, 2, 3):
+                health = (await http_get(server.port, "/healthz")).json()
+                probes[sample.tick] = {
+                    "health": health,
+                    "failures": metric_value(
+                        (await http_get(server.port, "/metrics")).text,
+                        "krr_tpu_persist_failures_total",
+                    ),
+                    "epoch": server.scheduler.durable.epoch,
+                    "pending": len(server.state.store.pending_ops()),
+                }
+
+        config = chaos_config(
+            env,
+            hysteresis_enabled=False,
+            other_args={"state_path": state_path},
+        )
+        report = run(
+            run_soak(config, env["fleet"].backend, None, ticks=4, tick_seconds=TICK,
+                     on_tick=on_tick)
+        )
+
+        # Every tick published — persist faults degrade, never abort.
+        assert [t.ok for t in report.ticks] == [True] * 4
+        # Mid-fault posture: degraded verdict, counter climbing, epoch
+        # parked, backlog queued.
+        assert probes[1]["health"]["status"] == "degraded"
+        assert probes[1]["health"]["persist_failing"] is True
+        assert probes[1]["health"]["last_persist_error"]
+        assert probes[1]["failures"] == 1.0
+        assert probes[2]["failures"] == 2.0
+        assert probes[2]["epoch"] == probes[1]["epoch"] == 1  # only tick 0 persisted
+        assert probes[2]["pending"] > 0
+        # Fault-free tick 3: persists the backlog in one record, recovers
+        # the verdict.
+        assert probes[3]["health"]["status"] == "ok"
+        assert probes[3]["health"]["persist_failing"] is False
+        assert probes[3]["health"]["persist_failures"] == 2
+        assert probes[3]["epoch"] == 2 and probes[3]["pending"] == 0
+
+        # The recovered directory holds exactly the in-memory final state.
+        from krr_tpu.core.durastore import DurableStore
+        from krr_tpu.strategies.tdigest import TDigestStrategySettings
+
+        disk = DurableStore.open(state_path, TDigestStrategySettings().cpu_spec())
+        equal, detail = stores_bitexact(disk.store, report.store)
+        assert equal, detail
+        assert disk.store.extra_meta["serve_last_end"] == report.store.extra_meta["serve_last_end"]
+        disk.close()
+
+
+class TestSigkillSoak:
+    def test_sigkill_soak_restarts_to_last_durable_publish_bitexact(self, tmp_path):
+        """THE acceptance soak: a real serve subprocess over the chaos
+        fakes, SIGKILLed at 8 random points across a 10-tick schedule
+        (mid-fetch, mid-fold, mid-journal-append, mid-WAL-append, and —
+        with the compaction floor forced tiny — mid-compaction), restarted
+        from the same state directory each time. Every restart must
+        reconstruct the last durable publish (an unrecoverable store fails
+        the rerun loudly), and the completed schedule must converge BIT-
+        exact with a never-killed control run — store arrays, key order,
+        and window cursor alike."""
+        import os
+
+        from tests.fakes.chaos import run_kill_soak
+
+        fleet = build_fleet(
+            (
+                ArchetypeSpec("diurnal", workloads=2, pods=1),
+                ArchetypeSpec("bursty-batch", workloads=2, pods=1),
+            ),
+            samples=240,
+            seed=13,
+        )
+        server = ServerThread(fleet.backend).start()
+        try:
+            kubeconfig = write_kubeconfig(tmp_path / "kubeconfig", server.url)
+            state = str(tmp_path / "state")
+            control = str(tmp_path / "control")
+
+            def payload(state_path: str) -> dict:
+                return dict(
+                    kubeconfig=kubeconfig,
+                    prometheus_url=server.url,
+                    strategy="tdigest",
+                    quiet=True,
+                    server_port=0,
+                    scan_interval_seconds=TICK,
+                    hysteresis_enabled=False,
+                    # Tiny compaction floor: the WAL crosses it every few
+                    # ticks, so kills also land inside compactions and
+                    # restarts recover across manifest flips.
+                    store_compact_min_wal_mb=0.002,
+                    prometheus_retry_deadline_seconds=1.0,
+                    prometheus_backoff_cap_seconds=0.2,
+                    other_args={
+                        "history_duration": 1,
+                        "timeframe_duration": 1,
+                        "state_path": state_path,
+                    },
+                )
+
+            ticks = [ORIGIN + 3600.0 + i * TICK for i in range(10)]
+            env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            report = run_kill_soak(
+                payload(state), ticks, kills=8, seed=17,
+                cfg_path=str(tmp_path / "soak.json"), repo_root=repo, env=env,
+            )
+            assert report["kills"] == 8
+            assert report["runs"] >= 9  # 8 killed runs + >=1 completing run
+            run_kill_soak(
+                payload(control), ticks, kills=0, seed=18,
+                cfg_path=str(tmp_path / "control.json"), repo_root=repo, env=env,
+            )
+        finally:
+            server.stop()
+
+        from krr_tpu.core.durastore import DurableStore
+        from krr_tpu.strategies.tdigest import TDigestStrategySettings
+
+        spec = TDigestStrategySettings().cpu_spec()
+        soaked = DurableStore.open(state, spec)
+        clean = DurableStore.open(control, spec)
+        equal, detail = stores_bitexact(soaked.store, clean.store)
+        assert equal, detail
+        assert soaked.store.extra_meta["serve_last_end"] == clean.store.extra_meta["serve_last_end"]
+        # Both runs' stores saw every tick: the soaked one compacted at
+        # least once (the tiny floor guarantees it), and its epoch counts
+        # every durable publish the control made.
+        assert soaked.epoch == clean.epoch == len(ticks)
+        soaked.close()
+        clean.close()

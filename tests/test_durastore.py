@@ -1,0 +1,637 @@
+"""Durable sharded digest store: every durability claim proven by fault
+injection, not inspection.
+
+* WAL framing: delta appends replay bit-exact; torn tails (cuts at sampled
+  offsets) and bit flips truncate to the last valid record deterministically.
+* Base snapshots: a corrupt shard fails LOUDLY with the offending file named.
+* Crash-point matrix: a simulated crash at EVERY fs-op boundary inside a
+  persist and inside a compaction recovers to a durable state.
+* Legacy migration: single-file state auto-migrates bit-exact (interrupted
+  migrations resume); ``--store_format legacy`` stays byte-compatible.
+* Epoch protocol: journal-ahead-of-store truncates deterministically;
+  store-ahead warns and keeps history.
+* Hygiene: stale ``*.tmp``/unreferenced files sweep at open; ``.lock``
+  files no longer accumulate; ``atomic_write`` fsyncs the parent directory
+  after the rename.
+"""
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.durastore import MANIFEST_NAME, WAL_MAGIC, DurableStore
+from krr_tpu.core.streaming import FS, DigestStore, FsOps, atomic_write
+from krr_tpu.history.journal import FLAG_EPOCH, RecommendationJournal
+from krr_tpu.ops.digest import DigestSpec
+
+from .fakes.chaos import CrashPointFs, FaultyFs, SimulatedCrash
+
+SPEC = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=64)
+
+
+def fold_window(store: DigestStore, keys: "list[str]", seed: int) -> None:
+    """One deterministic synthetic window fold (sparse counts, like a real
+    delta tick's contribution)."""
+    rng = np.random.default_rng(seed)
+    n = len(keys)
+    counts = np.zeros((n, SPEC.num_buckets), np.float32)
+    occupied = rng.integers(0, SPEC.num_buckets, size=(n, 4))
+    for i in range(n):
+        counts[i, occupied[i]] += rng.integers(1, 5, size=4)
+    store.merge_window(
+        keys,
+        counts,
+        counts.sum(axis=1),
+        rng.gamma(2.0, 0.3, n).astype(np.float32),
+        counts.sum(axis=1),
+        rng.uniform(50, 400, n).astype(np.float32),
+    )
+
+
+def snapshot(store: DigestStore) -> dict:
+    return {
+        "keys": list(store.keys),
+        "cpu_counts": store.cpu_counts.copy(),
+        "cpu_total": store.cpu_total.copy(),
+        "cpu_peak": store.cpu_peak.copy(),
+        "mem_total": store.mem_total.copy(),
+        "mem_peak": store.mem_peak.copy(),
+        "extra": dict(store.extra_meta),
+    }
+
+
+def assert_matches(store: DigestStore, snap: dict) -> None:
+    assert store.keys == snap["keys"]
+    for field in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak"):
+        np.testing.assert_array_equal(getattr(store, field), snap[field], err_msg=field)
+    assert store.extra_meta == snap["extra"]
+
+
+def build_ticks(path: str, ticks: int = 5, *, compact_min_bytes: int = 1 << 30) -> "list[dict]":
+    """A store dir with ``ticks`` delta records in the WAL (compaction held
+    off) — returns the per-epoch snapshots [after tick 0, after tick 1, …]
+    prefixed by the base (epoch-0) snapshot."""
+    durable = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=compact_min_bytes)
+    snaps = [snapshot(durable.store)]
+    for t in range(ticks):
+        fold_window(durable.store, [f"w{i}" for i in range(t + 2)], seed=t)
+        durable.store.extra_meta["serve_last_end"] = 1000.0 + t
+        durable.save_delta()
+        snaps.append(snapshot(durable.store))
+    durable.close()
+    return snaps
+
+
+class TestDeltaWal:
+    def test_delta_appends_replay_bitexact(self, tmp_path):
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=5)
+        durable = DurableStore.open(path, SPEC, shard_rows=3)
+        assert durable.epoch == 5
+        assert durable._wal_records == 5
+        assert_matches(durable.store, snaps[-1])
+        durable.close()
+
+    def test_whole_store_folds_elide_keys_and_replay_bitexact(self, tmp_path):
+        """The seasoned serve tick folds every resident row in row order:
+        its WAL record must elide the (fleet-sized) key list, and replay of
+        the elided record — the direct-CSR fast path — must still be
+        bit-exact, peaks included."""
+        path = str(tmp_path / "state")
+        durable = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=1 << 30)
+        fold_window(durable.store, ["a", "b", "c"], seed=0)  # grows: keys carried
+        durable.save_delta()
+        for t in (1, 2):  # seasoned ticks: same rows, same order -> elided
+            fold_window(durable.store, ["a", "b", "c"], seed=t)
+            durable.save_delta()
+        snap = snapshot(durable.store)
+        durable.close()
+        wal_name = json.load(open(os.path.join(path, MANIFEST_NAME)))["wal"]
+        blob = open(os.path.join(path, wal_name), "rb").read()
+        # Record 1 (growing) carries keys; records 2-3 (seasoned) do not.
+        metas = []
+        pos = len(WAL_MAGIC)
+        import io as io_mod
+
+        import numpy as np_mod
+
+        while pos < len(blob):
+            length, _ = struct.unpack_from("<II", blob, pos)
+            payload = blob[pos + 8 : pos + 8 + length]
+            with np_mod.load(io_mod.BytesIO(payload), allow_pickle=False) as data:
+                metas.append(json.loads(bytes(data["meta"]).decode()))
+            pos += 8 + length
+        assert "keys" in metas[0]["ops"][0]
+        assert "keys" not in metas[1]["ops"][0]
+        assert "keys" not in metas[2]["ops"][0]
+        reopened = DurableStore.open(path, SPEC, shard_rows=3)
+        assert_matches(reopened.store, snap)
+        reopened.close()
+
+    def test_drop_and_grow_ops_replay(self, tmp_path):
+        path = str(tmp_path / "state")
+        durable = DurableStore.open(path, SPEC, shard_rows=2, compact_min_bytes=1 << 30)
+        fold_window(durable.store, ["a", "b", "c", "d"], seed=1)
+        durable.save_delta()
+        durable.store.compact({"a", "c"})  # churn compaction drops b, d
+        durable.store.rows_for(["e"])  # resume-path growth: empty row
+        durable.save_delta()
+        snap = snapshot(durable.store)
+        assert snap["keys"] == ["a", "c", "e"]
+        durable.close()
+        reopened = DurableStore.open(path, SPEC, shard_rows=2)
+        assert_matches(reopened.store, snap)
+        reopened.close()
+
+    def test_compaction_folds_wal_into_bases_and_sweeps(self, tmp_path):
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=4)
+        durable = DurableStore.open(path, SPEC, shard_rows=2)
+        old_wal = durable._wal_name
+        assert durable.maybe_compact(force=True)
+        assert durable._wal_records == 0
+        assert durable._wal_name != old_wal
+        assert not os.path.exists(os.path.join(path, old_wal))
+        # Shards are contiguous row ranges of shard_rows.
+        manifest = json.load(open(os.path.join(path, MANIFEST_NAME)))
+        assert [s["rows"] for s in manifest["shards"]] == [2, 2, 1]
+        assert manifest["epoch"] == 4
+        durable.close()
+        reopened = DurableStore.open(path, SPEC, shard_rows=2)
+        assert_matches(reopened.store, snaps[-1])
+        assert reopened.epoch == 4
+        reopened.close()
+
+    def test_threshold_triggers_compaction(self, tmp_path):
+        path = str(tmp_path / "state")
+        durable = DurableStore.open(
+            path, SPEC, shard_rows=4, compact_min_bytes=1, compact_wal_ratio=0.01
+        )
+        fold_window(durable.store, ["a", "b"], seed=0)
+        durable.save_delta()  # crosses the (tiny) threshold -> compacts
+        assert durable._wal_records == 0
+        assert durable.epoch == 1
+        durable.close()
+
+
+class TestTornTails:
+    def test_cut_at_sampled_offsets_recovers_last_valid_record(self, tmp_path):
+        """The torn-tail property: for cuts sampled across the whole WAL
+        (record boundaries, ±1 byte, mid-record, inside the frame header),
+        recovery reconstructs exactly the state after the last record that
+        remains whole."""
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=5)
+        wal_name = json.load(open(os.path.join(path, MANIFEST_NAME)))["wal"]
+        wal_path = os.path.join(path, wal_name)
+        blob = open(wal_path, "rb").read()
+
+        # Parse the frame boundaries ourselves (independent of the code
+        # under test): offsets[k] = end of record k.
+        offsets = [len(WAL_MAGIC)]
+        pos = len(WAL_MAGIC)
+        while pos < len(blob):
+            length, _crc = struct.unpack_from("<II", blob, pos)
+            pos += 8 + length
+            offsets.append(pos)
+        assert len(offsets) == 6  # base + 5 records
+
+        cuts = set()
+        for k, end in enumerate(offsets):
+            cuts.update({end, end - 1, end + 1, end + 4})
+        rng = np.random.default_rng(3)
+        cuts.update(int(c) for c in rng.integers(len(WAL_MAGIC), len(blob), 8))
+        for cut in sorted(c for c in cuts if len(WAL_MAGIC) <= c <= len(blob)):
+            with open(wal_path, "wb") as f:
+                f.write(blob[:cut])
+            survivors = sum(1 for end in offsets[1:] if end <= cut)
+            durable = DurableStore.open(path, SPEC, shard_rows=3)
+            assert durable.epoch == survivors, f"cut at {cut}"
+            assert_matches(durable.store, snaps[survivors])
+            # The torn tail was truncated on disk: reopening is clean.
+            assert os.path.getsize(wal_path) == offsets[survivors]
+            durable.close()
+        # Restore for other assertions' sake.
+        with open(wal_path, "wb") as f:
+            f.write(blob)
+
+    def test_bitflips_truncate_from_corrupt_record(self, tmp_path):
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=4)
+        wal_name = json.load(open(os.path.join(path, MANIFEST_NAME)))["wal"]
+        wal_path = os.path.join(path, wal_name)
+        blob = bytearray(open(wal_path, "rb").read())
+        offsets = [len(WAL_MAGIC)]
+        pos = len(WAL_MAGIC)
+        while pos < len(blob):
+            length, _crc = struct.unpack_from("<II", blob, pos)
+            pos += 8 + length
+            offsets.append(pos)
+
+        rng = np.random.default_rng(5)
+        flip_at = sorted(int(x) for x in rng.integers(len(WAL_MAGIC), len(blob), 6))
+        for flip in flip_at:
+            corrupted = bytearray(blob)
+            corrupted[flip] ^= 0x40
+            with open(wal_path, "wb") as f:
+                f.write(corrupted)
+            # Every record whose bytes end at or before the flip survives.
+            survivors = sum(1 for end in offsets[1:] if end <= flip)
+            durable = DurableStore.open(path, SPEC, shard_rows=3)
+            assert durable.epoch == survivors, f"flip at {flip}"
+            assert_matches(durable.store, snaps[survivors])
+            durable.close()
+            with open(wal_path, "wb") as f:
+                f.write(blob)
+
+    def test_flipped_wal_header_resets_to_base(self, tmp_path):
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=3)
+        wal_name = json.load(open(os.path.join(path, MANIFEST_NAME)))["wal"]
+        wal_path = os.path.join(path, wal_name)
+        blob = bytearray(open(wal_path, "rb").read())
+        blob[2] ^= 0xFF
+        with open(wal_path, "wb") as f:
+            f.write(blob)
+        durable = DurableStore.open(path, SPEC, shard_rows=3)
+        assert durable.epoch == 0
+        assert_matches(durable.store, snaps[0])
+        durable.close()
+
+
+class TestCorruptBases:
+    def test_corrupt_shard_fails_loudly_naming_the_file(self, tmp_path):
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=2)
+        durable = DurableStore.open(path, SPEC, shard_rows=2)
+        durable.maybe_compact(force=True)
+        shard = durable._shards[0]["file"]
+        durable.close()
+        shard_path = os.path.join(path, shard)
+        blob = bytearray(open(shard_path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(shard_path, "wb") as f:
+            f.write(blob)
+        with pytest.raises(ValueError, match=f"(?s){shard}.*checksum"):
+            DurableStore.open(path, SPEC, shard_rows=2)
+
+    def test_missing_shard_fails_loudly(self, tmp_path):
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=2)
+        durable = DurableStore.open(path, SPEC, shard_rows=2)
+        durable.maybe_compact(force=True)
+        shard = durable._shards[0]["file"]
+        durable.close()
+        os.unlink(os.path.join(path, shard))
+        with pytest.raises(ValueError, match=shard):
+            DurableStore.open(path, SPEC, shard_rows=2)
+
+    def test_corrupt_manifest_fails_loudly(self, tmp_path):
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=1)
+        with open(os.path.join(path, MANIFEST_NAME), "w") as f:
+            f.write("{not json")
+        with pytest.raises(ValueError, match="manifest"):
+            DurableStore.open(path, SPEC)
+
+    def test_spec_mismatch_fails_like_legacy(self, tmp_path):
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=1)
+        other = DigestSpec(gamma=1.02, min_value=1e-7, num_buckets=64)
+        with pytest.raises(ValueError, match="incompatible"):
+            DurableStore.open(path, other)
+
+
+class TestCrashPointMatrix:
+    def test_crash_at_every_fs_op_in_a_persist_recovers_durably(self, tmp_path):
+        """Simulated crash at EVERY fs-op boundary inside save_delta: the
+        reopened store must equal either the pre-persist durable state or
+        the post-persist state (the record landed before the crash), never
+        anything else — and a follow-up persist must succeed."""
+        base_path = str(tmp_path / "probe")
+        counter = CrashPointFs(crash_at=None)
+        durable = DurableStore.open(base_path, SPEC, shard_rows=3, fs=counter, compact_min_bytes=1 << 30)
+        fold_window(durable.store, ["a", "b"], seed=0)
+        before = counter.calls
+        durable.save_delta()
+        ops_per_persist = counter.calls - before
+        durable.close()
+        assert ops_per_persist >= 2  # append + fsync at minimum
+
+        for crash_at in range(ops_per_persist):
+            path = str(tmp_path / f"crash-{crash_at}")
+            durable = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=1 << 30)
+            fold_window(durable.store, ["a", "b"], seed=1)
+            durable.store.extra_meta["serve_last_end"] = 111.0
+            durable.save_delta()
+            pre = snapshot(durable.store)
+            pre_epoch = durable.epoch
+            fold_window(durable.store, ["a", "b", "c"], seed=2)
+            durable.store.extra_meta["serve_last_end"] = 222.0
+            post = snapshot(durable.store)
+            durable.fs = CrashPointFs(crash_at=crash_at)
+            with pytest.raises(SimulatedCrash):
+                durable.save_delta()
+            durable.close()  # the dead process's fds
+            recovered = DurableStore.open(path, SPEC, shard_rows=3)
+            assert recovered.epoch in (pre_epoch, pre_epoch + 1), f"crash at {crash_at}"
+            assert_matches(recovered.store, pre if recovered.epoch == pre_epoch else post)
+            # And the directory is healthy: the next persist goes through.
+            fold_window(recovered.store, ["a", "b", "c"], seed=3)
+            recovered.save_delta()
+            recovered.close()
+
+    def test_crash_at_every_fs_op_in_a_compaction_preserves_state(self, tmp_path):
+        """Compaction never changes logical state: a crash at ANY fs-op
+        inside it must recover bit-exact to the pre-compaction state, from
+        either the old manifest generation or the new one."""
+        probe_path = str(tmp_path / "probe")
+        snaps = build_ticks(probe_path, ticks=3)
+        counter = CrashPointFs(crash_at=None)
+        durable = DurableStore.open(probe_path, SPEC, shard_rows=2, fs=counter)
+        before = counter.calls
+        durable.maybe_compact(force=True)
+        ops_per_compact = counter.calls - before
+        durable.close()
+        assert ops_per_compact >= 5  # shards + wal + manifest fsyncs
+
+        for crash_at in range(ops_per_compact):
+            path = str(tmp_path / f"compact-crash-{crash_at}")
+            snaps = build_ticks(path, ticks=3)
+            durable = DurableStore.open(path, SPEC, shard_rows=2)
+            durable.fs = CrashPointFs(crash_at=crash_at)
+            with pytest.raises(SimulatedCrash):
+                durable.maybe_compact(force=True)
+            durable.close()
+            recovered = DurableStore.open(path, SPEC, shard_rows=2)
+            assert_matches(recovered.store, snaps[-1])
+            assert recovered.epoch == 3, f"crash at {crash_at}"
+            recovered.close()
+
+
+class TestDiskFaultDegrade:
+    def test_enospc_keeps_memory_intact_and_backlog_persists_later(self, tmp_path):
+        path = str(tmp_path / "state")
+        durable = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=1 << 30)
+        fold_window(durable.store, ["a", "b"], seed=0)
+        durable.save_delta()
+        # Two ticks under ENOSPC: both persists fail, ops queue up.
+        faulty = FaultyFs(("append", "fsync"))
+        durable.fs = faulty
+        for t in (1, 2):
+            fold_window(durable.store, ["a", "b"], seed=t)
+            durable.store.extra_meta["serve_last_end"] = 100.0 + t
+            with pytest.raises(OSError):
+                durable.save_delta()
+        assert faulty.faults >= 2
+        assert durable.epoch == 1
+        assert len(durable.store.pending_ops()) == 2
+        # The scheduler compacts the backlog on failure so a sustained
+        # outage pins sparse captures, not dense window matrices — the
+        # re-encoded ops must persist and replay identically.
+        durable.store.compact_pending()
+        assert [op[0] for op in durable.store.pending_ops()] == ["fold_csr", "fold_csr"]
+        in_memory = snapshot(durable.store)
+        # Disk still holds only tick 0.
+        check = DurableStore.open(path, SPEC, shard_rows=3)
+        assert check.epoch == 1
+        check.close()
+        # Fault clears: ONE persist carries the backlog.
+        durable.fs = FS
+        durable.save_delta()
+        assert durable.epoch == 2 and not durable.store.pending_ops()
+        durable.close()
+        recovered = DurableStore.open(path, SPEC, shard_rows=3)
+        assert_matches(recovered.store, in_memory)
+        recovered.close()
+
+    def test_wal_unlinked_by_another_process_fails_loudly(self, tmp_path):
+        """A live handle whose WAL was replaced under it (a second process
+        compacting the same directory — exclusive ownership violated) must
+        fail the persist LOUDLY instead of fsync-acknowledging ticks into
+        an orphaned inode recovery can never see."""
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=2)
+        owner = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=1 << 30)
+        intruder = DurableStore.open(path, SPEC, shard_rows=3)
+        intruder.maybe_compact(force=True)  # unlinks the owner's live WAL
+        intruder.close()
+        fold_window(owner.store, ["a"], seed=0)
+        with pytest.raises(OSError, match="exclusively owned"):
+            owner.save_delta()
+        assert owner.store.pending_ops()  # nothing acknowledged
+        owner.close()
+
+    def test_partial_append_truncates_before_next_persist(self, tmp_path):
+        """An append that wrote SOME bytes before failing (ENOSPC part-way)
+        must not leave a torn prefix in front of the next record."""
+        path = str(tmp_path / "state")
+        durable = DurableStore.open(path, SPEC, shard_rows=3, compact_min_bytes=1 << 30)
+        fold_window(durable.store, ["a"], seed=0)
+        durable.save_delta()
+
+        class HalfWriteFs(FsOps):
+            def append(self, f, data: bytes) -> None:
+                f.write(data[: len(data) // 2])
+                raise OSError(28, "No space left on device")
+
+        durable.fs = HalfWriteFs()
+        fold_window(durable.store, ["a"], seed=1)
+        with pytest.raises(OSError):
+            durable.save_delta()
+        durable.fs = FS
+        durable.save_delta()  # truncates the torn half-frame, then appends
+        final = snapshot(durable.store)
+        durable.close()
+        recovered = DurableStore.open(path, SPEC, shard_rows=3)
+        assert_matches(recovered.store, final)
+        assert recovered.epoch == 2
+        recovered.close()
+
+
+class TestLegacyMigration:
+    def make_legacy(self, path: str) -> DigestStore:
+        store = DigestStore(spec=SPEC, keys=["a", "b", "c"])
+        fold_window(store, ["a", "b", "c"], seed=9)
+        store.extra_meta = {"serve_last_end": 777.0, "serve_quarantine": {"a": 1.0}}
+        store.save(path)
+        return store
+
+    def test_legacy_file_auto_migrates_bitexact(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        legacy = self.make_legacy(path)
+        durable = DurableStore.open(path, SPEC, shard_rows=2)
+        assert os.path.isdir(path)
+        assert not os.path.exists(path + ".migrating")
+        assert_matches(durable.store, snapshot(legacy))
+        assert durable.epoch == 0
+        durable.close()
+        # Idempotent: a second open recovers the directory.
+        again = DurableStore.open(path, SPEC, shard_rows=2)
+        assert_matches(again.store, snapshot(legacy))
+        again.close()
+
+    def test_interrupted_migration_resumes_from_sidecar(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        legacy = self.make_legacy(path)
+        # Simulate a crash after the rename but before the manifest commit:
+        # the legacy bytes sit in the sidecar, the dir is partial garbage.
+        os.replace(path, path + ".migrating")
+        os.makedirs(path)
+        with open(os.path.join(path, "base-00000000-0000.npz"), "wb") as f:
+            f.write(b"partial")
+        durable = DurableStore.open(path, SPEC, shard_rows=2)
+        assert_matches(durable.store, snapshot(legacy))
+        assert not os.path.exists(path + ".migrating")
+        durable.close()
+
+    def test_store_format_legacy_stays_byte_compatible(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        self.make_legacy(path)
+        durable = DurableStore.open(path, SPEC, store_format="legacy")
+        assert durable.fmt == "legacy" and os.path.isfile(path)
+        fold_window(durable.store, ["a", "b", "c"], seed=10)
+        durable.save_delta()  # legacy full rewrite
+        durable.close()
+        assert os.path.isfile(path)
+        # The file is a plain legacy snapshot: the pre-durastore loader
+        # reads it directly, CSR fields and all.
+        loaded = DigestStore.load(path)
+        assert loaded.keys == ["a", "b", "c"]
+        with np.load(path, allow_pickle=False) as data:
+            assert "csr_vals" in data.files
+        # And a sharded open on a DIRECTORY refuses --store_format legacy.
+        dir_path = str(tmp_path / "dir-state")
+        DurableStore.open(dir_path, SPEC).close()
+        with pytest.raises(ValueError, match="store_format legacy"):
+            DurableStore.open(dir_path, SPEC, store_format="legacy")
+
+    def test_open_or_create_reads_state_directories(self, tmp_path):
+        """One-shot readers (tdigest CLI, tests) see serve-written state
+        directories transparently through DigestStore.open_or_create — and
+        get an UNTRACKED store (no persistence engine drains the capture,
+        so a long-lived reader folding into it must not pin windows)."""
+        path = str(tmp_path / "state")
+        snaps = build_ticks(path, ticks=2)
+        store = DigestStore.open_or_create(path, SPEC)
+        assert_matches(store, snaps[-1])
+        assert store.track_deltas is False
+        fold_window(store, list(store.keys), seed=0)
+        assert not store.pending_ops()
+
+
+class TestEpochReconciliation:
+    def seed_journal(self, path: str, epochs: "list[int]") -> None:
+        journal = RecommendationJournal(path)
+        for i, epoch in enumerate(epochs):
+            journal.append_tick(
+                1000.0 + i * 60.0,
+                ["c/ns/w/main/Deployment", "c/ns/x/main/Deployment"],
+                np.asarray([0.5 + i, 0.6], np.float32),
+                np.asarray([100.0, 120.0], np.float32),
+                np.asarray([True, True]),
+                epoch=epoch,
+            )
+        journal.close()
+
+    def test_journal_ahead_truncates_to_store_epoch(self, tmp_path):
+        path = str(tmp_path / "serve.journal")
+        self.seed_journal(path, [1, 2, 3])
+        journal = RecommendationJournal(path)
+        assert journal.record_count == 6 and journal.last_epoch == 3
+        # The store only durably published epoch 2: the crash landed
+        # between tick 3's journal append and its store persist.
+        assert journal.reconcile_epoch(2) == "journal_ahead"
+        assert journal.record_count == 4
+        assert journal.last_epoch == 2
+        assert float(journal.newest_ts) == 1060.0
+        journal.close()
+        # The truncation is durable, not in-memory-only.
+        reread = RecommendationJournal(path)
+        assert reread.record_count == 4 and reread.last_epoch == 2
+        assert reread.reconcile_epoch(2) == "consistent"
+        reread.close()
+
+    def test_store_ahead_warns_and_keeps_history(self, tmp_path):
+        path = str(tmp_path / "serve.journal")
+        self.seed_journal(path, [1, 2])
+        journal = RecommendationJournal(path)
+        assert journal.reconcile_epoch(5) == "store_ahead"
+        assert journal.record_count == 4  # nothing dropped
+        journal.close()
+
+    def test_pre_epoch_journal_skips_reconciliation(self, tmp_path):
+        path = str(tmp_path / "serve.journal")
+        journal = RecommendationJournal(path)
+        journal.append_tick(
+            1000.0, ["c/ns/w/main/Deployment"],
+            np.asarray([0.5], np.float32), np.asarray([100.0], np.float32),
+            np.asarray([True]),
+        )
+        assert journal.reconcile_epoch(7) is None
+        assert journal.record_count == 1
+        journal.close()
+
+    def test_markers_invisible_to_readers(self, tmp_path):
+        path = str(tmp_path / "serve.journal")
+        self.seed_journal(path, [1, 2])
+        journal = RecommendationJournal(path, readonly=True)
+        recs = journal.records()
+        assert len(recs) == 4
+        assert not np.any(recs["flags"] & FLAG_EPOCH)
+        assert journal.last_epoch == 2
+        # Grouping and published reconstruction see recommendation rows only.
+        assert len(list(journal.records_by_workload())) == 2
+        assert len(journal.last_published()) == 2
+
+
+class TestHygiene:
+    def test_sweep_removes_stale_tmp_and_unreferenced_files(self, tmp_path):
+        path = str(tmp_path / "state")
+        build_ticks(path, ticks=1)
+        for stray in ("leftover.tmp", "base-99999999-0000.npz", "wal-99999999.log"):
+            with open(os.path.join(path, stray), "wb") as f:
+                f.write(b"junk")
+        with open(os.path.join(path, "operator-notes.txt"), "w") as f:
+            f.write("keep me")
+        durable = DurableStore.open(path, SPEC)
+        durable.close()
+        remaining = set(os.listdir(path))
+        assert "leftover.tmp" not in remaining
+        assert "base-99999999-0000.npz" not in remaining
+        assert "wal-99999999.log" not in remaining
+        assert "operator-notes.txt" in remaining  # only our patterns sweep
+
+    def test_locked_removes_lock_file(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        with DigestStore.locked(path):
+            assert os.path.exists(path + ".lock")
+        assert not os.path.exists(path + ".lock")
+
+    def test_atomic_write_fsyncs_file_then_renames_then_fsyncs_dir(self, tmp_path):
+        events: "list[tuple]" = []
+
+        class RecordingFs(FsOps):
+            def fsync(self, f):
+                events.append(("fsync",))
+                super().fsync(f)
+
+            def replace(self, src, dst):
+                events.append(("replace", dst))
+                super().replace(src, dst)
+
+            def fsync_dir(self, path):
+                events.append(("fsync_dir", path))
+                super().fsync_dir(path)
+
+        target = str(tmp_path / "out.bin")
+        with atomic_write(target, fs=RecordingFs()) as f:
+            f.write(b"payload")
+        assert [e[0] for e in events] == ["fsync", "replace", "fsync_dir"]
+        assert events[1][1] == target
+        assert events[2][1] == str(tmp_path)
+        assert open(target, "rb").read() == b"payload"
