@@ -842,6 +842,154 @@ def analyze_smoke_leg(tracer, secondary: dict, check) -> None:
     )
 
 
+def sentinel_leg(secondary: dict, check) -> None:
+    """Regression-sentinel gates (`krr_tpu.obs.sentinel` over
+    `krr_tpu.obs.timeline`): two synthetic 60-tick timelines sharing
+    byte-identical noise — a clean control and a twin with one injected
+    fetch-transport regression (ttfb bulge) and one injected compute
+    regression — driven through the SAME trend_report/sentinel code that
+    serves ``GET /debug/timeline`` and ``krr-tpu analyze --trend``. Four
+    parity-style gates:
+
+    * detection — both injected regressions produce regressed verdicts;
+    * attribution — the verdicts name fetch_transport (ttfb-dominated) and
+      compute at the injected ticks;
+    * zero false positives — the clean control produces NO verdicts, and
+      the injected run flags only the injected ticks;
+    * recorder overhead — the full per-tick recorder cost (record build +
+      durable CRC-framed fsync'd append + sentinel classification) stays
+      under 2% of the obs leg's measured scan wall (10 ms absolute floor,
+      like the tracing-overhead gate).
+    """
+    import copy
+    import tempfile
+
+    import numpy as np
+
+    from krr_tpu.obs.sentinel import RegressionSentinel, trend_report
+    from krr_tpu.obs.timeline import ScanTimeline
+
+    ticks = max(20, int(os.environ.get("BENCH_SENTINEL_TICKS", 60)))
+    rng = np.random.default_rng(47)
+    base = {
+        "fetch_transport": 0.9,
+        "fetch_decode": 0.25,
+        "fetch_backoff": 0.0,
+        "fetch_other": 0.1,
+        "fold": 0.2,
+        "compute": 0.35,
+        "discover": 0.05,
+        "publish": 0.05,
+        "other": 0.0,
+        "idle": 0.1,
+    }
+
+    def record(i: int) -> dict:
+        cats = {k: round(v * float(1.0 + rng.normal(0, 0.04)), 6) for k, v in base.items()}
+        phases = {
+            "ttfb": round(0.5 * float(1.0 + rng.normal(0, 0.05)), 6),
+            "body_read": round(0.3 * float(1.0 + rng.normal(0, 0.05)), 6),
+            "connect": round(0.05 * float(1.0 + rng.normal(0, 0.05)), 6),
+        }
+        return {
+            "v": 1,
+            "ts": 1e9 + i * 300.0,
+            "scan_id": f"bench-{i}",
+            "kind": "delta",
+            "wall": round(sum(cats.values()), 6),
+            "categories": cats,
+            "phases": phases,
+            "rows": 256,
+            "failed_rows": 0,
+            "wire_bytes": 1 << 22,
+            "queries": 16,
+            "retries": 0,
+            "publish": {"changed": 3, "suppressed": 1},
+            "persist": {"seconds": 0.02, "bytes": 4096, "epoch": i + 1, "failing": False},
+            "plan": {"coalesced": 2, "sharded": 1},
+        }
+
+    clean = [record(i) for i in range(ticks)]
+    injected = copy.deepcopy(clean)
+    fetch_at, compute_at = int(ticks * 0.6), int(ticks * 0.85)
+    for i in (fetch_at, fetch_at + 1):
+        injected[i]["categories"]["fetch_transport"] = round(
+            injected[i]["categories"]["fetch_transport"] + 3.0, 6
+        )
+        injected[i]["phases"]["ttfb"] = round(injected[i]["phases"]["ttfb"] + 2.8, 6)
+        injected[i]["wall"] = round(injected[i]["wall"] + 3.0, 6)
+    for i in (compute_at, compute_at + 1):
+        injected[i]["categories"]["compute"] = round(
+            injected[i]["categories"]["compute"] + 2.0, 6
+        )
+        injected[i]["wall"] = round(injected[i]["wall"] + 2.0, 6)
+    injected_ts = {injected[i]["ts"] for i in
+                   (fetch_at, fetch_at + 1, compute_at, compute_at + 1)}
+
+    control = trend_report(clean, warmup_scans=8)
+    report = trend_report(injected, warmup_scans=8)
+    fetch_verdicts = [v for v in report["regressions"] if v["dominant"] == "fetch_transport"]
+    compute_verdicts = [v for v in report["regressions"] if v["dominant"] == "compute"]
+    detected = bool(fetch_verdicts) and bool(compute_verdicts)
+    attributed = (
+        any(v["ts"] == injected[fetch_at]["ts"] and "ttfb-dominated" in v["suspect"]
+            for v in fetch_verdicts)
+        and any(v["ts"] == injected[compute_at]["ts"] for v in compute_verdicts)
+    )
+    spurious = [v for v in report["regressions"] if v["ts"] not in injected_ts]
+    no_false_positives = control["regressed"] == 0 and not spurious
+
+    # Recorder overhead: the whole per-tick cost — durable append (CRC frame
+    # + fsync) plus sentinel classification — against a real scan wall.
+    sentinel = RegressionSentinel(warmup_scans=8)
+    with tempfile.TemporaryDirectory() as tmp:
+        timeline = ScanTimeline.open(os.path.join(tmp, "timeline.log"))
+        start = time.perf_counter()
+        for r in injected:
+            timeline.append(r)
+            sentinel.observe(r, fire=False)
+        recorder_seconds = time.perf_counter() - start
+        timeline.close()
+    per_tick = recorder_seconds / ticks
+    scan_wall = float(secondary.get("obs_plain_scan_seconds") or 0.0)
+    overhead_pct = 100.0 * per_tick / scan_wall if scan_wall > 0 else 0.0
+
+    secondary["sentinel_ticks"] = float(ticks)
+    secondary["sentinel_clean_regressions"] = float(control["regressed"])
+    secondary["sentinel_injected_regressions"] = float(report["regressed"])
+    secondary["sentinel_recorder_seconds_per_tick"] = round(per_tick, 6)
+    secondary["timeline_overhead_pct"] = round(overhead_pct, 3)
+    print(
+        f"bench: sentinel {ticks}-tick timeline: injected run flagged "
+        f"{report['regressed']} (fetch_transport {len(fetch_verdicts)}, compute "
+        f"{len(compute_verdicts)}), clean control {control['regressed']}; recorder "
+        f"{per_tick * 1e3:.2f} ms/tick ({overhead_pct:.2f}% of a "
+        f"{scan_wall:.3f}s scan)",
+        file=sys.stderr,
+    )
+    check(
+        "sentinel_detects_injected",
+        detected,
+        f"fetch verdicts {len(fetch_verdicts)}, compute verdicts {len(compute_verdicts)}",
+    )
+    check(
+        "sentinel_attribution_correct",
+        attributed,
+        f"regressions: {[(v['ts'], v['dominant'], v['suspect']) for v in report['regressions']]}",
+    )
+    check(
+        "sentinel_zero_false_positives",
+        no_false_positives,
+        f"clean {control['regressed']}, spurious {[(v['ts'], v['dominant']) for v in spurious]}",
+    )
+    check(
+        "timeline_overhead<2%",
+        per_tick <= max(0.02 * scan_wall, 0.010),
+        f"recorder {per_tick * 1e3:.2f} ms/tick vs scan wall {scan_wall:.4f}s "
+        f"({overhead_pct:.2f}%)",
+    )
+
+
 def obs_device_leg(secondary: dict, check) -> None:
     """Device-observability leg (`krr_tpu.obs.device`): the SAME compute —
     one `SimpleStrategy.run_batch` over a fixed synthetic fleet — run with
@@ -1204,6 +1352,12 @@ def main() -> None:
         # sub-spans + fencing added by `krr_tpu.obs.device`.
         obs_leg(secondary, check)
         obs_device_leg(secondary, check)
+        # Sentinel gates (`krr_tpu.obs.sentinel` over `krr_tpu.obs.timeline`):
+        # injected regressions on a synthetic timeline must be detected and
+        # correctly attributed, a clean control must stay silent, and the
+        # flight recorder's per-tick cost must stay under 2% of a scan wall.
+        # Runs after obs_leg: the overhead gate reads its measured scan wall.
+        sentinel_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_CHAOS"):
         # Chaos soak gates: degraded-publish semantics, recovery

@@ -237,6 +237,44 @@ class Config(pd.BaseModel):
     #: stores must not pay a base rewrite per handful of ticks.
     store_compact_min_wal_mb: float = pd.Field(16.0, ge=0)
 
+    # Scan flight recorder + regression sentinel (`krr_tpu.obs.timeline`,
+    # `krr_tpu.obs.sentinel`) — serve-only: each completed tick appends one
+    # durable timeline record, and the sentinel classifies it against
+    # rolling median/MAD baselines.
+    #: Timeline file override. None = derive from the strategy's state_path
+    #: (``<state_dir>/timeline.log`` in a sharded state directory,
+    #: ``<state_path>.timeline`` beside a legacy single file); an explicit
+    #: empty string keeps the recorder memory-only even with a state_path.
+    timeline_path: Optional[str] = None
+    #: Scan records the recorder retains (in memory and, via retention
+    #: compaction, on disk).
+    timeline_retain_records: int = pd.Field(4096, ge=1)
+    #: The --no-sentinel escape hatch: False records the timeline without
+    #: classifying it.
+    sentinel_enabled: bool = True
+    #: Nominal scans of a kind (full|delta) the sentinel must observe
+    #: before issuing verdicts for that kind — a cold server must not page
+    #: on its first tick.
+    sentinel_warmup_scans: int = pd.Field(8, ge=2)
+    #: Rolling baseline window: nominal values per (kind, category) the
+    #: median/MAD bands are computed over. Also the consecutive-regression
+    #: count after which a sustained level shift rebases as the new normal.
+    sentinel_baseline_scans: int = pd.Field(64, ge=2)
+    #: Deviation threshold in band units: a category regresses when its
+    #: value exceeds ``median + sigma × max(1.4826·MAD, floors)``.
+    sentinel_sigma: float = pd.Field(3.0, gt=0)
+    #: Relative band floor as a fraction of the median — keeps a
+    #: near-constant series (MAD ≈ 0) from flagging noise.
+    sentinel_rel_floor: float = pd.Field(0.10, ge=0)
+    #: Absolute band floor in seconds (same purpose, for tiny medians).
+    sentinel_abs_floor_seconds: float = pd.Field(0.05, ge=0)
+    #: Register the optional ``scan_regressions`` SLO objective: regressed
+    #: scans burn its error budget like aborted scans burn scan_failures'.
+    sentinel_slo_enabled: bool = False
+    #: Error budget for that objective: the fraction of classified scans
+    #: allowed to regress before the budget burns.
+    sentinel_slo_budget: float = pd.Field(0.10, gt=0, le=1)
+
     #: Staleness budget for quarantined workloads: how old a quarantined
     #: workload's last folded sample may grow while its digests carry
     #: forward. Past the budget the workload's accumulated row is dropped

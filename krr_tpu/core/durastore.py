@@ -98,6 +98,13 @@ def _crc(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
 
 
+#: Public aliases for sibling durable logs that REUSE this framing (the
+#: scan timeline, `krr_tpu.obs.timeline`): same ``[u32 LE payload_len]
+#: [u32 LE crc32(payload)][payload]`` frames, same torn-tail discipline.
+FRAME = _FRAME
+frame_crc = _crc
+
+
 class DurableStore:
     """A resident :class:`DigestStore` plus its durable on-disk form.
 
@@ -143,6 +150,13 @@ class DurableStore:
         #: Set when an append failed part-way: the next persist truncates
         #: the file back to the last known-good size before writing.
         self._wal_dirty_tail = False
+
+    @property
+    def wal_size(self) -> int:
+        """Bytes in the live WAL (header included) — the public read the
+        scheduler uses to attribute per-tick appended bytes; 0 for the
+        legacy single-file format."""
+        return self._wal_size
 
     # ------------------------------------------------------------------ open
     @classmethod

@@ -595,16 +595,123 @@ def _server_options() -> list[click.Option]:
             panel="Server Settings",
             help="...for this many consecutive scan ticks (then it jumps to the current raw value).",
         ),
+        # Dual-name boolean: a single inverted flag (is_flag + flag_value=
+        # False) silently loses its default=True under click 8.3 — the serve
+        # CLI was running every deployment with hysteresis OFF. The
+        # documented --no-hysteresis switch is unchanged.
         PanelOption(
-            ["--no-hysteresis", "hysteresis_enabled"],
-            is_flag=True,
-            flag_value=False,
+            ["--hysteresis/--no-hysteresis", "hysteresis_enabled"],
             default=True,
             panel="Server Settings",
             help=(
-                "Publish every recompute verbatim (no dead-band gate) — "
-                "bit-exact legacy behavior; the journal still records every tick."
+                "--no-hysteresis publishes every recompute verbatim (no "
+                "dead-band gate) — bit-exact legacy behavior; the journal "
+                "still records every tick."
             ),
+        ),
+        PanelOption(
+            ["--timeline-path", "timeline_path"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "Scan flight-recorder file (one durable record per completed "
+                "tick; GET /debug/timeline, krr-tpu analyze --trend). Default: "
+                "derived from --state_path (timeline.log inside the state "
+                "directory); pass an empty string to keep the recorder "
+                "memory-only."
+            ),
+        ),
+        PanelOption(
+            ["--timeline-retain", "timeline_retain_records"],
+            type=int,
+            default=Config.model_fields["timeline_retain_records"].default,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Scan records the flight recorder retains (retention "
+                "compaction bounds the file for arbitrarily long serves)."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel/--no-sentinel", "sentinel_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "--no-sentinel records the scan timeline without classifying "
+                "it: no regression verdicts, metrics, or /statusz trend section."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-warmup", "sentinel_warmup_scans"],
+            type=int,
+            default=Config.model_fields["sentinel_warmup_scans"].default,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Nominal scans per kind (full|delta) the sentinel observes "
+                "before issuing regression verdicts for that kind."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-baseline", "sentinel_baseline_scans"],
+            type=int,
+            default=Config.model_fields["sentinel_baseline_scans"].default,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Rolling baseline window: nominal values per category the "
+                "median/MAD bands cover (also the consecutive-regression "
+                "count after which a sustained level shift rebases)."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-sigma", "sentinel_sigma"],
+            type=float,
+            default=Config.model_fields["sentinel_sigma"].default,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Deviation threshold in band units: a category regresses "
+                "past median + sigma x max(1.4826*MAD, floors)."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-rel-floor", "sentinel_rel_floor"],
+            type=float,
+            default=Config.model_fields["sentinel_rel_floor"].default,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Relative band floor (fraction of the median): keeps a "
+                "near-constant category from flagging noise as regression."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-abs-floor", "sentinel_abs_floor_seconds"],
+            type=float,
+            default=Config.model_fields["sentinel_abs_floor_seconds"].default,
+            show_default=True,
+            panel="Server Settings",
+            help="Absolute band floor in seconds (the same guard for tiny medians).",
+        ),
+        PanelOption(
+            ["--sentinel-slo", "sentinel_slo_enabled"],
+            is_flag=True,
+            default=False,
+            panel="SLO Settings",
+            help=(
+                "Register the scan_regressions SLO objective: sentinel-"
+                "regressed scans burn its error budget like aborted scans "
+                "burn scan_failures'."
+            ),
+        ),
+        PanelOption(
+            ["--sentinel-slo-budget", "sentinel_slo_budget"],
+            type=float,
+            default=Config.model_fields["sentinel_slo_budget"].default,
+            show_default=True,
+            panel="SLO Settings",
+            help="Error budget for --sentinel-slo: the fraction of classified scans allowed to regress.",
         ),
     ]
 
@@ -944,13 +1051,81 @@ def _make_analyze_command() -> click.Command:
     decode vs fold vs compute vs idle), the what-if-fetch-were-free
     estimate, and the critical path itself. Input is a ``--trace`` Chrome
     JSON file from any scan/serve run, or ``--url`` against a live server
-    (fetches its ``/debug/trace`` ring)."""
+    (fetches its ``/debug/trace`` ring). ``--trend`` switches to the scan
+    TIMELINE instead (`krr_tpu.obs.sentinel` over the flight recorder's
+    records): per-scan regression verdicts with baseline bands, from a
+    ``--timeline`` file or a live server's ``/debug/timeline``."""
 
-    def callback(trace: Any, url: Any, n: int, fmt: str, output: Any) -> None:
+    def _render_out(rendered: str, output: Any) -> None:
+        if output:
+            with open(output, "w") as f:
+                f.write(rendered)
+        else:
+            click.echo(rendered, nl=False)
+
+    def _trend(timeline: Any, url: Any, n: int, fmt: str, output: Any) -> None:
+        import json
+
+        from krr_tpu.obs.sentinel import render_trend_text, trend_report
+        from krr_tpu.obs.timeline import ScanTimeline
+
+        if (timeline is None) == (url is None):
+            raise click.UsageError(
+                "pass exactly one of --timeline FILE or --url URL with --trend"
+            )
+        live_report = None
+        if timeline is not None:
+            try:
+                # Read EVERYTHING: warm-up and baselines are honest only
+                # over the full timeline (the HTTP route does the same);
+                # -n limits the rendered records below, never the replay.
+                records = ScanTimeline.read_records(timeline)
+            except OSError as e:
+                raise click.UsageError(f"cannot read timeline file {timeline}: {e}") from e
+            except ValueError as e:
+                raise click.UsageError(str(e)) from e
+        else:
+            import urllib.error
+            import urllib.request
+
+            target = url.rstrip("/") + "/debug/timeline?format=json" + (
+                f"&n={n}" if n > 0 else ""
+            )
+            try:
+                with urllib.request.urlopen(target, timeout=30) as response:
+                    payload = json.load(response)
+            except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
+                raise click.UsageError(f"cannot fetch {target}: {e}") from e
+            records = payload.get("records", [])
+            # The server already replayed the FULL retained timeline with
+            # the live sentinel's configured band knobs — prefer its trend
+            # over a default-knob recompute, so offline verdicts can't
+            # contradict /statusz on a server running custom --sentinel-*.
+            live_report = payload.get("trend")
+        if not records:
+            # A fresh server (or empty file) is a benign state, not an error.
+            click.echo("no completed scans recorded yet — the timeline is empty")
+            return
+        report = live_report or trend_report(records)
+        shown = records[-n:] if n > 0 else records
+        rendered = (
+            json.dumps({"records": shown, "trend": report}, indent=2) + "\n"
+            if fmt == "json"
+            else render_trend_text(report, shown)
+        )
+        _render_out(rendered, output)
+
+    def callback(
+        trace: Any, url: Any, n: int, fmt: str, output: Any, trend: bool, timeline: Any
+    ) -> None:
         import json
 
         from krr_tpu.obs.profile import profile_chrome_payload, render_text
 
+        if trend or timeline is not None:
+            if trace is not None:
+                raise click.UsageError("--trend reads a --timeline file (or --url), not --trace")
+            return _trend(timeline, url, n, fmt, output)
         if (trace is None) == (url is None):
             raise click.UsageError("pass exactly one of --trace FILE or --url URL")
         if trace is not None:
@@ -972,14 +1147,19 @@ def _make_analyze_command() -> click.Command:
             except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
                 raise click.UsageError(f"cannot fetch {target}: {e}") from e
         report = profile_chrome_payload(payload, n=n)
+        if url is not None and not report["scans"]:
+            # A live server whose trace ring is empty is a FRESH server, not
+            # a broken one: say so plainly and exit clean instead of dumping
+            # an empty report and a confusing error.
+            click.echo(
+                "no completed scans yet — the server's trace ring is empty "
+                "(retry after the first scheduler tick)"
+            )
+            return
         rendered = (
             json.dumps(report, indent=2) + "\n" if fmt == "json" else render_text(report)
         )
-        if output:
-            with open(output, "w") as f:
-                f.write(rendered)
-        else:
-            click.echo(rendered, nl=False)
+        _render_out(rendered, output)
         if not report["scans"]:
             raise click.ClickException("trace holds no completed scan spans")
 
@@ -995,7 +1175,28 @@ def _make_analyze_command() -> click.Command:
             PanelOption(
                 ["--url", "url"],
                 default=None,
-                help="Base URL of a live krr-tpu serve instance; reads its /debug/trace ring.",
+                help=(
+                    "Base URL of a live krr-tpu serve instance; reads its "
+                    "/debug/trace ring (or /debug/timeline with --trend)."
+                ),
+            ),
+            PanelOption(
+                ["--trend", "trend"],
+                is_flag=True,
+                default=False,
+                help=(
+                    "Analyze the scan TIMELINE instead of a trace: replay the "
+                    "flight recorder's records through the regression sentinel "
+                    "(baseline bands, per-scan verdicts, suspect layers)."
+                ),
+            ),
+            PanelOption(
+                ["--timeline", "timeline"],
+                default=None,
+                help=(
+                    "Scan timeline file (timeline.log in the serve state "
+                    "directory); implies --trend."
+                ),
             ),
             PanelOption(
                 ["-n", "n"],
@@ -1021,7 +1222,8 @@ def _make_analyze_command() -> click.Command:
             "Attribute a recorded scan's wall clock across fetch transport/decode, "
             "fold, compute, publish, and idle; estimate the wall if fetch were "
             "free; and print the critical path. Reads a --trace file or a live "
-            "server's /debug/trace ring."
+            "server's /debug/trace ring. With --trend: replay the scan timeline "
+            "through the regression sentinel instead."
         ),
     )
 

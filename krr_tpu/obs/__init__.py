@@ -28,14 +28,27 @@ Prometheus text-format registry.
   the ``/healthz`` ``degraded`` verdict ride on it.
 * `dump`    — SIGUSR2 on-demand debug dumps (trace ring + metrics
   snapshot to timestamped files).
+* `timeline` — the durable scan flight recorder: one CRC-framed record
+  per completed serve tick (category seconds, transport phases, fetch
+  plan, publish/persist outcome), crash-safe beside the durable store.
+* `sentinel` — the regression sentinel: rolling median/MAD baselines
+  over the timeline, per-scan nominal/regressed verdicts attributed to
+  the dominant deviating category and its suspect layer.
 """
 
 from krr_tpu.obs.device import NULL_DEVICE_OBS, DeviceObs, install_compile_hooks
 from krr_tpu.obs.health import Objective, SloEngine, default_objectives
 from krr_tpu.obs.metrics import MetricsRegistry, record_build_info, refresh_process_metrics
+from krr_tpu.obs.sentinel import RegressionSentinel, render_trend_text, trend_report
+from krr_tpu.obs.timeline import ScanTimeline, build_scan_record
 from krr_tpu.obs.trace import NULL_TRACER, NullTracer, Span, Tracer, current_ids, write_chrome_trace
 
 __all__ = [
+    "RegressionSentinel",
+    "ScanTimeline",
+    "build_scan_record",
+    "render_trend_text",
+    "trend_report",
     "DeviceObs",
     "MetricsRegistry",
     "NULL_DEVICE_OBS",

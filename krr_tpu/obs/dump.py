@@ -55,17 +55,29 @@ def debug_dump(
     trace_target: Optional[str] = None,
     metrics_target: Optional[str] = None,
     logger=None,
-) -> tuple[str, str, str]:
+    timeline=None,
+    sentinel=None,
+) -> tuple[str, ...]:
     """Write the trace ring + a metrics exposition snapshot + the ring's
-    critical-path attribution report; returns the three paths. Never raises
-    past logging — a debug aid must not take down the process it is
-    inspecting."""
+    critical-path attribution report — and, when the process carries a scan
+    flight recorder (serve), a fourth artifact: the timeline's records with
+    the sentinel trend report over them (`krr_tpu.obs.sentinel` — the same
+    JSON ``GET /debug/timeline`` serves). Returns the written paths (three,
+    or four with a timeline). Never raises past logging — a debug aid must
+    not take down the process it is inspecting."""
+    import json
+
     from krr_tpu.obs.profile import write_profile_report
 
     stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
     trace_path = _dump_path(trace_target, "krr-tpu-trace", stamp, ".json")
     metrics_path = _dump_path(metrics_target, "krr-tpu-metrics", stamp, ".prom")
     profile_path = _dump_path(trace_target, "krr-tpu-profile", stamp, ".profile.json")
+    paths = [trace_path, metrics_path, profile_path]
+    trend_path = None
+    if timeline is not None:
+        trend_path = _dump_path(trace_target, "krr-tpu-trend", stamp, ".trend.json")
+        paths.append(trend_path)
     try:
         write_chrome_trace(tracer, trace_path)
         refresh_process_metrics(metrics)
@@ -74,20 +86,33 @@ def debug_dump(
         with open(metrics_path, "w") as f:
             f.write(metrics.render())
         write_profile_report(tracer, profile_path)
+        if timeline is not None:
+            from krr_tpu.obs.sentinel import sentinel_knobs, trend_report
+
+            records = timeline.records()
+            with open(trend_path, "w") as f:
+                json.dump(
+                    {
+                        "records": records,
+                        "trend": trend_report(records, **sentinel_knobs(sentinel)),
+                        "live": sentinel.status() if sentinel is not None else None,
+                    },
+                    f,
+                    indent=2,
+                )
+                f.write("\n")
     except Exception:
         if logger is not None:
-            logger.warning(
-                f"debug dump failed (trace={trace_path} metrics={metrics_path} "
-                f"profile={profile_path})"
-            )
+            logger.warning(f"debug dump failed ({' '.join(paths)})")
             logger.debug_exception()
-        return trace_path, metrics_path, profile_path
+        return tuple(paths)
     if logger is not None:
         logger.info(
             f"debug dump written: trace={trace_path} metrics={metrics_path} "
             f"profile={profile_path}"
+            + (f" trend={trend_path}" if trend_path else "")
         )
-    return trace_path, metrics_path, profile_path
+    return tuple(paths)
 
 
 def install_signal_dump(
@@ -98,10 +123,13 @@ def install_signal_dump(
     metrics_target: Optional[str] = None,
     logger=None,
     loop=None,
+    timeline=None,
+    sentinel=None,
 ) -> bool:
     """Install the SIGUSR2 handler. With ``loop`` (serve) it registers on
     the event loop; without (one-shot scans) through ``signal.signal``.
-    Returns whether a handler was installed (False off-unix)."""
+    Serve passes its flight recorder + sentinel so the dump gains the trend
+    artifact. Returns whether a handler was installed (False off-unix)."""
     if not hasattr(signal, "SIGUSR2"):
         return False
 
@@ -112,11 +140,18 @@ def install_signal_dump(
             trace_target=trace_target,
             metrics_target=metrics_target,
             logger=logger,
+            timeline=timeline,
+            sentinel=sentinel,
         )
 
     try:
         if loop is not None:
-            loop.add_signal_handler(signal.SIGUSR2, dump)
+            # Off the loop: a trend replay over a full retained timeline is
+            # real CPU (median/MAD over thousands of records) and the dump
+            # handler must not stall /healthz probes or the scheduler.
+            loop.add_signal_handler(
+                signal.SIGUSR2, lambda: loop.run_in_executor(None, dump)
+            )
         else:
             signal.signal(signal.SIGUSR2, dump)
     except (NotImplementedError, ValueError, OSError):

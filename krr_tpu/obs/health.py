@@ -133,6 +133,17 @@ class SloEngine:
             state.samples.append((now, 0.0, 0.0))
             self._state[objective.name] = state
 
+    def add_objective(self, objective: Objective, now: Optional[float] = None) -> None:
+        """Register an objective after construction (the serve composition
+        root appends the optional sentinel ``scan_regressions`` objective
+        once the sentinel exists) — same zero-baseline seeding as the
+        constructor, so its first evaluation covers everything since
+        registration."""
+        self.objectives.append(objective)
+        state = _AlertState()
+        state.samples.append((float(self.clock()) if now is None else float(now), 0.0, 0.0))
+        self._state[objective.name] = state
+
     # ----------------------------------------------------------- sampling
     def _sample(self, objective: Objective, state: _AlertState) -> None:
         if objective.sample is not None:

@@ -105,6 +105,14 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_pad_waste_pct", "gauge", "Padding waste of the last packed batch by resource: percent of the rectangular [rows x capacity] matrix that is padding, not real samples."),
     ("krr_tpu_packed_elements", "gauge", "Elements of the last packed batch by resource and kind — a partition: real samples plus padding sum to the rectangular [rows x capacity] matrix."),
     ("krr_tpu_device_memory_bytes", "gauge", "Device memory watermarks by device and kind (bytes_in_use|peak_bytes_in_use|bytes_limit) where the backend reports them (no-op on CPU)."),
+    # Scan flight recorder + regression sentinel (`krr_tpu.obs.timeline`,
+    # `krr_tpu.obs.sentinel`).
+    ("krr_tpu_timeline_records", "gauge", "Scan records retained by the flight recorder's in-memory ring (the durable timeline file may hold up to 2x before retention compaction)."),
+    ("krr_tpu_timeline_bytes", "gauge", "Bytes of the durable scan-timeline file (magic header + CRC-framed records); 0 for the memory-only recorder."),
+    ("krr_tpu_timeline_compactions_total", "counter", "Scan-timeline retention compactions: the file atomically rewritten down to the newest retain_records records."),
+    ("krr_tpu_timeline_append_failures_total", "counter", "Scan-timeline appends that failed on a disk fault (ENOSPC/EIO) — the record survives in memory only and the next append truncates the torn tail first."),
+    ("krr_tpu_scan_regression", "gauge", "Regression sentinel deviation by category: the last classified scan's sigmas above its median/MAD baseline band while that category is regressed, 0 while nominal."),
+    ("krr_tpu_scan_regressions_total", "counter", "Scans the regression sentinel classified as regressed, by the dominant deviating category."),
     # SLO engine (`krr_tpu.obs.health`).
     ("krr_tpu_slo_burn_rate", "gauge", "Error-budget burn rate by objective and window (fast|slow): windowed bad ratio divided by the objective's budget; 1.0 consumes exactly the budget over the window."),
     ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),
@@ -209,6 +217,13 @@ class MetricsRegistry:
         label. Summaries/histograms: pass the explicit ``_sum``/``_count``
         name."""
         return float(sum(self._values.get(name, {}).values()))
+
+    def series(self, name: str) -> "dict[tuple[tuple[str, str], ...], float]":
+        """Every labeled series of one metric (label tuple → value) — for
+        readers that need per-series values where a sum would lie (the
+        timeline recorder snapshots the per-target in-flight LIMIT gauge,
+        where summing across targets is meaningless)."""
+        return dict(self._values.get(name, {}))
 
     def histogram_buckets(
         self, name: str, **labels: str

@@ -32,6 +32,12 @@ Routes:
   fetch-decode / fold / compute / …), the what-if-fetch-were-free
   estimate, and the critical path per scan. JSON by default,
   ``?format=text`` for humans, ``?n=`` limits scans.
+* ``GET /debug/timeline`` — the durable scan flight recorder
+  (`krr_tpu.obs.timeline`): one compact record per completed tick
+  (category seconds, transport phases, fetch-plan shape, publish/persist
+  outcome) plus the regression sentinel's trend report over them
+  (`krr_tpu.obs.sentinel`). JSON by default, ``?format=text`` for humans,
+  ``?n=`` limits the records returned.
 """
 
 from __future__ import annotations
@@ -82,6 +88,31 @@ def _json_body(payload: dict) -> bytes:
     return (json.dumps(payload) + "\n").encode()
 
 
+def _count_param(
+    query: dict[str, list[str]], name: str = "n"
+) -> "tuple[Optional[int], Optional[tuple[int, str, bytes]]]":
+    """Shared ``?n=`` / count-parameter validation for the debug routes:
+    ``(value_or_None, error_response_or_None)``. A non-integer OR negative
+    value is a 400 with a JSON error — never a 500, and never a silently
+    absorbed ``-3`` (0 and absent both mean "all")."""
+    raw = (query.get(name) or ["0"])[-1]
+    try:
+        value = int(raw)
+    except ValueError:
+        return None, (
+            400,
+            "application/json",
+            _json_body({"error": f"{name} must be an integer, got {raw!r}"}),
+        )
+    if value < 0:
+        return None, (
+            400,
+            "application/json",
+            _json_body({"error": f"{name} must be >= 0, got {value}"}),
+        )
+    return (value if value > 0 else None), None
+
+
 class HttpApp:
     """Route table + HTTP/1.1 plumbing over a :class:`ServerState`.
 
@@ -114,6 +145,12 @@ class HttpApp:
         self.drift_dead_band_pct = float(drift_dead_band_pct)
         self.drift_confirm_ticks = int(drift_confirm_ticks)
         self.hysteresis_enabled = bool(hysteresis_enabled)
+        #: Trend-report memo for /debug/timeline: ``(key, report)`` where
+        #: the key is (record count, newest ts). The replay over a
+        #: full-retention timeline is real CPU (median/MAD over thousands
+        #: of records) and is IDENTICAL between scheduler ticks — a poller
+        #: must not burn a core-second per scrape recomputing it.
+        self._trend_memo: "Optional[tuple[tuple, dict]]" = None
         #: Open client connections, for shutdown: ``Server.close()`` stops
         #: the listener but never touches established keep-alive
         #: connections, and on Python ≥ 3.12.1 ``wait_closed()`` waits for
@@ -153,19 +190,20 @@ class HttpApp:
             return await self._debug_trace(query)
         if path == "/debug/profile":
             return await self._debug_profile(query)
+        if path == "/debug/timeline":
+            return await self._debug_timeline(query)
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
 
     async def _debug_trace(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
         """The last N completed scan ticks' spans as Chrome trace-event JSON
         (``?n=`` limits; default the whole ring). Rendered in a worker
         thread — a full ring of wide-fleet scans is thousands of events."""
-        try:
-            n = int((query.get("n") or ["0"])[-1])
-        except ValueError:
-            return 400, "application/json", _json_body({"error": "n must be an integer"})
+        n, error = _count_param(query)
+        if error is not None:
+            return error
 
         def render() -> bytes:
-            return _json_body(self.tracer.export_chrome(n if n > 0 else None))
+            return _json_body(self.tracer.export_chrome(n))
 
         return 200, "application/json", await asyncio.to_thread(render)
 
@@ -173,10 +211,9 @@ class HttpApp:
         """Critical-path attribution of the last N completed scan ticks
         (`krr_tpu.obs.profile` over the trace ring). Worker-thread rendered:
         the sweep walks every span of every ringed scan."""
-        try:
-            n = int((query.get("n") or ["0"])[-1])
-        except ValueError:
-            return 400, "application/json", _json_body({"error": "n must be an integer"})
+        n, error = _count_param(query)
+        if error is not None:
+            return error
         fmt = (query.get("format") or ["json"])[-1]
         if fmt not in ("json", "text"):
             return 400, "application/json", _json_body(
@@ -186,10 +223,61 @@ class HttpApp:
         def render() -> bytes:
             from krr_tpu.obs.profile import profile_traces, render_text
 
-            report = profile_traces(self.tracer.traces(n if n > 0 else None))
+            report = profile_traces(self.tracer.traces(n))
             if fmt == "text":
                 return render_text(report).encode()
             return _json_body(report)
+
+        content_type = "text/plain; charset=utf-8" if fmt == "text" else "application/json"
+        return 200, content_type, await asyncio.to_thread(render)
+
+    async def _debug_timeline(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """The scan flight recorder's records plus the sentinel trend report
+        over them (`krr_tpu.obs.timeline` / `krr_tpu.obs.sentinel`).
+        ``?n=`` limits the RECORDS returned; the trend always replays the
+        whole retained timeline so warm-up and baselines are honest."""
+        n, error = _count_param(query)
+        if error is not None:
+            return error
+        fmt = (query.get("format") or ["json"])[-1]
+        if fmt not in ("json", "text"):
+            return 400, "application/json", _json_body(
+                {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
+            )
+        timeline = self.state.timeline
+        if timeline is None:
+            return 404, "application/json", _json_body(
+                {"error": "no scan timeline on this server"}
+            )
+
+        def render() -> bytes:
+            from krr_tpu.obs.sentinel import render_trend_text, sentinel_knobs, trend_report
+
+            records = timeline.records()
+            sentinel = self.state.sentinel
+            key = (len(records), records[-1].get("ts") if records else None)
+            memo = self._trend_memo
+            if memo is not None and memo[0] == key:
+                report = memo[1]
+            else:
+                report = trend_report(records, **sentinel_knobs(sentinel))
+                # Benign race (worker threads): worst case is one duplicate
+                # compute, never a torn result — the tuple swap is atomic.
+                self._trend_memo = (key, report)
+            window = records[-(n or len(records)):]
+            if fmt == "text":
+                return render_trend_text(report, window).encode()
+            # Per-record verdicts follow the SAME window as the records:
+            # a full-retention timeline's verdict list is per-category
+            # deviation dicts for thousands of scans — multi-MB per scrape
+            # for data the regressions + status summaries already carry.
+            report = {**report, "verdicts": report["verdicts"][-(n or len(records)):]}
+            payload = {
+                "records": window,
+                "trend": report,
+                "live": sentinel.status() if sentinel is not None else None,
+            }
+            return _json_body(payload)
 
         content_type = "text/plain; charset=utf-8" if fmt == "text" else "application/json"
         return 200, content_type, await asyncio.to_thread(render)
@@ -205,12 +293,20 @@ class HttpApp:
             )
         fmt = (query.get("format") or ["json"])[-1]
         if fmt == "text":
-            return 200, "text/plain; charset=utf-8", engine.render_text().encode()
+            text = engine.render_text()
+            if self.state.sentinel is not None:
+                text += self._trend_text()
+            return 200, "text/plain; charset=utf-8", text.encode()
         if fmt != "json":
             return 400, "application/json", _json_body(
                 {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
             )
         payload = engine.status()
+        # The trend section: the sentinel's warm-up posture, current
+        # median/MAD bands, and the last verdict — serve-only, like the
+        # server summary below.
+        if self.state.sentinel is not None:
+            payload["trend"] = self.state.sentinel.status()
         # The serve-side degraded-state summary rides along (the one-shot
         # --statusz dump has no server, so this section is serve-only).
         payload["server"] = {
@@ -222,6 +318,30 @@ class HttpApp:
             "last_persist_error": self.state.last_persist_error,
         }
         return 200, "application/json", _json_body(payload)
+
+    def _trend_text(self) -> str:
+        """The human trend lines appended to ``/statusz?format=text``."""
+        sentinel = self.state.sentinel
+        status = sentinel.status()
+        lines = ["", "trend (regression sentinel):"]
+        for kind, posture in sorted(status["baselines"].items()):
+            flag = "warm" if posture["warmed"] else f"warming ({posture['observed']} seen)"
+            lines.append(f"  [{kind}] {flag}")
+        verdict = status.get("last_verdict")
+        if verdict is None:
+            lines.append("  no classified scans yet")
+        elif verdict["status"] == "regressed":
+            lines.append(
+                f"  last scan REGRESSED: {verdict['dominant']} "
+                f"+{verdict['sigma']:.1f}σ → {verdict['suspect']}"
+            )
+        else:
+            lines.append(f"  last scan: {verdict['status']}")
+        lines.append(
+            f"  {status['regressed_scans']} of {status['classified_scans']} "
+            f"classified scans regressed this process"
+        )
+        return "\n".join(lines) + "\n"
 
     async def _healthz(self) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
@@ -496,7 +616,7 @@ class HttpApp:
         route_label = (
             split.path
             if split.path
-            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace", "/debug/profile")
+            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace", "/debug/profile", "/debug/timeline")
             else "other"
         )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
@@ -620,6 +740,61 @@ class KrrServer:
         self.state.slo = engine_from_config(
             self.session.metrics, config, clock=clock, logger=self.logger
         )
+        # The scan flight recorder + regression sentinel
+        # (`krr_tpu.obs.timeline` / `krr_tpu.obs.sentinel`): the durable
+        # timeline lives beside the durable store (inside the sharded state
+        # directory, a ``.timeline`` sidecar beside a legacy single file);
+        # without a state path the recorder is memory-only — /debug/timeline
+        # and the sentinel still work, they just don't survive a restart.
+        import os as _os
+
+        from krr_tpu.obs.sentinel import RegressionSentinel
+        from krr_tpu.obs.timeline import ScanTimeline
+
+        timeline_path = config.timeline_path
+        if timeline_path is None and state_path:
+            timeline_path = (
+                _os.path.join(state_path, "timeline.log")
+                if self.durable is not None and self.durable.fmt == "sharded"
+                else f"{state_path}.timeline"
+            )
+        self.state.timeline = ScanTimeline.open(
+            timeline_path or None,
+            retain_records=config.timeline_retain_records,
+            metrics=self.session.metrics,
+            logger=self.logger,
+        )
+        if config.sentinel_enabled:
+            self.state.sentinel = RegressionSentinel(
+                warmup_scans=config.sentinel_warmup_scans,
+                baseline_scans=config.sentinel_baseline_scans,
+                sigma=config.sentinel_sigma,
+                rel_floor=config.sentinel_rel_floor,
+                abs_floor_seconds=config.sentinel_abs_floor_seconds,
+                metrics=self.session.metrics,
+                logger=self.logger,
+            )
+            # Baselines survive restarts by construction: the durable
+            # timeline replays through the same classification.
+            self.state.sentinel.seed(self.state.timeline.records())
+            if config.sentinel_slo_enabled and self.state.slo is not None:
+                from krr_tpu.obs.health import Objective
+
+                sentinel = self.state.sentinel
+                self.state.slo.add_objective(
+                    Objective(
+                        name="scan_regressions",
+                        description=(
+                            "Scans must stay inside their baseline cost bands: "
+                            "sentinel-regressed scans burn this budget."
+                        ),
+                        budget=config.sentinel_slo_budget,
+                        sample=lambda: (
+                            float(sentinel.regressed_scans),
+                            float(sentinel.classified_scans),
+                        ),
+                    )
+                )
         self.scheduler = ScanScheduler(
             self.session,
             self.state,
@@ -677,6 +852,8 @@ class KrrServer:
             self._server = None
         if self.state.journal is not None:
             self.state.journal.close()
+        if self.state.timeline is not None:
+            self.state.timeline.close()
         if self.durable is not None:
             self.durable.close()
         await self.session.close()
@@ -706,6 +883,8 @@ async def run_server(config: Config, *, logger: Optional[KrrLogger] = None) -> N
         metrics_target=config.metrics_dump_path,
         logger=server.logger,
         loop=loop,
+        timeline=server.state.timeline,
+        sentinel=server.state.sentinel,
     )
     try:
         await stop.wait()

@@ -133,6 +133,14 @@ class ScanScheduler:
         self.max_staleness = (
             float(getattr(config, "max_staleness_seconds", 0.0)) or 10.0 * self.scan_interval
         )
+        #: Last completed tick's distillables for the flight recorder
+        #: (`krr_tpu.obs.timeline`): window, rows, publish verdict, persist
+        #: outcome — consumed by :meth:`_observe_timeline` in run_once.
+        self.last_tick_stats: "Optional[dict]" = None
+        #: Cumulative fetch-plan counter totals at the last recorded tick,
+        #: so the timeline record carries per-TICK coalesced/sharded deltas
+        #: instead of process-lifetime sums.
+        self._plan_totals: "dict[str, float]" = {"coalesced": 0.0, "sharded": 0.0}
         #: key → grid-aligned start of the first window its fetch missed:
         #: the catch-up fetch's left edge. Persisted in the store's
         #: extra_meta (same atomic save as the cursor) — a restart must
@@ -686,8 +694,16 @@ class ScanScheduler:
         await self._recompute_and_publish(objects, rows, end)
         t4 = time.perf_counter()
 
+        persist_seconds = 0.0
+        persist_bytes = 0
         if self.state_path:
+            wal_before = self.durable.wal_size if self.durable is not None else 0
             await self._persist()
+            persist_seconds = time.perf_counter() - t4
+            # Appended WAL bytes (clamped: a threshold compaction inside
+            # the persist resets the WAL, which is not a negative append).
+            wal_after = self.durable.wal_size if self.durable is not None else 0
+            persist_bytes = max(0, wal_after - wal_before)
 
         metrics.inc("krr_tpu_scans_total", kind=kind)
         # Every object's fetch was ATTEMPTED this tick — the SLO fetch
@@ -758,6 +774,26 @@ class ScanScheduler:
             quarantined=len(self._quarantine),
         )
         self.state.last_scan_id = scan_span.trace_id
+        self.last_tick_stats = {
+            "scan_id": scan_span.trace_id,
+            "kind": kind,
+            "window_start": start,
+            "window_end": end,
+            "objects": len(objects),
+            "failed_rows": len(failed_keys),
+            "backfilled": len(fresh),
+            "stale": len(self._quarantine),
+            "publish_changed": self.state.last_publish_changed,
+            "publish_suppressed": self.state.last_publish_suppressed,
+            "persist_seconds": persist_seconds,
+            "persist_bytes": persist_bytes,
+            "persist_failing": self.state.persist_failing,
+            "epoch": (
+                self.durable.epoch
+                if self.durable is not None and self.durable.fmt == "sharded"
+                else None
+            ),
+        }
         self.logger.info(
             f"{kind} scan {scan_span.trace_id or ''} folded window [{start:.0f}, {end:.0f}] "
             f"({len(objects)} objects, {len(self.state.store.keys)} store rows): "
@@ -766,13 +802,55 @@ class ScanScheduler:
         )
         return True
 
+    # ----------------------------------------------- flight recorder hook
+    async def _observe_timeline(self) -> None:
+        """Distill the just-completed tick into one timeline record (from
+        the trace ring's newest trace + the tick stash), append it to the
+        flight recorder, and run the sentinel's classification. Failures
+        here degrade — the recorder must never take down the scan loop it
+        is recording."""
+        timeline = self.state.timeline
+        sentinel = self.state.sentinel
+        stats = self.last_tick_stats
+        if (timeline is None and sentinel is None) or stats is None:
+            return
+        if stats.get("scan_id") != self.state.last_scan_id:
+            return  # stale stash (defensive: the tick aborted after stashing)
+        from krr_tpu.obs.profile import profile_trace
+        from krr_tpu.obs.timeline import build_scan_record
+
+        report = None
+        for spans in reversed(self.session.tracer.traces()):
+            if spans and spans[0].trace_id == stats["scan_id"]:
+                report = profile_trace(spans)
+                break
+        metrics = self.state.metrics
+        plan_delta: dict[str, float] = {}
+        for key, metric in (
+            ("coalesced", "krr_tpu_fetch_plan_coalesced_total"),
+            ("sharded", "krr_tpu_fetch_plan_sharded_total"),
+        ):
+            total = metrics.total(metric)
+            plan_delta[key] = max(0.0, total - self._plan_totals[key])
+            self._plan_totals[key] = total
+        record = build_scan_record(
+            report, stats, metrics=metrics, slo=self.state.slo, plan_delta=plan_delta
+        )
+        self.last_tick_stats = None
+        if timeline is not None:
+            # The append fsyncs: off the loop like every other disk leg.
+            await asyncio.to_thread(timeline.append, record)
+        if sentinel is not None:
+            sentinel.observe(record)
+
     # ----------------------------------------------------------- the loop
     async def run_once(self) -> "Optional[bool]":
         """One guarded scheduler round: tick, count a failure if it aborts,
-        then evaluate the SLO engine — failures included, which is the
-        point: the burn-rate windows must see bad ticks the moment they
-        happen, not whenever the next healthy tick lands. Returns the
-        tick's result (None when it failed)."""
+        record the completed tick into the flight recorder (and classify it
+        through the sentinel), then evaluate the SLO engine — failures
+        included, which is the point: the burn-rate windows must see bad
+        ticks the moment they happen, not whenever the next healthy tick
+        lands. Returns the tick's result (None when it failed)."""
         did_scan: Optional[bool] = None
         try:
             did_scan = await self.tick()
@@ -786,6 +864,16 @@ class ScanScheduler:
             self.logger.debug_exception()
         else:
             self.state.consecutive_scan_failures = 0
+        if did_scan:
+            try:
+                await self._observe_timeline()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.warning(f"Scan timeline recording failed: {e}")
+                self.logger.debug_exception()
+        # Sentinel verdicts land BEFORE the SLO evaluation so the optional
+        # scan_regressions objective sees this tick's classification.
         if self.state.slo is not None:
             self.state.slo.evaluate()
         return did_scan

@@ -142,6 +142,15 @@ class ServerState:
         #: ``degraded`` while it has firing alerts. None for states built
         #: without a server (unit tests, embedders).
         self.slo: "Optional[SloEngine]" = None
+        #: The scan flight recorder (`krr_tpu.obs.timeline`): the scheduler
+        #: appends one record per completed tick, GET /debug/timeline and
+        #: the SIGUSR2 trend artifact read it. None for states built
+        #: without a server.
+        self.timeline = None
+        #: The regression sentinel (`krr_tpu.obs.sentinel`): classifies each
+        #: timeline record against rolling baselines; /statusz renders its
+        #: trend section. None when --no-sentinel (or no server).
+        self.sentinel = None
         #: Persistence posture (durable store saves): True while the last
         #: persist attempt failed (ENOSPC/EIO) — serve keeps publishing
         #: from memory, /healthz downgrades to ``degraded``, and the next

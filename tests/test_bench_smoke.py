@@ -66,6 +66,16 @@ def test_bench_smoke_end_to_end():
     # but assert the fields so a leg-skipping refactor can't pass silently).
     assert secondary.get("analyze_smoke") == "ok", secondary
     assert secondary.get("analyze_scans", 0) > 0, secondary
+    # The sentinel leg ran end-to-end: the injected fetch-transport and
+    # compute regressions on the synthetic timeline were detected and
+    # attributed, the clean control stayed silent, and the recorder's
+    # per-tick cost cleared the <2% overhead gate (gate failures are rc 1;
+    # assert the fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("sentinel_ticks", 0) >= 20, secondary
+    assert secondary.get("sentinel_injected_regressions", 0) >= 2, secondary
+    assert secondary.get("sentinel_clean_regressions") == 0.0, secondary
+    assert secondary.get("sentinel_recorder_seconds_per_tick", 1.0) > 0, secondary
+    assert "timeline_overhead_pct" in secondary, secondary
     # The fleet leg's transport-phase split and pipeline wait accounting
     # made it into the record (the real PrometheusLoader against the fake
     # backend: TTFB and body-read must have been observed).

@@ -714,6 +714,90 @@ class TestPersistFaultDegrade:
         disk.close()
 
 
+class TestScanSentinelSoak:
+    """The flight-recorder acceptance criteria (`krr_tpu.obs.timeline` /
+    `krr_tpu.obs.sentinel`) driven through the REAL serve composition: a
+    mid-run injected Prometheus latency regime must produce a sentinel
+    verdict attributed to fetch_transport within 3 ticks of onset, and a
+    long clean-control soak must produce ZERO regression verdicts."""
+
+    ONSET = 12  # the latency regime starts here (after the warm-up window)
+
+    def _config(self, env, state_path=None, **overrides):
+        other_args = {}
+        if state_path is not None:
+            other_args["state_path"] = state_path
+        return chaos_config(
+            env,
+            hysteresis_enabled=False,
+            sentinel_warmup_scans=6,
+            # CI-robust bands at toy scale: a clean tick's categories sit in
+            # the tens of milliseconds, so the absolute floor makes a
+            # verdict require ≥ 1.2 s of excess — far above even a loaded
+            # box's scheduler stalls, far below the injected latency's
+            # multi-second transport bulge.
+            sentinel_abs_floor_seconds=0.4,
+            other_args={**other_args},
+            **overrides,
+        )
+
+    def test_latency_regime_attributed_to_fetch_transport_within_3_ticks(self, chaos_env):
+        env = chaos_env
+        onset = self.ONSET
+        timeline = FaultTimeline([(onset, onset + 3, FaultSpec(latency_seconds=1.0))])
+        verdicts: "list[tuple[int, dict]]" = []
+
+        def on_tick(server, sample):
+            sentinel = server.state.sentinel
+            if sentinel is not None and sentinel.last_verdict is not None:
+                verdicts.append((sample.tick, dict(sentinel.last_verdict)))
+
+        report = run(
+            run_soak(
+                self._config(env), env["fleet"].backend, timeline,
+                ticks=onset + 5, tick_seconds=TICK, on_tick=on_tick,
+            )
+        )
+        assert all(t.ok for t in report.ticks)  # latency slows, never aborts
+        regressed = [
+            (tick, v) for tick, v in verdicts if v.get("status") == "regressed"
+        ]
+        assert regressed, "sentinel never fired across the latency regime"
+        first_tick, first = regressed[0]
+        # Within 3 ticks of onset, attributed to the transport category.
+        assert onset <= first_tick <= onset + 2, f"first verdict at tick {first_tick}"
+        assert first["dominant"] == "fetch_transport", first
+        assert first["sigma"] >= 3.0
+        assert "Prometheus" in first["suspect"] or "transport" in first["suspect"]
+        # The verdict also fired as the metric and counted toward the totals.
+        assert (
+            report.metrics.value(
+                "krr_tpu_scan_regressions_total", category="fetch_transport"
+            )
+            or 0.0
+        ) >= 1.0
+        # No pre-onset false positives (the post-onset clean tail may still
+        # flag while the elevated scans are excluded from the baseline).
+        assert all(tick >= onset for tick, _v in regressed)
+
+    def test_50_tick_clean_control_has_zero_verdicts(self, chaos_env):
+        env = chaos_env
+        report = run(
+            run_soak(
+                self._config(env), env["fleet"].backend, None,
+                ticks=50, tick_seconds=TICK,
+            )
+        )
+        assert all(t.ok for t in report.ticks)
+        sentinel = report.state.sentinel
+        assert sentinel.warmed("delta")
+        assert sentinel.classified_scans >= 40
+        assert sentinel.regressed_scans == 0, sentinel.last_verdict
+        assert (report.metrics.total("krr_tpu_scan_regressions_total") or 0.0) == 0.0
+        # 50 records on the in-memory recorder (no state path configured).
+        assert len(report.state.timeline.records()) == 50
+
+
 class TestSigkillSoak:
     def test_sigkill_soak_restarts_to_last_durable_publish_bitexact(self, tmp_path):
         """THE acceptance soak: a real serve subprocess over the chaos
@@ -795,4 +879,42 @@ class TestSigkillSoak:
         # every durable publish the control made.
         assert soaked.epoch == clean.epoch == len(ticks)
         soaked.close()
+
+        # --- the flight recorder's SIGKILL leg (`krr_tpu.obs.timeline`) ---
+        from krr_tpu.obs.sentinel import RegressionSentinel
+        from krr_tpu.obs.timeline import ScanTimeline
+
+        soaked_path = os.path.join(state, "timeline.log")
+        control_path = os.path.join(control, "timeline.log")
+        soaked_recs = ScanTimeline.read_records(soaked_path)
+        control_recs = ScanTimeline.read_records(control_path)
+        # The never-killed control recorded every tick; the killed run may
+        # have lost records for ticks killed between the store persist and
+        # the timeline append (their windows are folded, never re-run) but
+        # records most of the schedule.
+        assert len(control_recs) == len(ticks)
+        assert len(soaked_recs) >= len(ticks) - 8 and len(soaked_recs) >= 2
+        # Recovery truncated cleanly: re-OPENING the killed timeline is a
+        # no-op — the file read back is bit-identical to itself up to the
+        # last durable record (no torn bytes survived the kills).
+        before = open(soaked_path, "rb").read()
+        reopened = ScanTimeline.open(soaked_path)
+        assert reopened.records() == soaked_recs
+        reopened.close()
+        assert open(soaked_path, "rb").read() == before
+        # Structural agreement with the control at every shared tick: the
+        # recorded schedule is an ordered subset with identical window
+        # geometry and fleet shape (timing fields differ run to run).
+        by_ts = {r["ts"]: r for r in control_recs}
+        assert [r["ts"] for r in soaked_recs] == sorted(r["ts"] for r in soaked_recs)
+        for record in soaked_recs:
+            twin = by_ts.get(record["ts"])
+            assert twin is not None, f"tick {record['ts']} missing from control"
+            for field in ("kind", "rows", "failed_rows", "window_seconds"):
+                assert record[field] == twin[field], (field, record["ts"])
+        # Sentinel baselines survive the restarts: a sentinel seeded from
+        # the recovered timeline is warm without any re-warm-up window.
+        sentinel = RegressionSentinel(warmup_scans=4)
+        sentinel.seed(soaked_recs)
+        assert sentinel.warmed("delta")
         clean.close()

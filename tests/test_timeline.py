@@ -1,0 +1,666 @@
+"""Scan flight recorder + regression sentinel (`krr_tpu.obs.timeline`,
+`krr_tpu.obs.sentinel`).
+
+* Timeline durability: append/reopen bit-exactness, the torn-tail/bit-flip
+  truncation property matrix (the durastore discipline on the timeline's
+  framing — the recovered file is bit-identical to the original up to the
+  last durable record), retention compaction, degrade-on-disk-fault, and
+  the read-only ``analyze --trend`` parse.
+* Sentinel semantics: warm-up gating, median/MAD band detection, dominant-
+  category attribution with phase refinement, poison-proof baselines,
+  per-kind regimes, restart seeding, regime-acceptance rebase, and the
+  optional SLO objective's event counts.
+* Surfacing: ``GET /debug/timeline`` (and the shared ``?n=`` validation on
+  all three debug routes), the ``/statusz`` trend section, the SIGUSR2
+  trend artifact, and the ``analyze --trend`` / empty-ring CLI paths.
+"""
+
+import asyncio
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from krr_tpu.obs.metrics import MetricsRegistry
+from krr_tpu.obs.sentinel import RegressionSentinel, render_trend_text, trend_report
+from krr_tpu.obs.timeline import TIMELINE_MAGIC, ScanTimeline, build_scan_record
+from krr_tpu.obs.trace import Tracer
+
+from .fakes.chaos import FaultyFs
+
+BASE_CATEGORIES = {
+    "fetch_transport": 0.5,
+    "fetch_decode": 0.1,
+    "fetch_backoff": 0.0,
+    "fetch_other": 0.05,
+    "fold": 0.1,
+    "compute": 0.2,
+    "discover": 0.02,
+    "publish": 0.03,
+    "other": 0.0,
+    "idle": 0.05,
+}
+
+
+def make_record(i: int, kind: str = "delta", categories: dict | None = None, phases: dict | None = None, **overrides) -> dict:
+    cats = dict(BASE_CATEGORIES)
+    cats.update(categories or {})
+    record = {
+        "v": 1,
+        "ts": 1_000_000.0 + i * 300.0,
+        "scan_id": f"scan-{i}",
+        "kind": kind,
+        "wall": round(sum(cats.values()), 6),
+        "categories": cats,
+        "phases": {"ttfb": 0.3, "body_read": 0.15, "connect": 0.02, **(phases or {})},
+        "rows": 8,
+        "failed_rows": 0,
+        "stale_workloads": 0,
+        "wire_bytes": 1 << 20,
+        "queries": 4,
+        "retries": 0,
+        "publish": {"changed": 1, "suppressed": 0},
+        "persist": {"seconds": 0.01, "bytes": 512, "epoch": i + 1, "failing": False},
+        "plan": {"coalesced": 1, "sharded": 0},
+    }
+    record.update(overrides)
+    return record
+
+
+def frame_offsets(path: str) -> "tuple[bytes, list[int]]":
+    """(file bytes, [end offset of record k] prefixed by the header end) —
+    parsed independently of the code under test."""
+    blob = open(path, "rb").read()
+    offsets = [len(TIMELINE_MAGIC)]
+    pos = len(TIMELINE_MAGIC)
+    while pos < len(blob):
+        length, _crc = struct.unpack_from("<II", blob, pos)
+        pos += 8 + length
+        offsets.append(pos)
+    return blob, offsets
+
+
+# ------------------------------------------------------------------ timeline
+class TestScanTimeline:
+    def test_append_reopen_roundtrips_records(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        records = [make_record(i) for i in range(5)]
+        for record in records:
+            assert timeline.append(record) is True
+        timeline.close()
+        reopened = ScanTimeline.open(path)
+        assert reopened.records() == records
+        assert ScanTimeline.read_records(path) == records
+        reopened.close()
+
+    def test_torn_tail_matrix_recovers_bit_identical_prefix(self, tmp_path):
+        """The acceptance property: for cuts sampled across the whole file
+        (record boundaries, ±1 byte, inside the frame header, mid-record),
+        recovery keeps exactly the records that remain whole AND the
+        recovered file is BIT-identical to the original truncated at the
+        last durable record boundary."""
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        records = [make_record(i) for i in range(6)]
+        for record in records:
+            timeline.append(record)
+        timeline.close()
+        blob, offsets = frame_offsets(path)
+        assert len(offsets) == 7  # header + 6 records
+
+        cuts = set()
+        for end in offsets:
+            cuts.update({end, end - 1, end + 1, end + 4})
+        rng = np.random.default_rng(11)
+        cuts.update(int(c) for c in rng.integers(len(TIMELINE_MAGIC), len(blob), 8))
+        for cut in sorted(c for c in cuts if len(TIMELINE_MAGIC) <= c <= len(blob)):
+            with open(path, "wb") as f:
+                f.write(blob[:cut])
+            survivors = sum(1 for end in offsets[1:] if end <= cut)
+            recovered = ScanTimeline.open(path)
+            assert recovered.records() == records[:survivors], f"cut at {cut}"
+            recovered.close()
+            # Bit-identical to the never-torn file up to the last durable
+            # record: truncation cut exactly the torn bytes, nothing else.
+            assert open(path, "rb").read() == blob[: offsets[survivors]], f"cut at {cut}"
+        with open(path, "wb") as f:
+            f.write(blob)
+
+    def test_bitflips_truncate_from_corrupt_record(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        records = [make_record(i) for i in range(4)]
+        for record in records:
+            timeline.append(record)
+        timeline.close()
+        blob, offsets = frame_offsets(path)
+        rng = np.random.default_rng(13)
+        for flip in sorted(int(x) for x in rng.integers(len(TIMELINE_MAGIC), len(blob), 6)):
+            corrupted = bytearray(blob)
+            corrupted[flip] ^= 0x20
+            with open(path, "wb") as f:
+                f.write(corrupted)
+            survivors = sum(1 for end in offsets[1:] if end <= flip)
+            recovered = ScanTimeline.open(path)
+            assert recovered.records() == records[:survivors], f"flip at {flip}"
+            recovered.close()
+            with open(path, "wb") as f:
+                f.write(blob)
+
+    def test_flipped_header_resets(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        timeline.append(make_record(0))
+        timeline.close()
+        blob = bytearray(open(path, "rb").read())
+        blob[1] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(blob)
+        recovered = ScanTimeline.open(path)
+        assert recovered.records() == []
+        recovered.close()
+
+    def test_retention_compaction_bounds_the_file(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        registry = MetricsRegistry()
+        timeline = ScanTimeline.open(path, retain_records=4, metrics=registry)
+        for i in range(10):
+            timeline.append(make_record(i))
+        # 10 > 2*4 → at least one retention rewrite down to the ring.
+        assert registry.total("krr_tpu_timeline_compactions_total") >= 1
+        assert timeline.records() == [make_record(i) for i in range(6, 10)]
+        timeline.close()
+        reopened = ScanTimeline.open(path, retain_records=4)
+        assert reopened.records() == [make_record(i) for i in range(6, 10)]
+        reopened.close()
+
+    def test_open_with_lowered_retention_compacts_and_still_appends(self, tmp_path):
+        """Recovery-triggered compaction (the on-disk count exceeds a
+        lowered retain_records) must leave exactly one live append handle —
+        and appends after it must land durably."""
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path, retain_records=100)
+        for i in range(10):
+            timeline.append(make_record(i))
+        timeline.close()
+        reopened = ScanTimeline.open(path, retain_records=3)
+        assert reopened.records() == [make_record(i) for i in range(7, 10)]
+        assert reopened.append(make_record(10)) is True
+        reopened.close()
+        assert ScanTimeline.read_records(path) == [make_record(i) for i in range(7, 11)]
+
+    def test_disk_fault_degrades_and_next_append_truncates_tail(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        registry = MetricsRegistry()
+        timeline = ScanTimeline.open(path, metrics=registry)
+        assert timeline.append(make_record(0)) is True
+        # Fault the fsync: the append part-writes, marks the tail dirty,
+        # degrades to memory-only for that record.
+        timeline.fs = FaultyFs(ops=("fsync",))
+        assert timeline.append(make_record(1)) is False
+        assert registry.total("krr_tpu_timeline_append_failures_total") == 1.0
+        assert len(timeline.records()) == 2  # memory ring kept it
+        # Healed: the next append truncates the torn bytes first, so the
+        # durable file holds records 0 and 2 — both cleanly framed.
+        timeline.fs = type(timeline.fs).__mro__[1]()  # plain FsOps
+        assert timeline.append(make_record(2)) is True
+        timeline.close()
+        assert ScanTimeline.read_records(path) == [make_record(0), make_record(2)]
+
+    def test_failed_retention_compaction_degrades_and_retries(self, tmp_path):
+        """A disk fault during the retention rewrite must not undo the
+        append's durable verdict or escape to the caller — bookkeeping
+        re-derives from the file and a later (healed) append compacts."""
+        from krr_tpu.core.streaming import FsOps
+
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path, retain_records=2)
+        for i in range(4):
+            assert timeline.append(make_record(i)) is True
+        # The 5th append crosses 2*retain; the compaction's atomic rewrite
+        # faults at its rename (appends don't use replace, so the record
+        # itself commits durably first).
+        timeline.fs = FaultyFs(ops=("replace",))
+        assert timeline.append(make_record(4)) is True
+        assert ScanTimeline.read_records(path) == [make_record(i) for i in range(5)]
+        # Healed: the next append retries the compaction successfully.
+        timeline.fs = FsOps()
+        assert timeline.append(make_record(5)) is True
+        timeline.close()
+        assert ScanTimeline.read_records(path) == [make_record(4), make_record(5)]
+
+    def test_read_records_never_writes(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        timeline.append(make_record(0))
+        timeline.close()
+        with open(path, "ab") as f:
+            f.write(b"torn-tail-bytes")
+        before = open(path, "rb").read()
+        assert ScanTimeline.read_records(path) == [make_record(0)]
+        assert open(path, "rb").read() == before  # untouched, torn tail included
+
+    def test_memory_only_recorder(self):
+        timeline = ScanTimeline.open(None, retain_records=3)
+        for i in range(5):
+            assert timeline.append(make_record(i)) is False
+        assert [r["scan_id"] for r in timeline.records()] == ["scan-2", "scan-3", "scan-4"]
+        assert timeline.records(2) == [make_record(3), make_record(4)]
+        assert timeline.nbytes == 0
+
+
+class TestBuildScanRecord:
+    def test_distills_profile_and_stats(self):
+        from krr_tpu.obs.profile import profile_trace
+
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan", kind="serve"):
+            with tracer.span("fetch", namespace="default"):
+                pass
+            with tracer.span("compute", rows=2):
+                pass
+        report = profile_trace(tracer.traces()[-1])
+        registry = MetricsRegistry()
+        registry.set("krr_tpu_prom_inflight_limit", 24, cluster="fake")
+        stats = {
+            "scan_id": report["scan_id"],
+            "kind": "delta",
+            "window_start": 100.0,
+            "window_end": 400.0,
+            "objects": 2,
+            "failed_rows": 1,
+            "backfilled": 0,
+            "stale": 1,
+            "publish_changed": 2,
+            "publish_suppressed": 3,
+            "persist_seconds": 0.5,
+            "persist_bytes": 4096,
+            "epoch": 7,
+        }
+        record = build_scan_record(
+            report, stats, metrics=registry, plan_delta={"coalesced": 2, "sharded": 1}
+        )
+        assert record["kind"] == "delta" and record["ts"] == 400.0
+        assert record["window_seconds"] == 300.0
+        assert set(record["categories"]) == set(report["categories"])
+        assert record["rows"] == 2 and record["failed_rows"] == 1
+        assert record["publish"] == {"changed": 2, "suppressed": 3}
+        assert record["persist"]["epoch"] == 7 and record["persist"]["bytes"] == 4096
+        assert record["plan"] == {"coalesced": 2, "sharded": 1, "inflight_limit": 24.0}
+        # Records must be JSON-serializable as-is (the timeline frames JSON).
+        json.dumps(record)
+
+    def test_missing_profile_degrades_to_zeroes(self):
+        record = build_scan_record(None, {"kind": "full", "window_end": 50.0})
+        assert record["wall"] == 0.0 and record["categories"] == {}
+        json.dumps(record)
+
+
+# ------------------------------------------------------------------ sentinel
+class TestRegressionSentinel:
+    def _warm(self, sentinel: RegressionSentinel, n: int = 10, rng=None) -> int:
+        rng = rng or np.random.default_rng(0)
+        for i in range(n):
+            jitter = {
+                k: v * float(1.0 + rng.normal(0, 0.03)) for k, v in BASE_CATEGORIES.items()
+            }
+            verdict = sentinel.observe(make_record(i, categories=jitter), fire=False)
+            assert verdict["status"] in ("warming", "nominal")
+        return n
+
+    def test_warmup_gates_verdicts(self):
+        sentinel = RegressionSentinel(warmup_scans=4)
+        for i in range(4):
+            assert sentinel.observe(make_record(i), fire=False)["status"] == "warming"
+        assert sentinel.classified_scans == 0
+        assert sentinel.observe(make_record(4), fire=False)["status"] == "nominal"
+        assert sentinel.classified_scans == 1
+
+    def test_fetch_transport_regression_attributed_with_phase_detail(self):
+        registry = MetricsRegistry()
+        sentinel = RegressionSentinel(warmup_scans=4, metrics=registry)
+        n = self._warm(sentinel, 10)
+        bad = make_record(
+            n,
+            categories={"fetch_transport": 1.8},
+            phases={"ttfb": 1.6},
+        )
+        verdict = sentinel.observe(bad)
+        assert verdict["status"] == "regressed"
+        assert verdict["dominant"] == "fetch_transport"
+        assert verdict["sigma"] >= 3.0
+        assert "ttfb-dominated" in verdict["suspect"]
+        assert "Prometheus" in verdict["suspect"]
+        # Fired: the gauge carries the sigmas, the counter the dominant.
+        assert registry.value("krr_tpu_scan_regression", category="fetch_transport") > 0
+        assert (
+            registry.value("krr_tpu_scan_regressions_total", category="fetch_transport")
+            == 1.0
+        )
+        # A nominal scan right after zeroes the gauge.
+        sentinel.observe(make_record(n + 1))
+        assert registry.value("krr_tpu_scan_regression", category="fetch_transport") == 0.0
+
+    def test_compute_regression_attributed(self):
+        sentinel = RegressionSentinel(warmup_scans=4)
+        n = self._warm(sentinel, 10)
+        verdict = sentinel.observe(make_record(n, categories={"compute": 1.2}), fire=False)
+        assert verdict["status"] == "regressed" and verdict["dominant"] == "compute"
+        assert "compute" in verdict["suspect"]
+
+    def test_clean_noisy_series_stays_nominal(self):
+        sentinel = RegressionSentinel(warmup_scans=8)
+        rng = np.random.default_rng(7)
+        verdicts = []
+        for i in range(60):
+            jitter = {
+                k: v * float(1.0 + rng.normal(0, 0.05)) for k, v in BASE_CATEGORIES.items()
+            }
+            verdicts.append(sentinel.observe(make_record(i, categories=jitter), fire=False))
+        assert sum(1 for v in verdicts if v["status"] == "regressed") == 0
+
+    def test_regressed_scans_do_not_poison_the_baseline(self):
+        sentinel = RegressionSentinel(warmup_scans=4)
+        n = self._warm(sentinel, 10)
+        for i in range(5):  # a sustained regression keeps firing...
+            verdict = sentinel.observe(
+                make_record(n + i, categories={"fetch_transport": 1.8}), fire=False
+            )
+            assert verdict["status"] == "regressed"
+        # ...and the recovered regime is still nominal (the elevated values
+        # never folded into the baseline).
+        verdict = sentinel.observe(make_record(n + 5), fire=False)
+        assert verdict["status"] == "nominal"
+
+    def test_sustained_regime_rebases_after_a_baseline_window(self):
+        sentinel = RegressionSentinel(warmup_scans=4, baseline_scans=6)
+        n = self._warm(sentinel, 8)
+        statuses = [
+            sentinel.observe(
+                make_record(n + i, categories={"fetch_transport": 1.8}), fire=False
+            )["status"]
+            for i in range(8)
+        ]
+        # Every scan of the acceptance window pages; the moment the streak
+        # fills a whole baseline window the baseline is REPLACED with the
+        # new regime, so the very next elevated scan is nominal — not
+        # baseline_scans² ticks of median creep.
+        assert statuses[:6] == ["regressed"] * 6
+        assert statuses[6:] == ["nominal"] * 2
+
+    def test_baselines_are_per_kind(self):
+        sentinel = RegressionSentinel(warmup_scans=3)
+        self._warm(sentinel, 6)  # delta regime warmed
+        # A FULL scan costs 10x a delta: it must not be judged against the
+        # delta baseline — its own kind is still warming.
+        full = make_record(
+            100, kind="full", categories={k: v * 10 for k, v in BASE_CATEGORIES.items()}
+        )
+        assert sentinel.observe(full, fire=False)["status"] == "warming"
+
+    def test_seed_replays_and_survives_restart(self):
+        records = [make_record(i) for i in range(10)]
+        first = RegressionSentinel(warmup_scans=4)
+        for record in records:
+            first.observe(record, fire=False)
+        assert first.warmed("delta")
+        # "Restart": a fresh sentinel seeded from the recovered timeline is
+        # warm immediately — no re-warm-up window after every restart.
+        reborn = RegressionSentinel(warmup_scans=4)
+        assert reborn.seed(records) == 10
+        assert reborn.warmed("delta")
+        assert reborn.classified_scans == 0  # live counters start fresh
+        verdict = reborn.observe(make_record(11, categories={"compute": 1.5}), fire=False)
+        assert verdict["status"] == "regressed" and verdict["dominant"] == "compute"
+
+    def test_slo_objective_counts_regressions(self):
+        from krr_tpu.obs.health import Objective, SloEngine
+
+        sentinel = RegressionSentinel(warmup_scans=3)
+        engine = SloEngine([], clock=lambda: 0.0)
+        engine.add_objective(
+            Objective(
+                name="scan_regressions",
+                description="test",
+                budget=0.1,
+                sample=lambda: (
+                    float(sentinel.regressed_scans),
+                    float(sentinel.classified_scans),
+                ),
+            )
+        )
+        self._warm(sentinel, 6)
+        sentinel.observe(make_record(50, categories={"fold": 2.0}), fire=False)
+        engine.evaluate(now=1.0)
+        status = engine.status(now=1.0)
+        obj = status["objectives"][0]
+        assert obj["events"]["bad"] == 1.0
+        assert obj["events"]["total"] == float(sentinel.classified_scans)
+
+    def test_trend_report_and_text_render(self):
+        records = [make_record(i) for i in range(12)]
+        records.append(make_record(12, categories={"fetch_transport": 2.0}))
+        report = trend_report(records, warmup_scans=4)
+        assert report["scans"] == 13 and report["regressed"] == 1
+        assert report["regressions"][0]["dominant"] == "fetch_transport"
+        text = render_trend_text(report, records)
+        assert "REGRESSED" in text and "fetch_transport" in text
+        assert "baseline[delta]" in text
+
+
+# ---------------------------------------------------------------- HTTP routes
+class TestDebugTimelineRoute:
+    def _app(self, timeline=None, sentinel=None, tracer=None):
+        from krr_tpu.server.app import HttpApp
+        from krr_tpu.server.state import ServerState
+        from krr_tpu.utils.logging import NULL_LOGGER
+
+        class FakeStore:
+            keys: list = []
+
+        state = ServerState(FakeStore())
+        state.timeline = timeline
+        state.sentinel = sentinel
+        return HttpApp(state, NULL_LOGGER, tracer=tracer or Tracer(ring_scans=2))
+
+    def test_404_without_a_timeline(self):
+        status, _ct, body = asyncio.run(self._app().route("GET", "/debug/timeline", {}))
+        assert status == 404 and b"no scan timeline" in body
+
+    def test_json_records_and_trend(self):
+        timeline = ScanTimeline.open(None)
+        for i in range(6):
+            timeline.append(make_record(i))
+        sentinel = RegressionSentinel(warmup_scans=3)
+        app = self._app(timeline, sentinel)
+        status, content_type, body = asyncio.run(app.route("GET", "/debug/timeline", {}))
+        assert status == 200 and content_type == "application/json"
+        payload = json.loads(body)
+        assert len(payload["records"]) == 6
+        assert payload["trend"]["scans"] == 6
+        assert payload["live"] is not None
+        # n limits the records (and the per-record verdict list), not the
+        # trend's replay coverage.
+        status, _ct, body = asyncio.run(app.route("GET", "/debug/timeline", {"n": ["2"]}))
+        payload = json.loads(body)
+        assert len(payload["records"]) == 2 and payload["trend"]["scans"] == 6
+        assert len(payload["trend"]["verdicts"]) == 2
+
+    def test_text_format(self):
+        timeline = ScanTimeline.open(None)
+        for i in range(4):
+            timeline.append(make_record(i))
+        app = self._app(timeline)
+        status, content_type, body = asyncio.run(
+            app.route("GET", "/debug/timeline", {"format": ["text"]})
+        )
+        assert status == 200 and content_type.startswith("text/plain")
+        assert b"scan timeline" in body
+        status, _ct, _body = asyncio.run(
+            app.route("GET", "/debug/timeline", {"format": ["xml"]})
+        )
+        assert status == 400
+
+    @pytest.mark.parametrize("path", ["/debug/trace", "/debug/profile", "/debug/timeline"])
+    @pytest.mark.parametrize("bad", ["x", "-1", "1.5", ""])
+    def test_shared_n_validation_rejects_with_400_json(self, path, bad):
+        app = self._app(ScanTimeline.open(None))
+        status, content_type, body = asyncio.run(app.route("GET", path, {"n": [bad]}))
+        assert status == 400, f"{path} n={bad!r}"
+        assert content_type == "application/json"
+        assert "error" in json.loads(body)
+
+
+class TestStatuszTrendSection:
+    def test_trend_rides_statusz(self):
+        from krr_tpu.obs.health import SloEngine
+        from krr_tpu.server.app import HttpApp
+        from krr_tpu.server.state import ServerState
+        from krr_tpu.utils.logging import NULL_LOGGER
+
+        class FakeStore:
+            keys: list = []
+
+        state = ServerState(FakeStore())
+        state.slo = SloEngine([], clock=lambda: 0.0)
+        sentinel = RegressionSentinel(warmup_scans=3)
+        for i in range(6):
+            sentinel.observe(make_record(i), fire=False)
+        state.sentinel = sentinel
+        app = HttpApp(state, NULL_LOGGER)
+        status, _ct, body = asyncio.run(app.route("GET", "/statusz", {}))
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["trend"]["baselines"]["delta"]["warmed"] is True
+        assert payload["trend"]["classified_scans"] == sentinel.classified_scans
+        status, _ct, body = asyncio.run(app.route("GET", "/statusz", {"format": ["text"]}))
+        assert b"trend (regression sentinel)" in body
+
+
+class TestTrendDumpArtifact:
+    def test_sigusr2_dump_gains_the_trend_artifact(self, tmp_path):
+        from krr_tpu.obs.dump import debug_dump
+
+        timeline = ScanTimeline.open(None)
+        for i in range(3):
+            timeline.append(make_record(i))
+        tracer = Tracer(ring_scans=2)
+        with tracer.span("scan"):
+            pass
+        paths = debug_dump(
+            tracer,
+            MetricsRegistry(),
+            trace_target=str(tmp_path / "trace.json"),
+            metrics_target=str(tmp_path / "metrics.prom"),
+            timeline=timeline,
+            sentinel=RegressionSentinel(),
+        )
+        assert len(paths) == 4
+        trend = json.load(open(paths[3]))
+        assert len(trend["records"]) == 3 and trend["trend"]["scans"] == 3
+        # Without a timeline (one-shot scans) the dump keeps its 3 artifacts.
+        assert (
+            len(
+                debug_dump(
+                    tracer,
+                    MetricsRegistry(),
+                    trace_target=str(tmp_path / "trace.json"),
+                    metrics_target=str(tmp_path / "metrics.prom"),
+                )
+            )
+            == 3
+        )
+
+
+# ------------------------------------------------------------------- the CLI
+class TestAnalyzeTrend:
+    def _invoke(self, args):
+        from click.testing import CliRunner
+
+        from krr_tpu.main import _make_analyze_command
+
+        return CliRunner().invoke(_make_analyze_command(), args)
+
+    def test_trend_over_a_timeline_file(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        for i in range(10):
+            timeline.append(make_record(i))
+        timeline.append(make_record(10, categories={"fetch_transport": 2.0}))
+        timeline.close()
+        result = self._invoke(["--timeline", path])
+        assert result.exit_code == 0, result.output
+        assert "REGRESSED" in result.output and "fetch_transport" in result.output
+        result = self._invoke(["--trend", "--timeline", path, "--format", "json"])
+        assert result.exit_code == 0
+        payload = json.loads(result.output)
+        assert payload["trend"]["regressed"] == 1
+
+    def test_n_limits_rendered_records_not_the_replay(self, tmp_path):
+        """-n must slice the DISPLAY, not the classification input: a
+        truncated replay would re-warm from scratch and erase verdicts the
+        server issued over the full baseline."""
+        path = str(tmp_path / "timeline.log")
+        timeline = ScanTimeline.open(path)
+        for i in range(10):
+            timeline.append(make_record(i))
+        timeline.append(make_record(10, categories={"fetch_transport": 2.0}))
+        timeline.close()
+        result = self._invoke(["--timeline", path, "-n", "2", "--format", "json"])
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)
+        assert len(payload["records"]) == 2
+        assert payload["trend"]["scans"] == 11 and payload["trend"]["regressed"] == 1
+
+    def test_empty_timeline_is_benign(self, tmp_path):
+        path = str(tmp_path / "timeline.log")
+        ScanTimeline.open(path).close()
+        result = self._invoke(["--timeline", path])
+        assert result.exit_code == 0
+        assert "no completed scans" in result.output
+
+    def test_trend_refuses_trace_input(self, tmp_path):
+        result = self._invoke(["--trend", "--trace", "x"])
+        assert result.exit_code != 0
+        result = self._invoke(["--trend"])
+        assert result.exit_code != 0
+
+    def test_url_with_empty_ring_exits_clean(self):
+        """The satellite: `analyze --url` against a fresh serve (no
+        completed ticks, empty trace ring) prints a clear message and exits
+        0 instead of an empty report + error."""
+        import http.server
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(
+                    {"records": []}
+                    if self.path.startswith("/debug/timeline")
+                    else {"traceEvents": [], "displayTimeUnit": "ms"}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            url = f"http://127.0.0.1:{server.server_port}"
+            result = self._invoke(["--url", url])
+            assert result.exit_code == 0, result.output
+            assert "no completed scans yet" in result.output
+            result = self._invoke(["--trend", "--url", url])
+            assert result.exit_code == 0, result.output
+            assert "no completed scans" in result.output
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
