@@ -157,6 +157,10 @@ SMOKE_DEFAULTS = {
     "BENCH_STORE_ROWS": "512",
     "BENCH_STORE_KILLS": "2",
     "BENCH_STORE_KILL_TICKS": "6",
+    # Wire leg: compressed + downsampled scan vs the identity/raw control
+    # (bit-exactness, engagement, and wire_compression_ratio gates).
+    "BENCH_WIRE_WORKLOADS": "2",
+    "BENCH_WIRE_SAMPLES": "120",
 }
 
 
@@ -296,11 +300,31 @@ def chaos_leg(secondary: dict, check) -> None:
                     (4, 4, FaultSpec(down=True)),
                 ]
             )
+
+            async def settle_breaker(server, sample):
+                # The breaker cooldown is WALL-clock while soak ticks run
+                # back-to-back on a fake scan clock: under CI scheduling
+                # jitter the recovery tick's first queries can land inside
+                # the cooldown window of the hard-down tick's last
+                # fast-fail and quarantine a workload — an extra degraded
+                # tick that reads as starvation. Waiting out the cooldown
+                # after any tick that left the breaker non-closed makes
+                # recovery deterministic: the next tick's first query is
+                # the half-open probe.
+                if sample.breaker_state and sample.breaker_state > 0:
+                    await asyncio.sleep(0.05)
+
             report = asyncio.run(
-                run_soak(config(), fleet.backend, timeline, ticks=ticks, tick_seconds=300.0)
+                run_soak(
+                    config(), fleet.backend, timeline, ticks=ticks,
+                    tick_seconds=300.0, on_tick=settle_breaker,
+                )
             )
             control = asyncio.run(
-                run_soak(config(), fleet.backend, None, ticks=ticks, tick_seconds=300.0)
+                run_soak(
+                    config(), fleet.backend, None, ticks=ticks,
+                    tick_seconds=300.0, on_tick=settle_breaker,
+                )
             )
     finally:
         server.stop()
@@ -679,6 +703,147 @@ def fetchplan_leg(secondary: dict, check) -> None:
         "fetchplan_autotuner",
         bool(autotuned),
         f"limiter enabled={limiter.enabled} baseline={limiter.baseline_ttfb} gauge={limit_gauge}",
+    )
+
+
+def wire_leg(secondary: dict, check) -> None:
+    """Wire-shrink gates (compressed transport + server-side downsampling,
+    `--fetch-compression`/`--fetch-downsample`): the same grid-aligned
+    digest-fleet fetch runs through the real PrometheusLoader over HTTP
+    twice — treated (gzip negotiation + downsampled stats route) vs the
+    identity/raw escape-hatch control. Three parity-style gates:
+
+    * bit-exactness — the treated fleet arrays are BIT-identical to the
+      identity/raw control's;
+    * engagement — gzip responses negotiated AND stats queries rode the
+      downsample rewrite (a wiring break can't pass silently);
+    * compression — wire bytes shrank: ``wire_compression_ratio``
+      (identity wire ÷ treated wire) must hit the acceptance bar of 5x.
+      The ratio is deterministic for a fixed fixture (byte counts, not
+      timings), so the gate cannot flake.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.integrations.kubernetes import KubernetesLoader
+    from krr_tpu.integrations.prometheus import PrometheusLoader
+    from krr_tpu.obs.metrics import MetricsRegistry
+    from tests.fakes.chaos import write_kubeconfig
+    from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    workloads = int(os.environ.get("BENCH_WIRE_WORKLOADS", 3))
+    samples = int(os.environ.get("BENCH_WIRE_SAMPLES", 180))
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True
+    rng = np.random.default_rng(43)
+    for ns in ("w1", "w2"):
+        for w in range(workloads):
+            for pod in cluster.add_workload_with_pods(
+                "Deployment", f"{ns}-wl{w}", ns, pod_count=2
+            ):
+                # Realistic value precision (real fleets quantize: irates
+                # resolve to ~0.1 millicores, working sets to whole pages)
+                # — full-precision iid random mantissas would render the
+                # JSON artificially incompressible and benchmark the RNG's
+                # entropy instead of the transport.
+                metrics.set_series(
+                    ns, "main", pod,
+                    cpu=np.round(rng.gamma(2.0, 0.05, samples), 4),
+                    memory=np.floor(rng.uniform(5e7, 4e8, samples) / 4096) * 4096,
+                )
+
+    backend = FakeBackend(cluster, metrics)
+    # Sample anchor on the absolute minute grid: downsample eligibility
+    # (epoch-aligned subquery steps) and the fake's interval-membership
+    # sample model both demand it.
+    backend.SERIES_ORIGIN = 1_699_999_980.0
+    start = backend.SERIES_ORIGIN
+    end = start + (samples - 1) * 60.0
+    server = ServerThread(backend).start()
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = write_kubeconfig(os.path.join(tmp, "kubeconfig"), server.url)
+
+            def config(**overrides) -> Config:
+                return Config(
+                    kubeconfig=kubeconfig,
+                    prometheus_url=server.url,
+                    quiet=True,
+                    **overrides,
+                )
+
+            objects = asyncio.run(
+                KubernetesLoader(config()).list_scannable_objects(["fake"])
+            )
+
+            def gather(cfg, registry):
+                async def fetch():
+                    prom = PrometheusLoader(cfg, cluster="fake", metrics=registry)
+                    try:
+                        return await prom.gather_fleet_digests(
+                            objects, end - start, 60, gamma=1.01, min_value=1e-7,
+                            num_buckets=128, end_time=end,
+                        )
+                    finally:
+                        await prom.close()
+
+                return asyncio.run(fetch())
+
+            treated_registry = MetricsRegistry()
+            t0 = time.perf_counter()
+            treated = gather(config(fetch_downsample="auto"), treated_registry)
+            treated_seconds = time.perf_counter() - t0
+            control_registry = MetricsRegistry()
+            control = gather(
+                config(fetch_compression="off", fetch_downsample="off"),
+                control_registry,
+            )
+    finally:
+        server.stop()
+
+    bitexact = all(
+        np.array_equal(getattr(treated, attr), getattr(control, attr))
+        for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak")
+    ) and not treated.failed_rows
+    treated_wire = treated_registry.total("krr_tpu_prom_wire_bytes_total")
+    control_wire = control_registry.total("krr_tpu_prom_wire_bytes_total")
+    gzip_responses = treated_registry.value(
+        "krr_tpu_prom_wire_encoding_total", encoding="gzip"
+    ) or 0.0
+    downsampled = treated_registry.value(
+        "krr_tpu_fetch_downsampled_total", cluster="fake"
+    ) or 0.0
+    ratio = control_wire / treated_wire if treated_wire else 0.0
+    secondary["wire_scan_seconds"] = round(treated_seconds, 4)
+    secondary["wire_identity_mb"] = round(control_wire / 1e6, 3)
+    secondary["wire_compressed_mb"] = round(treated_wire / 1e6, 3)
+    secondary["wire_compression_ratio"] = round(ratio, 2)
+    secondary["wire_gzip_responses"] = gzip_responses
+    secondary["wire_downsampled_queries"] = downsampled
+    secondary["wire_bitexact"] = 1.0 if bitexact else 0.0
+    print(
+        f"bench: wire {len(objects)} workloads x {samples} samples -> "
+        f"{control_wire / 1e6:.2f} MB identity vs {treated_wire / 1e6:.2f} MB "
+        f"treated (x{ratio:.1f}, {gzip_responses:.0f} gzip responses, "
+        f"{downsampled:.0f} downsampled queries, bit-exact: {bitexact})",
+        file=sys.stderr,
+    )
+    check("wire_bitexact", bitexact, "treated scan diverged from the identity/raw control")
+    check(
+        "wire_engaged",
+        gzip_responses >= 1 and downsampled >= 1,
+        f"gzip={gzip_responses} downsampled={downsampled}",
+    )
+    check(
+        "wire_ratio",
+        ratio >= 5.0,
+        f"wire_compression_ratio {ratio:.2f} < 5 "
+        f"(identity {control_wire}B vs treated {treated_wire}B)",
     )
 
 
@@ -1371,6 +1536,12 @@ def main() -> None:
         # the AIMD autotuner seeing per-query verdicts.
         fetchplan_leg(secondary, check)
 
+    if not os.environ.get("BENCH_SKIP_WIRE"):
+        # Wire-shrink gates: compressed + downsampled scan bit-exact vs the
+        # identity/raw control, with compression engagement and a measured
+        # wire_compression_ratio > 1.
+        wire_leg(secondary, check)
+
     if not os.environ.get("BENCH_SKIP_STORE"):
         # Durable-store gates: delta append vs legacy full rewrite,
         # recovery-replay bit-exactness, and the SIGKILL kill-recover soak.
@@ -1549,6 +1720,9 @@ def _fetch_trendline_fields(secondary: dict) -> dict:
         "fetch_vs_previous_round": None,
         "previous_round_fetch_seconds": None,
         "fetch_regression_vs_previous": False,
+        "wire_vs_previous_round": None,
+        "previous_round_wire_mb": None,
+        "wire_regression_vs_previous": False,
     }
     current = secondary.get("fleet_e2e_fetch_seconds")
     previous = _previous_round_payload()
@@ -1577,6 +1751,35 @@ def _fetch_trendline_fields(secondary: dict) -> dict:
             "fetch_regression_vs_previous": regression,
         }
     )
+    # Wire-bytes twin of the fetch-seconds gate: at a pinned fleet width
+    # the warm scan's wire MB is nearly deterministic, so growth past 15%
+    # means compression silently fell back (or response volume grew) —
+    # exactly the regression the compressed transport exists to prevent.
+    current_wire = secondary.get("fleet_e2e_wire_mb")
+    prev_wire = prev_secondary.get("fleet_e2e_wire_mb")
+    if (
+        isinstance(current_wire, (int, float)) and current_wire > 0
+        and isinstance(prev_wire, (int, float)) and prev_wire > 0
+    ):
+        wire_vs = current_wire / prev_wire
+        wire_regression = wire_vs > 1.15
+        print(
+            f"bench: fleet wire {current_wire} MB vs {prev_file} {prev_wire} MB "
+            f"-> x{wire_vs:.3f}"
+            + (
+                " WIRE REGRESSION (>15% above previous round — compression fallback?)"
+                if wire_regression
+                else ""
+            ),
+            file=sys.stderr,
+        )
+        fields.update(
+            {
+                "wire_vs_previous_round": round(wire_vs, 3),
+                "previous_round_wire_mb": prev_wire,
+                "wire_regression_vs_previous": wire_regression,
+            }
+        )
     return fields
 
 

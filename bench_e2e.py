@@ -93,12 +93,18 @@ def _serve_fixture(n_containers: int, samples: int, conn, shared: int = 0) -> No
         if shared and i >= shared:
             metrics.alias_series("default", "main", pod, pods[i % shared])
         else:
+            # Realistic value precision (irates ~0.1 millicore resolution,
+            # working sets page-granular): full-precision iid random
+            # mantissas would make the rendered JSON artificially
+            # incompressible and the compressed-transport leg would
+            # benchmark the RNG's entropy, not the wire. Body shape
+            # (samples, labels, timestamps) is unchanged.
             metrics.set_series(
                 "default",
                 "main",
                 pod,
-                cpu=rng.gamma(2.0, 0.05, samples),
-                memory=rng.uniform(5e7, 4e8, samples),
+                cpu=np.round(rng.gamma(2.0, 0.05, samples), 4),
+                memory=np.floor(rng.uniform(5e7, 4e8, samples) / 4096) * 4096,
             )
     server = ServerThread(FakeBackend(cluster, metrics)).start()
     conn.send(server.port)
@@ -203,6 +209,18 @@ def _fixture_env(n_containers: int, samples: int, shared: int = 0):
                 runner.stats["prom_wire_bytes"] = runner.metrics.total(
                     "krr_tpu_prom_wire_bytes_total"
                 )
+                # Compressed-transport split: wire = what crossed the
+                # socket (compressed when negotiated), decoded = the
+                # post-inflate stream the scanner actually parsed.
+                runner.stats["prom_decoded_bytes"] = runner.metrics.total(
+                    "krr_tpu_prom_decoded_bytes_total"
+                )
+                runner.stats["prom_gzip_responses"] = (
+                    runner.metrics.value(
+                        "krr_tpu_prom_wire_encoding_total", encoding="gzip"
+                    )
+                    or 0.0
+                )
                 # Adaptive-fetch-plan engagement for the round record: how
                 # many coalesced/sharded query groups the planner issued.
                 for kind in ("coalesced", "sharded"):
@@ -292,7 +310,14 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
     machines and cores."""
     with _fixture_env(n_containers, samples, shared=shared) as (make_config, one_scan):
         config = make_config(
-            strategy="tdigest", other_args={"digest_ingest": True}
+            strategy="tdigest", other_args={"digest_ingest": True},
+            # The wire-shrink headline configuration: compressed transport
+            # (the default) + server-side downsampling on the stats route.
+            # The pinned scan_end sits on the absolute step grid (the
+            # fake's SERIES_ORIGIN is grid-aligned), so eligibility engages
+            # exactly as a grid-aligned serve deployment's would; results
+            # stay bit-exact vs raw (gated by the wire bench leg + tests).
+            fetch_downsample="auto",
         )
         cold_elapsed, cold_stats = one_scan(config)
         # Warm: fake's window bodies cached. Best-of-2, matching the kernel
@@ -335,7 +360,17 @@ def run_fleet_e2e(n_containers: int = 100_000, samples: int = 1344, shared: int 
             for key, value in stats.items()
             if key.startswith("prom_phase_")
         },
+        # Wire = bytes off the socket (COMPRESSED under the default
+        # --fetch-compression auto — the ROADMAP "sub-GB" target reads off
+        # this number); decoded = the post-inflate stream the scanner
+        # parsed, so decoded/wire is the measured compression ratio.
         "fleet_e2e_wire_mb": round(stats.get("prom_wire_bytes", 0.0) / 1e6, 1),
+        "fleet_e2e_decoded_mb": round(stats.get("prom_decoded_bytes", 0.0) / 1e6, 1),
+        "fleet_e2e_wire_ratio": (
+            round(stats.get("prom_decoded_bytes", 0.0) / stats["prom_wire_bytes"], 2)
+            if stats.get("prom_wire_bytes") and stats.get("prom_gzip_responses")
+            else None
+        ),
         "fleet_e2e_discover_seconds": round(stats["discover_seconds"], 3),
         "fleet_e2e_fetch_seconds": round(stats["fetch_seconds"], 3),
         "fleet_e2e_compute_seconds": round(stats["compute_seconds"], 3),
@@ -534,7 +569,8 @@ def main() -> None:
             f"waits put {out['fleet_e2e_put_blocked_seconds']}s / "
             f"get {out['fleet_e2e_get_starved_seconds']}s, "
             f"ttfb {out.get('fleet_e2e_phase_ttfb_seconds', 0)}s body {out.get('fleet_e2e_phase_body_read_seconds', 0)}s "
-            f"sink {out.get('fleet_e2e_phase_sink_seconds', 0)}s over {out['fleet_e2e_wire_mb']} MB wire; "
+            f"sink {out.get('fleet_e2e_phase_sink_seconds', 0)}s over {out['fleet_e2e_wire_mb']} MB wire"
+            f" (decoded {out['fleet_e2e_decoded_mb']} MB, ratio {out['fleet_e2e_wire_ratio']}); "
             f"cold {out['fleet_e2e_cold_seconds']}s; warm CPU split: client fetch "
             f"{out['fleet_e2e_fetch_cpu_seconds']}s, server {out['fleet_e2e_server_cpu_seconds']}s)",
             file=sys.stderr,

@@ -105,6 +105,32 @@ class Config(pd.BaseModel):
     #: degraded TTFB or failed ladders); false pins the fixed-width
     #: semaphore at --prometheus-max-connections.
     fetch_autotune: bool = True
+    #: Compressed transport for range-query responses: "auto" sends
+    #: ``Accept-Encoding: gzip`` (zstd, gzip when a zstd module is
+    #: importable) on both data planes and stream-decompresses into the
+    #: native ingest (wire byte counters then report COMPRESSED bytes;
+    #: decoded bytes report the post-inflate stream). "gzip" pins gzip
+    #: even when zstd is available. "off" keeps today's identity requests
+    #: byte-identical — the escape hatch and the wire-bench control.
+    fetch_compression: Literal["auto", "gzip", "off"] = "auto"
+    #: Server-side pre-aggregation for STATS-route range queries (the
+    #: count+max ingest — the memory resource, and any stats_only
+    #: strategy resource): "auto" rewrites eligible queries as
+    #: max_over_time/count_over_time subqueries into grid-aligned coarse
+    #: buckets so the server ships one value per bucket instead of every
+    #: raw sample — bit-exact by construction (sum of bucket counts / max
+    #: of bucket maxes equal the raw window's count/max), eligible only
+    #: when the window start sits on the absolute step grid (serve aligns
+    #: its window origin when this is on; one-shot scans engage when
+    #: --scan-end-timestamp lands on the grid). The CPU digest route never
+    #: downsamples — its per-value histogram needs every sample. Backends
+    #: that reject subqueries fall back to the raw fetch automatically,
+    #: per namespace, persistently. "off" disables the rewrite entirely.
+    fetch_downsample: Literal["auto", "off"] = "off"
+    #: Grid points per coarse downsample bucket. 0 = auto: up to 60,
+    #: bounded so at least two full buckets fit the window and the coarse
+    #: step survives the Prometheus duration format exactly.
+    fetch_downsample_factor: int = pd.Field(0, ge=0)
 
     # Kubernetes settings
     kubeconfig: Optional[str] = None  # path override; default resolution in integrations

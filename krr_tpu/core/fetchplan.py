@@ -35,6 +35,7 @@ policy and `krr_tpu.integrations.prometheus` stays the mechanism.
 from __future__ import annotations
 
 import asyncio
+import math
 import re
 import time
 from dataclasses import dataclass, field
@@ -68,6 +69,125 @@ class PlanGroup:
         if self.kind == "sharded" and self.shard is not None:
             return f"{self.namespaces[0]}[{self.shard[0] + 1}/{self.shard[1]}]"
         return ",".join(self.namespaces)
+
+
+#: Largest downsample factor the auto policy will pick: one coarse bucket
+#: per K grid points, capped so a bucket never spans more than an hour of a
+#: minute-step grid (coarser buckets stop paying for themselves — two coarse
+#: queries replace one raw one, so the wire reduction is ~K/2).
+DOWNSAMPLE_MAX_FACTOR = 60
+
+
+@dataclass(frozen=True)
+class DownsamplePlan:
+    """The exact window arithmetic of one downsampled stats fetch.
+
+    A raw stats query evaluates at ``start, start + S, …`` (``n`` grid
+    points); the stats route keeps only per-series (sample count, max).
+    Both aggregates reconstruct EXACTLY from grid-aligned coarse buckets:
+    ship ``count_over_time``/``max_over_time`` of the same expression over
+    ``[K·S : S]`` subquery buckets and the sum of counts / max of maxes
+    equals the raw window's count/max bit-for-bit (counts are small
+    integers in float64, maxes are the same float64 values the raw parse
+    would have seen — the server formats, we parse, no arithmetic in
+    between changes them).
+
+    Bucket geometry: Prometheus evaluates subquery INNER steps aligned to
+    absolute time (multiples of ``S`` since the epoch), so eligibility
+    requires ``start % S == 0`` — then an outer evaluation at
+    ``start + (K-1)·S + j·K·S`` covers exactly grid points
+    ``[jK, jK+K-1]`` (the half-open ``(t - K·S, t]`` subquery window). The
+    ``q = n // K`` full buckets cover points ``[0, qK)``; the remaining
+    ``n mod K`` points (``tail_start``..``tail_end``) ride one ordinary
+    fine-grained query, so the union is exact with no bucket ever reaching
+    outside the window."""
+
+    factor: int
+    step_seconds: int
+    coarse_step_seconds: int
+    coarse_start: float
+    coarse_end: float
+    buckets: int
+    tail_start: Optional[float]
+    tail_end: Optional[float]
+
+    def subquery_suffix(self, closed_left: bool = False) -> str:
+        """The ``[range:step]`` subquery selector for the rewritten query.
+
+        Range-selector boundary semantics changed in Prometheus 3.0: a
+        range ``[R]`` at evaluation time ``t`` covers ``(t-R, t]``
+        (half-open) on 3.x but ``[t-R, t]`` (closed, one extra aligned
+        boundary evaluation) on 2.x — the loader probes which one the
+        backend speaks (`PrometheusLoader._subquery_semantics`). Under
+        ``closed_left`` the range shrinks by one step so each bucket still
+        covers exactly ``factor`` grid points; the outer evaluation
+        positions are identical either way."""
+        span = self.coarse_step_seconds - (self.step_seconds if closed_left else 0)
+        return f"[{span}s:{self.step_seconds}s]"
+
+
+def downsample_factor(step_seconds: int, n_points: int, requested: int = 0) -> int:
+    """The downsample factor K for an ``n_points`` window at ``step_seconds``
+    resolution — ``requested`` when the knob pins one (reduced if the window
+    can't fit it), else auto. 0 = ineligible. Constraints: K ≥ 2, at least
+    two full coarse buckets (``n // K ≥ 2``), and the coarse step ``K·S``
+    must survive :func:`~krr_tpu.integrations.prometheus.step_string`
+    verbatim (sub-minute, or whole minutes — a silently rounded coarse step
+    would desynchronize the buckets from the grid)."""
+    step = int(step_seconds)
+    if step <= 0 or n_points < 4:
+        return 0
+    cap = min(DOWNSAMPLE_MAX_FACTOR, n_points // 2)
+    k = min(int(requested), cap) if requested > 0 else cap
+    if k < 2:
+        return 0
+    if step >= 60:
+        # Whole-minute steps (effective_step_seconds guarantees it): any
+        # multiple is whole minutes too.
+        return k
+    if k * step < 60:
+        return k
+    # Sub-minute step whose coarse step would cross the minute mark: K must
+    # make K·S a whole minute, or stay under one.
+    minute_multiple = 60 // math.gcd(step, 60)
+    aligned = (k // minute_multiple) * minute_multiple
+    if aligned >= 2:
+        return aligned
+    sub_minute = (60 - 1) // step
+    return sub_minute if sub_minute >= 2 else 0
+
+
+def plan_downsample(
+    start: float, end: float, step_seconds: int, factor: int = 0
+) -> Optional[DownsamplePlan]:
+    """Window arithmetic for one downsampled stats fetch, or None when the
+    window is ineligible: unaligned start (subquery inner steps evaluate on
+    the absolute ``step_seconds`` grid — a misaligned window would aggregate
+    DIFFERENT samples than the raw query fetches), or too few points for at
+    least two full coarse buckets. ``step_seconds`` must already be the
+    effective (server-evaluated) step."""
+    step = int(step_seconds)
+    if step <= 0 or float(start) % step != 0:
+        return None
+    n = int((end - start) // step) + 1
+    k = downsample_factor(step, n, factor)
+    if not k:
+        return None
+    buckets = n // k
+    coarse_step = k * step
+    coarse_start = start + (k - 1) * step
+    coarse_end = coarse_start + (buckets - 1) * coarse_step
+    tail_points = n - buckets * k
+    return DownsamplePlan(
+        factor=k,
+        step_seconds=step,
+        coarse_step_seconds=coarse_step,
+        coarse_start=coarse_start,
+        coarse_end=coarse_end,
+        buckets=buckets,
+        tail_start=start + buckets * k * step if tail_points else None,
+        tail_end=start + (n - 1) * step if tail_points else None,
+    )
 
 
 class FetchPlanner:
@@ -145,18 +265,25 @@ class FetchPlanner:
         self.last_plan: list[PlanGroup] = []
 
     # ------------------------------------------------------------ telemetry
-    def observe(self, namespace: str, *, series: float, bytes_seen: float = 0.0) -> None:
-        """Record one scan's observation for a namespace: the actual series
-        count its queries returned/probed, and response bytes (per resource,
-        summed across sub-windows). EWMA (α=0.5) so one odd scan doesn't
-        whipsaw the plan, while churn converges in a couple of scans."""
+    def _entry(self, namespace: str) -> dict[str, float]:
+        """The namespace's telemetry entry, touched to the LRU tail (dict
+        order IS the LRU order), evicting the stalest entry when full."""
         entry = self.telemetry.pop(namespace, None)
         if entry is None:
             entry = {}
             while len(self.telemetry) >= self.MAX_NAMESPACES:
                 self.telemetry.pop(next(iter(self.telemetry)))
-        # Reinsert at the end: dict order IS the LRU order.
         self.telemetry[namespace] = entry
+        return entry
+
+    def observe(self, namespace: str, *, series: float, bytes_seen: float = 0.0) -> None:
+        """Record one scan's observation for a namespace: the actual series
+        count its queries returned/probed, and response WIRE bytes (per
+        resource, summed across sub-windows; compressed transport reports
+        compressed bytes, so the coalescing byte target bounds what actually
+        crosses the network). EWMA (α=0.5) so one odd scan doesn't
+        whipsaw the plan, while churn converges in a couple of scans."""
+        entry = self._entry(namespace)
         prior = entry.get("series")
         entry["series"] = float(series) if prior is None else 0.5 * prior + 0.5 * float(series)
         if bytes_seen > 0 and series > 0:
@@ -174,13 +301,20 @@ class FetchPlanner:
         without this flag the planner would rebuild the same failing shards
         (+ per-workload fallback storm) every tick. Persisted with the
         telemetry entry; clears only when the entry ages out of the LRU."""
-        entry = self.telemetry.pop(namespace, None)
-        if entry is None:
-            entry = {}
-            while len(self.telemetry) >= self.MAX_NAMESPACES:
-                self.telemetry.pop(next(iter(self.telemetry)))
-        self.telemetry[namespace] = entry
-        entry["no_shard"] = 1.0
+        self._entry(namespace)["no_shard"] = 1.0
+
+    def forbid_downsample(self, namespace: str) -> None:
+        """Pin a namespace's stats queries to the raw (undownsampled) shape:
+        its subquery rewrite was REJECTED with a non-transient answer — the
+        canonical case is a backend without subquery support (Prometheus
+        < 2.7, or a query frontend that rejects the syntax) answering 400
+        every scan. Persisted with the telemetry entry, like
+        :meth:`forbid_shard`, so a restarted server doesn't rediscover the
+        rejection one fallback round-trip per tick."""
+        self._entry(namespace)["no_downsample"] = 1.0
+
+    def downsample_allowed(self, namespace: str) -> bool:
+        return not self.telemetry.get(namespace, {}).get("no_downsample")
 
     def state(self) -> dict:
         """JSON-serializable snapshot (persisted beside the serve window

@@ -42,13 +42,20 @@ import threading
 import time
 import urllib.parse
 import urllib.request
+import zlib
 from typing import Any, Iterable, Optional
 
 import httpx
 import numpy as np
 
 from krr_tpu.core.config import Config
-from krr_tpu.core.fetchplan import AdaptiveLimiter, FetchPlanner, PlanGroup
+from krr_tpu.core.fetchplan import (
+    AdaptiveLimiter,
+    DownsamplePlan,
+    FetchPlanner,
+    PlanGroup,
+    plan_downsample,
+)
 from krr_tpu.integrations.kubeconfig import resolve_credentials
 from krr_tpu.integrations.kubernetes import KubeApi
 from krr_tpu.integrations.service_discovery import PROMETHEUS_SELECTORS, ServiceDiscovery
@@ -328,6 +335,11 @@ class _RawTransport:
     #: ``krr_tpu_prom_connections_{opened,reused}_total``.
     metrics: "Optional[MetricsRegistry]" = None
     cluster: str = "default"
+    #: ``Accept-Encoding`` value for range requests, attached by the loader
+    #: after construction like the handles above. None (the
+    #: ``--fetch-compression off`` escape hatch) sends NO header — requests
+    #: stay byte-identical to the pre-compression transport.
+    accept_encoding: "Optional[str]" = None
 
     def __init__(self, base_url: str, headers: dict[str, str], verify: Any, timeout: float = 300.0):
         parsed = urllib.parse.urlsplit(base_url)
@@ -397,10 +409,22 @@ class _RawTransport:
         takes the ZERO-COPY lane: the body reads via ``readinto`` straight
         into the pump's pooled buffers — no per-chunk ``bytes`` allocation,
         no memcpy out of http.client's internal buffer — and parses on the
-        pump's worker concurrently with the next ``recv``."""
+        pump's worker concurrently with the next ``recv``.
+
+        Compressed transport (``accept_encoding`` set): requests carry
+        ``Accept-Encoding`` and a response that negotiated an encoding is
+        handled per lane — the pump lane keeps reading COMPRESSED bytes
+        through the same pooled buffers (``begin_body`` arms the pump's
+        inflater; the worker inflates before the native feed), while the
+        buffered lane inflates the whole body after the read (error bodies
+        too — diagnostics must be readable). ``meter`` byte accounting is
+        owned HERE on the buffered lane so wire bytes mean what crossed the
+        socket, never the inflated size."""
         with self._lock:
             conn, fresh = (self._idle.pop(), False) if self._idle else (self._connect(), True)
         self._count_connection(fresh)
+        if self.accept_encoding is not None:
+            headers = {**headers, "Accept-Encoding": self.accept_encoding}
         while True:
             fed = False  # once the sink has bytes, a transparent retry would duplicate them
             try:
@@ -417,14 +441,42 @@ class _RawTransport:
                     meter.add_phase("request_write", t1 - t0)
                     meter.add_phase("ttfb", t2 - t1)
                 status = response.status
+                getheader = getattr(response, "getheader", None)
+                encoding = _content_encoding(
+                    getheader("Content-Encoding") if getheader is not None else None
+                )
                 if sink is None or status >= 300:
                     t0 = time.perf_counter()
                     data = response.read()
                     if meter is not None:
                         meter.add_phase("body_read", time.perf_counter() - t0)
+                        meter.add_bytes(len(data))
+                        meter.note_encoding(encoding)
+                    if encoding is not None:
+                        if status < 300:
+                            # Whole-body inflate for the buffered lane (the
+                            # parse needs identity bytes; corrupt/truncated
+                            # streams raise loudly here, a terminal
+                            # per-query failure). Timed as decode — it IS
+                            # decode work.
+                            t0 = time.perf_counter()
+                            data = _inflate_body(data, encoding)
+                            if meter is not None:
+                                meter.add_phase("decode", time.perf_counter() - t0)
+                                meter.decoded_bytes += len(data)
+                        else:
+                            # Error bodies inflate best-effort only: the
+                            # status is the diagnosis, and an inflate
+                            # failure must not mask it.
+                            try:
+                                data = _inflate_body(data, encoding)
+                            except ValueError:
+                                pass
                 else:
                     data = b""
                     read_seconds = 0.0
+                    if hasattr(sink, "begin_body"):
+                        sink.begin_body(encoding)
                     if hasattr(sink, "acquire_buffer"):
                         # Zero-copy pump lane: readinto a pooled buffer, hand
                         # it to the sink worker, read the next while it
@@ -448,14 +500,26 @@ class _RawTransport:
                             fed = True
                             sink.commit(buf, n)
                     else:
-                        while True:
-                            t0 = time.perf_counter()
-                            chunk = response.read(1 << 20)
-                            read_seconds += time.perf_counter() - t0
-                            if not chunk:
-                                break
-                            fed = True
-                            sink(chunk)
+                        # Plain-callable sinks (no pump): inflate inline so a
+                        # compressed body can never reach the sink undecoded.
+                        inflater = None
+                        if encoding is not None:
+                            inflater = _acquire_inflater()
+                            inflater.arm(encoding)
+                        try:
+                            while True:
+                                t0 = time.perf_counter()
+                                chunk = response.read(1 << 20)
+                                read_seconds += time.perf_counter() - t0
+                                if not chunk:
+                                    break
+                                fed = True
+                                sink(inflater.feed(chunk) if inflater is not None else chunk)
+                            if inflater is not None:
+                                inflater.finish()
+                        finally:
+                            if inflater is not None:
+                                _release_inflater(inflater)
                     if meter is not None:
                         meter.add_phase("body_read", read_seconds)
             except (http.client.HTTPException, ConnectionError):
@@ -712,14 +776,161 @@ TRANSPORT_PHASES = (
 )
 
 
-class _QueryMeter:
-    """Per-query instrumentation accumulator: attempts made, response bytes
-    seen, per-phase transport seconds, decoded-array bytes, and backoff
-    wait, across retries. One query runs one attempt at a time, so plain
-    int/float adds suffice (worker-thread attempts hand the meter back
-    before the next attempt starts)."""
+def _zstd_decompressobj_factory():
+    """A thunk building streaming zstd decompressors, or None when no zstd
+    module is importable (the container may lack one — compression then
+    negotiates gzip only; nothing is installed on demand)."""
+    try:  # Python 3.14+ stdlib
+        from compression.zstd import ZstdDecompressor  # type: ignore
 
-    __slots__ = ("attempts", "auth_attempts", "bytes", "decoded_bytes", "backoff", "phases")
+        return lambda: ZstdDecompressor()
+    except ImportError:
+        pass
+    try:
+        import zstandard  # type: ignore
+    except ImportError:
+        return None
+    return lambda: zstandard.ZstdDecompressor().decompressobj()
+
+
+_ZSTD_FACTORY = _zstd_decompressobj_factory()
+
+
+def accept_encoding_for(mode: str) -> Optional[str]:
+    """The ``Accept-Encoding`` request header for a ``--fetch-compression``
+    mode — None (no header at all: byte-identical to the pre-compression
+    requests) for "off", gzip always, zstd first when "auto" and a zstd
+    module is importable."""
+    if mode == "off":
+        return None
+    if mode == "auto" and _ZSTD_FACTORY is not None:
+        return "zstd, gzip"
+    return "gzip"
+
+
+class _Inflater:
+    """Streaming decompressor for ONE response body.
+
+    Wrapper instances are pooled (`_acquire_inflater`/`_release_inflater`)
+    so a GB-scale fan-out doesn't churn allocator state at query rate; the
+    underlying zlib/zstd stream object is re-armed per response in
+    :meth:`arm` (they are single-stream by design — a C-level state
+    allocation measured in microseconds against MB-scale bodies).
+
+    Failure contract (as loud as ``krr_stream_finish``'s -3): corrupt
+    compressed data — including a server that claims ``Content-Encoding:
+    gzip`` over identity bytes — raises ValueError from :meth:`feed`, and a
+    compressed stream that ends before its terminator (a truncated tail
+    with valid HTTP framing) raises ValueError from :meth:`finish`. Both
+    surface as terminal per-query errors that ride the existing
+    degrade/quarantine path; neither can fold a silently short window.
+    Multi-member gzip bodies (concatenated members are legal) re-arm on the
+    member boundary and keep inflating."""
+
+    __slots__ = ("encoding", "_obj")
+
+    def __init__(self) -> None:
+        self.encoding: Optional[str] = None
+        self._obj = None
+
+    def arm(self, encoding: str) -> None:
+        self.encoding = encoding
+        if encoding == "gzip":
+            self._obj = zlib.decompressobj(16 + zlib.MAX_WBITS)
+        elif encoding == "zstd" and _ZSTD_FACTORY is not None:
+            self._obj = _ZSTD_FACTORY()
+        else:
+            raise ValueError(
+                f"unsupported Content-Encoding {encoding!r} on a Prometheus response"
+            )
+
+    def feed(self, data) -> bytes:
+        try:
+            out = self._obj.decompress(data)
+            if self.encoding == "gzip":
+                # Multi-member gzip: a finished member may be followed by
+                # another (servers legally concatenate); restart on the
+                # leftover bytes instead of silently dropping them.
+                while self._obj.eof and self._obj.unused_data:
+                    rest = self._obj.unused_data
+                    self._obj = zlib.decompressobj(16 + zlib.MAX_WBITS)
+                    out += self._obj.decompress(rest)
+            return out
+        except ValueError:
+            raise
+        except Exception as e:  # zlib.error / zstd errors
+            raise ValueError(
+                f"corrupt {self.encoding}-compressed Prometheus response body "
+                f"({type(e).__name__}: {e})"
+            ) from None
+
+    def finish(self) -> None:
+        """End-of-body check: the compressed stream must have reached its
+        own terminator — HTTP framing alone cannot vouch for a compressed
+        body, and an unterminated stream means bytes were lost in transit."""
+        if not getattr(self._obj, "eof", True):
+            raise ValueError(
+                f"truncated {self.encoding}-compressed Prometheus response body "
+                f"(stream ended before the compressed terminator)"
+            )
+
+    def release(self) -> None:
+        self._obj = None
+        self.encoding = None
+
+
+_INFLATER_POOL: "list[_Inflater]" = []
+_INFLATER_POOL_CAP = 64  # ~2x the default fan-out width
+_INFLATER_LOCK = threading.Lock()
+
+
+def _acquire_inflater() -> _Inflater:
+    with _INFLATER_LOCK:
+        if _INFLATER_POOL:
+            return _INFLATER_POOL.pop()
+    return _Inflater()
+
+
+def _release_inflater(inflater: _Inflater) -> None:
+    inflater.release()
+    with _INFLATER_LOCK:
+        if len(_INFLATER_POOL) < _INFLATER_POOL_CAP:
+            _INFLATER_POOL.append(inflater)
+
+
+def _inflate_body(data: bytes, encoding: str) -> bytes:
+    """Whole-body decompression for the buffered routes (error bodies
+    included — the caller needs the decoded diagnostics either way)."""
+    inflater = _acquire_inflater()
+    try:
+        inflater.arm(encoding)
+        out = inflater.feed(data)
+        inflater.finish()
+        return out
+    finally:
+        _release_inflater(inflater)
+
+
+def _content_encoding(value: Optional[str]) -> Optional[str]:
+    """Normalized Content-Encoding of a response; None means identity."""
+    encoding = (value or "").strip().lower()
+    return encoding if encoding and encoding != "identity" else None
+
+
+class _QueryMeter:
+    """Per-query instrumentation accumulator: attempts made, wire bytes
+    read (compressed bytes when the response negotiated an encoding),
+    per-phase transport seconds, decoded bytes (post-inflate stream bytes
+    on compressed responses; parsed-array bytes on buffered identity
+    parses), the negotiated encoding, and backoff wait, across retries.
+    One query runs one attempt at a time, so plain int/float adds suffice
+    (worker-thread attempts hand the meter back before the next attempt
+    starts)."""
+
+    __slots__ = (
+        "attempts", "auth_attempts", "bytes", "decoded_bytes", "backoff",
+        "phases", "encoding",
+    )
 
     def __init__(self) -> None:
         self.attempts = 0
@@ -732,12 +943,19 @@ class _QueryMeter:
         self.decoded_bytes = 0
         self.backoff = 0.0
         self.phases: dict[str, float] = {}
+        #: Negotiated Content-Encoding of the last response body (None
+        #: until a body arrived; "identity" when the server sent plain
+        #: bytes) — the wire-vs-decoded split's label.
+        self.encoding: Optional[str] = None
 
     def add_bytes(self, n: int) -> None:
         self.bytes += n
 
     def add_phase(self, phase: str, seconds: float) -> None:
         self.phases[phase] = self.phases.get(phase, 0.0) + seconds
+
+    def note_encoding(self, encoding: Optional[str]) -> None:
+        self.encoding = encoding or "identity"
 
 
 #: Sentinel closing a `_SinkPump`'s worker queue.
@@ -792,6 +1010,35 @@ class _SinkPump:
         self._error: Optional[BaseException] = None
         self._loop = loop
         self._space: Optional[asyncio.Event] = asyncio.Event() if loop is not None else None
+        #: Pooled streaming decompressor, armed by :meth:`begin_body` when
+        #: the response negotiated a Content-Encoding: the reader then
+        #: commits COMPRESSED bytes (wire accounting stays honest) and the
+        #: sink worker inflates them before the native feed — inflation
+        #: overlaps the socket read like the parse does.
+        self._inflater: Optional[_Inflater] = None
+
+    def begin_body(self, encoding: Optional[str]) -> None:
+        """Declare the response body's Content-Encoding BEFORE the first
+        commit. Identity (None) keeps the zero-copy lanes untouched; a
+        compressed encoding arms a pooled inflater on the worker path. An
+        unsupported encoding raises immediately — feeding undecodable bytes
+        to the scanner would fail later and less legibly. Idempotent-safe
+        across the raw transport's free keep-alive retry (which re-declares
+        before any byte was committed): a previously armed, unfed inflater
+        is released back to the pool first."""
+        encoding = _content_encoding(encoding)
+        if self._meter is not None:
+            self._meter.note_encoding(encoding)
+        self._drop_inflater()
+        if encoding is None:
+            return
+        inflater = _acquire_inflater()
+        try:
+            inflater.arm(encoding)
+        except BaseException:
+            _release_inflater(inflater)
+            raise
+        self._inflater = inflater
 
     # ------------------------------------------------- raw (buffer) lane
     def acquire_buffer(self) -> bytearray:
@@ -836,21 +1083,34 @@ class _SinkPump:
 
     # ---------------------------------------------------------- lifecycle
     def close(self) -> None:
-        """Drain, join the worker, and re-raise any sink error (success
-        path — call before ``finalize``)."""
+        """Drain, join the worker, re-raise any sink error, and verify a
+        compressed stream reached its terminator (success path — call
+        before ``finalize``; a truncated compressed tail must fail the
+        query here, not fold a silently short window)."""
         self._join()
-        if self._error is not None:
-            raise self._error
+        try:
+            if self._error is not None:
+                raise self._error
+            if self._inflater is not None:
+                self._inflater.finish()
+        finally:
+            self._drop_inflater()
 
     def abort(self) -> None:
         """Stop the worker without raising (failure/cancel path)."""
         self._join()
+        self._drop_inflater()
 
     def _join(self) -> None:
         worker, self._worker = self._worker, None
         if worker is not None:
             self._filled.put(_PUMP_CLOSE)
             worker.join()
+
+    def _drop_inflater(self) -> None:
+        inflater, self._inflater = self._inflater, None
+        if inflater is not None:
+            _release_inflater(inflater)
 
     def _raise_if_failed(self) -> None:
         if self._error is not None:
@@ -872,7 +1132,19 @@ class _SinkPump:
             try:
                 if self._error is None:
                     t0 = time.perf_counter()
-                    if isinstance(buf, bytes):
+                    if self._inflater is not None:
+                        # Compressed lane: the committed bytes are WIRE
+                        # bytes; inflate on this worker (overlapping the
+                        # socket read) and feed the decoded stream. The
+                        # decoded counter is the post-inflate byte count —
+                        # the honest twin of the compressed wire counter.
+                        view = buf if isinstance(buf, bytes) else memoryview(buf)[:n]
+                        decoded = self._inflater.feed(view)
+                        if self._meter is not None:
+                            self._meter.decoded_bytes += len(decoded)
+                        if decoded:
+                            self._stream.feed(decoded)
+                    elif isinstance(buf, bytes):
                         self._stream.feed(buf)
                     elif self._feed_view is not None:
                         self._feed_view(buf, n)
@@ -949,6 +1221,34 @@ class PrometheusLoader:
             max_shards=config.fetch_plan_max_shards,
         )
         self.planner.seed(plan_seed)
+        #: Compressed transport (``--fetch-compression``): the
+        #: Accept-Encoding header both data planes send on range requests;
+        #: None = today's identity requests, byte-identical.
+        self._accept_encoding = accept_encoding_for(
+            getattr(config, "fetch_compression", "auto") or "auto"
+        )
+        #: Server-side pre-aggregation (``--fetch-downsample``): stats-route
+        #: queries over grid-aligned windows rewrite into subquery
+        #: count/max buckets (see `_downsampled_stats`).
+        self._downsample_mode = getattr(config, "fetch_downsample", "off") or "off"
+        self._downsample_factor = int(getattr(config, "fetch_downsample_factor", 0) or 0)
+        #: Probed range-selector boundary semantics of the target
+        #: (`_subquery_semantics`): None until probed, then True (closed
+        #: left boundary — Prometheus < 3.0), False (half-open — 3.x).
+        self._subquery_closed: Optional[bool] = None
+        #: The probe failed (no subquery support, or no usable answer):
+        #: downsampling stays off for this loader's lifetime — one probe,
+        #: not a rejection per scan.
+        self._subquery_unsupported = False
+        #: Single-flight for the probe: a scan's first stats fan-out races
+        #: every plan group here, and without the lock each would issue its
+        #: own probe (N warnings + N fallback counts on an unsupported
+        #: backend, against the documented one-probe contract).
+        self._subquery_probe_lock = asyncio.Lock()
+        #: monotonic deadline before which a TRANSIENTLY-failed probe is
+        #: not retried (a hard-down backend must not earn one probe + one
+        #: warning per stats query).
+        self._subquery_probe_backoff = 0.0
         self.retries = 3
         #: Backoff sleeps are capped (pre-jitter) so deep ladders can't
         #: balloon a scan's wall, and charged against the per-scan retry
@@ -1031,6 +1331,7 @@ class PrometheusLoader:
                     # monkeypatched by tests/bench to force the httpx plane.
                     self._raw.metrics = self.metrics
                     self._raw.cluster = self.cluster or "default"
+                    self._raw.accept_encoding = self._accept_encoding
             except BaseException:
                 if client is not None:
                     await client.aclose()
@@ -1232,17 +1533,41 @@ class PrometheusLoader:
 
         return trace
 
+    def _httpx_compression_headers(self) -> "Optional[dict[str, str]]":
+        """Explicit ``Accept-Encoding`` for the httpx data plane's range
+        requests. gzip only — httpx's own decoder owns the buffered lane
+        there, and advertising zstd would require a codec httpx itself may
+        lack. None under ``--fetch-compression off``: headers stay exactly
+        httpx's defaults, byte-identical to the pre-compression plane."""
+        if self._accept_encoding is None:
+            return None
+        return {"Accept-Encoding": "gzip"}
+
     async def _httpx_range_query(
         self, query: str, start: float, end: float, step: str, meter: "Optional[_QueryMeter]" = None
     ) -> tuple[int, bytes]:
         """Range request via the httpx client — the fallback data plane for
-        environments the raw transport can't honor (see _make_raw_transport)."""
+        environments the raw transport can't honor (see _make_raw_transport).
+        httpx decodes negotiated encodings itself on this buffered lane; the
+        meter's wire counter reads the transport's downloaded-byte count so
+        compressed responses report compressed bytes, like the raw plane."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        compression = self._httpx_compression_headers()
+        if compression is not None:
+            kwargs["headers"] = compression
         if meter is not None:
             kwargs["extensions"] = {"trace": self._httpx_phase_trace(meter, map_body=True)}
         response = await self._client.request(method, "/api/v1/query_range", **kwargs)
-        return response.status_code, response.content
+        body = response.content
+        if meter is not None:
+            encoding = _content_encoding(response.headers.get("Content-Encoding"))
+            wire = int(getattr(response, "num_bytes_downloaded", 0) or 0) or len(body)
+            meter.add_bytes(wire)
+            meter.note_encoding(encoding)
+            if encoding is not None:
+                meter.decoded_bytes += len(body)
+        return response.status_code, body
 
     async def _httpx_stream_attempt(
         self, query: str, start: float, end: float, step: str, make_stream, finalize, meter=None
@@ -1260,6 +1585,9 @@ class PrometheusLoader:
         loop (a GB-scale readout would stall every concurrent fetch)."""
         assert self._client is not None
         method, kwargs = self._httpx_range_request_args(query, start, end, step)
+        compression = self._httpx_compression_headers()
+        if compression is not None:
+            kwargs["headers"] = compression
         if meter is not None:
             # map_body=False: the chunk loop below times body_read itself so
             # sink (feed) time can be carved out of it — the transport's own
@@ -1275,9 +1603,29 @@ class PrometheusLoader:
                     pump.abort()  # worker never started: no join cost
                     stream.abort()
                     return response.status_code, None, err
+                # An encoding WE negotiated switches to the RAW byte
+                # iterator: the pump's worker inflates (like the raw
+                # plane's), the wire counter sees compressed bytes, and
+                # decompression overlaps the read instead of running on the
+                # event loop inside httpx's decoder. Anything else — the
+                # ``off`` escape hatch (httpx's own default Accept-Encoding
+                # still goes out, so gzip/deflate responses are possible),
+                # or a proxy answering a coding we didn't ask for (deflate,
+                # br) — stays on ``aiter_bytes``, where httpx decodes
+                # transparently exactly as the pre-compression plane did.
+                encoding = _content_encoding(response.headers.get("Content-Encoding"))
+                own_inflate = (
+                    self._accept_encoding is not None and encoding in ("gzip", "zstd")
+                )
+                pump.begin_body(encoding if own_inflate else None)
+                chunks = (
+                    response.aiter_raw(1 << 20)
+                    if own_inflate
+                    else response.aiter_bytes(1 << 20)
+                )
                 read_seconds = 0.0
                 t_wait = time.perf_counter()
-                async for chunk in response.aiter_bytes(1 << 20):
+                async for chunk in chunks:
                     t_got = time.perf_counter()
                     read_seconds += t_got - t_wait
                     await pump.awrite(chunk)
@@ -1483,12 +1831,16 @@ class PrometheusLoader:
     def _decode_timed(self, decode, body: bytes, meter: _QueryMeter):
         """Run a buffered-body parse inside the query's instrumentation
         window (sync — worker thread): the parse IS the query's decode
-        phase, and its output arrays are the decoded-bytes side of the
-        wire-vs-decoded comparison."""
+        phase, and on IDENTITY responses its output arrays are the
+        decoded-bytes side of the wire-vs-decoded comparison. Compressed
+        responses already counted their post-inflate body bytes at the
+        transport — adding the parsed-array bytes on top would double the
+        decoded counter (and the compression ratio built on it)."""
         t0 = time.perf_counter()
         out = decode(body)
         meter.add_phase("decode", time.perf_counter() - t0)
-        meter.decoded_bytes += self._decoded_nbytes(out)
+        if meter.encoding in (None, "identity"):
+            meter.decoded_bytes += self._decoded_nbytes(out)
         return out
 
     @staticmethod
@@ -1570,6 +1922,8 @@ class PrometheusLoader:
             span.set(status=status, retries=retries, bytes=meter.bytes)
             if meter.decoded_bytes:
                 span.set(decoded_bytes=meter.decoded_bytes)
+            if meter.encoding is not None:
+                span.set(encoding=meter.encoding)
             if meter.backoff:
                 span.set(retry_wait=round(meter.backoff, 6))
             for phase, seconds in meter.phases.items():
@@ -1583,16 +1937,29 @@ class PrometheusLoader:
                     self.metrics.inc("krr_tpu_prom_wire_bytes_total", meter.bytes, route=route)
                 if meter.decoded_bytes:
                     self.metrics.inc("krr_tpu_prom_decoded_bytes_total", meter.decoded_bytes)
+                if meter.encoding is not None:
+                    self.metrics.inc(
+                        "krr_tpu_prom_wire_encoding_total", encoding=meter.encoding
+                    )
                 if retries:
                     self.metrics.inc("krr_tpu_prom_query_retries_total", retries)
                 if status == "ok":
                     self.metrics.inc("krr_tpu_prom_points_total", points)
             if self.slow_query_seconds and elapsed >= self.slow_query_seconds:
                 backoff_note = f", {meter.backoff:.1f}s in retry backoff" if meter.backoff else ""
+                # Wire bytes + negotiated encoding in the log line: a
+                # compressed-but-slow query (fat fleet, healthy transport)
+                # must read differently from a fat identity one (a proxy
+                # stripped Accept-Encoding and the volume is the problem).
+                wire_note = (
+                    f", {meter.bytes / 1e6:.1f} MB wire ({meter.encoding or 'identity'})"
+                    if meter.bytes
+                    else ""
+                )
                 self.logger.warning(
                     f"Slow Prometheus query: {elapsed:.1f}s ({route}, window "
                     f"[{start:.0f}, {end:.0f}] step {step}, {points} points, "
-                    f"{retries} retries{backoff_note}, {status}): {query[:200]}"
+                    f"{retries} retries{backoff_note}{wire_note}, {status}): {query[:200]}"
                 )
 
     async def _fetch_range_body(
@@ -1615,13 +1982,15 @@ class PrometheusLoader:
             meters.append(meter)
 
         async def attempt():
+            # Byte accounting lives in the transports now: with compressed
+            # transport, ``len(body)`` is the INFLATED size while the wire
+            # counter must mean bytes off the socket.
             if self._raw is not None:
                 status, body = await asyncio.to_thread(
                     self._raw_range_query, query, start, end, step, meter
                 )
             else:  # proxied environment: ride the httpx client
                 status, body = await self._httpx_range_query(query, start, end, step, meter)
-            meter.add_bytes(len(body))
             return status, body, body
 
         return await self._instrumented(
@@ -2388,7 +2757,8 @@ class PrometheusLoader:
             try:
                 if resource in stats_resources:
                     for (pod, _c), total, peak in await self._query_range_stats(
-                        query, start, end, step_seconds, expected_series=len(obj.pods)
+                        query, start, end, step_seconds, expected_series=len(obj.pods),
+                        downsample_ns=(obj.namespace,),
                     ):
                         # First series per pod; drop sample-less pods — the
                         # same rules as the full-series branch below.
@@ -2428,6 +2798,7 @@ class PrometheusLoader:
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route),
                         points_divisor=points_divisor, meters=meters,
+                        downsample_ns=group.namespaces,
                     )
                     if total > 0
                 ]
@@ -2508,15 +2879,244 @@ class PrometheusLoader:
             meters=meters,
         )
 
+    # --------------------------------------------------- downsampled stats
+    def _downsample_plan(
+        self, start: float, end: float, step_seconds: float,
+        namespaces: "tuple[str, ...]",
+    ) -> Optional[DownsamplePlan]:
+        """The downsample plan for one stats query, or None when the mode is
+        off, any involved namespace is pinned to raw (a prior non-transient
+        rejection — see `FetchPlanner.forbid_downsample`), or the window is
+        ineligible (unaligned start / too few points — `plan_downsample`)."""
+        if self._downsample_mode == "off" or not namespaces:
+            return None
+        if any(not self.planner.downsample_allowed(ns) for ns in namespaces):
+            return None
+        return plan_downsample(
+            start, end, effective_step_seconds(step_seconds),
+            factor=self._downsample_factor,
+        )
+
+    #: One instant query settles BOTH preconditions of the rewrite: whether
+    #: the backend evaluates subqueries at all, and which range-selector
+    #: boundary semantics it speaks. Evaluated at an epoch-aligned minute,
+    #: the 120s/60s subquery has inner evaluations at 2 aligned timestamps
+    #: under 3.x's half-open ``(t-R, t]`` windows and 3 under 2.x's closed
+    #: ``[t-R, t]`` — so the count IS the version answer.
+    _SUBQUERY_PROBE = "count_over_time(vector(1)[120s:60s])"
+
+    async def _subquery_semantics(self) -> Optional[bool]:
+        """True = closed left boundaries (Prometheus < 3.0), False =
+        half-open (3.x), None = subqueries unusable here (probe rejected or
+        unparseable) — downsampling then stays off for this loader. Probed
+        once and cached; the answer decides each bucket's subquery range
+        (see `DownsamplePlan.subquery_suffix`), which is what keeps the
+        rewrite bit-exact on BOTH installed bases instead of silently
+        double-counting boundary samples on 2.x. Single-flight: concurrent
+        callers (a scan's stats fan-out) wait on the first probe instead of
+        issuing their own."""
+        if self._subquery_unsupported:
+            return None
+        if self._subquery_closed is not None:
+            return self._subquery_closed
+        async with self._subquery_probe_lock:
+            return await self._probe_subquery_semantics()
+
+    async def _probe_subquery_semantics(self) -> Optional[bool]:
+        if self._subquery_unsupported:  # a sibling settled it while we waited
+            return None
+        if self._subquery_closed is not None:
+            return self._subquery_closed
+        if time.monotonic() < self._subquery_probe_backoff:
+            return None  # recent transient failure: don't re-probe per query
+        probe_time = float((int(time.time()) // 60) * 60)
+        params = {"query": self._SUBQUERY_PROBE, "time": str(probe_time)}
+        detail = "no answer"
+        answered = False  # the BACKEND spoke — only its answer may latch
+        for _attempt in range(2):  # one free retry for transport hiccups
+            try:
+                assert self._client is not None  # callers ran _ensure_connected
+                response = await self._client.get("/api/v1/query", params=params)
+                if response.status_code == 200:
+                    result = (response.json().get("data") or {}).get("result") or []
+                    count = int(float(result[0]["value"][1])) if result else 0
+                    if count == 2:
+                        self._subquery_closed = False
+                        return False
+                    if count == 3:
+                        self._subquery_closed = True
+                        return True
+                    answered = True
+                    detail = f"probe counted {count} boundary evaluations"
+                    break
+                detail = f"HTTP {response.status_code}"
+                if 400 <= response.status_code < 500:
+                    answered = True
+                    break  # the backend answered no — retrying can't help
+            except Exception as e:
+                detail = f"{type(e).__name__}: {e}"
+        if not answered:
+            # A transport hiccup / 5xx is the MOMENT failing, not the
+            # backend declining subqueries: skip downsampling for a minute
+            # (bounding probes + warnings during an outage) and probe again
+            # after — latching unsupported here would forfeit the wire
+            # reduction for the process's whole lifetime because Prometheus
+            # happened to restart as serve came up.
+            self._subquery_probe_backoff = time.monotonic() + 60.0
+            self.logger.warning(
+                f"subquery semantics probe against {self.cluster or 'default'} "
+                f"failed transiently ({detail}); stats queries fetch raw and "
+                f"the probe retries in 60s"
+            )
+            return None
+        self._subquery_unsupported = True
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_fetch_downsample_fallback_total",
+                cluster=self.cluster or "default",
+            )
+        self.logger.warning(
+            f"Prometheus target {self.cluster or 'default'} does not answer the "
+            f"subquery semantics probe ({detail}); --fetch-downsample stays off "
+            f"for this target — stats queries fetch raw"
+        )
+        return None
+
+    async def _downsampled_stats(
+        self, query: str, plan: DownsamplePlan, closed_left: bool,
+        start: float, end: float,
+        step_seconds: float, expected_series: int, keep: "Optional[set]",
+        points_divisor: int, meters,
+    ) -> "list[tuple[tuple, float, float]]":
+        """The server-side pre-aggregated stats fetch: two coarse subquery
+        aggregations (``count_over_time``/``max_over_time`` over
+        grid-aligned ``[K·S : S]`` buckets — the server ships one value per
+        bucket instead of every raw sample) plus one fine-grained query for
+        the partial tail bucket. The combine is exact BY CONSTRUCTION for
+        the stats route's only aggregates: summed bucket counts equal the
+        raw window's sample count (small integers in float64), and the max
+        of bucket maxes equals the raw max (the same float64 values the
+        server would have shipped raw). One documented divergence: Prometheus
+        counts NaN staleness markers in ``count_over_time`` while the raw
+        parse drops non-finite samples client-side — the irate/working-set
+        expressions these queries wrap never produce them.
+
+        Values align positionally across sub-windows like every other
+        route; per-bucket ORDER is irrelevant because only sum/max consume
+        them. The CPU digest route never takes this path — its per-value
+        histogram needs every sample."""
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_fetch_downsampled_total", cluster=self.cluster or "default"
+            )
+        suffix = plan.subquery_suffix(closed_left)
+        legs = [
+            self._query_range(
+                f"count_over_time(({query}){suffix})",
+                plan.coarse_start, plan.coarse_end, plan.coarse_step_seconds,
+                expected_series=expected_series, keep=keep,
+                points_divisor=points_divisor, meters=meters,
+            ),
+            self._query_range(
+                f"max_over_time(({query}){suffix})",
+                plan.coarse_start, plan.coarse_end, plan.coarse_step_seconds,
+                expected_series=expected_series, keep=keep,
+                points_divisor=points_divisor, meters=meters,
+            ),
+        ]
+        if plan.tail_start is not None:
+            legs.append(
+                self._query_range(
+                    query, plan.tail_start, plan.tail_end, step_seconds,
+                    expected_series=expected_series, keep=keep,
+                    points_divisor=points_divisor, meters=meters,
+                )
+            )
+        # return_exceptions so one failing leg doesn't orphan its siblings'
+        # in-flight downloads (the same rationale as the window fan-out).
+        results = await asyncio.gather(*legs, return_exceptions=True)
+        for result in results:
+            if isinstance(result, BaseException):
+                raise result
+        totals: dict[tuple, float] = {}
+        peaks: dict[tuple, float] = {}
+        for key, samples in results[0]:
+            if samples.size:
+                totals[key] = float(samples.sum())
+        for key, samples in results[1]:
+            if samples.size:
+                peaks[key] = max(peaks.get(key, float("-inf")), float(samples.max()))
+        if len(results) > 2:
+            for key, samples in results[2]:
+                if samples.size:
+                    totals[key] = totals.get(key, 0.0) + float(samples.size)
+                    peaks[key] = max(peaks.get(key, float("-inf")), float(samples.max()))
+        ordered = list(totals)
+        ordered.extend(key for key in peaks if key not in totals)
+        return [
+            (key, totals.get(key, 0.0), peaks.get(key, float("-inf")))
+            for key in ordered
+        ]
+
     async def _query_range_stats(
         self, query: str, start: float, end: float, step_seconds: float,
         expected_series: int = 0, keep: "Optional[set]" = None, sink=None,
         points_divisor: int = 1, meters=None,
+        downsample_ns: "tuple[str, ...]" = (),
     ) -> "Optional[list[tuple[tuple, float, float]]]":
         """Range query → per-series (pod, count, max) only — the memory
         ingest, which needs no histogram and no per-sample log(). Split
         sub-windows merge exactly (counts add, peaks max). ``sink`` as in
-        `_query_range_digest` (returns None when it consumed the windows)."""
+        `_query_range_digest` (returns None when it consumed the windows).
+
+        ``downsample_ns`` (the query's namespaces) opts the query into
+        server-side pre-aggregation when ``--fetch-downsample`` is on and
+        the window is eligible: the rewrite ships one value per coarse
+        bucket instead of every raw sample (see `_downsampled_stats`) and
+        is bit-exact for this route's count/max aggregates. A backend that
+        rejects the subquery syntax non-transiently falls back to the raw
+        fetch below AND pins the namespaces
+        (`FetchPlanner.forbid_downsample`, persisted with the plan
+        telemetry) so the rejection isn't re-discovered every scan;
+        transient failures and sample-limit rejections keep their existing
+        ladders (the caller's halved-window retry re-enters here)."""
+        plan = self._downsample_plan(start, end, step_seconds, downsample_ns)
+        if plan is not None:
+            closed_left = await self._subquery_semantics()
+            if closed_left is None:
+                plan = None  # probe says the target can't do this (logged once)
+        if plan is not None:
+            try:
+                return await self._downsampled_stats(
+                    query, plan, closed_left, start, end, step_seconds,
+                    expected_series, keep, points_divisor, meters,
+                )
+            except PrometheusQueryError as e:
+                if e.status >= 500 or self._halved_retry_worthwhile(e):
+                    raise  # transient / too-big: the existing ladders own it
+                if e.status == 400:
+                    # The backend rejected the QUERY ITSELF (parse/validation
+                    # class) — re-issuing the same rewrite every scan would
+                    # repeat the rejection, so pin the namespaces to raw.
+                    # Other 4xx (429 rate limits, 408, proxy quirks) answer
+                    # about the MOMENT, not the syntax: fall back this once
+                    # and let the next scan try again.
+                    for ns in downsample_ns:
+                        self.planner.forbid_downsample(ns)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "krr_tpu_fetch_downsample_fallback_total",
+                        cluster=self.cluster or "default",
+                    )
+                pinned = (
+                    f" and pinning {', '.join(downsample_ns)} to raw stats queries"
+                    if e.status == 400
+                    else ""
+                )
+                self.logger.warning(
+                    f"Downsampled stats query rejected ({e}); "
+                    f"falling back to the raw fetch{pinned}"
+                )
         from functools import partial
 
         from krr_tpu.integrations.native import open_stream, parse_matrix_stats
@@ -2591,6 +3191,7 @@ class PrometheusLoader:
                     series = await self._query_range_stats(
                         query, start, end, step_seconds,
                         expected_series=len(obj.pods), sink=sink,
+                        downsample_ns=(obj.namespace,),
                     )
                     if series is None:
                         return
@@ -2646,6 +3247,7 @@ class PrometheusLoader:
                         query, start, end, step_seconds,
                         expected_series=expected, keep=set(route), sink=sink,
                         points_divisor=points_divisor, meters=meters,
+                        downsample_ns=group.namespaces,
                     )
                     if fetched is None:
                         return expected, meters

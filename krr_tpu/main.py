@@ -284,6 +284,50 @@ def _common_options() -> list[click.Option]:
                 "failure signals; false pins the fixed-width semaphore."
             ),
         ),
+        PanelOption(
+            ["--fetch-compression"],
+            type=click.Choice(["auto", "gzip", "off"]),
+            default="auto",
+            show_default=True,
+            help=(
+                "Compressed transport for Prometheus range responses: 'auto' "
+                "sends Accept-Encoding gzip (zstd too when a zstd module is "
+                "importable) and stream-decompresses into the native ingest; "
+                "'gzip' pins gzip; 'off' keeps identity requests byte-"
+                "identical to the pre-compression transport. Wire byte "
+                "counters report compressed bytes; decoded bytes report the "
+                "post-inflate stream."
+            ),
+        ),
+        PanelOption(
+            ["--fetch-downsample"],
+            type=click.Choice(["auto", "off"]),
+            default="off",
+            show_default=True,
+            help=(
+                "Server-side pre-aggregation for stats-route queries (the "
+                "count+max memory ingest): 'auto' rewrites eligible queries "
+                "as count/max_over_time subqueries into grid-aligned coarse "
+                "buckets — one value per bucket instead of every raw sample, "
+                "bit-exact by construction — with automatic per-namespace "
+                "fallback to raw when the backend rejects subqueries. Serve "
+                "aligns its window origin to the grid when on; one-shot "
+                "scans engage when --scan-end-timestamp lands on the grid. "
+                "The CPU digest route never downsamples (its histogram "
+                "needs every sample)."
+            ),
+        ),
+        PanelOption(
+            ["--fetch-downsample-factor", "fetch_downsample_factor"],
+            type=int,
+            default=0,
+            show_default=True,
+            help=(
+                "Grid points per downsample bucket. 0 = auto (up to 60, "
+                "bounded so at least two full buckets fit the window and the "
+                "coarse step survives the Prometheus duration format exactly)."
+            ),
+        ),
         PanelOption(["--kubeconfig"], default=None, help="Path to kubeconfig file (defaults to $KUBECONFIG or ~/.kube/config)."),
         PanelOption(
             ["--batched-fleet-queries"],

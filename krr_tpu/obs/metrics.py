@@ -94,8 +94,11 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_prom_connections_reused_total", "counter", "Keep-alive connections reused from the raw Prometheus transport's idle pool."),
     ("krr_tpu_fetch_plan_coalesced_total", "counter", "Coalesced (multi-namespace) batched queries issued by adaptive fetch plans, per cluster (one per plan group per resource, counted at issue time)."),
     ("krr_tpu_fetch_plan_sharded_total", "counter", "Shard queries issued by adaptive fetch plans over giant namespaces, per cluster (one per shard group per resource, counted at issue time)."),
-    ("krr_tpu_prom_wire_bytes_total", "counter", "Response body bytes read off the Prometheus transport by data plane (buffered|streamed)."),
-    ("krr_tpu_prom_decoded_bytes_total", "counter", "Bytes of decoded sample arrays produced by buffered-route parses (streamed ingest never materializes decoded arrays; compare against wire bytes for JSON overhead)."),
+    ("krr_tpu_prom_wire_bytes_total", "counter", "Response body bytes read off the Prometheus transport by data plane (buffered|streamed) — COMPRESSED bytes when the response negotiated an encoding, so this counter always means what crossed the network."),
+    ("krr_tpu_prom_decoded_bytes_total", "counter", "Decoded bytes behind the wire counter: post-inflate body bytes on compressed responses, parsed sample-array bytes on buffered identity parses (decoded ÷ wire is the live compression ratio)."),
+    ("krr_tpu_prom_wire_encoding_total", "counter", "Range-query responses by negotiated Content-Encoding (identity|gzip|zstd) — identity climbing while --fetch-compression is on means something on the path stripped Accept-Encoding."),
+    ("krr_tpu_fetch_downsampled_total", "counter", "Stats-route queries rewritten as grid-aligned server-side subquery downsamples (--fetch-downsample), per cluster, counted at issue time."),
+    ("krr_tpu_fetch_downsample_fallback_total", "counter", "Downsampled stats queries that fell back to the raw fetch after a non-transient backend rejection (the namespaces are pinned to raw in the plan telemetry)."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
     ("krr_tpu_http_request_seconds", "histogram", "HTTP request latency by route.", DEFAULT_SECONDS_BUCKETS),
     # Device-level compute observability (`krr_tpu.obs.device`).

@@ -246,6 +246,7 @@ def profile_trace(spans: list) -> Optional[dict]:
     retries = 0
     wire_bytes = 0
     decoded_bytes = 0
+    encodings: dict[str, int] = {}
     prom_duration = 0.0
     for span in prom_spans:
         prom_duration += max(0.0, span.end - span.start)
@@ -253,6 +254,9 @@ def profile_trace(spans: list) -> Optional[dict]:
         retries += int(_float_attr(span, "retries"))
         wire_bytes += int(_float_attr(span, "bytes"))
         decoded_bytes += int(_float_attr(span, "decoded_bytes"))
+        encoding = span.attributes.get("encoding")
+        if encoding:
+            encodings[str(encoding)] = encodings.get(str(encoding), 0) + 1
         for key, value in span.attributes.items():
             if key.startswith("phase_"):
                 try:
@@ -301,6 +305,10 @@ def profile_trace(spans: list) -> Optional[dict]:
             "backoff_seconds": round(backoff, 6),
             "wire_bytes": wire_bytes,
             "decoded_bytes": decoded_bytes,
+            # Negotiated Content-Encoding per completed query — identity
+            # creeping in while compression is on means something on the
+            # path stripped Accept-Encoding.
+            "encodings": encodings,
             "phase_seconds": {k: round(v, 6) for k, v in sorted(phase_seconds.items())},
         },
         "what_if": {

@@ -55,8 +55,12 @@ from typing import Optional
 from krr_tpu.obs.profile import CATEGORIES
 
 #: Monitored categories — the profile partition minus ``idle`` (idle wall is
-#: the scheduler waiting, not a cost regression) plus the whole wall.
-MONITORED = tuple(c for c in CATEGORIES if c != "idle") + ("wall",)
+#: the scheduler waiting, not a cost regression), the whole wall, and the
+#: tick's wire megabytes (``wire_mb`` — the one non-seconds series: a
+#: silent fallback to identity transport multiplies wire bytes by the
+#: compression ratio while every timing band may stay green, and it must
+#: page as a trend verdict, not a mystery slowdown later).
+MONITORED = tuple(c for c in CATEGORIES if c != "idle") + ("wall", "wire_mb")
 
 #: Transport phases whose bands refine a fetch_transport attribution.
 _PHASE_DETAIL = ("connect", "request_write", "ttfb", "body_read", "queue_wait")
@@ -73,6 +77,11 @@ SUSPECT_LAYERS = {
     "publish": "render + publish stage",
     "other": "scheduler / uncategorized host work",
     "wall": "whole-scan wall (no single dominant category)",
+    "wire_mb": (
+        "wire bytes up at steady timings → compression fell back to identity "
+        "(a proxy stripping Accept-Encoding?) or response volume grew — "
+        "check the record's encodings and downsample engagement"
+    ),
 }
 
 #: phase → the refinement appended to a fetch_transport attribution.
@@ -149,6 +158,17 @@ class RegressionSentinel:
         categories = record.get("categories") or {}
         values = {c: float(categories.get(c, 0.0)) for c in CATEGORIES if c != "idle"}
         values["wall"] = float(record.get("wall", 0.0))
+        # Wire megabytes — a value band, not a timing band (its "excess" is
+        # MB, not seconds). A record WITHOUT wire bytes (pre-compression
+        # timeline files, fake-source deployments) contributes NO sample:
+        # folding 0.0 would seed an all-zero baseline whose floor-width
+        # band pages a guaranteed false "compression fell back" verdict on
+        # the first real post-upgrade scan — the series must instead warm
+        # up on its own real samples (the per-series warm-up gate in
+        # `_observe` holds verdicts until it has them).
+        wire_bytes = record.get("wire_bytes") or 0
+        if wire_bytes:
+            values["wire_mb"] = float(wire_bytes) / 1e6
         for phase, seconds in (record.get("phases") or {}).items():
             if phase in _PHASE_DETAIL:
                 values[f"phase_{phase}"] = float(seconds)
@@ -214,9 +234,14 @@ class RegressionSentinel:
         if regressed:
             # Dominant = the category that ADDED the most wall, not the one
             # with the tightest band: attribution must name where the
-            # seconds went.
+            # seconds went. wire_mb is a VALUE band in megabytes — ranked
+            # against seconds its raw excess would win almost every
+            # co-occurring regression at fleet scale, so it only becomes
+            # dominant when no timing category regressed alongside it.
+            timing = [name for name in regressed if name != "wire_mb"]
+            pool = timing or regressed
             dominant = max(
-                regressed, key=lambda name: deviations[name]["value"] - deviations[name]["median"]
+                pool, key=lambda name: deviations[name]["value"] - deviations[name]["median"]
             )
             detail = self._phase_detail(dominant, deviations)
             suspect = SUSPECT_LAYERS.get(dominant, dominant)
@@ -226,9 +251,12 @@ class RegressionSentinel:
                 status="regressed",
                 dominant=dominant,
                 sigma=deviations[dominant]["sigma"],
+                # In the dominant series' unit (see excess_unit) — seconds
+                # for every timing category, megabytes for wire_mb.
                 excess_seconds=round(
                     deviations[dominant]["value"] - deviations[dominant]["median"], 6
                 ),
+                excess_unit="MB" if dominant == "wire_mb" else "s",
                 regressed=regressed,
                 suspect=suspect,
             )
@@ -296,7 +324,8 @@ class RegressionSentinel:
             self.logger.warning(
                 f"scan regression: {verdict.get('scan_id') or 'scan'} "
                 f"[{verdict['kind']}] {verdict['dominant']} "
-                f"+{verdict['sigma']:.1f}σ (+{verdict['excess_seconds']:.3f}s "
+                f"+{verdict['sigma']:.1f}σ (+{verdict['excess_seconds']:.3f}"
+                f"{verdict.get('excess_unit', 's')} "
                 f"over baseline) → {verdict['suspect']}"
             )
 
@@ -416,15 +445,17 @@ def render_trend_text(report: dict, records: "Optional[list[dict]]" = None) -> s
         for name, band in posture["series"].items():
             if name.startswith("phase_"):
                 continue
+            unit = "MB" if name == "wire_mb" else "s"
             lines.append(
-                f"    {name:<16} median {band['median']:>9.3f}s "
-                f"± {band['band']:.3f}s  (n={band['samples']})"
+                f"    {name:<16} median {band['median']:>9.3f}{unit} "
+                f"± {band['band']:.3f}{unit}  (n={band['samples']})"
             )
     for verdict in report.get("regressions", [])[-16:]:
         lines.append(
             f"  REGRESSED {verdict.get('scan_id') or verdict.get('ts')} "
             f"[{verdict['kind']}]: {verdict['dominant']} +{verdict['sigma']:.1f}σ "
-            f"(+{verdict['excess_seconds']:.3f}s) → {verdict['suspect']}"
+            f"(+{verdict['excess_seconds']:.3f}{verdict.get('excess_unit', 's')}) "
+            f"→ {verdict['suspect']}"
         )
     if records:
         tail = records[-8:]

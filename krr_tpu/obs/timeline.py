@@ -390,6 +390,7 @@ def build_scan_record(
         "queries": int(fetch.get("queries", 0)),
         "retries": int(fetch.get("retries", 0)),
         "wire_bytes": int(fetch.get("wire_bytes", 0)),
+        "decoded_bytes": int(fetch.get("decoded_bytes", 0)),
         "publish": {
             "changed": int(stats.get("publish_changed") or 0),
             "suppressed": int(stats.get("publish_suppressed") or 0),
@@ -401,9 +402,36 @@ def build_scan_record(
             "failing": bool(stats.get("persist_failing", False)),
         },
     }
+    # Wire-shrink observability (satellites of the compressed-transport PR):
+    # the per-tick encoding census, the live compression ratio (None until a
+    # compressed response contributed — an all-identity tick has no ratio to
+    # claim), and how many stats queries rode the downsample rewrite. A
+    # silent fallback to identity shows up here as the ratio vanishing and
+    # wire_bytes jumping — which the sentinel's wire_mb band turns into a
+    # paged trend verdict instead of a mystery slowdown.
+    encodings = {
+        str(k): int(v) for k, v in (fetch.get("encodings") or {}).items()
+    }
+    record["encodings"] = encodings
+    wire = record["wire_bytes"]
+    decoded = record["decoded_bytes"]
+    # Only when EVERY response negotiated an encoding: on a mixed tick —
+    # exactly the half-stripped-Accept-Encoding regime this field helps
+    # diagnose — identity responses add wire bytes with no matching
+    # decoded contribution, which would drag the ratio DOWN and read as
+    # "compression degraded" instead of "some responses fell back". The
+    # encodings census carries the mixed-tick signal; the ratio stays an
+    # honest measurement or absent.
+    compressed_only = bool(encodings) and all(k != "identity" for k in encodings)
+    record["wire_compression_ratio"] = (
+        round(decoded / wire, 3)
+        if compressed_only and wire > 0 and decoded > 0
+        else None
+    )
     plan: dict[str, Any] = {
         "coalesced": int((plan_delta or {}).get("coalesced", 0)),
         "sharded": int((plan_delta or {}).get("sharded", 0)),
+        "downsampled": int((plan_delta or {}).get("downsampled", 0)),
     }
     if metrics is not None:
         inflight = metrics.series("krr_tpu_prom_inflight_limit")

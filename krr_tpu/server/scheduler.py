@@ -138,9 +138,11 @@ class ScanScheduler:
         #: outcome — consumed by :meth:`_observe_timeline` in run_once.
         self.last_tick_stats: "Optional[dict]" = None
         #: Cumulative fetch-plan counter totals at the last recorded tick,
-        #: so the timeline record carries per-TICK coalesced/sharded deltas
-        #: instead of process-lifetime sums.
-        self._plan_totals: "dict[str, float]" = {"coalesced": 0.0, "sharded": 0.0}
+        #: so the timeline record carries per-TICK coalesced/sharded/
+        #: downsampled deltas instead of process-lifetime sums.
+        self._plan_totals: "dict[str, float]" = {
+            "coalesced": 0.0, "sharded": 0.0, "downsampled": 0.0,
+        }
         #: key → grid-aligned start of the first window its fetch missed:
         #: the catch-up fetch's left edge. Persisted in the store's
         #: extra_meta (same atomic save as the cursor) — a restart must
@@ -156,6 +158,23 @@ class ScanScheduler:
         # instead of re-deriving from cold routed counts.
         session.seed_fetch_plans(self.state.store.extra_meta.get("serve_fetch_plan"))
         self._publish_stale_state()
+        if (
+            getattr(config, "fetch_downsample", "off") != "off"
+            and self.state.last_end is not None
+            and float(self.state.last_end) % self._step_seconds() != 0
+        ):
+            # A pre-downsample deployment restored its cursor: the window
+            # grid was anchored before alignment existed, every later edge
+            # inherits the misalignment (realigning mid-stream would skip
+            # or double-count a partial step), and eligibility will decline
+            # every query. Loud, or the operator reads a forever-zero
+            # krr_tpu_fetch_downsampled_total as a mystery.
+            self.logger.warning(
+                "--fetch-downsample is on but the persisted window grid is not "
+                "aligned to the step grid (the state predates the flag); "
+                "downsampling stays disengaged until the window grid is rebuilt "
+                "(fresh state_path, or a full rescan after quarantine expiry)"
+            )
         # The hysteresis gate on the publish path (`krr_tpu.history.policy`).
         # A resumed journal re-seeds the trailing published baselines, so a
         # restart keeps gating against the pre-restart published values
@@ -498,6 +517,15 @@ class ScanScheduler:
 
         if self.state.last_end is None:
             start = now - settings.history_timedelta.total_seconds()
+            if getattr(self.session.config, "fetch_downsample", "off") != "off":
+                # Server-side downsampling is only exact on the ABSOLUTE
+                # step grid (Prometheus evaluates subquery inner steps at
+                # epoch-aligned timestamps): align the first window's origin
+                # down to it. Every later edge inherits the alignment —
+                # delta starts are last_end + step, backfill/catch-up edges
+                # derive from the aligned end. Costs at most one extra step
+                # of history on the first full scan.
+                start -= start % step
             kind = "full"
         else:
             # One step past the last folded window's right edge: the
@@ -829,6 +857,7 @@ class ScanScheduler:
         for key, metric in (
             ("coalesced", "krr_tpu_fetch_plan_coalesced_total"),
             ("sharded", "krr_tpu_fetch_plan_sharded_total"),
+            ("downsampled", "krr_tpu_fetch_downsampled_total"),
         ):
             total = metrics.total(metric)
             plan_delta[key] = max(0.0, total - self._plan_totals[key])

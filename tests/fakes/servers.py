@@ -13,6 +13,7 @@ discovery + proxied queries can be tested end-to-end.
 from __future__ import annotations
 
 import asyncio
+import gzip
 import re
 import threading
 from dataclasses import dataclass, field
@@ -149,6 +150,38 @@ class FakeMetrics:
     #: truncated JSON): the parser must fail the query, never fold half a
     #: window.
     truncate_bodies: bool = False
+    #: Honest ``Accept-Encoding`` negotiation for range responses: gzip when
+    #: the client advertises it, identity otherwise. False answers identity
+    #: REGARDLESS of the request header — the "proxy stripped
+    #: Accept-Encoding" regime the wire sentinel must page on.
+    compress_responses: bool = True
+    #: Fault: strip this many bytes off the END of a gzip body (valid HTTP
+    #: framing around a compressed stream missing its terminator) — the
+    #: client's inflater must fail the query loudly, never fold a silently
+    #: short window.
+    truncate_compressed_tail: int = 0
+    #: Fault: claim ``Content-Encoding: gzip`` over identity bytes (a
+    #: misconfigured proxy) — the client's inflater must reject the body.
+    lie_content_encoding: bool = False
+    #: Reject every subquery (the loader's semantics PROBE included) with
+    #: the 400 parse error a pre-subquery backend answers — the loader must
+    #: disable downsampling for the target after one probe.
+    reject_subqueries: bool = False
+    #: Accept the probe but 400 subquery RANGE queries (a query frontend
+    #: that blocks subqueries on the range path only) — exercises the
+    #: loader's per-namespace raw pinning.
+    fail_subquery_ranges: bool = False
+    #: Emulate Prometheus < 3.0 range-selector semantics: a range ``[R]``
+    #: covers the CLOSED window ``[t-R, t]`` (one extra aligned boundary
+    #: evaluation) instead of 3.x's half-open ``(t-R, t]``. The semantics
+    #: probe answers 3 instead of 2, and subquery buckets include their
+    #: left boundary — the loader must shrink its bucket ranges by one
+    #: step to stay bit-exact.
+    subquery_closed_boundaries: bool = False
+    #: Accept-Encoding header of each range request seen (None when the
+    #: client sent none) — lets tests pin that ``--fetch-compression off``
+    #: keeps requests byte-identical to the pre-compression transport.
+    range_request_encodings: list = field(default_factory=list)
     _fault_rng: Any = None
 
     def fault_rng(self):
@@ -182,6 +215,11 @@ class FakeMetrics:
     #: enforce_range serving (fragment i spans [offs[i], offs[i+1]-1)).
     _value_offsets: dict[tuple[str, str, str], tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
 
+    #: Gzipped twins of the cached batched bodies (same keys): fleet-scale
+    #: bodies compress once on the cold scan instead of per warm request —
+    #: the bench must measure the scanner, not the fake's deflate.
+    _gzip_bodies: dict[tuple, bytes] = field(default_factory=dict)
+
     def set_series(self, namespace: str, container: str, pod: str, cpu: np.ndarray, memory: np.ndarray) -> None:
         key = (namespace, container, pod)
         self.series[key] = (np.asarray(cpu, float), np.asarray(memory, float))
@@ -195,6 +233,7 @@ class FakeMetrics:
         self._value_strs[key] = tuple(strs)
         self._value_offsets[key] = tuple(offsets)
         self._batched_bodies.clear()
+        self._gzip_bodies.clear()
 
     def alias_series(
         self, namespace: str, container: str, pod: str, source_pod: str
@@ -211,6 +250,7 @@ class FakeMetrics:
         self._value_strs[key] = self._value_strs[src]
         self._value_offsets[key] = self._value_offsets[src]
         self._batched_bodies.clear()
+        self._gzip_bodies.clear()
 
     def sliced_values(self, key: tuple[str, str, str], is_cpu: bool, i0: int, i1: int) -> str:
         """The values-array JSON for samples [i0, i1] — an O(1) substring of
@@ -243,6 +283,14 @@ _COALESCED_QUERY_RE = re.compile(
 #: The shard shape's pod restriction (only ever present alongside a
 #: `_BATCHED_QUERY_RE` match — per-workload queries group by (pod) alone).
 _SHARD_PODS_RE = re.compile(r'pod=~"(?P<pods>[^"]*)"')
+
+#: The downsample rewrite's subquery shape
+#: (`PrometheusLoader._downsampled_stats`): a count/max aggregation of any
+#: inner query over ``[R s : S s]`` grid buckets.
+_SUBQUERY_RE = re.compile(
+    r"^(?P<fn>max|count)_over_time\(\((?P<inner>.*)\)\[(?P<range>\d+)s:(?P<step>\d+)s\]\)$",
+    re.S,
+)
 
 
 class FakeBackend:
@@ -332,6 +380,25 @@ class FakeBackend:
             return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
         form = await request.post()  # form-encoded POST, like real Prometheus
         q = str(({**request.query, **form}).get("query", ""))
+        # The loader's subquery-semantics probe
+        # (`count_over_time(vector(1)[Rs:Ss])` at an aligned instant):
+        # half-open 3.x windows hold R/S aligned inner evaluations, closed
+        # 2.x windows one more. A pre-subquery backend 400s the syntax.
+        probe = re.fullmatch(r"count_over_time\(vector\(1\)\[(\d+)s:(\d+)s\]\)", q)
+        if probe:
+            if self.metrics.reject_subqueries:
+                return web.json_response(
+                    {"status": "error",
+                     "error": 'invalid parameter "query": parse error: unexpected "["'},
+                    status=400,
+                )
+            count = int(probe.group(1)) // int(probe.group(2))
+            if self.metrics.subquery_closed_boundaries:
+                count += 1
+            return web.json_response(
+                {"status": "success", "data": {"resultType": "vector",
+                                               "result": [{"metric": {}, "value": [0, str(count)]}]}}
+            )
         # `count(<batched range query>)` — the loader's series-count probe
         # for sizing sub-windows: answer with the TRUE number of series the
         # wrapped query would return (all series the matcher selects), for
@@ -374,17 +441,62 @@ class FakeBackend:
     MAX_URL_BYTES = 8192
     #: Real Prometheus rejects range queries past 11,000 points per series.
     MAX_RANGE_POINTS = 11_000
-    #: Absolute time of sample 0 when ``enforce_range`` is on (also the
-    #: static timestamp base in the pre-rendered fragments).
-    SERIES_ORIGIN = 1_700_000_000.0
+    #: Absolute time of sample 0 when ``enforce_range`` is on (the
+    #: pre-rendered fragments carry independent static timestamps; every
+    #: consumer discards them). Sits ON the absolute evaluation grid
+    #: (divisible by 900 and 60): the fake models samples by
+    #: interval-membership at ``origin + i·step``, so grid-aligned queries —
+    #: which downsample eligibility requires — describe the same sample
+    #: sets through raw slices and subquery buckets only when the origin is
+    #: aligned too (1.7e9 % 60 was 20, which silently broke that).
+    SERIES_ORIGIN = 1_699_999_200.0
 
-    def _range_response(self, body: bytes) -> web.Response:
-        """Assemble a range-query response, applying the truncated-body
-        fault: valid HTTP framing around the FIRST HALF of the JSON — the
-        parser must fail the query cleanly, never fold half a window."""
-        if self.metrics.truncate_bodies:
+    def _range_response(
+        self,
+        body: bytes,
+        request: Optional[web.Request] = None,
+        cache_key: Optional[tuple] = None,
+    ) -> web.Response:
+        """Assemble a range-query response: the truncated-body fault first
+        (valid HTTP framing around the FIRST HALF of the JSON — the parser
+        must fail the query cleanly, never fold half a window), then real
+        ``Accept-Encoding`` negotiation — gzip when the client advertised
+        it (zstd requests degrade to gzip, like a server without the
+        codec), identity otherwise or when ``compress_responses`` is off
+        (the stripped-header regime). Compressed-path faults ride here too:
+        ``truncate_compressed_tail`` serves a gzip stream missing its last
+        bytes behind intact framing, ``lie_content_encoding`` stamps
+        ``Content-Encoding: gzip`` on identity bytes."""
+        metrics = self.metrics
+        if metrics.truncate_bodies:
             body = body[: max(1, len(body) // 2)]
+        if metrics.lie_content_encoding:
+            return web.Response(
+                body=body, content_type="application/json",
+                headers={"Content-Encoding": "gzip"},
+            )
+        accept = ""
+        if request is not None:
+            accept = (request.headers.get("Accept-Encoding") or "").lower()
+        if metrics.compress_responses and "gzip" in accept:
+            faulted = metrics.truncate_bodies or metrics.truncate_compressed_tail
+            compressed = (
+                None if faulted or cache_key is None else self._gzip_cache_get(cache_key)
+            )
+            if compressed is None:
+                compressed = gzip.compress(body, compresslevel=1)
+                if not faulted and cache_key is not None:
+                    metrics._gzip_bodies[cache_key] = compressed
+            if metrics.truncate_compressed_tail:
+                compressed = compressed[: max(1, len(compressed) - metrics.truncate_compressed_tail)]
+            return web.Response(
+                body=compressed, content_type="application/json",
+                headers={"Content-Encoding": "gzip"},
+            )
         return web.Response(body=body, content_type="application/json")
+
+    def _gzip_cache_get(self, cache_key: tuple) -> Optional[bytes]:
+        return self.metrics._gzip_bodies.get(cache_key)
 
     @staticmethod
     def _step_seconds(step: str) -> float:
@@ -396,6 +508,9 @@ class FakeBackend:
 
     async def query_range(self, request: web.Request) -> web.Response:
         self.metrics.request_count += 1
+        self.metrics.range_request_encodings.append(
+            request.headers.get("Accept-Encoding")
+        )
         if len(str(request.rel_url)) > self.MAX_URL_BYTES:
             return web.json_response({"status": "error", "error": "URI Too Long"}, status=414)
         if self.metrics.down:
@@ -440,6 +555,22 @@ class FakeBackend:
                 status=400,
             )
         query = params.get("query", "")
+        # Downsample subquery shape: aggregate the INNER query's series into
+        # grid buckets (selection below runs on the inner query; assembly
+        # branches on `agg`).
+        agg: Optional[tuple[str, int, int]] = None
+        subquery = _SUBQUERY_RE.match(str(query).strip())
+        if subquery:
+            if self.metrics.reject_subqueries or self.metrics.fail_subquery_ranges:
+                # A pre-subquery backend (or a frontend blocking subqueries
+                # on the range path): the syntax itself is the error.
+                return web.json_response(
+                    {"status": "error",
+                     "error": 'invalid parameter "query": parse error: unexpected "["'},
+                    status=400,
+                )
+            agg = (subquery["fn"], int(subquery["range"]), int(subquery["step"]))
+            query = subquery["inner"]
         is_cpu = "cpu_usage" in query
         coalesced = _COALESCED_QUERY_RE.search(query)
         batched = None if coalesced else _BATCHED_QUERY_RE.search(query)
@@ -524,6 +655,14 @@ class FakeBackend:
             return web.json_response(
                 {"status": "error", "error": "injected namespace outage"}, status=500
             )
+        if agg is not None:
+            return self._aggregated_response(
+                request, agg, selected, metric_json, is_cpu, req_start, req_end,
+                step_sec,
+                cache_key=(scope, is_cpu, agg, req_start, req_end, step_sec)
+                if scope
+                else None,
+            )
         start = float(params.get("start", 0))
         step = 60.0
         if self.metrics.enforce_range:
@@ -537,7 +676,9 @@ class FakeBackend:
             t0 = self.SERIES_ORIGIN
             cache_key = (scope, is_cpu, req_start, req_end, step_sec) if scope else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
-                return self._range_response(self.metrics._batched_bodies[cache_key])
+                return self._range_response(
+                    self.metrics._batched_bodies[cache_key], request, cache_key
+                )
             fragments = []
             for ns, cont, pod in selected:
                 n = len(self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1])
@@ -553,11 +694,13 @@ class FakeBackend:
             ).encode()
             if cache_key is not None:
                 self.metrics._batched_bodies[cache_key] = body
-            return self._range_response(body)
+            return self._range_response(body, request, cache_key)
         if not self.metrics.duplicate_pods:
             cache_key = (scope, is_cpu) if scope else None
             if cache_key is not None and cache_key in self.metrics._batched_bodies:
-                return self._range_response(self.metrics._batched_bodies[cache_key])
+                return self._range_response(
+                    self.metrics._batched_bodies[cache_key], request, cache_key
+                )
             # Fast path: assemble the body from pre-rendered values strings.
             fragments = [
                 '{"metric":%s,"values":[%s]}'
@@ -570,7 +713,7 @@ class FakeBackend:
             ).encode()
             if cache_key is not None:
                 self.metrics._batched_bodies[cache_key] = body
-            return self._range_response(body)
+            return self._range_response(body, request, cache_key)
         result = []
         for ns, cont, pod in selected:
             cpu, memory = self.metrics.series[(ns, cont, pod)]
@@ -581,6 +724,57 @@ class FakeBackend:
                 dupe = [[t, repr(float(v) + 1000.0)] for t, v in values]
                 result.append({"metric": metric_dict(ns, cont, pod), "values": dupe})
         return web.json_response({"status": "success", "data": {"resultType": "matrix", "result": result}})
+
+    def _aggregated_response(
+        self, request: web.Request, agg: tuple, selected: list,
+        metric_json, is_cpu: bool, req_start: float, req_end: float, step_sec: float,
+        cache_key: Optional[tuple] = None,
+    ) -> web.Response:
+        """Evaluate a ``count/max_over_time((inner)[R:S])`` subquery like
+        real Prometheus: one outer evaluation per requested grid point,
+        each aggregating the inner samples in the half-open window
+        ``(t − R, t]`` on the inner step grid (anchored at SERIES_ORIGIN —
+        the same index math the raw enforce_range slicing uses, so
+        downsampled and raw responses describe the same samples). Empty
+        buckets emit no point, exactly like an empty inner range. Values
+        format through ``repr(float)`` like every other handler, so the
+        client's parse sees the identical float64s the raw path would."""
+        if cache_key is not None and cache_key in self.metrics._batched_bodies:
+            return self._range_response(
+                self.metrics._batched_bodies[cache_key], request, cache_key
+            )
+        fn, sub_range, sub_step = agg
+        t0 = self.SERIES_ORIGIN
+        closed = self.metrics.subquery_closed_boundaries
+        n_outer = int((req_end - req_start) // step_sec) + 1
+        fragments = []
+        for ns, cont, pod in selected:
+            samples = self.metrics.series[(ns, cont, pod)][0 if is_cpu else 1]
+            n = len(samples)
+            vals = []
+            for j in range(n_outer):
+                t = req_start + j * step_sec
+                i_hi = min(int((t - t0) // sub_step), n - 1)
+                # 3.x half-open (t-R, t] excludes the left boundary; the 2.x
+                # emulation (closed [t-R, t]) includes it.
+                left = t - sub_range - t0
+                i_lo = int(-(-left // sub_step)) if closed else int(left // sub_step) + 1
+                i_lo = max(i_lo, 0)
+                if i_hi < i_lo:
+                    continue
+                bucket = samples[i_lo : i_hi + 1]
+                value = float(bucket.max()) if fn == "max" else float(len(bucket))
+                vals.append(f'[{int(t)},"{value!r}"]')
+            if vals:
+                fragments.append(
+                    '{"metric":%s,"values":[%s]}' % (metric_json(ns, cont, pod), ",".join(vals))
+                )
+        body = (
+            '{"status":"success","data":{"resultType":"matrix","result":[%s]}}' % ",".join(fragments)
+        ).encode()
+        if cache_key is not None:
+            self.metrics._batched_bodies[cache_key] = body
+        return self._range_response(body, request, cache_key)
 
     # ----------------------------------------------------------------- app
     def build_app(self) -> web.Application:

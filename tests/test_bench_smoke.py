@@ -104,6 +104,15 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("fetchplan_sharded", 0) >= 2, secondary
     assert secondary.get("fetchplan_bitexact") == 1.0, secondary
     assert secondary.get("fetchplan_autotune_engaged") == 1.0, secondary
+    # The wire leg ran end-to-end: the compressed + downsampled scan was
+    # bit-exact vs the identity/raw control, gzip really negotiated, the
+    # stats route really rode the downsample rewrite, and the measured
+    # compression ratio beat 1 (gate failures are rc 1; assert the fields
+    # so a leg-skipping refactor can't pass silently).
+    assert secondary.get("wire_bitexact") == 1.0, secondary
+    assert secondary.get("wire_gzip_responses", 0) >= 1, secondary
+    assert secondary.get("wire_downsampled_queries", 0) >= 1, secondary
+    assert secondary.get("wire_compression_ratio", 0) >= 5.0, secondary
     # The durable-store leg ran end-to-end: the per-tick delta append beat
     # the legacy full rewrite, recovery replay was bit-exact, and the
     # SIGKILL kill-recover soak (real serve subprocesses killed mid-run)
@@ -117,9 +126,12 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("store_kill_recover_bitexact") == 1.0, secondary
     assert secondary.get("store_kills", 0) >= 2, secondary
     # The fleet leg records the ROADMAP target ratio fetch/(discover+compute)
-    # beside the fetch seconds the regression gate reads.
+    # beside the fetch seconds the regression gate reads, plus the
+    # compressed-transport wire/decoded split.
     assert "fleet_e2e_fetch_ratio" in secondary, secondary
+    assert "fleet_e2e_decoded_mb" in secondary, secondary
     # The fetch trendline gate fields are emitted unconditionally (null /
     # False when the previous round ran at a different fleet width).
     assert "fetch_vs_previous_round" in payload
     assert "fetch_regression_vs_previous" in payload
+    assert "wire_regression_vs_previous" in payload

@@ -280,7 +280,8 @@ class TestBuildScanRecord:
             "epoch": 7,
         }
         record = build_scan_record(
-            report, stats, metrics=registry, plan_delta={"coalesced": 2, "sharded": 1}
+            report, stats, metrics=registry,
+            plan_delta={"coalesced": 2, "sharded": 1, "downsampled": 4},
         )
         assert record["kind"] == "delta" and record["ts"] == 400.0
         assert record["window_seconds"] == 300.0
@@ -288,8 +289,34 @@ class TestBuildScanRecord:
         assert record["rows"] == 2 and record["failed_rows"] == 1
         assert record["publish"] == {"changed": 2, "suppressed": 3}
         assert record["persist"]["epoch"] == 7 and record["persist"]["bytes"] == 4096
-        assert record["plan"] == {"coalesced": 2, "sharded": 1, "inflight_limit": 24.0}
+        assert record["plan"] == {
+            "coalesced": 2, "sharded": 1, "downsampled": 4, "inflight_limit": 24.0,
+        }
+        # No compressed response contributed: the ratio must be absent, not
+        # a fabricated identity 1.0.
+        assert record["wire_compression_ratio"] is None
+        assert record["encodings"] == {}
         # Records must be JSON-serializable as-is (the timeline frames JSON).
+        json.dumps(record)
+
+    def test_compression_fields(self):
+        """A tick whose queries negotiated gzip carries the per-tick ratio
+        (decoded ÷ wire) and the encoding census."""
+        tracer = Tracer(ring_scans=4)
+        with tracer.span("scan", kind="serve"):
+            span = tracer.start_span(
+                "prom_query", route="streamed", status="ok", retries=0,
+            )
+            span.set(bytes=1_000_000, decoded_bytes=10_000_000, encoding="gzip")
+            tracer.finish_span(span)
+        from krr_tpu.obs.profile import profile_trace
+
+        report = profile_trace(tracer.traces()[-1])
+        record = build_scan_record(report, {"kind": "delta", "window_end": 50.0})
+        assert record["wire_bytes"] == 1_000_000
+        assert record["decoded_bytes"] == 10_000_000
+        assert record["wire_compression_ratio"] == 10.0
+        assert record["encodings"] == {"gzip": 1}
         json.dumps(record)
 
     def test_missing_profile_degrades_to_zeroes(self):

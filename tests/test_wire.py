@@ -1,0 +1,836 @@
+"""Wire-shrink tests: compressed transport + server-side downsampling.
+
+The two fronts of the "shrink the wire" PR, each bit-exact-gated against
+its escape hatch:
+
+* **Compressed transport** (``--fetch-compression``): Accept-Encoding
+  negotiation on both data planes, pooled streaming inflation into the
+  zero-hop sink pump, honest wire-vs-decoded counter split, and the loud
+  failure contract — truncated compressed tails, corrupt streams, and
+  lying ``Content-Encoding`` headers must fail the query (riding the
+  degrade/quarantine path), never fold a silently short window.
+* **Server-side pre-aggregation** (``--fetch-downsample``): stats-route
+  queries rewritten as grid-aligned ``count/max_over_time`` subqueries.
+  The golden tests prove the downsampled fetch lands BIT-compatible in
+  digest windows (fleet arrays and DigestStore folds identical to the raw
+  control), eligibility declines misaligned windows, and backend rejection
+  falls back to raw and pins the namespace persistently.
+
+Fixture note: downsample goldens anchor the fake's SERIES_ORIGIN on the
+absolute step grid (1_699_999_980 ≡ 0 mod 60). The fake models samples at
+``origin + i·step`` with interval-membership semantics (no lookback), so
+raw slices and subquery buckets describe the same sample sets only when
+the origin sits on the grid the client queries — exactly the alignment
+real Prometheus's epoch-aligned subquery steps impose, which is why the
+loader's eligibility check requires it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import zlib
+
+import numpy as np
+import pytest
+import yaml
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.fetchplan import DownsamplePlan, downsample_factor, plan_downsample
+from krr_tpu.integrations.kubernetes import KubernetesLoader
+from krr_tpu.integrations.prometheus import (
+    PrometheusLoader,
+    PrometheusQueryError,
+    _Inflater,
+    _SinkPump,
+    accept_encoding_for,
+)
+from krr_tpu.obs.metrics import MetricsRegistry
+
+from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+#: SERIES_ORIGIN shifted onto the minute grid (1.7e9 % 60 == 20): the
+#: alignment downsample eligibility requires (see module docstring).
+ALIGNED_ORIGIN = 1_699_999_980.0
+
+
+# ------------------------------------------------------------- unit: planning
+class TestDownsamplePlanning:
+    def test_factor_minute_steps_take_the_cap(self):
+        assert downsample_factor(60, 1000) == 60
+        assert downsample_factor(900, 1000) == 60
+
+    def test_factor_bounded_by_two_full_buckets(self):
+        assert downsample_factor(60, 16) == 8  # n // 2
+        assert downsample_factor(60, 3) == 0  # too small to bother
+
+    def test_factor_sub_minute_steps_stay_format_exact(self):
+        # 15 s: K must keep K*S under a minute or on a whole minute.
+        k = downsample_factor(15, 1000)
+        assert k * 15 % 60 == 0
+        # 7 s: no whole-minute multiple under the cap's reach ⇒ sub-minute.
+        k7 = downsample_factor(7, 100)
+        assert k7 >= 2 and k7 * 7 < 60
+
+    def test_requested_factor_is_sanitized_not_trusted(self):
+        assert downsample_factor(60, 1000, requested=7) == 7
+        assert downsample_factor(60, 10, requested=30) == 5  # window caps it
+        k = downsample_factor(15, 1000, requested=7)  # 105 s is not "1m45s"
+        assert k * 15 % 60 == 0 or k * 15 < 60
+
+    def test_plan_rejects_misaligned_start(self):
+        assert plan_downsample(1_700_000_020.0, 1_700_003_600.0, 60) is None
+
+    def test_plan_geometry_covers_every_point_exactly_once(self):
+        start = ALIGNED_ORIGIN
+        n = 61  # q=2 buckets of 30 plus a 1-point tail
+        plan = plan_downsample(start, start + (n - 1) * 60, 60)
+        assert isinstance(plan, DownsamplePlan)
+        assert plan.factor == 30 and plan.buckets == 2
+        covered = set()
+        for j in range(plan.buckets):
+            t = plan.coarse_start + j * plan.coarse_step_seconds
+            lo = t - plan.coarse_step_seconds
+            covered.update(
+                i for i in range(n) if lo < start + i * 60 <= t
+            )
+        assert covered == set(range(plan.buckets * plan.factor))
+        assert plan.tail_start == start + 60 * 60 and plan.tail_end == plan.tail_start
+
+    def test_plan_no_tail_when_buckets_tile_exactly(self):
+        plan = plan_downsample(ALIGNED_ORIGIN, ALIGNED_ORIGIN + 59 * 60, 60)
+        assert plan.factor * plan.buckets == 60 and plan.tail_start is None
+
+
+# ------------------------------------------------------------ unit: inflater
+class TestInflater:
+    def _gz(self, data: bytes) -> bytes:
+        return gzip.compress(data, 5)
+
+    def test_round_trip_and_multi_member(self):
+        inflater = _Inflater()
+        inflater.arm("gzip")
+        out = inflater.feed(self._gz(b"hello ") + self._gz(b"world"))
+        inflater.finish()
+        assert out == b"hello world"
+
+    def test_truncated_tail_raises_at_finish(self):
+        inflater = _Inflater()
+        inflater.arm("gzip")
+        inflater.feed(self._gz(b"x" * 4096)[:-6])
+        with pytest.raises(ValueError, match="truncated"):
+            inflater.finish()
+
+    def test_identity_bytes_claimed_gzip_raise(self):
+        inflater = _Inflater()
+        inflater.arm("gzip")
+        with pytest.raises(ValueError, match="corrupt"):
+            inflater.feed(b'{"status":"success"}')
+
+    def test_corrupt_middle_raises(self):
+        blob = bytearray(self._gz(b"y" * 8192))
+        blob[len(blob) // 2] ^= 0xFF
+        inflater = _Inflater()
+        inflater.arm("gzip")
+        with pytest.raises(ValueError, match="corrupt"):
+            inflater.feed(bytes(blob))
+            inflater.finish()
+
+    def test_unsupported_encoding_raises_at_arm(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            _Inflater().arm("br")
+
+    def test_accept_encoding_modes(self):
+        assert accept_encoding_for("off") is None
+        assert "gzip" in (accept_encoding_for("auto") or "")
+        assert accept_encoding_for("gzip") == "gzip"
+
+
+class _ListSink:
+    """Minimal stream double: collects fed chunks."""
+
+    def __init__(self):
+        self.fed: list[bytes] = []
+
+    def feed(self, chunk: bytes) -> None:
+        self.fed.append(bytes(chunk))
+
+
+class TestPumpInflation:
+    def test_pump_inflates_on_the_worker_and_counts_decoded(self):
+        from krr_tpu.integrations.prometheus import _QueryMeter
+
+        sink = _ListSink()
+        meter = _QueryMeter()
+        pump = _SinkPump(sink, meter=meter)
+        payload = b'{"status":"success","data":{"result":[]}}' * 64
+        compressed = gzip.compress(payload, 5)
+        pump.begin_body("gzip")
+        # Raw lane shape: pooled buffer readinto + commit.
+        buf = pump.acquire_buffer()
+        buf[: len(compressed)] = compressed
+        pump.commit(buf, len(compressed))
+        pump.close()
+        assert b"".join(sink.fed) == payload
+        assert meter.bytes == len(compressed)  # wire = compressed
+        assert meter.decoded_bytes == len(payload)  # decoded = post-inflate
+        assert meter.encoding == "gzip"
+
+    def test_pump_truncated_stream_fails_at_close(self):
+        pump = _SinkPump(_ListSink())
+        compressed = gzip.compress(b"z" * 4096, 5)[:-4]
+        pump.begin_body("gzip")
+        buf = pump.acquire_buffer()
+        buf[: len(compressed)] = compressed
+        pump.commit(buf, len(compressed))
+        with pytest.raises(ValueError, match="truncated"):
+            pump.close()
+
+    def test_pump_corrupt_stream_fails_at_close(self):
+        pump = _SinkPump(_ListSink())
+        pump.begin_body("gzip")
+        buf = pump.acquire_buffer()
+        junk = b'{"status":"success"}'
+        buf[: len(junk)] = junk
+        pump.commit(buf, len(junk))
+        with pytest.raises(ValueError, match="corrupt"):
+            pump.close()
+
+    def test_identity_path_untouched(self):
+        sink = _ListSink()
+        pump = _SinkPump(sink)
+        pump.begin_body(None)
+        buf = pump.acquire_buffer()
+        buf[:3] = b"abc"
+        pump.commit(buf, 3)
+        pump.close()
+        assert sink.fed == [b"abc"]
+
+
+# --------------------------------------------------------- fixture plumbing
+def _build_env(tmp_path, *, samples: int = 96, origin: float = ALIGNED_ORIGIN):
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True
+    rng = np.random.default_rng(77)
+    for ns, workloads, pods in (("alpha", 2, 2), ("beta", 1, 3)):
+        for w in range(workloads):
+            for pod in cluster.add_workload_with_pods(
+                "Deployment", f"{ns}-wl{w}", ns, pod_count=pods
+            ):
+                metrics.set_series(
+                    ns, "main", pod,
+                    cpu=rng.gamma(2.0, 0.05, samples),
+                    memory=rng.uniform(5e7, 4e8, samples),
+                )
+    backend = FakeBackend(cluster, metrics)
+    backend.SERIES_ORIGIN = origin  # instance override: grid-aligned anchor
+    server = ServerThread(backend).start()
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(yaml.dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "u"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+        "users": [{"name": "u", "user": {"token": "t"}}],
+    }))
+    return {
+        "server": server,
+        "metrics": metrics,
+        "backend": backend,
+        "kubeconfig": str(kubeconfig),
+        "origin": origin,
+        "samples": samples,
+    }
+
+
+@pytest.fixture()
+def wire_env(tmp_path):
+    env = _build_env(tmp_path)
+    yield env
+    env["server"].stop()
+
+
+def _config(env, **overrides) -> Config:
+    defaults = dict(
+        kubeconfig=env["kubeconfig"],
+        prometheus_url=env["server"].url,
+        quiet=True,
+        format="json",
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def _objects(env):
+    return asyncio.run(KubernetesLoader(_config(env)).list_scannable_objects(["fake"]))
+
+
+def _gather_digests(env, config, objects, registry=None, *, points: int = 61):
+    """One digest-fleet fetch over a grid-aligned window ending on the
+    fake's sample grid."""
+    start = env["origin"]
+    end = start + (points - 1) * 60.0
+
+    async def fetch():
+        prom = PrometheusLoader(config, cluster="fake", metrics=registry)
+        try:
+            return await prom.gather_fleet_digests(
+                objects, end - start, 60, gamma=1.01, min_value=1e-7,
+                num_buckets=128, end_time=end,
+            ), prom.planner
+        finally:
+            await prom.close()
+
+    return asyncio.run(fetch())
+
+
+def _fleet_arrays_equal(a, b) -> None:
+    for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak"):
+        np.testing.assert_array_equal(
+            getattr(a, attr), getattr(b, attr), err_msg=attr
+        )
+
+
+# --------------------------------------------------- compressed transport e2e
+class TestCompressedTransport:
+    def test_gzip_scan_bitexact_with_honest_counters(self, wire_env):
+        objects = _objects(wire_env)
+        registry = MetricsRegistry()
+        compressed, _ = _gather_digests(
+            wire_env, _config(wire_env), objects, registry
+        )
+        identity_registry = MetricsRegistry()
+        identity, _ = _gather_digests(
+            wire_env, _config(wire_env, fetch_compression="off"),
+            objects, identity_registry,
+        )
+        _fleet_arrays_equal(compressed, identity)
+        assert not compressed.failed_rows
+        wire = registry.total("krr_tpu_prom_wire_bytes_total")
+        decoded = registry.total("krr_tpu_prom_decoded_bytes_total")
+        identity_wire = identity_registry.total("krr_tpu_prom_wire_bytes_total")
+        # The split is honest: compressed wire ≪ identity wire, and the
+        # decoded side recovers the identity volume.
+        assert 0 < wire < identity_wire / 2
+        assert decoded >= identity_wire * 0.9
+        assert registry.value(
+            "krr_tpu_prom_wire_encoding_total", encoding="gzip"
+        ) >= 1
+        assert identity_registry.value(
+            "krr_tpu_prom_wire_encoding_total", encoding="identity"
+        ) >= 1
+
+    def test_off_keeps_identity_requests(self, wire_env):
+        # http.client stamps ``Accept-Encoding: identity`` when the caller
+        # sets nothing — that IS today's request shape, and off must keep
+        # it byte-identical (no gzip advertised anywhere).
+        objects = _objects(wire_env)
+        metrics = wire_env["metrics"]
+        metrics.range_request_encodings.clear()
+        _gather_digests(wire_env, _config(wire_env, fetch_compression="off"), objects)
+        assert metrics.range_request_encodings
+        assert set(metrics.range_request_encodings) == {"identity"}
+        metrics.range_request_encodings.clear()
+        _gather_digests(wire_env, _config(wire_env), objects)
+        assert all(
+            encoding and "gzip" in encoding
+            for encoding in metrics.range_request_encodings
+        )
+
+    def test_server_ignoring_accept_encoding_still_works(self, wire_env):
+        # The "proxy stripped Accept-Encoding" regime: requests advertise
+        # gzip, the server answers identity — results identical, encoding
+        # census says identity (which the wire sentinel band then pages on).
+        objects = _objects(wire_env)
+        wire_env["metrics"].compress_responses = False
+        try:
+            registry = MetricsRegistry()
+            stripped, _ = _gather_digests(
+                wire_env, _config(wire_env), objects, registry
+            )
+        finally:
+            wire_env["metrics"].compress_responses = True
+        control, _ = _gather_digests(
+            wire_env, _config(wire_env, fetch_compression="off"), objects
+        )
+        _fleet_arrays_equal(stripped, control)
+        assert registry.value(
+            "krr_tpu_prom_wire_encoding_total", encoding="identity"
+        ) >= 1
+        assert not registry.value("krr_tpu_prom_wire_encoding_total", encoding="gzip")
+
+    @pytest.mark.parametrize(
+        "knob, value",
+        [
+            ("truncate_compressed_tail", 8),
+            ("lie_content_encoding", True),
+        ],
+        ids=["truncated-gzip-tail", "gzip-claim-identity-bytes"],
+    )
+    def test_compressed_faults_degrade_loudly(self, wire_env, knob, value):
+        """Both compressed-path faults must surface as per-query failures
+        that mark every row failed (the degrade/quarantine contract) —
+        never a short window folded as success."""
+        objects = _objects(wire_env)
+        setattr(wire_env["metrics"], knob, value)
+        try:
+            fleet, _ = _gather_digests(wire_env, _config(wire_env), objects)
+        finally:
+            setattr(wire_env["metrics"], knob, type(value)(0) if knob != "lie_content_encoding" else False)
+        assert fleet.failed_rows == set(range(len(objects)))
+        # Nothing half-folded behind the failures.
+        assert not np.any(fleet.cpu_total) and not np.any(fleet.mem_total)
+
+    def test_httpx_plane_compressed_bitexact(self, wire_env, monkeypatch):
+        # Proxied environments (raw transport declines): the httpx plane
+        # negotiates too, streaming aiter_raw through the pump's inflater.
+        objects = _objects(wire_env)
+        control, _ = _gather_digests(
+            wire_env, _config(wire_env, fetch_compression="off"), objects
+        )
+        monkeypatch.setattr(
+            PrometheusLoader, "_make_raw_transport",
+            staticmethod(lambda url, headers, verify: None),
+        )
+        registry = MetricsRegistry()
+        proxied, _ = _gather_digests(wire_env, _config(wire_env), objects, registry)
+        _fleet_arrays_equal(proxied, control)
+        assert registry.value(
+            "krr_tpu_prom_wire_encoding_total", encoding="gzip"
+        ) >= 1
+        wire = registry.total("krr_tpu_prom_wire_bytes_total")
+        decoded = registry.total("krr_tpu_prom_decoded_bytes_total")
+        assert 0 < wire < decoded
+
+    def test_httpx_plane_truncated_tail_degrades_loudly(self, wire_env, monkeypatch):
+        objects = _objects(wire_env)
+        monkeypatch.setattr(
+            PrometheusLoader, "_make_raw_transport",
+            staticmethod(lambda url, headers, verify: None),
+        )
+        wire_env["metrics"].truncate_compressed_tail = 8
+        try:
+            fleet, _ = _gather_digests(wire_env, _config(wire_env), objects)
+        finally:
+            wire_env["metrics"].truncate_compressed_tail = 0
+        assert fleet.failed_rows == set(range(len(objects)))
+
+
+# ------------------------------------------------------- downsample goldens
+class TestDownsampleGolden:
+    def test_downsampled_fleet_bitexact_and_engaged(self, wire_env):
+        objects = _objects(wire_env)
+        registry = MetricsRegistry()
+        down, planner = _gather_digests(
+            wire_env, _config(wire_env, fetch_downsample="auto"), objects, registry
+        )
+        raw_registry = MetricsRegistry()
+        raw, _ = _gather_digests(
+            wire_env, _config(wire_env), objects, raw_registry
+        )
+        _fleet_arrays_equal(down, raw)
+        assert not down.failed_rows
+        assert registry.value("krr_tpu_fetch_downsampled_total", cluster="fake") >= 1
+        assert not raw_registry.value("krr_tpu_fetch_downsampled_total", cluster="fake")
+        # The point of the exercise: the stats leg's wire shrank.
+        assert (
+            registry.total("krr_tpu_prom_wire_bytes_total")
+            < raw_registry.total("krr_tpu_prom_wire_bytes_total")
+        )
+
+    def test_downsampled_folds_bitcompatible_in_digest_store_windows(self, wire_env):
+        """THE golden test: fold both fleets into digest-store windows —
+        the recommendation substrate — and require bit-identical state."""
+        from krr_tpu.core.streaming import DigestStore
+        from krr_tpu.ops.digest import DigestSpec
+
+        objects = _objects(wire_env)
+        down, _ = _gather_digests(
+            wire_env, _config(wire_env, fetch_downsample="auto"), objects
+        )
+        raw, _ = _gather_digests(wire_env, _config(wire_env), objects)
+        spec = DigestSpec(gamma=1.01, min_value=1e-7, num_buckets=128)
+        stores = []
+        for fleet in (down, raw):
+            store = DigestStore(spec=spec)
+            store.fold_fleet(fleet, mem_scale=1e6)
+            stores.append(store)
+        assert stores[0].keys == stores[1].keys
+        for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak"):
+            np.testing.assert_array_equal(
+                getattr(stores[0], attr), getattr(stores[1], attr), err_msg=attr
+            )
+
+    def test_misaligned_window_declines_downsample(self, wire_env):
+        objects = _objects(wire_env)
+        registry = MetricsRegistry()
+        start = wire_env["origin"] + 20.0  # off the absolute minute grid
+        end = start + 60 * 60.0
+
+        async def fetch():
+            prom = PrometheusLoader(
+                _config(wire_env, fetch_downsample="auto"), cluster="fake",
+                metrics=registry,
+            )
+            try:
+                return await prom.gather_fleet_digests(
+                    objects, end - start, 60, gamma=1.01, min_value=1e-7,
+                    num_buckets=128, end_time=end,
+                )
+            finally:
+                await prom.close()
+
+        fleet = asyncio.run(fetch())
+        assert not fleet.failed_rows
+        assert not registry.value("krr_tpu_fetch_downsampled_total", cluster="fake")
+
+    def test_pre_subquery_backend_fails_the_probe_once(self, wire_env):
+        """A backend without subquery support 400s the semantics probe: the
+        loader disables downsampling for the target after ONE probe — no
+        coarse queries issued, results identical to raw, no namespaces
+        pinned (the target, not the namespaces, said no)."""
+        objects = _objects(wire_env)
+        wire_env["metrics"].reject_subqueries = True
+        try:
+            registry = MetricsRegistry()
+            down, planner = _gather_digests(
+                wire_env, _config(wire_env, fetch_downsample="auto"),
+                objects, registry,
+            )
+            raw, _ = _gather_digests(wire_env, _config(wire_env), objects)
+            _fleet_arrays_equal(down, raw)
+            assert not down.failed_rows
+            assert registry.total("krr_tpu_fetch_downsample_fallback_total") == 1
+            assert not registry.value(
+                "krr_tpu_fetch_downsampled_total", cluster="fake"
+            )
+            assert planner.downsample_allowed("alpha")
+        finally:
+            wire_env["metrics"].reject_subqueries = False
+
+    def test_range_rejection_falls_back_and_pins_namespaces(self, wire_env):
+        """A frontend that answers the probe but 400s subquery RANGE
+        queries: the rewrite falls back to raw AND pins the namespaces
+        persistently (the pin rides the plan telemetry across restarts)."""
+        objects = _objects(wire_env)
+        wire_env["metrics"].fail_subquery_ranges = True
+        try:
+            registry = MetricsRegistry()
+            down, planner = _gather_digests(
+                wire_env, _config(wire_env, fetch_downsample="auto"),
+                objects, registry,
+            )
+            raw, _ = _gather_digests(wire_env, _config(wire_env), objects)
+            _fleet_arrays_equal(down, raw)
+            assert not down.failed_rows
+            assert registry.total("krr_tpu_fetch_downsample_fallback_total") >= 1
+            assert not planner.downsample_allowed("alpha")
+            assert not planner.downsample_allowed("beta")
+            state = planner.state()
+            reseeded = PrometheusLoader(
+                _config(wire_env, fetch_downsample="auto"), cluster="fake",
+                plan_seed=state,
+            )
+            assert not reseeded.planner.downsample_allowed("alpha")
+        finally:
+            wire_env["metrics"].fail_subquery_ranges = False
+
+    def test_transient_4xx_falls_back_without_pinning(self, wire_env):
+        """A 404 on the coarse leg (a proxy hiccup, a rate limit) answers
+        about the MOMENT, not the syntax: fall back this once, never pin —
+        a single transient throttle must not disable the feature forever."""
+        import asyncio as _asyncio
+
+        loader = PrometheusLoader(
+            _config(wire_env, fetch_downsample="auto"), cluster="fake"
+        )
+        loader._subquery_closed = False  # probed
+        calls = []
+
+        async def fake_query_range(query, *args, **kwargs):
+            calls.append(query)
+            if "over_time" in query:
+                raise PrometheusQueryError(429, "too many requests")
+            return []
+
+        async def fake_fold_windows(*args, **kwargs):
+            return [("raw-fallback",)]
+
+        loader._query_range = fake_query_range
+        loader._fold_windows = fake_fold_windows
+        result = _asyncio.run(
+            loader._query_range_stats(
+                "sum by (pod, container) (x)", ALIGNED_ORIGIN,
+                ALIGNED_ORIGIN + 60 * 60, 60, downsample_ns=("alpha",),
+            )
+        )
+        assert result == [("raw-fallback",)]  # fell back to the raw fetch
+        assert any("over_time" in q for q in calls)  # the rewrite was tried
+        assert loader.planner.downsample_allowed("alpha")  # …but never pinned
+
+    def test_closed_boundary_backend_stays_bitexact(self, wire_env):
+        """Prometheus < 3.0 evaluates range selectors over CLOSED [t-R, t]
+        windows (one extra aligned boundary point). The loader's semantics
+        probe detects it and shrinks each bucket's subquery range by one
+        step — the rewrite must stay bit-exact on that installed base too."""
+        objects = _objects(wire_env)
+        wire_env["metrics"].subquery_closed_boundaries = True
+        try:
+            registry = MetricsRegistry()
+            down, _ = _gather_digests(
+                wire_env, _config(wire_env, fetch_downsample="auto"),
+                objects, registry,
+            )
+            raw, _ = _gather_digests(wire_env, _config(wire_env), objects)
+        finally:
+            wire_env["metrics"].subquery_closed_boundaries = False
+        _fleet_arrays_equal(down, raw)
+        assert not down.failed_rows
+        assert registry.value("krr_tpu_fetch_downsampled_total", cluster="fake") >= 1
+
+    def test_downsample_rides_compression(self, wire_env):
+        """Both fronts together — the acceptance shape: compressed AND
+        downsampled vs the identity/raw control, bit-exact, smaller."""
+        objects = _objects(wire_env)
+        registry = MetricsRegistry()
+        treated, _ = _gather_digests(
+            wire_env,
+            _config(wire_env, fetch_downsample="auto"), objects, registry,
+        )
+        control_registry = MetricsRegistry()
+        control, _ = _gather_digests(
+            wire_env,
+            _config(wire_env, fetch_compression="off"), objects, control_registry,
+        )
+        _fleet_arrays_equal(treated, control)
+        ratio = (
+            control_registry.total("krr_tpu_prom_wire_bytes_total")
+            / max(registry.total("krr_tpu_prom_wire_bytes_total"), 1.0)
+        )
+        assert ratio > 2.0, f"wire ratio only {ratio:.2f}x"
+
+
+# ------------------------------------------------------------ serve tick e2e
+class TestServeWireBitExact:
+    """The serve legs of the acceptance criterion: clean incremental ticks
+    and quarantine catch-up legs, compressed+downsampled vs the
+    identity/raw control, through the real composition (chaos harness —
+    real loader over HTTP, fake clock)."""
+
+    TICK = 300.0
+
+    @pytest.fixture(scope="class")
+    def serve_env(self, tmp_path_factory):
+        from .fakes.chaos import ServerThread as ChaosServerThread
+        from .fakes.chaos import build_fleet, write_kubeconfig
+
+        fleet = build_fleet(samples=240, seed=29)
+        # Grid-aligned sample anchor (see module docstring): the soak clock
+        # below starts exactly one history width past it, so both arms
+        # fetch identical windows whether or not origin alignment engages.
+        fleet.backend.SERIES_ORIGIN = ALIGNED_ORIGIN
+        server = ChaosServerThread(fleet.backend).start()
+        kubeconfig = write_kubeconfig(
+            tmp_path_factory.mktemp("wire-serve") / "config", server.url
+        )
+        yield {"fleet": fleet, "server": server, "kubeconfig": kubeconfig}
+        server.stop()
+
+    def _config(self, env, **overrides) -> Config:
+        defaults = dict(
+            kubeconfig=env["kubeconfig"],
+            prometheus_url=env["server"].url,
+            strategy="tdigest",
+            quiet=True,
+            server_port=0,
+            scan_interval_seconds=self.TICK,
+            hysteresis_enabled=False,
+            prometheus_breaker_threshold=100,
+            prometheus_breaker_cooldown_seconds=0.02,
+            prometheus_retry_deadline_seconds=2.0,
+            prometheus_backoff_cap_seconds=0.25,
+            pipeline_depth=1,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        defaults.update(overrides)
+        return Config(**defaults)
+
+    def _soak(self, env, timeline=None, **overrides):
+        from .fakes.chaos import run_soak
+
+        return asyncio.run(
+            run_soak(
+                self._config(env, **overrides), env["fleet"].backend, timeline,
+                ticks=6, tick_seconds=self.TICK, start=ALIGNED_ORIGIN + 3600.0,
+            )
+        )
+
+    def test_clean_ticks_bitexact_vs_identity_raw_control(self, serve_env):
+        from .fakes.chaos import stores_bitexact
+
+        treated = self._soak(serve_env, fetch_downsample="auto")
+        control = self._soak(
+            serve_env, fetch_compression="off", fetch_downsample="off"
+        )
+        assert [t.ok for t in treated.ticks] == [True] * 6
+        equal, detail = stores_bitexact(treated.store, control.store)
+        assert equal, detail
+        assert treated.state.peek().body_json == control.state.peek().body_json
+        # Not vacuous: the treated soak really compressed and downsampled.
+        assert treated.metrics.value(
+            "krr_tpu_prom_wire_encoding_total", encoding="gzip"
+        ) >= 1
+        assert treated.metrics.total("krr_tpu_fetch_downsampled_total") >= 6
+        assert (
+            treated.metrics.total("krr_tpu_prom_wire_bytes_total")
+            < control.metrics.total("krr_tpu_prom_wire_bytes_total")
+        )
+
+    def test_quarantine_catchup_bitexact_vs_control(self, serve_env):
+        from .fakes.chaos import FaultSpec, FaultTimeline, stores_bitexact
+
+        timeline = lambda: FaultTimeline(  # noqa: E731 - fresh per soak
+            [(2, 4, FaultSpec(fail_namespaces=frozenset({"diurnal"})))]
+        )
+        treated = self._soak(serve_env, timeline(), fetch_downsample="auto")
+        control = self._soak(
+            serve_env, timeline(), fetch_compression="off", fetch_downsample="off"
+        )
+        assert treated.counts()["degraded"] >= 1
+        assert treated.counts()["aborted"] == 0
+        equal, detail = stores_bitexact(treated.store, control.store)
+        assert equal, detail
+        assert treated.state.peek().body_json == control.state.peek().body_json
+
+
+class TestProbeSingleFlight:
+    def test_concurrent_stats_fanout_probes_once(self, wire_env):
+        """A scan's first stats fan-out races every plan group into the
+        semantics probe — single-flight means ONE probe request, and on an
+        unsupported backend one warning + one fallback count, not N."""
+
+        async def drive():
+            prom = PrometheusLoader(
+                _config(wire_env, fetch_downsample="auto"), cluster="fake"
+            )
+            try:
+                await prom._ensure_connected()
+                probes = []
+                original_get = prom._client.get
+
+                async def counting_get(url, **kwargs):
+                    params = kwargs.get("params") or {}
+                    if "over_time" in str(params.get("query", "")):
+                        probes.append(params["query"])
+                    return await original_get(url, **kwargs)
+
+                prom._client.get = counting_get
+                answers = await asyncio.gather(
+                    *[prom._subquery_semantics() for _ in range(6)]
+                )
+                return answers, probes
+            finally:
+                await prom.close()
+
+        answers, probes = asyncio.run(drive())
+        assert set(answers) == {False}  # the fake speaks 3.x half-open
+        assert len(probes) == 1, probes
+
+
+class TestDecodedByteHonesty:
+    def test_compressed_buffered_parse_does_not_double_count(self, wire_env):
+        """On a compressed buffered response the transport already counted
+        the post-inflate body; the parse must not add its array bytes on
+        top — the decoded counter (and the compression ratio built on it)
+        would read ~2x."""
+        from krr_tpu.integrations.prometheus import _QueryMeter
+
+        loader = PrometheusLoader(_config(wire_env), cluster="fake")
+        meter = _QueryMeter()
+        meter.note_encoding("gzip")
+        meter.decoded_bytes = 1000  # what the transport counted
+        out = loader._decode_timed(lambda body: [(("p", ""), np.zeros(8))], b"{}", meter)
+        assert meter.decoded_bytes == 1000  # unchanged: no numpy double count
+        identity = _QueryMeter()
+        identity.note_encoding(None)
+        loader._decode_timed(lambda body: out, b"{}", identity)
+        assert identity.decoded_bytes == 64  # legacy identity semantics kept
+
+
+# --------------------------------------------------------- sentinel wire band
+class TestWireSentinelBand:
+    def test_pre_upgrade_timeline_does_not_false_page(self):
+        """Seeding from a timeline whose records predate wire accounting
+        must NOT band wire_mb at zero — the first real post-upgrade scan
+        would otherwise page a guaranteed false 'compression fell back'
+        verdict. The series instead warms up on its own samples."""
+        from krr_tpu.obs.sentinel import RegressionSentinel
+
+        sentinel = RegressionSentinel(warmup_scans=4, baseline_scans=16)
+        old = {
+            "kind": "delta",
+            "wall": 1.0,
+            "categories": {"fetch_transport": 0.5, "compute": 0.3},
+            "phases": {},
+        }
+        sentinel.seed([dict(old, ts=float(i)) for i in range(12)])
+        verdict = sentinel.observe(
+            dict(old, ts=50.0, wire_bytes=50_000_000), fire=False
+        )
+        assert verdict["status"] == "nominal", verdict
+    def test_identity_fallback_pages_as_wire_regression(self):
+        from krr_tpu.obs.sentinel import RegressionSentinel
+
+        sentinel = RegressionSentinel(warmup_scans=4, baseline_scans=16)
+        base = {
+            "kind": "delta",
+            "wall": 1.0,
+            "categories": {"fetch_transport": 0.5, "compute": 0.3},
+            "phases": {"ttfb": 0.2, "body_read": 0.2},
+        }
+        for i in range(12):
+            record = dict(base, ts=float(i), wire_bytes=5_000_000 + (i % 3) * 10_000)
+            verdict = sentinel.observe(record, fire=False)
+            assert verdict["status"] in ("warming", "nominal")
+        # A proxy starts stripping Accept-Encoding: same timings, 10x wire.
+        verdict = sentinel.observe(
+            dict(base, ts=99.0, wire_bytes=50_000_000), fire=False
+        )
+        assert verdict["status"] == "regressed"
+        assert verdict["dominant"] == "wire_mb"
+        assert verdict["excess_unit"] == "MB"  # never rendered as seconds
+        assert "identity" in verdict["suspect"] or "wire" in verdict["suspect"]
+
+    def test_timing_regression_outranks_wire_for_dominance(self):
+        """wire_mb's raw excess is megabytes — mixed-unit ranking would let
+        a marginal wire crossing steal attribution from a real timing
+        regression, so timing categories win dominance when both trip."""
+        from krr_tpu.obs.sentinel import RegressionSentinel
+
+        sentinel = RegressionSentinel(warmup_scans=4, baseline_scans=16)
+        base = {
+            "kind": "delta",
+            "wall": 1.0,
+            "categories": {"fetch_transport": 0.5, "compute": 0.3},
+            "phases": {},
+        }
+        for i in range(12):
+            sentinel.observe(
+                dict(base, ts=float(i), wire_bytes=5_000_000 + (i % 3) * 10_000),
+                fire=False,
+            )
+        verdict = sentinel.observe(
+            {
+                "kind": "delta",
+                "ts": 99.0,
+                "wall": 41.0,
+                "categories": {"fetch_transport": 40.0, "compute": 0.3},
+                "phases": {},
+                "wire_bytes": 50_000_000,  # +~45 MB excess vs +39.5 s
+            },
+            fire=False,
+        )
+        assert verdict["status"] == "regressed"
+        assert "wire_mb" in verdict["regressed"]
+        assert verdict["dominant"] == "fetch_transport"
+        assert verdict["excess_unit"] == "s"
