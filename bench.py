@@ -161,6 +161,12 @@ SMOKE_DEFAULTS = {
     # (bit-exactness, engagement, and wire_compression_ratio gates).
     "BENCH_WIRE_WORKLOADS": "2",
     "BENCH_WIRE_SAMPLES": "120",
+    # Federation leg: N in-process shards vs the single-process control
+    # (merged-store bit-exactness + engagement gates; fold seconds and
+    # delta wire bytes trended).
+    "BENCH_FED_SHARDS": "3",
+    "BENCH_FED_TICKS": "4",
+    "BENCH_FED_WORKLOADS": "2",
 }
 
 
@@ -844,6 +850,171 @@ def wire_leg(secondary: dict, check) -> None:
         ratio >= 5.0,
         f"wire_compression_ratio {ratio:.2f} < 5 "
         f"(identity {control_wire}B vs treated {treated_wire}B)",
+    )
+
+
+def federation_leg(secondary: dict, check) -> None:
+    """Federation gates (`krr_tpu.federation`): N in-process scanner shards
+    stream their ticks' delta-WAL records over real TCP to an aggregator
+    serve, against a single-process control scanning the same fleet. Two
+    parity-style gates:
+
+    * bit-exactness — the aggregator's merged DigestStore is bit-identical
+      (per key) to the single-process control's after every tick applies;
+    * engagement — every shard connected, records actually flowed, and the
+      aggregate ticks applied them (a silently idle federation must fail,
+      not trend zeros).
+
+    Trended: ``federation_fold_seconds`` (aggregate-tick replay cost, the
+    sum of the apply histogram) and ``federation_wire_bytes`` (delta record
+    payload bytes on the wire per run), under ``secondary.federation_*``.
+    """
+    import asyncio
+    import time as _time
+
+    from krr_tpu.core.runner import ScanSession
+    from krr_tpu.core.config import Config
+    from krr_tpu.federation.shard import FederatedShard
+    from krr_tpu.server.app import KrrServer
+    from tests.fakes.federation import (
+        FleetInventory,
+        MultiClusterFleet,
+        ORIGIN,
+        history_factory,
+        stores_bitexact_by_key,
+    )
+
+    shards_n = max(2, int(os.environ.get("BENCH_FED_SHARDS", 3)))
+    ticks = max(2, int(os.environ.get("BENCH_FED_TICKS", 4)))
+    workloads = max(1, int(os.environ.get("BENCH_FED_WORKLOADS", 2)))
+    tick_seconds = 300.0
+    start = ORIGIN + 3600.0
+    fleet = MultiClusterFleet(
+        clusters=shards_n,
+        namespaces_per_cluster=2,
+        workloads_per_namespace=workloads,
+        seed=53,
+    )
+
+    def config(**overrides) -> Config:
+        defaults = dict(
+            strategy="tdigest",
+            quiet=True,
+            server_port=0,
+            scan_interval_seconds=tick_seconds,
+            hysteresis_enabled=False,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        defaults.update(overrides)
+        return Config(**defaults)
+
+    async def run() -> dict:
+        now = [start]
+
+        # Single-process control over the whole fleet.
+        control = KrrServer(
+            config(),
+            session=ScanSession(
+                config(),
+                inventory=FleetInventory(fleet),
+                history_factory=history_factory(fleet),
+            ),
+            clock=lambda: now[0],
+        )
+        for t in range(ticks):
+            now[0] = start + t * tick_seconds
+            assert await control.scheduler.run_once()
+
+        # Federated: aggregator serve + one in-process shard per cluster,
+        # over real TCP.
+        now[0] = start
+        server = KrrServer(
+            config(federation_listen="127.0.0.1:0"),
+            session=ScanSession(
+                config(),
+                inventory=FleetInventory(fleet, clusters=[]),
+                history_factory=history_factory(fleet),
+            ),
+            clock=lambda: now[0],
+        )
+        await server.start(run_scheduler=False)
+        shards = [
+            FederatedShard(
+                config(
+                    clusters=[c],
+                    federation_aggregator=f"127.0.0.1:{server.aggregator.port}",
+                ),
+                session=ScanSession(
+                    config(clusters=[c]),
+                    inventory=FleetInventory(fleet, clusters=[c]),
+                    history_factory=history_factory(fleet),
+                ),
+                clock=lambda: now[0],
+                shard_id=c,
+            )
+            for c in fleet.clusters
+        ]
+        try:
+            for t in range(ticks):
+                now[0] = start + t * tick_seconds
+                for shard in shards:
+                    assert await shard.tick(now[0])
+                agg = server.aggregator
+                deadline = _time.monotonic() + 30.0
+                while not all(
+                    s.shard_id in agg._shards
+                    and agg._shards[s.shard_id].enqueued >= s.epoch
+                    for s in shards
+                ):
+                    assert _time.monotonic() < deadline, "aggregator never received"
+                    await asyncio.sleep(0.01)
+                assert await server.scheduler.run_once()
+                for shard in shards:
+                    assert await shard.wait_acked(shard.epoch, timeout=10.0)
+            metrics = server.state.metrics
+            equal, detail = stores_bitexact_by_key(
+                server.state.store, control.state.store
+            )
+            return {
+                "equal": equal,
+                "detail": detail,
+                "connected": metrics.value("krr_tpu_federation_connected_shards") or 0.0,
+                "records": metrics.total("krr_tpu_federation_records_total"),
+                "wire_bytes": metrics.total("krr_tpu_federation_bytes_total"),
+                "fold_seconds": metrics.total("krr_tpu_federation_apply_seconds_sum"),
+                "applied": sum(s.applied for s in agg._shards.values()),
+                "rows": len(server.state.store.keys),
+            }
+        finally:
+            for shard in shards:
+                await shard.close()
+            await server.shutdown()
+            await control.shutdown()
+
+    report = asyncio.run(run())
+    secondary["federation_shards"] = float(shards_n)
+    secondary["federation_ticks"] = float(ticks)
+    secondary["federation_rows"] = float(report["rows"])
+    secondary["federation_records"] = report["records"]
+    secondary["federation_wire_bytes"] = report["wire_bytes"]
+    secondary["federation_fold_seconds"] = round(report["fold_seconds"], 4)
+    secondary["federation_bitexact"] = 1.0 if report["equal"] else 0.0
+    print(
+        f"bench: federation {shards_n} shards x {ticks} ticks -> "
+        f"{report['records']:.0f} records / {report['wire_bytes'] / 1e3:.1f} KB wire, "
+        f"aggregate fold {report['fold_seconds']:.4f}s, "
+        f"merged store bit-exact: {report['equal']}",
+        file=sys.stderr,
+    )
+    check("federation_bitexact", report["equal"], report["detail"])
+    check(
+        "federation_engaged",
+        report["connected"] == shards_n
+        and report["records"] >= shards_n * ticks
+        and report["applied"] >= shards_n * ticks
+        and report["wire_bytes"] > 0,
+        f"connected={report['connected']}, records={report['records']}, "
+        f"applied={report['applied']}, wire={report['wire_bytes']}",
     )
 
 
@@ -1541,6 +1712,13 @@ def main() -> None:
         # identity/raw control, with compression engagement and a measured
         # wire_compression_ratio > 1.
         wire_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_FEDERATION"):
+        # Federation gates: N in-process shards streaming delta-WAL records
+        # over real TCP into an aggregator serve — merged store bit-exact
+        # vs the single-process control, aggregate fold cost and delta wire
+        # bytes trended.
+        federation_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_STORE"):
         # Durable-store gates: delta append vs legacy full rewrite,

@@ -301,6 +301,38 @@ class Config(pd.BaseModel):
     #: allowed to regress before the budget burns.
     sentinel_slo_budget: float = pd.Field(0.10, gt=0, le=1)
 
+    # Multi-cluster federation (`krr_tpu.federation`)
+    #: ``host:port`` the serve process accepts scanner-shard delta streams
+    #: on — setting it turns serve into the federation AGGREGATOR: the
+    #: scheduler stops scanning and each tick replays queued shard records
+    #: into the fleet store instead, publishing the merged view through the
+    #: unchanged read path. None = classic single-process serve.
+    federation_listen: Optional[str] = None
+    #: ``host:port`` of the aggregator a ``krr-tpu shard`` process streams
+    #: its delta records to.
+    federation_aggregator: Optional[str] = None
+    #: Shard identity in the federation (epoch watermarks key on it).
+    #: Default: the shard's configured cluster list joined with '/'.
+    federation_shard_id: Optional[str] = None
+    #: Shard staleness budget at the aggregator: a shard whose newest
+    #: delivered window is older than this serves carried-forward rows with
+    #: ``stale_since`` marks (the federation twin of the quarantine marks).
+    #: 0 = auto: three scan cadences.
+    federation_staleness_seconds: float = pd.Field(0.0, ge=0)
+    #: Record-count bound on BOTH sides of the federation stream: the
+    #: aggregator queues at most this many decoded-but-unapplied records
+    #: per shard before back-pressuring that shard's connection, and a
+    #: shard whose unacked buffer exceeds it collapses the backlog into
+    #: one snapshot record (bounded memory through an aggregator outage
+    #: of any length).
+    federation_queue_records: int = pd.Field(4096, ge=1)
+
+    #: One-shot recovery flag for ``--fetch-downsample`` over a persisted
+    #: window cursor that predates the flag (unaligned grid): drop the
+    #: cursor and accumulated rows at startup so the next tick runs a
+    #: grid-ALIGNED full backfill and downsampling actually engages.
+    realign_window_grid: bool = False
+
     #: Staleness budget for quarantined workloads: how old a quarantined
     #: workload's last folded sample may grow while its digests carry
     #: forward. Past the budget the workload's accumulated row is dropped

@@ -79,7 +79,6 @@ from krr_tpu.core.streaming import (
     DigestStore,
     FsOps,
     atomic_write,
-    csr_decode,
     csr_encode,
     flatnonzero_f32,
 )
@@ -99,10 +98,145 @@ def _crc(data: bytes) -> int:
 
 
 #: Public aliases for sibling durable logs that REUSE this framing (the
-#: scan timeline, `krr_tpu.obs.timeline`): same ``[u32 LE payload_len]
+#: scan timeline, `krr_tpu.obs.timeline`; the federation wire protocol,
+#: `krr_tpu.federation.protocol`): same ``[u32 LE payload_len]
 #: [u32 LE crc32(payload)][payload]`` frames, same torn-tail discipline.
 FRAME = _FRAME
 frame_crc = _crc
+
+
+# --------------------------------------------------------- record seams
+#
+# The WAL record's encode/decode/apply halves are PUBLIC module functions:
+# the federation subsystem (`krr_tpu.federation`) promotes the exact same
+# record bytes from a disk format to a network protocol — a scanner shard
+# encodes its tick's captured ops with `encode_ops` and the aggregator
+# replays them with `decode_ops` + `apply_ops`, so the wire format and the
+# WAL format cannot drift apart.
+
+def encode_ops(ops: list, *, epoch: int, extra: dict, num_buckets: int) -> bytes:
+    """Encode captured mutation ops (`DigestStore.pending_ops`) into one
+    record payload: an ``.npz`` whose ``meta`` member carries the epoch,
+    caller annotations (``extra``), and the op descriptors, with the fold
+    windows stored sparsely (CSR)."""
+    descriptors: list[dict] = []
+    arrays: dict[str, np.ndarray] = {}
+    for i, op in enumerate(ops):
+        kind = op[0]
+        if kind in ("fold", "fold_csr"):
+            if kind == "fold":
+                _, keys, cpu_counts, cpu_total, cpu_peak, mem_total, mem_peak = op
+                # The bit-view occupied scan: the window matrix is the
+                # record's dominant cost at fleet scale, and the fast
+                # scan replays bit-identically (see flatnonzero_f32).
+                vals, cols, indptr = csr_encode(
+                    cpu_counts, num_buckets, len(cpu_total),
+                    flat=flatnonzero_f32(cpu_counts),
+                )
+            else:  # pre-encoded by compact_pending (persist-failure backlog)
+                _, keys, vals, cols, indptr, cpu_total, cpu_peak, mem_total, mem_peak = op
+            arrays[f"f{i}_vals"] = vals
+            arrays[f"f{i}_cols"] = cols
+            arrays[f"f{i}_indptr"] = indptr
+            arrays[f"f{i}_cpu_total"] = np.asarray(cpu_total, np.float32)
+            arrays[f"f{i}_cpu_peak"] = np.asarray(cpu_peak, np.float32)
+            arrays[f"f{i}_mem_total"] = np.asarray(mem_total, np.float32)
+            arrays[f"f{i}_mem_peak"] = np.asarray(mem_peak, np.float32)
+            descriptor = {"kind": "fold"}
+            if keys is not None:  # whole-store folds elide the key list
+                descriptor["keys"] = list(keys)
+            descriptors.append(descriptor)
+        else:  # grow / drop carry only keys
+            descriptors.append({"kind": kind, "keys": list(op[1])})
+    meta = {"epoch": int(epoch), "extra": extra, "ops": descriptors}
+    buf = io.BytesIO()
+    # JSON as a uint8 byte array: np.savez stores str scalars as UCS-4
+    # (4 bytes per char — a fleet-wide key list would quadruple).
+    np.savez(
+        buf,
+        meta=np.frombuffer(json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+        **arrays,
+    )
+    return buf.getvalue()
+
+
+def decode_ops(payload: bytes) -> "tuple[dict, list]":
+    """Decode one record payload FULLY into ``(meta, parsed_ops)`` without
+    touching any store — the parse half of replay. A payload that fails to
+    decode raises before anything applies, so a replayer can stop cleanly
+    at the previous record, never half-applied."""
+    with np.load(io.BytesIO(payload), allow_pickle=False) as data:
+        meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+        parsed: list[tuple] = []
+        for i, op in enumerate(meta["ops"]):
+            kind = op["kind"]
+            if kind == "fold":
+                parsed.append(
+                    (
+                        kind,
+                        op.get("keys"),
+                        data[f"f{i}_vals"],
+                        data[f"f{i}_cols"],
+                        data[f"f{i}_indptr"],
+                        data[f"f{i}_cpu_total"],
+                        data[f"f{i}_cpu_peak"],
+                        data[f"f{i}_mem_total"],
+                        data[f"f{i}_mem_peak"],
+                    )
+                )
+            elif kind in ("grow", "drop"):
+                parsed.append((kind, list(op["keys"])))
+            else:
+                raise ValueError(f"unknown WAL op kind {kind!r}")
+    return meta, parsed
+
+
+def apply_ops(store: DigestStore, parsed: list) -> None:
+    """Apply decoded ops onto ``store`` in order — the mutate half of
+    replay. Ordered replay of captured fold CONTRIBUTIONS re-runs the same
+    exact float32 adds and peak maxes, so the per-key state is
+    bit-identical to having folded the windows directly. Does NOT touch
+    ``extra_meta`` or any epoch bookkeeping (callers own both: WAL
+    recovery installs the record's extra wholesale, the federation
+    aggregator keeps its own fleet-level meta)."""
+    for op in parsed:
+        kind = op[0]
+        if kind == "fold":
+            _, keys, vals, cols, indptr, cpu_total, cpu_peak, mem_total, mem_peak = op
+            rows = len(indptr) - 1
+            if keys is None:
+                # Whole-store fold (key list elided at capture: it
+                # equaled the store's rows). Apply the CSR straight
+                # onto the row arrays — bit-identical to the dense
+                # fold (CSR positions are unique, the skipped cells
+                # would have added +0.0) without materializing a
+                # dense [N x B] window per replayed record.
+                if len(store.keys) != rows:
+                    raise ValueError(
+                        f"whole-store fold expects {rows} rows, store has {len(store.keys)}"
+                    )
+                cols = np.asarray(cols).astype(np.int64, copy=False)
+                row_of = np.repeat(np.arange(rows, dtype=np.int64), np.diff(indptr))
+                store.cpu_counts.ravel()[row_of * store.spec.num_buckets + cols] += vals
+                store.cpu_total += cpu_total
+                np.maximum(store.cpu_peak, cpu_peak, out=store.cpu_peak)
+                store.mem_total += mem_total
+                np.maximum(store.mem_peak, mem_peak, out=store.mem_peak)
+            else:
+                # Keyed records scatter sparsely (no dense [rows x B]
+                # materialization — the aggregator replays MANY of these
+                # per tick) and re-capture in CSR form, so a durable
+                # aggregator's own WAL appends pin kilobytes, not dense
+                # windows. Bit-identical to the dense fold (see
+                # `DigestStore.merge_window_csr`).
+                store.merge_window_csr(
+                    keys, vals, cols, indptr,
+                    cpu_total, cpu_peak, mem_total, mem_peak,
+                )
+        elif kind == "grow":
+            store.rows_for(op[1])
+        else:  # "drop" — the parse phase rejected unknown kinds
+            store.compact(frozenset(store.keys) - set(op[1]))
 
 
 class DurableStore:
@@ -397,71 +531,14 @@ class DurableStore:
         self._wal_records = records
 
     def _apply_record(self, payload: bytes) -> None:
-        """Decode FULLY, then apply: a record that fails to decode (an
-        encoder bug — the CRC already vouched for the bytes) must leave the
-        store untouched so replay can stop cleanly at the previous record,
-        never half-applied."""
-        with np.load(io.BytesIO(payload), allow_pickle=False) as data:
-            meta = json.loads(bytes(data["meta"]).decode("utf-8"))
-            parsed: list[tuple] = []
-            for i, op in enumerate(meta["ops"]):
-                kind = op["kind"]
-                if kind == "fold":
-                    parsed.append(
-                        (
-                            kind,
-                            op.get("keys"),
-                            data[f"f{i}_vals"],
-                            data[f"f{i}_cols"],
-                            data[f"f{i}_indptr"],
-                            data[f"f{i}_cpu_total"],
-                            data[f"f{i}_cpu_peak"],
-                            data[f"f{i}_mem_total"],
-                            data[f"f{i}_mem_peak"],
-                        )
-                    )
-                elif kind in ("grow", "drop"):
-                    parsed.append((kind, list(op["keys"])))
-                else:
-                    raise ValueError(f"unknown WAL op kind {kind!r}")
-        store = self.store
-        for op in parsed:
-            kind = op[0]
-            if kind == "fold":
-                _, keys, vals, cols, indptr, cpu_total, cpu_peak, mem_total, mem_peak = op
-                rows = len(indptr) - 1
-                if keys is None:
-                    # Whole-store fold (key list elided at capture: it
-                    # equaled the store's rows). Apply the CSR straight
-                    # onto the row arrays — bit-identical to the dense
-                    # fold (CSR positions are unique, the skipped cells
-                    # would have added +0.0) without materializing a
-                    # dense [N x B] window per replayed record.
-                    if len(store.keys) != rows:
-                        raise ValueError(
-                            f"whole-store fold expects {rows} rows, store has {len(store.keys)}"
-                        )
-                    cols = np.asarray(cols).astype(np.int64, copy=False)
-                    row_of = np.repeat(np.arange(rows, dtype=np.int64), np.diff(indptr))
-                    store.cpu_counts.ravel()[row_of * store.spec.num_buckets + cols] += vals
-                    store.cpu_total += cpu_total
-                    np.maximum(store.cpu_peak, cpu_peak, out=store.cpu_peak)
-                    store.mem_total += mem_total
-                    np.maximum(store.mem_peak, mem_peak, out=store.mem_peak)
-                else:
-                    store.merge_window(
-                        keys,
-                        csr_decode(vals, cols, indptr, rows, store.spec.num_buckets),
-                        cpu_total,
-                        cpu_peak,
-                        mem_total,
-                        mem_peak,
-                    )
-            elif kind == "grow":
-                store.rows_for(op[1])
-            else:  # "drop" — the parse phase rejected unknown kinds
-                store.compact(frozenset(store.keys) - set(op[1]))
-        store.extra_meta = dict(meta.get("extra", {}))
+        """Decode FULLY, then apply (via the public `decode_ops` /
+        `apply_ops` seams): a record that fails to decode (an encoder bug —
+        the CRC already vouched for the bytes) must leave the store
+        untouched so replay can stop cleanly at the previous record, never
+        half-applied."""
+        meta, parsed = decode_ops(payload)
+        apply_ops(self.store, parsed)
+        self.store.extra_meta = dict(meta.get("extra", {}))
         self.epoch = int(meta["epoch"])
 
     def _sweep(self) -> None:
@@ -543,45 +620,12 @@ class DurableStore:
         self.maybe_compact()
 
     def _encode_record(self, ops: list, *, epoch: int) -> bytes:
-        descriptors: list[dict] = []
-        arrays: dict[str, np.ndarray] = {}
-        for i, op in enumerate(ops):
-            kind = op[0]
-            if kind in ("fold", "fold_csr"):
-                if kind == "fold":
-                    _, keys, cpu_counts, cpu_total, cpu_peak, mem_total, mem_peak = op
-                    # The bit-view occupied scan: the window matrix is the
-                    # record's dominant cost at fleet scale, and the fast
-                    # scan replays bit-identically (see flatnonzero_f32).
-                    vals, cols, indptr = csr_encode(
-                        cpu_counts, self.store.spec.num_buckets, len(cpu_total),
-                        flat=flatnonzero_f32(cpu_counts),
-                    )
-                else:  # pre-encoded by compact_pending (persist-failure backlog)
-                    _, keys, vals, cols, indptr, cpu_total, cpu_peak, mem_total, mem_peak = op
-                arrays[f"f{i}_vals"] = vals
-                arrays[f"f{i}_cols"] = cols
-                arrays[f"f{i}_indptr"] = indptr
-                arrays[f"f{i}_cpu_total"] = np.asarray(cpu_total, np.float32)
-                arrays[f"f{i}_cpu_peak"] = np.asarray(cpu_peak, np.float32)
-                arrays[f"f{i}_mem_total"] = np.asarray(mem_total, np.float32)
-                arrays[f"f{i}_mem_peak"] = np.asarray(mem_peak, np.float32)
-                descriptor = {"kind": "fold"}
-                if keys is not None:  # whole-store folds elide the key list
-                    descriptor["keys"] = list(keys)
-                descriptors.append(descriptor)
-            else:  # grow / drop carry only keys
-                descriptors.append({"kind": kind, "keys": list(op[1])})
-        meta = {"epoch": int(epoch), "extra": self.store.extra_meta, "ops": descriptors}
-        buf = io.BytesIO()
-        # JSON as a uint8 byte array: np.savez stores str scalars as UCS-4
-        # (4 bytes per char — a fleet-wide key list would quadruple).
-        np.savez(
-            buf,
-            meta=np.frombuffer(json.dumps(meta).encode("utf-8"), dtype=np.uint8),
-            **arrays,
+        return encode_ops(
+            ops,
+            epoch=epoch,
+            extra=self.store.extra_meta,
+            num_buckets=self.store.spec.num_buckets,
         )
-        return buf.getvalue()
 
     # ------------------------------------------------------------ compaction
     def maybe_compact(self, force: bool = False) -> bool:

@@ -248,7 +248,9 @@ class ScanSession:
         if self._inventory is None:
             from krr_tpu.integrations.kubernetes import KubernetesLoader
 
-            self._inventory = KubernetesLoader(self.config, logger=self.logger)
+            self._inventory = KubernetesLoader(
+                self.config, logger=self.logger, metrics=self.metrics
+            )
         return self._inventory
 
     def get_history_source(self, cluster: Optional[str]) -> HistorySource:

@@ -198,6 +198,15 @@ class DigestStore:
         #: rewriting the whole state. Off by default: untracked consumers
         #: (cold CLI scans) must not accumulate window arrays forever.
         self.track_deltas = False
+        #: When True, whole-store folds capture their key list EXPLICITLY
+        #: instead of eliding it. The elision is only sound when the replay
+        #: target holds the identical keys by induction (WAL recovery of
+        #: the same store); a capture destined for a DIFFERENT store — a
+        #: federation shard streaming its delta ops into the aggregator's
+        #: merged fleet store (`krr_tpu.federation`) — must carry keys so
+        #: the ops scatter onto the right rows of a store that also holds
+        #: other shards' keys.
+        self.capture_full_keys = False
         self._pending_ops: list = []
 
     # ------------------------------------------------------------------ merge
@@ -245,6 +254,7 @@ class DigestStore:
         # identical keys at that point. A growing window never elides.
         whole = (
             self.track_deltas
+            and not self.capture_full_keys
             and len(keys) == len(self.keys)
             and list(keys) == self.keys
         )
@@ -287,6 +297,51 @@ class DigestStore:
             np.maximum.at(self.cpu_peak, rows, f32(cpu_peak))
             np.add.at(self.mem_total, rows, f32(mem_total))
             np.maximum.at(self.mem_peak, rows, f32(mem_peak))
+        return rows
+
+    def merge_window_csr(
+        self,
+        keys: list[str],
+        vals: np.ndarray,
+        cols: np.ndarray,
+        indptr: np.ndarray,
+        cpu_total: np.ndarray,
+        cpu_peak: np.ndarray,
+        mem_total: np.ndarray,
+        mem_peak: np.ndarray,
+    ) -> np.ndarray:
+        """Sparse twin of :meth:`merge_window`: fold a CSR-encoded window
+        (the WAL/federation record form) WITHOUT materializing the dense
+        [rows x num_buckets] matrix — the replay hot path for keyed records
+        (`krr_tpu.core.durastore.apply_ops`). At delta occupancy the scatter
+        touches ~1/250th of the cells the dense fold would, and the delta
+        capture stays in CSR form (``fold_csr`` — identical WAL bytes), so
+        an aggregator replaying many shards' records never pins dense
+        windows. Bit-exactness: the scatter applies the same float32 adds
+        to the same cells in the same row-major order the dense fold would
+        (untouched cells would have added +0.0 — a no-op: digest counts
+        are sums of non-negative values, so ``-0.0`` cannot occur)."""
+
+        def f32(a: np.ndarray) -> np.ndarray:
+            return np.asarray(a).astype(np.float32, copy=False)
+
+        rows = self._ensure_rows(keys)
+        cpu_total, cpu_peak = f32(cpu_total), f32(cpu_peak)
+        mem_total, mem_peak = f32(mem_total), f32(mem_peak)
+        if self.track_deltas:
+            self._pending_ops.append(
+                ("fold_csr", list(keys), vals, cols, indptr,
+                 cpu_total, cpu_peak, mem_total, mem_peak)
+            )
+        cols64 = np.asarray(cols).astype(np.int64, copy=False)
+        row_of = np.repeat(rows, np.diff(indptr))
+        np.add.at(
+            self.cpu_counts.ravel(), row_of * self.spec.num_buckets + cols64, vals
+        )
+        np.add.at(self.cpu_total, rows, cpu_total)
+        np.maximum.at(self.cpu_peak, rows, cpu_peak)
+        np.add.at(self.mem_total, rows, mem_total)
+        np.maximum.at(self.mem_peak, rows, mem_peak)
         return rows
 
     def fold_fleet(self, fleet, mem_scale: float = 1.0) -> np.ndarray:

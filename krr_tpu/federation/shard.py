@@ -1,0 +1,663 @@
+"""The scanner shard: one cluster's discover→fetch→fold, streamed as deltas.
+
+A :class:`FederatedShard` is the serve scheduler's scan half without the
+serve half: it owns a private :class:`~krr_tpu.core.streaming.DigestStore`
+with delta capture ON, runs the existing discover → fetch → fold pipeline
+(`krr_tpu.core.runner.ScanSession`) over ITS clusters on the same
+grid-clamped window math the scheduler uses, and after each fold encodes
+the tick's captured mutation ops into one WAL-format record
+(`krr_tpu.core.durastore.encode_ops`) streamed to the central aggregator
+(`krr_tpu.federation.protocol`).
+
+Delivery discipline (the exactly-once half the shard owns):
+
+* every tick's record appends to an UNACKED buffer before it is sent; the
+  buffer only drops records the aggregator has ACKED (records are already
+  sparse-encoded bytes, so the buffer costs roughly one WAL delta per
+  unacked tick);
+* a lost connection just marks the stream down — ticks keep scanning and
+  buffering; the next pump reconnects, handshakes, and re-sends everything
+  past the aggregator's acked epoch (duplicates on the wire are discarded
+  deterministically by the aggregator's epoch watermark);
+* a shard whose GENERATION the aggregator doesn't recognize (first
+  contact, or the aggregator met a previous incarnation) cannot replay
+  history its store never captured — it re-syncs from state: the current
+  store encodes as one snapshot record flagged ``reset``, which makes the
+  aggregator drop the shard's old rows before applying (bit-exact: the
+  snapshot IS the sum of every window the shard folded).
+
+Failure domain: the whole shard. A failed fetch aborts the tick (nothing
+folds, nothing ships, the window refetches next tick) — per-workload
+quarantine stays a single-scanner concern; at the aggregator a silent
+shard's rows keep serving with ``stale_since`` marks.
+
+``krr-tpu shard`` (:func:`run_shard`) runs one as a process; tests and
+``bench.py`` drive ticks in-process with a pinned clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import time
+from collections import deque
+from typing import Optional
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.durastore import encode_ops
+from krr_tpu.core.runner import ScanSession
+from krr_tpu.core.streaming import DigestStore, object_key
+from krr_tpu.federation.protocol import (
+    FED_MAGIC,
+    FRAME_OVERHEAD,
+    MSG_ACK,
+    MSG_DELTA,
+    MSG_HELLO,
+    MSG_INVENTORY,
+    MSG_WELCOME,
+    PROTOCOL_VERSION,
+    ProtocolError,
+    decode_control,
+    encode_control,
+    encode_inventory,
+    encode_message,
+    read_message,
+)
+from krr_tpu.utils.logging import KrrLogger
+
+
+def parse_endpoint(value: str, flag: str) -> "tuple[str, int]":
+    """``host:port`` → (host, port), with IPv6 bracket support."""
+    host, sep, port = value.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"{flag} must be host:port, got {value!r}")
+    return host.strip("[]") or "127.0.0.1", int(port)
+
+
+class FederatedShard:
+    """One scanner shard: local scan state + the delta stream uplink."""
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        session: Optional[ScanSession] = None,
+        shard_id: Optional[str] = None,
+        clock=time.time,
+        logger: Optional[KrrLogger] = None,
+    ) -> None:
+        self.config = config
+        self.session = session or ScanSession(config, logger=logger)
+        self.logger = logger or self.session.logger
+        self.clock = clock
+        settings = self.session.strategy.settings
+        if not hasattr(settings, "cpu_spec"):
+            raise ValueError(
+                "krr-tpu shard requires a digest-backed strategy (tdigest): "
+                "the delta stream is digest mergeability on the wire"
+            )
+        self.spec = settings.cpu_spec()
+        self.store = DigestStore(spec=self.spec)
+        self.store.track_deltas = True
+        # Records land in the aggregator's MERGED store (other shards' rows
+        # interleave): whole-store folds must carry their key lists.
+        self.store.capture_full_keys = True
+        if not (shard_id or config.federation_shard_id):
+            clusters = config.clusters if isinstance(config.clusters, list) else None
+            shard_id = "/".join(clusters) if clusters else "default"
+        self.shard_id = shard_id or config.federation_shard_id
+        #: Fresh per store lifetime: a restarted shard can't re-send ticks
+        #: its in-memory store never captured, so the aggregator must not
+        #: resume its old epoch watermark against us.
+        self.generation = os.urandom(8).hex()
+        if not config.federation_aggregator:
+            raise ValueError("shard needs --aggregator (federation_aggregator) host:port")
+        self.host, self.port = parse_endpoint(
+            config.federation_aggregator, "--aggregator"
+        )
+        self.scan_interval = float(config.scan_interval_seconds)
+        self.discovery_interval = float(config.discovery_interval_seconds)
+        self.metrics = self.session.metrics
+
+        self.epoch = 0
+        self.last_end: Optional[float] = None
+        self._objects = None
+        self._discovered_at = -float("inf")
+        #: (epoch, framed DELTA message) awaiting the aggregator's ack.
+        #: Bounded: past ``federation_queue_records`` buffered records the
+        #: backlog COLLAPSES into one snapshot record (`_collapse_buffer`)
+        #: — a days-long aggregator outage must cost one store-sized
+        #: record, not one delta per tick until the shard OOMs.
+        self._buffer: "deque[tuple[int, bytes]]" = deque()
+        self.buffer_cap = int(getattr(config, "federation_queue_records", 4096))
+        self.acked = 0
+        self._sent_through = 0
+        self._inventory_dirty = True
+        #: Set when the aggregator met us under a different (or no)
+        #: generation: the next record we encode carries ``reset`` so the
+        #: aggregator drops our old rows before applying.
+        self._needs_reset = True
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._recv_task: Optional[asyncio.Task] = None
+        self._ack_event: Optional[asyncio.Event] = None
+        self.consecutive_failures = 0
+        self.last_error: Optional[str] = None
+
+    # ------------------------------------------------------------- scanning
+    def _step_seconds(self) -> float:
+        from krr_tpu.integrations.prometheus import effective_step_seconds
+
+        return float(
+            effective_step_seconds(
+                self.session.strategy.settings.timeframe_timedelta.total_seconds()
+            )
+        )
+
+    async def _discover(self, now: float) -> None:
+        objects = await self.session.discover()
+        if not objects and self.store.keys:
+            # Fail-soft like the scheduler: an empty inventory over a
+            # non-empty store is overwhelmingly an apiserver outage, and
+            # compacting on it would stream fleet-wide drop ops to the
+            # aggregator — destroying accumulated history centrally too.
+            self.metrics.inc("krr_tpu_discovery_failures_total")
+            self.logger.warning(
+                f"[shard {self.shard_id}] discovery returned no objects while the "
+                f"local store holds {len(self.store.keys)} rows — keeping the "
+                f"previous inventory"
+            )
+            return
+        self._objects = objects
+        self._discovered_at = now
+        self.metrics.set("krr_tpu_fleet_objects", len(objects))
+        # Churn compaction: the captured drop ops ride the next delta
+        # record, so deleted workloads leave the AGGREGATOR's store too.
+        dropped = self.store.compact({object_key(obj) for obj in objects})
+        if dropped:
+            self.metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
+        self._inventory_dirty = True
+
+    async def tick(self, now: Optional[float] = None) -> bool:
+        """One scan tick: (maybe) re-discover, fetch the due window, fold,
+        encode the captured deltas as one record, buffer + send it. Returns
+        False when no new window was due (the pump still runs, so a downed
+        connection keeps retrying between due windows)."""
+        if now is None:
+            now = float(self.clock())
+        settings = self.session.strategy.settings
+        step = self._step_seconds()
+        self.session.begin_scan()
+
+        if self._objects is None or now - self._discovered_at >= self.discovery_interval:
+            await self._discover(now)
+        objects = self._objects or []
+
+        if self.last_end is None:
+            start = now - settings.history_timedelta.total_seconds()
+            if getattr(self.config, "fetch_downsample", "off") != "off":
+                # Same grid alignment as the serve scheduler: downsampling
+                # is only exact on the absolute step grid.
+                start -= start % step
+            kind = "full"
+        else:
+            start = self.last_end + step
+            kind = "delta"
+            if start > now:
+                self.metrics.inc("krr_tpu_scans_skipped_total")
+                await self._pump()
+                return False
+        end = start + ((now - start) // step) * step
+
+        # Leg split, mirroring the scheduler: workloads that appeared since
+        # the last tick get a full-window backfill beside the fleet delta
+        # (a delta-width fetch would lose their pre-discovery history).
+        backfill_start = end - (settings.history_timedelta.total_seconds() // step) * step
+        fresh = []
+        seasoned = []
+        if kind == "delta":
+            for obj in objects:
+                (fresh if object_key(obj) not in self.store else seasoned).append(obj)
+        else:
+            seasoned = objects
+
+        legs = []
+        if seasoned or not fresh:
+            legs.append((seasoned, start, kind))
+        if fresh:
+            legs.append((fresh, backfill_start, "backfill"))
+        step_seconds = settings.timeframe_timedelta.total_seconds()
+        # Whole-shard failure domain: raise_on_failure aborts the tick on
+        # any terminal fetch failure — nothing folds, nothing ships, the
+        # window refetches next tick, and the AGGREGATOR's staleness marks
+        # cover the serving side.
+        fleets = await asyncio.gather(
+            *[
+                self.session.gather_fleet_digests(
+                    leg_objects,
+                    history_seconds=end - w_start,
+                    step_seconds=step_seconds,
+                    end_time=end,
+                    raise_on_failure=True,
+                )
+                for leg_objects, w_start, _ in legs
+                if leg_objects
+            ],
+            return_exceptions=True,
+        )
+        for fleet in fleets:
+            if isinstance(fleet, BaseException):
+                raise fleet
+
+        from krr_tpu.strategies.simple import MEMORY_SCALE
+
+        for fleet in fleets:
+            self.store.fold_fleet(fleet, MEMORY_SCALE)
+        self.last_end = end
+
+        await self._encode_tick(
+            extra={"window_end": end, "window_start": start, "kind": kind}
+        )
+        self.metrics.inc("krr_tpu_scans_total", kind="shard")
+        self.metrics.set("krr_tpu_scan_window_seconds", end - start)
+        self.metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
+        self.metrics.set("krr_tpu_digest_store_rows", len(self.store.keys))
+        if fresh:
+            self.metrics.inc("krr_tpu_backfilled_objects_total", len(fresh))
+        await self._pump()
+        return True
+
+    async def _encode_tick(self, *, extra: dict) -> None:
+        """Capture → record → buffer: one epoch per encoded record. The
+        CSR encode runs off the loop (fleet-scale records are real numpy +
+        zip work that would stall ack processing)."""
+        ops = self.store.pending_ops()
+        if self._needs_reset:
+            extra = {**extra, "reset": True}
+            self._needs_reset = False
+        payload = await asyncio.to_thread(
+            encode_ops,
+            ops,
+            epoch=self.epoch + 1,
+            extra=extra,
+            num_buckets=self.spec.num_buckets,
+        )
+        self.epoch += 1
+        self.store.clear_pending(len(ops))
+        self._buffer.append((self.epoch, encode_message(MSG_DELTA, payload)))
+        if len(self._buffer) > self.buffer_cap:
+            await self._collapse_buffer()
+        self.metrics.set("krr_tpu_federation_unacked_records", len(self._buffer))
+
+    async def _collapse_buffer(self) -> None:
+        """Replace the whole unacked backlog with ONE snapshot record at
+        the current epoch. The snapshot is flagged ``reset`` (the
+        aggregator drops the shard's superseded rows first), so it is
+        bit-exact — the store IS the sum of every buffered delta plus the
+        acked history — and bounded by the store size instead of the
+        outage length. The aggregator accepts reset records at any epoch,
+        so the collapsed epoch sequence re-anchors cleanly."""
+        dropped = len(self._buffer)
+        self._buffer.clear()
+        snapshot = await asyncio.to_thread(self._snapshot_record)
+        if snapshot is not None:
+            self._buffer.append(snapshot)
+            self._sent_through = min(self._sent_through, snapshot[0] - 1)
+        else:
+            self._needs_reset = True
+        self.logger.warning(
+            f"[shard {self.shard_id}] unacked backlog hit {dropped} records "
+            f"(--federation-queue-records {self.buffer_cap}) — collapsed into "
+            f"one snapshot record; the aggregator re-syncs from it"
+        )
+
+    def _snapshot_record(self) -> "Optional[tuple[int, bytes]]":
+        """The whole store as ONE reset record at the current epoch — the
+        generation-resync path. Applying it to fresh aggregator rows
+        reconstructs the shard's accumulated state exactly (the store IS
+        the sum of its folded windows)."""
+        store = self.store
+        if not store.keys:
+            return None
+        ops = [
+            (
+                "fold",
+                list(store.keys),
+                store.cpu_counts,
+                store.cpu_total,
+                store.cpu_peak,
+                store.mem_total,
+                store.mem_peak,
+            )
+        ]
+        payload = encode_ops(
+            ops,
+            epoch=self.epoch,
+            extra={"reset": True, "window_end": self.last_end, "kind": "snapshot"},
+            num_buckets=self.spec.num_buckets,
+        )
+        return self.epoch, encode_message(MSG_DELTA, payload)
+
+    async def run_once(self, now: Optional[float] = None) -> "Optional[bool]":
+        """One guarded tick (the shard loop's unit): failures count and
+        degrade — the stream pump still runs so the uplink heals while the
+        backend is down."""
+        try:
+            did_scan = await self.tick(now)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.metrics.inc("krr_tpu_scan_failures_total")
+            self.consecutive_failures += 1
+            self.last_error = f"{type(e).__name__}: {e}"[:300]
+            self.logger.warning(
+                f"[shard {self.shard_id}] scan failed: {e} — the window refetches next tick"
+            )
+            self.logger.debug_exception()
+            with contextlib.suppress(Exception):
+                await self._pump()
+            return None
+        else:
+            self.consecutive_failures = 0
+            return did_scan
+
+    # ------------------------------------------------------------- transport
+    async def _connect(self) -> None:
+        if self._recv_task is not None and not self._recv_task.done():
+            self._recv_task.cancel()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                FED_MAGIC
+                + encode_control(
+                    MSG_HELLO,
+                    shard_id=self.shard_id,
+                    generation=self.generation,
+                    version=PROTOCOL_VERSION,
+                    spec={
+                        "gamma": self.spec.gamma,
+                        "min_value": self.spec.min_value,
+                        "num_buckets": self.spec.num_buckets,
+                    },
+                    clusters=sorted(
+                        {obj.cluster or "" for obj in (self._objects or [])}
+                    )
+                    or (
+                        self.config.clusters
+                        if isinstance(self.config.clusters, list)
+                        else []
+                    ),
+                )
+            )
+            await writer.drain()
+            message = await read_message(reader)
+            if message is None or message[0] != MSG_WELCOME:
+                raise ProtocolError("aggregator closed the handshake without WELCOME")
+            welcome = decode_control(message[1])
+            if "error" in welcome:
+                raise ProtocolError(f"aggregator refused the handshake: {welcome['error']}")
+        except BaseException:
+            writer.close()
+            raise
+        self._inventory_dirty = True
+        if welcome.get("generation") != self.generation:
+            # The aggregator never met THIS store: nothing it acked maps to
+            # our epochs. Re-sync from state — drop the buffered deltas
+            # (the snapshot subsumes them) and ship the whole store as one
+            # reset record; an empty young store just flags the next delta.
+            self._buffer.clear()
+            self.acked = 0
+            self._sent_through = 0
+            snapshot = await asyncio.to_thread(self._snapshot_record)
+            if snapshot is not None:
+                self._buffer.append(snapshot)
+                self._sent_through = snapshot[0] - 1
+                self.acked = snapshot[0] - 1
+            else:
+                self._needs_reset = True
+            self.logger.info(
+                f"[shard {self.shard_id}] aggregator does not know generation "
+                f"{self.generation} — re-syncing from a full snapshot"
+            )
+        else:
+            acked = int(welcome.get("acked_epoch", 0))
+            self.acked = max(self.acked, acked)
+            self._prune_acked()
+            # Re-send everything past the ack (the torn-stream heal): the
+            # aggregator discards any duplicate it already enqueued.
+            self._sent_through = self.acked
+        self._reader, self._writer = reader, writer
+        self._recv_task = asyncio.ensure_future(self._recv_loop(reader))
+        self.metrics.inc("krr_tpu_federation_reconnects_total")
+        self.metrics.set("krr_tpu_federation_unacked_records", len(self._buffer))
+
+    def _prune_acked(self) -> None:
+        while self._buffer and self._buffer[0][0] <= self.acked:
+            self._buffer.popleft()
+
+    async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                message = await read_message(reader)
+                if message is None:
+                    break
+                kind, body = message
+                if kind == MSG_ACK:
+                    ack = decode_control(body)
+                    self.acked = max(self.acked, int(ack.get("epoch", 0)))
+                    self._prune_acked()
+                    self.metrics.set(
+                        "krr_tpu_federation_unacked_records", len(self._buffer)
+                    )
+                    if self._ack_event is not None:
+                        self._ack_event.set()
+        except (ProtocolError, OSError):
+            pass  # the connection is dead; the next pump reconnects
+        finally:
+            # CancelledError propagates (close() owns the suppression —
+            # swallowing it here would make the task complete "normally"
+            # and break outer cancellation scopes). Only tear down OUR
+            # connection: a reconnect may already have installed a fresh
+            # reader/writer by the time this loop unwinds.
+            if self._reader is reader:
+                self._disconnect()
+
+    def _disconnect(self) -> None:
+        writer, self._reader, self._writer = self._writer, None, None
+        if writer is not None:
+            writer.close()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def _pump(self) -> None:
+        """Send whatever is due: (re)connect, the current inventory when it
+        changed, then every buffered record past ``_sent_through``. Send
+        failures just mark the stream down — the next pump retries."""
+        if self._writer is None:
+            try:
+                await self._connect()
+            except (OSError, ProtocolError, asyncio.IncompleteReadError) as e:
+                self.logger.warning(
+                    f"[shard {self.shard_id}] cannot reach aggregator at "
+                    f"{self.host}:{self.port}: {e} — buffering "
+                    f"({len(self._buffer)} unacked record(s))"
+                )
+                return
+        writer = self._writer
+        try:
+            if self._inventory_dirty and self._objects is not None:
+                # Serialized off the loop (a fleet-scale inventory is tens
+                # of MB of model_dump + JSON — the aggregator offloads the
+                # same-size decode for the same reason).
+                body = await asyncio.to_thread(encode_inventory, self._objects)
+                if writer is not self._writer:
+                    return  # connection turned over under the encode
+                writer.write(encode_message(MSG_INVENTORY, body))
+                self._inventory_dirty = False
+            for epoch, frame in list(self._buffer):
+                if epoch <= self._sent_through:
+                    continue
+                writer.write(frame)
+                self._sent_through = epoch
+                self.metrics.inc(
+                    "krr_tpu_federation_sent_bytes_total", len(frame) - FRAME_OVERHEAD
+                )
+            await writer.drain()
+        except (OSError, ConnectionError):
+            self.logger.warning(
+                f"[shard {self.shard_id}] connection to the aggregator dropped "
+                f"mid-send — re-sending from epoch {self.acked} on reconnect"
+            )
+            self._disconnect()
+
+    async def wait_acked(self, epoch: int, timeout: float = 30.0) -> bool:
+        """Block until the aggregator has acked ``epoch`` (tests, graceful
+        shutdown). Pumps while waiting so a downed connection heals."""
+        if self._ack_event is None:
+            self._ack_event = asyncio.Event()
+        deadline = time.monotonic() + timeout
+        while self.acked < epoch:
+            if time.monotonic() >= deadline:
+                return False
+            await self._pump()
+            self._ack_event.clear()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._ack_event.wait(), timeout=0.1)
+        return True
+
+    def status(self) -> dict:
+        """The shard's /healthz body: scan + uplink posture."""
+        return {
+            "status": (
+                "ok"
+                if self.connected and self.consecutive_failures == 0
+                else "degraded"
+            ),
+            "shard_id": self.shard_id,
+            "generation": self.generation,
+            "connected": self.connected,
+            "epoch": self.epoch,
+            "acked_epoch": self.acked,
+            "unacked_records": len(self._buffer),
+            "last_window_end": self.last_end,
+            "consecutive_scan_failures": self.consecutive_failures,
+            "last_scan_error": self.last_error,
+            "objects": len(self._objects or []),
+        }
+
+    async def close(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._recv_task
+            self._recv_task = None
+        self._disconnect()
+        await self.session.close()
+
+
+class ShardStatusServer:
+    """A minimal HTTP surface for a shard process: ``GET /healthz`` (the
+    shard's scan + uplink posture as JSON) and ``GET /metrics`` (the shared
+    registry's exposition — the shard-side ``krr_tpu_federation_*`` family
+    would otherwise be write-only: `krr_tpu_federation_unacked_records` is
+    the signal that a shard is silently buffering through an aggregator
+    outage, and it manifests on the SHARD)."""
+
+    def __init__(self, shard: FederatedShard) -> None:
+        self.shard = shard
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._connections: "set[asyncio.StreamWriter]" = set()
+
+    async def serve(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(self._handle, host, port)
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "status server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        import json
+
+        self._connections.add(writer)
+        try:
+            request_line = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass  # drain headers; GET carries no body
+            parts = request_line.decode("latin-1", "replace").split()
+            path = parts[1].split("?", 1)[0] if len(parts) >= 2 else ""
+            if path == "/metrics":
+                from krr_tpu.obs.metrics import refresh_process_metrics
+
+                refresh_process_metrics(self.shard.metrics)
+                status, content_type = 200, "text/plain; version=0.0.4; charset=utf-8"
+                body = self.shard.metrics.render().encode()
+            elif path == "/healthz":
+                status, content_type = 200, "application/json"
+                body = (json.dumps(self.shard.status()) + "\n").encode()
+            else:
+                status, content_type = 404, "application/json"
+                body = b'{"error": "no route (shard serves /healthz and /metrics)"}\n'
+            reason = {200: "OK", 404: "Not Found"}[status]
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} {reason}\r\n"
+                    f"Content-Type: {content_type}\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                ).encode("latin-1")
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass
+        finally:
+            self._connections.discard(writer)
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for writer in list(self._connections):
+                writer.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+async def run_shard(config: Config, *, logger: Optional[KrrLogger] = None) -> None:
+    """The ``krr-tpu shard`` entry point: scan + stream until SIGINT/SIGTERM."""
+    import signal
+
+    shard = FederatedShard(config, logger=logger)
+    status_server = ShardStatusServer(shard)
+    await status_server.serve(config.server_host, config.server_port)
+    shard.logger.info(
+        f"Shard {shard.shard_id} scanning every {shard.scan_interval:.0f}s, "
+        f"streaming deltas to {shard.host}:{shard.port}; status on "
+        f"http://{config.server_host}:{status_server.port} (/healthz, /metrics)"
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix event loops
+            pass
+    try:
+        while not stop.is_set():
+            await shard.run_once()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(stop.wait(), timeout=shard.scan_interval)
+    finally:
+        shard.logger.info("Shard shutting down")
+        # Best-effort drain: give in-flight records a moment to ack so a
+        # rolling restart doesn't force a re-send of the whole tail.
+        if shard.epoch > shard.acked:
+            with contextlib.suppress(Exception):
+                await shard.wait_acked(shard.epoch, timeout=5.0)
+        await status_server.close()
+        await shard.close()

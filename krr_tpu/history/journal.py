@@ -399,18 +399,40 @@ class RecommendationJournal:
         if self._file is not None:
             self._file.close()
             self._file = None
+        # Re-stamp the NEWEST epoch marker into the rewritten file: older
+        # markers interleave the raw file (not the in-memory arrays) and
+        # are legitimately dropped — only the newest tick can ever be
+        # journal-ahead-of-store (the tick journals first, persists second)
+        # — but dropping that one too used to degrade reconcile_epoch to
+        # its documented no-marker no-op, so a crash landing between a
+        # compaction and the tick's store persist reconciled heuristically
+        # instead of exactly. Marker-first framing is preserved: the marker
+        # lands just before the first record of the newest tick.
+        live = self._records[: self._n]
+        marker_bytes = b""
+        marker_index: Optional[int] = None
+        if self.last_epoch is not None and self._n:
+            newest = self._max_ts
+            marker_index = int(np.argmax(live["ts"] == newest))
+            marker = np.zeros(1, dtype=RECORD_DTYPE)
+            marker["ts"] = newest
+            marker["key_hash"] = np.uint64(int(self.last_epoch))
+            marker["flags"] = FLAG_EPOCH
+            marker_bytes = marker.tobytes()
         try:
             with DigestStore.locked(self.path):
-                # Epoch markers are dropped by the rewrite (they interleave
-                # the raw file, not the in-memory arrays): a crash landing
-                # between this rewrite and the tick's store persist
-                # degrades reconcile_epoch to its no-marker no-op — the
-                # pre-epoch status quo — until the next append re-marks.
                 with atomic_write(self.path) as f:
                     f.write(MAGIC)
-                    f.write(self._records[: self._n].tobytes())
+                    if marker_index is None:
+                        f.write(live.tobytes())
+                    else:
+                        f.write(live[:marker_index].tobytes())
+                        f.write(marker_bytes)
+                        f.write(live[marker_index:].tobytes())
                 self._save_names()
-            self._markers = []
+            self._markers = (
+                [] if marker_index is None else [(marker_index, int(self.last_epoch))]
+            )
         finally:
             # Reopen the append handle even when the rewrite failed (disk
             # full mid-compaction): atomic_write left the old file intact,

@@ -10,8 +10,12 @@ directly on httpx (the ``kubernetes`` client package isn't in this image):
   ``matchLabels`` + ``matchExpressions`` (In/NotIn/Exists/DoesNotExist);
 * ``namespaces="*"`` scans everything except ``kube-system``; explicit list
   filters to those namespaces (reference `kubernetes.py:56-60`);
-* per-cluster errors are swallowed into an empty list with a logged error
-  (fail-soft, reference `kubernetes.py:51-54`).
+* per-cluster errors degrade to an empty list (fail-soft, reference
+  `kubernetes.py:51-54`) — but never silently: each failure counts in
+  ``krr_tpu_discovery_cluster_failures_total{cluster}`` and the failing
+  clusters surface on the loader's ``last_failed_clusters`` (which serve
+  reflects onto ``/healthz``), so a fleet that quietly shrank to a subset
+  of its clusters is visible without grepping logs.
 
 Improvement over the reference: pod lists are cached per (namespace,
 selector), so multi-container workloads issue one pod query instead of one per
@@ -226,10 +230,15 @@ class ClusterLoader:
     """Scans one cluster for workloads."""
 
     def __init__(self, cluster: Optional[str], config: Config, logger: KrrLogger = NULL_LOGGER,
-                 api: Optional[KubeApi] = None):
+                 api: Optional[KubeApi] = None, metrics=None):
         self.cluster = cluster
         self.config = config
         self.logger = logger
+        self.metrics = metrics
+        #: The last listing failure that degraded this cluster to an empty
+        #: inventory (None while healthy) — KubernetesLoader rolls these up
+        #: into ``last_failed_clusters`` per discovery round.
+        self.last_error: Optional[str] = None
         self._api = api
         self._api_lock = asyncio.Lock()
         self._pod_cache: dict[tuple[str, str], asyncio.Task[list[str]]] = {}
@@ -390,13 +399,27 @@ class ClusterLoader:
             return namespace != "kube-system"  # never scanned by default (reference behavior)
         return namespace in self.config.namespaces
 
+    def _record_failure(self, error: BaseException) -> None:
+        """Fail-soft bookkeeping for a discovery listing that degraded this
+        cluster to an empty inventory: counted per cluster (the metric) and
+        remembered (``last_error``, rolled up onto /healthz) — a silently
+        smaller fleet must not be silent."""
+        self.last_error = f"{type(error).__name__}: {error}"[:300]
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_discovery_cluster_failures_total",
+                cluster=self.cluster or "default",
+            )
+
     async def list_scannable_objects(self) -> list[K8sObjectData]:
         self.logger.debug(f"Listing scannable objects in {self.cluster or 'default'}")
+        self.last_error = None
         try:
             per_kind = await asyncio.gather(
                 *[self._list_workloads(kind, path) for kind, path in WORKLOAD_ENDPOINTS]
             )
         except Exception as e:
+            self._record_failure(e)
             self.logger.error(f"Error trying to list workloads in cluster {self.cluster or 'default'}: {e}")
             self.logger.debug_exception()
             return []
@@ -429,11 +452,13 @@ class ClusterLoader:
                 yield list(range(len(objects))), objects
             return
         self.logger.debug(f"Streaming scannable objects in {self.cluster or 'default'}")
+        self.last_error = None
         try:
             per_kind = await asyncio.gather(
                 *[self._list_kind_items(kind, path) for kind, path in WORKLOAD_ENDPOINTS]
             )
         except Exception as e:
+            self._record_failure(e)
             self.logger.error(f"Error trying to list workloads in cluster {self.cluster or 'default'}: {e}")
             self.logger.debug_exception()
             return
@@ -489,9 +514,15 @@ class ClusterLoader:
 class KubernetesLoader:
     """Multi-cluster inventory: context resolution + concurrent cluster scans."""
 
-    def __init__(self, config: Config, logger: KrrLogger = NULL_LOGGER):
+    def __init__(self, config: Config, logger: KrrLogger = NULL_LOGGER, metrics=None):
         self.config = config
         self.logger = logger
+        self.metrics = metrics
+        #: cluster → error string for every cluster whose LAST discovery
+        #: round failed (fail-soft degraded to an empty cluster inventory),
+        #: refreshed per listing call. The serve scheduler copies it onto
+        #: ``ServerState.discovery_failed_clusters`` for /healthz.
+        self.last_failed_clusters: dict[str, str] = {}
 
     async def list_clusters(self) -> Optional[list[str]]:
         """None means "the cluster we're inside"; otherwise kubeconfig contexts
@@ -514,14 +545,29 @@ class KubernetesLoader:
 
     def _loaders(self, clusters: Optional[list[str]]) -> list[ClusterLoader]:
         if clusters is None:
-            return [ClusterLoader(cluster=None, config=self.config, logger=self.logger)]
-        return [ClusterLoader(cluster=c, config=self.config, logger=self.logger) for c in clusters]
+            return [
+                ClusterLoader(
+                    cluster=None, config=self.config, logger=self.logger, metrics=self.metrics
+                )
+            ]
+        return [
+            ClusterLoader(cluster=c, config=self.config, logger=self.logger, metrics=self.metrics)
+            for c in clusters
+        ]
+
+    def _collect_failures(self, loaders: list[ClusterLoader]) -> None:
+        self.last_failed_clusters = {
+            loader.cluster or "default": loader.last_error
+            for loader in loaders
+            if loader.last_error
+        }
 
     async def list_scannable_objects(self, clusters: Optional[list[str]]) -> list[K8sObjectData]:
         loaders = self._loaders(clusters)
         try:
             nested = await asyncio.gather(*[loader.list_scannable_objects() for loader in loaders])
         finally:
+            self._collect_failures(loaders)
             await asyncio.gather(*[loader.close() for loader in loaders], return_exceptions=True)
         return [obj for objs in nested for obj in objs]
 
@@ -542,6 +588,10 @@ class KubernetesLoader:
                 async for positions, objects in loader.stream_scannable_objects():
                     await queue.put((ordinal, positions, objects))
             except Exception as e:
+                # The generator records its own listing failures; this
+                # catches everything past them (a mid-stream transport
+                # death) — same fail-soft verdict, same accounting.
+                loader._record_failure(e)
                 self.logger.error(
                     f"Error trying to list workloads in cluster {loader.cluster or 'default'}: {e}"
                 )
@@ -562,4 +612,5 @@ class KubernetesLoader:
             for task in pumps:  # an abandoned generator must not leak pumps
                 task.cancel()
             await asyncio.gather(*pumps, return_exceptions=True)
+            self._collect_failures(loaders)
             await asyncio.gather(*[loader.close() for loader in loaders], return_exceptions=True)

@@ -654,6 +654,55 @@ def _server_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--federation-listen", "federation_listen"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "host:port to accept federation scanner-shard delta streams "
+                "on — turns this serve into the central AGGREGATOR: scanner "
+                "shards (krr-tpu shard) own discover+fetch+fold and stream "
+                "their ticks' delta ops here; the scheduler replays them "
+                "into the fleet store and publishes the merged view through "
+                "the unchanged read path."
+            ),
+        ),
+        PanelOption(
+            ["--federation-staleness", "federation_staleness_seconds"],
+            type=float,
+            default=0.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Shard staleness budget: a shard whose newest delivered "
+                "window is older than this serves carried-forward rows with "
+                "stale_since marks. 0 = auto (three scan cadences)."
+            ),
+        ),
+        PanelOption(
+            ["--federation-queue-records", "federation_queue_records"],
+            type=int,
+            default=4096,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Most decoded-but-unapplied delta records the aggregator "
+                "queues per shard before back-pressuring that shard's stream."
+            ),
+        ),
+        PanelOption(
+            ["--realign-window-grid", "realign_window_grid"],
+            is_flag=True,
+            default=False,
+            panel="Server Settings",
+            help=(
+                "One-shot recovery for --fetch-downsample over a persisted "
+                "window cursor that predates the flag (unaligned grid): drop "
+                "the cursor and accumulated digest rows at startup so the "
+                "next tick runs a grid-aligned full backfill and downsampling "
+                "engages."
+            ),
+        ),
+        PanelOption(
             ["--timeline-path", "timeline_path"],
             default=None,
             panel="Server Settings",
@@ -890,6 +939,123 @@ def _make_serve_command(strategy_name: str, strategy_type: Any) -> click.Command
             "keeps per-container digests fresh with incremental delta scans, and "
             "GET /recommendations answers from the resident state "
             "(also: GET /healthz, GET /metrics)."
+        ),
+    )
+
+
+def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command:
+    """``krr-tpu shard``: one federation scanner shard (`krr_tpu.federation`).
+
+    Runs the discover→fetch→fold half of serve over ITS clusters (pick them
+    with ``-c``, or partition one big cluster by namespace with ``-n``) and
+    streams each tick's delta ops — the durable store's WAL records, on the
+    wire — to a central ``krr-tpu serve --federation-listen`` aggregator.
+    """
+    settings_fields = list(strategy_type.get_settings_type().model_fields)
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+        from krr_tpu.federation.shard import run_shard
+
+        clusters = list(kwargs.pop("clusters") or [])
+        namespaces = list(kwargs.pop("namespaces") or [])
+        other_args = {name: kwargs.pop(name) for name in settings_fields}
+        try:
+            config = Config(
+                clusters="*" if "*" in clusters else (clusters or None),
+                namespaces="*" if ("*" in namespaces or not namespaces) else namespaces,
+                strategy=strategy_name,
+                format="json",
+                other_args=other_args,
+                **kwargs,
+            )
+            config.create_strategy()  # validate strategy settings up front
+            if not config.federation_aggregator:
+                raise click.UsageError("--aggregator host:port is required")
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+        asyncio.run(run_shard(config, logger=config.create_logger()))
+
+    shard_options = [
+        PanelOption(
+            ["--aggregator", "federation_aggregator"],
+            default=None,
+            panel="Server Settings",
+            help="host:port of the krr-tpu serve --federation-listen aggregator (required).",
+        ),
+        PanelOption(
+            ["--shard-id", "federation_shard_id"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "Shard identity in the federation (epoch watermarks key on "
+                "it). Default: the configured cluster list."
+            ),
+        ),
+        PanelOption(
+            ["--host", "server_host"],
+            default="127.0.0.1",
+            show_default=True,
+            panel="Server Settings",
+            help="Address to bind the shard's status HTTP server to.",
+        ),
+        PanelOption(
+            ["--port", "server_port"],
+            type=int,
+            default=0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Shard status HTTP port (GET /healthz: scan + uplink "
+                "posture; GET /metrics: the shard-side krr_tpu_federation_* "
+                "family). 0 = ephemeral (logged at startup)."
+            ),
+        ),
+        PanelOption(
+            ["--federation-queue-records", "federation_queue_records"],
+            type=int,
+            default=4096,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Unacked-record buffer bound: past it the backlog collapses "
+                "into one snapshot record (bounded memory through an "
+                "aggregator outage of any length)."
+            ),
+        ),
+        PanelOption(
+            ["--scan-interval", "scan_interval_seconds"],
+            type=float,
+            default=900.0,
+            show_default=True,
+            panel="Server Settings",
+            help="Seconds between incremental delta scans on this shard.",
+        ),
+        PanelOption(
+            ["--discovery-interval", "discovery_interval_seconds"],
+            type=float,
+            default=3600.0,
+            show_default=True,
+            panel="Server Settings",
+            help="Seconds between fleet re-discoveries on this shard.",
+        ),
+    ]
+    # Shards take the scan commands' common options minus the one-shot-only
+    # flags (no formatter — output is the delta stream; no --statusz dump).
+    common = [o for o in _common_options() if o.name not in ("format", "statusz_path")]
+    return PanelCommand(
+        "shard",
+        callback=callback,
+        params=shard_options + common + _strategy_options(strategy_type),
+        help=(
+            "Run one federation scanner shard: discover+fetch+fold its "
+            "clusters locally and stream each tick's delta ops to a central "
+            "`krr-tpu serve --federation-listen` aggregator."
         ),
     )
 
@@ -1346,6 +1512,7 @@ def load_commands() -> None:
         app.add_command(_make_strategy_command(strategy_name, strategy_type))
     if "tdigest" in strategies:  # the serve + history subsystems ride the digest strategy
         app.add_command(_make_serve_command("tdigest", strategies["tdigest"]))
+        app.add_command(_make_shard_command("tdigest", strategies["tdigest"]))
         app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
     app.add_command(_make_analyze_command())
 

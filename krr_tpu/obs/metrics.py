@@ -44,6 +44,7 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_scans_skipped_total", "counter", "Scheduler ticks skipped because no new window had elapsed."),
     ("krr_tpu_scan_failures_total", "counter", "Scans aborted by an unexpected error."),
     ("krr_tpu_discovery_failures_total", "counter", "Discoveries that returned no objects while the store held rows — treated as transient inventory failures (no compaction)."),
+    ("krr_tpu_discovery_cluster_failures_total", "counter", "Per-cluster discovery listing failures that fail-soft degraded that cluster to an empty inventory (the fleet silently scans smaller until it recovers; /healthz names the failing clusters)."),
     ("krr_tpu_scan_duration_seconds", "gauge", "Last scan's wall seconds by leg (discover|fetch|fold|compute)."),
     ("krr_tpu_scan_pipeline_seconds", "gauge", "Last scan's streamed-pipeline stage busy seconds (fetch = producer span, fold = consumer busy)."),
     ("krr_tpu_scan_overlap_pct", "gauge", "Fetch/fold overlap of the last scan's streamed pipeline as a percentage of the shorter stage (100 = fully hidden)."),
@@ -116,6 +117,22 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_timeline_append_failures_total", "counter", "Scan-timeline appends that failed on a disk fault (ENOSPC/EIO) — the record survives in memory only and the next append truncates the torn tail first."),
     ("krr_tpu_scan_regression", "gauge", "Regression sentinel deviation by category: the last classified scan's sigmas above its median/MAD baseline band while that category is regressed, 0 while nominal."),
     ("krr_tpu_scan_regressions_total", "counter", "Scans the regression sentinel classified as regressed, by the dominant deviating category."),
+    # Multi-cluster federation (`krr_tpu.federation`): the aggregator's
+    # shard census + wire accounting, and the shard side's uplink state.
+    ("krr_tpu_federation_shards", "gauge", "Scanner shards known to the federation aggregator (connected or not; persisted watermarks count)."),
+    ("krr_tpu_federation_connected_shards", "gauge", "Scanner shards with a live connection to the federation aggregator."),
+    ("krr_tpu_federation_stale_shards", "gauge", "Shards whose newest applied window is older than the federation staleness budget — their workloads serve carried-forward values with stale_since marks."),
+    ("krr_tpu_federation_records_total", "counter", "Delta records accepted (decoded + queued) by the federation aggregator, by shard."),
+    ("krr_tpu_federation_duplicate_records_total", "counter", "Delta records discarded as duplicates by the aggregator's epoch watermark (exactly-once replay across shard re-sends), by shard."),
+    ("krr_tpu_federation_bytes_total", "counter", "Delta-record payload bytes received by the federation aggregator, by shard — the federation wire cost."),
+    ("krr_tpu_federation_queue_records", "gauge", "Decoded delta records queued at the aggregator awaiting the next aggregate tick (per-shard streams back-pressure past --federation-queue-records)."),
+    ("krr_tpu_federation_apply_seconds", "histogram", "Wall seconds an aggregate tick spent replaying queued shard delta records into the fleet store.", DEFAULT_SECONDS_BUCKETS),
+    ("krr_tpu_federation_shard_epoch", "gauge", "Newest delta epoch applied into the fleet store, by shard."),
+    ("krr_tpu_federation_shard_lag_seconds", "gauge", "Age of each shard's newest applied window at the last aggregate tick, by shard."),
+    ("krr_tpu_federation_disconnects_total", "counter", "Shard connections the aggregator lost (clean closes, torn frames, and protocol errors alike), by shard."),
+    ("krr_tpu_federation_unacked_records", "gauge", "Delta records a shard holds buffered awaiting the aggregator's epoch ack (re-sent on reconnect)."),
+    ("krr_tpu_federation_sent_bytes_total", "counter", "Delta-record bytes a shard has streamed to its aggregator (re-sends included)."),
+    ("krr_tpu_federation_reconnects_total", "counter", "Aggregator connections (re-)established by a shard."),
     # SLO engine (`krr_tpu.obs.health`).
     ("krr_tpu_slo_burn_rate", "gauge", "Error-budget burn rate by objective and window (fast|slow): windowed bad ratio divided by the objective's budget; 1.0 consumes exactly the budget over the window."),
     ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),

@@ -64,6 +64,10 @@ _NAME_CATEGORY = {
     "prom_query": "prom",
     "fetch": "fetch",
     "fold": "fold",
+    # The federation aggregate tick's replay of queued shard delta records
+    # (`krr_tpu.federation.aggregator`): it IS the tick's fold leg — the
+    # same WAL apply path a recovery replays — so it shares the bucket.
+    "apply": "fold",
     "compute": "compute",
     "pack": "compute",
     "digest": "compute",

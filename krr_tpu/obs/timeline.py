@@ -428,6 +428,11 @@ def build_scan_record(
         if compressed_only and wire > 0 and decoded > 0
         else None
     )
+    if "federation" in stats:
+        # Aggregate ticks (federation mode): shard census + per-tick
+        # applied records and delta wire bytes — the trendable federation
+        # cost beside the apply seconds already in `categories["fold"]`.
+        record["federation"] = dict(stats["federation"])
     plan: dict[str, Any] = {
         "coalesced": int((plan_delta or {}).get("coalesced", 0)),
         "sharded": int((plan_delta or {}).get("sharded", 0)),

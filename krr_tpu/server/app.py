@@ -316,7 +316,10 @@ class HttpApp:
             "persist_failing": self.state.persist_failing,
             "persist_failures": self.state.persist_failures,
             "last_persist_error": self.state.last_persist_error,
+            "discovery_failed_clusters": dict(self.state.discovery_failed_clusters),
         }
+        if self.state.federation is not None:
+            payload["federation"] = self.state.federation.status(float(self.clock()))
         return 200, "application/json", _json_body(payload)
 
     def _trend_text(self) -> str:
@@ -381,7 +384,10 @@ class HttpApp:
             ),
             # Degraded-state visibility without grepping logs: quarantined
             # workloads serving carried-forward values, how many ticks in a
-            # row have aborted, and the last abort's error.
+            # row have aborted, the last abort's error, and any cluster
+            # whose discovery listing failed (the fleet is silently smaller
+            # than configured until it recovers).
+            "discovery_failed_clusters": dict(self.state.discovery_failed_clusters),
             "stale_workloads": len(self.state.stale_workloads),
             "consecutive_scan_failures": self.state.consecutive_scan_failures,
             "last_scan_error": self.state.last_scan_error,
@@ -393,6 +399,10 @@ class HttpApp:
             "last_persist_error": self.state.last_persist_error,
             "slo_firing": firing,
         }
+        if self.state.federation is not None:
+            # Federation mode: per-shard connected/epoch/lag — the failure
+            # domain IS the shard, so liveness must name the silent one.
+            body["federation"] = self.state.federation.status(float(self.clock()))
         return (200 if status in ("ok", "degraded") else 503), "application/json", _json_body(body)
 
     async def _recommendations(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
@@ -795,6 +805,46 @@ class KrrServer:
                         ),
                     )
                 )
+        # Federation mode (`krr_tpu.federation`): --federation-listen turns
+        # this serve into the central AGGREGATOR — scanner shards stream
+        # their tick's delta ops here, the scheduler's aggregate tick
+        # replays them into the fleet store (the WAL recovery path), and
+        # the read path serves the merged view unchanged. Per-shard epoch
+        # watermarks recover from the store's extra_meta, so shard re-sends
+        # stay exactly-once across aggregator restarts.
+        self.aggregator = None
+        if config.federation_listen:
+            from krr_tpu.federation.aggregator import Aggregator
+            from krr_tpu.federation.shard import parse_endpoint
+
+            self._federation_endpoint = parse_endpoint(
+                config.federation_listen, "--federation-listen"
+            )
+            # Shard inventories persist in a sidecar beside the durable
+            # store (rendering metadata at discovery cadence): a restarted
+            # aggregator must keep RENDERING a dead shard's recovered rows
+            # (stale-marked) even though that shard never reconnects to
+            # re-send its inventory.
+            inventory_path = None
+            if state_path:
+                inventory_path = (
+                    _os.path.join(state_path, "federation-inventory.json")
+                    if self.durable is not None and self.durable.fmt == "sharded"
+                    else f"{state_path}.federation-inventory.json"
+                )
+            self.aggregator = Aggregator(
+                self.state,
+                settings.cpu_spec(),
+                scan_interval=config.scan_interval_seconds,
+                staleness_seconds=config.federation_staleness_seconds,
+                queue_cap=config.federation_queue_records,
+                inventory_path=inventory_path,
+                metrics=self.session.metrics,
+                logger=self.logger,
+                clock=clock,
+            )
+            self.aggregator.seed(store.extra_meta.get("federation"))
+            self.state.federation = self.aggregator
         self.scheduler = ScanScheduler(
             self.session,
             self.state,
@@ -803,6 +853,7 @@ class KrrServer:
             clock=clock,
             logger=self.logger,
             durable=self.durable,
+            aggregator=self.aggregator,
         )
         self.app = HttpApp(
             self.state,
@@ -829,6 +880,13 @@ class KrrServer:
         self._server = await asyncio.start_server(
             self.app.handle_connection, self.config.server_host, self.config.server_port
         )
+        if self.aggregator is not None:
+            host, port = self._federation_endpoint
+            await self.aggregator.serve(host, port)
+            self.logger.info(
+                f"Federation aggregator listening on {host}:{self.aggregator.port} "
+                f"(shard staleness budget {self.aggregator.staleness:.0f}s)"
+            )
         if run_scheduler:
             self.scheduler.start()
         self.logger.info(
@@ -850,6 +908,8 @@ class KrrServer:
             self.app.abort_connections()
             await self._server.wait_closed()
             self._server = None
+        if self.aggregator is not None:
+            await self.aggregator.close()
         if self.state.journal is not None:
             self.state.journal.close()
         if self.state.timeline is not None:

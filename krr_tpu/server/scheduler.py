@@ -84,9 +84,16 @@ class ScanScheduler:
         clock: Callable[[], float] = time.time,
         logger: Optional[KrrLogger] = None,
         durable=None,
+        aggregator=None,
     ) -> None:
         self.session = session
         self.state = state
+        #: Federation mode (`krr_tpu.federation.aggregator`): when set, the
+        #: scheduler stops scanning — scanner shards own discover+fetch+fold
+        #: — and each tick becomes an AGGREGATE tick instead: replay queued
+        #: shard delta records into the fleet store (the WAL recovery path)
+        #: and publish the merged view through the unchanged pipeline.
+        self.aggregator = aggregator
         #: The durable persistence engine (`krr_tpu.core.durastore`) when
         #: the serve composition opened one for state_path — per-tick delta
         #: WAL appends, threshold compaction, and the publish epoch the
@@ -167,14 +174,32 @@ class ScanScheduler:
             # grid was anchored before alignment existed, every later edge
             # inherits the misalignment (realigning mid-stream would skip
             # or double-count a partial step), and eligibility will decline
-            # every query. Loud, or the operator reads a forever-zero
-            # krr_tpu_fetch_downsampled_total as a mystery.
-            self.logger.warning(
-                "--fetch-downsample is on but the persisted window grid is not "
-                "aligned to the step grid (the state predates the flag); "
-                "downsampling stays disengaged until the window grid is rebuilt "
-                "(fresh state_path, or a full rescan after quarantine expiry)"
-            )
+            # every query — a forever-zero krr_tpu_fetch_downsampled_total.
+            if getattr(config, "realign_window_grid", False) or not self.state.store.keys:
+                # The one-shot --realign-window-grid escape (or a store with
+                # nothing to lose): drop the cursor AND the accumulated rows
+                # so the next tick runs a grid-ALIGNED full backfill — the
+                # only realignment that neither skips nor double-counts a
+                # partial step. The drop op rides the next durable persist.
+                dropped = self.state.store.compact(frozenset())
+                self.state.store.extra_meta.pop("serve_last_end", None)
+                self.state.last_end = None
+                self._quarantine.clear()
+                self._publish_stale_state()
+                self.logger.warning(
+                    f"--fetch-downsample window grid realignment: dropped the "
+                    f"persisted cursor and {dropped} accumulated row(s) — the "
+                    f"next tick runs a grid-aligned full backfill and "
+                    f"downsampling engages from it"
+                )
+            else:
+                self.logger.warning(
+                    "--fetch-downsample is on but the persisted window grid is "
+                    "not aligned to the step grid (the state predates the "
+                    "flag); downsampling stays disengaged until the window "
+                    "grid is rebuilt — restart once with --realign-window-grid "
+                    "to trade one full backfill for an aligned grid"
+                )
         # The hysteresis gate on the publish path (`krr_tpu.history.policy`).
         # A resumed journal re-seeds the trailing published baselines, so a
         # restart keeps gating against the pre-restart published values
@@ -206,6 +231,14 @@ class ScanScheduler:
     async def _discover(self, now: float) -> None:
         objects = await self.session.discover()
         metrics = self.state.metrics
+        # Per-cluster discovery failures (fail-soft listings degraded to an
+        # empty cluster): surface the FAILING CLUSTERS on /healthz instead
+        # of silently scanning a smaller fleet (the loader also counts them
+        # in krr_tpu_discovery_cluster_failures_total).
+        failed_clusters = getattr(
+            self.session.get_inventory(), "last_failed_clusters", None
+        )
+        self.state.discovery_failed_clusters = dict(failed_clusters or {})
         if not objects and self.state.store.keys:
             # Discovery is fail-soft per cluster (a listing error degrades to
             # an empty list) — an empty fleet under a non-empty resident
@@ -254,6 +287,12 @@ class ScanScheduler:
             self.state.store.extra_meta["serve_fetch_plan"] = plan_states
         else:
             self.state.store.extra_meta.pop("serve_fetch_plan", None)
+        if self.aggregator is not None:
+            # Per-shard epoch watermarks ride the SAME record as the applied
+            # ops: recovery can never see ops without the watermark that
+            # acks them, which is what makes shard re-sends exactly-once
+            # across aggregator restarts.
+            self.state.store.extra_meta["federation"] = self.aggregator.export_meta()
         with DigestStore.locked(self.state_path):
             if self.durable is not None:
                 # Sharded: one appended delta record carrying this tick's
@@ -499,8 +538,129 @@ class ScanScheduler:
                 tracer.discard(scan_span.trace_id)
             return did_scan
 
+    async def _federation_tick(self, scan_span) -> bool:
+        """The AGGREGATE tick (federation mode): replay queued shard delta
+        records into the fleet store — the WAL recovery path on the wire —
+        then publish the merged view through the unchanged pipeline (store
+        query → hysteresis → journal → render → snapshot swap → durable
+        persist). Acks flush only after the persist proves the applied ops
+        durable (memory-only serves ack right after apply)."""
+        agg = self.aggregator
+        now = float(self.clock())
+        metrics = self.state.metrics
+        tracer = self.session.tracer
+
+        t0 = time.perf_counter()
+        stale = agg.stale_marks(now)
+        pending = agg.pending_records()
+        if (
+            not pending
+            and not agg.dirty
+            and stale == self.state.stale_workloads
+            and self.state.peek() is not None
+        ):
+            metrics.inc("krr_tpu_scans_skipped_total")
+            scan_span.set(kind="skipped")
+            return False
+        agg.dirty = False
+        with tracer.span("apply", records=pending):
+            applied, applied_bytes = await agg.apply_queued()
+        t1 = time.perf_counter()
+
+        objects = agg.fleet_objects()
+        # Re-read AFTER the apply: freshly applied windows un-stale shards.
+        stale = agg.stale_marks(now)
+        self.state.stale_workloads = stale
+        metrics.set("krr_tpu_stale_workloads", len(stale))
+        end = agg.newest_window_end() or self.state.last_end or now
+        if objects:
+            keys = [object_key(obj) for obj in objects]
+            rows = await asyncio.to_thread(self.state.store.rows_for, keys)
+            await self._recompute_and_publish(objects, rows, end)
+        elif not applied:
+            # Nothing applied AND nothing to render (no shard has
+            # delivered an inventory yet): a pure no-op round.
+            metrics.inc("krr_tpu_scans_skipped_total")
+            scan_span.set(kind="skipped")
+            return False
+        # else: ops applied before any inventory arrived (e.g. an
+        # aggregator restart mid-reconnect wave) — keep serving whatever is
+        # published, but still persist + ack the applied records below.
+        # The window cursor advances whenever records applied, published or
+        # not, so freshness accounting tracks the applied windows.
+        self.state.last_end = end
+        t2 = time.perf_counter()
+
+        persist_seconds = 0.0
+        persist_bytes = 0
+        if self.state_path:
+            wal_before = self.durable.wal_size if self.durable is not None else 0
+            await self._persist()
+            persist_seconds = time.perf_counter() - t2
+            wal_after = self.durable.wal_size if self.durable is not None else 0
+            persist_bytes = max(0, wal_after - wal_before)
+        if not self.state.persist_failing:
+            # The applied ops are durable (or serve is memory-only, where
+            # apply IS the commit point): release the shards' buffers. A
+            # failing persist withholds acks — shards keep their records
+            # and the next fault-free tick's persist carries the backlog.
+            await agg.flush_acks()
+
+        metrics.inc("krr_tpu_scans_total", kind="aggregate")
+        metrics.set("krr_tpu_last_scan_timestamp_seconds", end)
+        metrics.set("krr_tpu_scan_duration_seconds", 0.0, phase="discover")
+        metrics.set("krr_tpu_scan_duration_seconds", 0.0, phase="fetch")
+        metrics.set("krr_tpu_scan_duration_seconds", t1 - t0, phase="fold")
+        metrics.set("krr_tpu_scan_duration_seconds", t2 - t1, phase="compute")
+        metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
+        metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
+        agg.tick_gauges(now)
+        federation_stats = agg.tick_stats(now, applied)
+        scan_span.set(
+            kind="aggregate",
+            window_end=end,
+            objects=len(objects),
+            applied_records=applied,
+            shards=federation_stats["shards"],
+            stale_shards=federation_stats["stale_shards"],
+        )
+        self.state.last_scan_id = scan_span.trace_id
+        self.last_tick_stats = {
+            "scan_id": scan_span.trace_id,
+            "kind": "aggregate",
+            "window_start": end,
+            "window_end": end,
+            "objects": len(objects),
+            "failed_rows": 0,
+            "backfilled": 0,
+            "stale": len(stale),
+            "publish_changed": self.state.last_publish_changed,
+            "publish_suppressed": self.state.last_publish_suppressed,
+            "persist_seconds": persist_seconds,
+            "persist_bytes": persist_bytes,
+            "persist_failing": self.state.persist_failing,
+            "epoch": (
+                self.durable.epoch
+                if self.durable is not None and self.durable.fmt == "sharded"
+                else None
+            ),
+            "federation": federation_stats,
+        }
+        self.logger.info(
+            f"aggregate tick {scan_span.trace_id or ''} applied {applied} shard "
+            f"record(s) ({applied_bytes} B) from "
+            f"{federation_stats['connected']}/{federation_stats['shards']} connected "
+            f"shard(s) ({len(self.state.store.keys)} store rows, "
+            f"{len(stale)} stale workload(s)): apply {t1 - t0:.2f}s, "
+            f"compute {t2 - t1:.2f}s"
+        )
+        return True
+
     async def _tick_traced(self, scan_span) -> bool:
         from krr_tpu.strategies.simple import MEMORY_SCALE
+
+        if self.aggregator is not None:
+            return await self._federation_tick(scan_span)
 
         now = float(self.clock())
         metrics = self.state.metrics

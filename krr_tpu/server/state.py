@@ -162,6 +162,17 @@ class ServerState:
         #: The most recent persist failure's error (survives recovery as a
         #: breadcrumb; ``persist_failing`` says whether it is current).
         self.last_persist_error: Optional[str] = None
+        #: Clusters whose last discovery listing FAILED (fail-soft degraded
+        #: to an empty cluster): cluster → error string. Surfaced on
+        #: /healthz and /statusz so a silently smaller fleet is visible;
+        #: the loader counts them in
+        #: ``krr_tpu_discovery_cluster_failures_total``. Owned by the
+        #: scheduler's discovery leg.
+        self.discovery_failed_clusters: dict[str, str] = {}
+        #: The federation aggregator (`krr_tpu.federation.aggregator`) when
+        #: serve runs with ``--federation-listen``: /healthz and /statusz
+        #: render its per-shard connected/epoch/lag state. None otherwise.
+        self.federation = None
         self._snapshot: Optional[Snapshot] = None
 
     async def publish(self, snapshot: Snapshot) -> None:

@@ -113,6 +113,17 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("wire_gzip_responses", 0) >= 1, secondary
     assert secondary.get("wire_downsampled_queries", 0) >= 1, secondary
     assert secondary.get("wire_compression_ratio", 0) >= 5.0, secondary
+    # The federation leg ran end-to-end: N in-process shards streamed
+    # delta-WAL records over real TCP into the aggregator serve, the
+    # merged store was bit-exact vs the single-process control, and the
+    # aggregate fold cost + delta wire bytes are trended (gate failures
+    # are rc 1; assert the fields so a leg-skipping refactor can't pass
+    # silently).
+    assert secondary.get("federation_bitexact") == 1.0, secondary
+    assert secondary.get("federation_shards", 0) >= 3, secondary
+    assert secondary.get("federation_records", 0) >= 12, secondary
+    assert secondary.get("federation_wire_bytes", 0) > 0, secondary
+    assert "federation_fold_seconds" in secondary, secondary
     # The durable-store leg ran end-to-end: the per-tick delta append beat
     # the legacy full rewrite, recovery replay was bit-exact, and the
     # SIGKILL kill-recover soak (real serve subprocesses killed mid-run)

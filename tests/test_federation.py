@@ -1,0 +1,1076 @@
+"""Federation subsystem tests (`krr_tpu.federation`).
+
+The headline is the scatter-gather acceptance criterion: an N-shard
+federated scan over the fake multi-cluster backend produces a merged
+DigestStore BIT-exact (per key) vs the single-process scan of the same
+fleet — including through a mid-record disconnect + reconnect
+(exactly-once replay via epoch acks) and a permanently-dead shard
+(carried-forward rows serve with stale marks while healthy shards
+publish). The protocol decoder rides the durastore torn-tail/bit-flip
+property-matrix discipline: everything past the first torn or corrupt
+frame is discarded, nothing half-applies, the re-send heals it.
+"""
+
+import asyncio
+import contextlib
+import json
+import time
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.durastore import encode_ops
+from krr_tpu.core.runner import ScanSession
+from krr_tpu.core.streaming import DigestStore, object_key
+from krr_tpu.federation.protocol import (
+    FED_MAGIC,
+    MSG_ACK,
+    MSG_DELTA,
+    MSG_HELLO,
+    MSG_INVENTORY,
+    MSG_WELCOME,
+    PROTOCOL_VERSION,
+    ProtocolError,
+    decode_control,
+    encode_control,
+    encode_inventory,
+    encode_message,
+    read_message,
+    scan_messages,
+)
+from krr_tpu.federation.shard import FederatedShard
+from krr_tpu.server.app import KrrServer
+
+from .fakes.federation import (
+    ORIGIN,
+    FleetInventory,
+    MultiClusterFleet,
+    WindowedHistory,
+    history_factory,
+    stores_bitexact_by_key,
+)
+
+TICK = 300.0
+START = ORIGIN + 3600.0
+
+
+def base_config(**overrides) -> Config:
+    other_args = {"history_duration": 1, "timeframe_duration": 1}
+    other_args.update(overrides.pop("other_args", {}))
+    defaults = dict(
+        strategy="tdigest",
+        quiet=True,
+        server_port=0,
+        scan_interval_seconds=TICK,
+        hysteresis_enabled=False,
+        other_args=other_args,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def control_server(fleet: MultiClusterFleet, clock, **overrides) -> KrrServer:
+    config = base_config(**overrides)
+    session = ScanSession(
+        config,
+        inventory=FleetInventory(fleet),
+        history_factory=history_factory(fleet),
+        logger=config.create_logger(),
+    )
+    return KrrServer(config, session=session, clock=clock)
+
+
+def aggregator_server(fleet: MultiClusterFleet, clock, **overrides) -> KrrServer:
+    config = base_config(federation_listen="127.0.0.1:0", **overrides)
+    session = ScanSession(
+        config,
+        inventory=FleetInventory(fleet, clusters=[]),
+        history_factory=history_factory(fleet),
+        logger=config.create_logger(),
+    )
+    return KrrServer(config, session=session, clock=clock)
+
+
+def make_shard(fleet: MultiClusterFleet, cluster: str, port: int, clock, **overrides) -> FederatedShard:
+    config = base_config(
+        clusters=[cluster],
+        federation_aggregator=f"127.0.0.1:{port}",
+        **overrides,
+    )
+    session = ScanSession(
+        config,
+        inventory=FleetInventory(fleet, clusters=[cluster]),
+        history_factory=history_factory(fleet),
+        logger=config.create_logger(),
+    )
+    return FederatedShard(config, session=session, clock=clock, shard_id=cluster)
+
+
+class _NamespaceScopedInventory(FleetInventory):
+    """One cluster partitioned by namespace: each shard sees only its
+    namespace's objects (the `krr-tpu shard -n` topology)."""
+
+    def __init__(self, fleet, cluster, namespaces):
+        super().__init__(fleet, clusters=[cluster])
+        self.namespaces = set(namespaces)
+
+    async def list_scannable_objects(self, clusters):
+        objects = await super().list_scannable_objects(clusters)
+        return [obj for obj in objects if obj.namespace in self.namespaces]
+
+
+def make_namespace_shard(
+    fleet: MultiClusterFleet, cluster: str, namespace: str, port: int, clock
+) -> FederatedShard:
+    config = base_config(
+        clusters=[cluster],
+        namespaces=[namespace],
+        federation_aggregator=f"127.0.0.1:{port}",
+    )
+    session = ScanSession(
+        config,
+        inventory=_NamespaceScopedInventory(fleet, cluster, [namespace]),
+        history_factory=history_factory(fleet),
+        logger=config.create_logger(),
+    )
+    return FederatedShard(config, session=session, clock=clock, shard_id=namespace)
+
+
+async def wait_for(predicate, timeout: float = 10.0, message: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out waiting for {message}"
+        await asyncio.sleep(0.01)
+
+
+async def federated_round(server: KrrServer, shards, now: float) -> None:
+    """One federation round: every shard ticks, the aggregator receives,
+    one aggregate tick applies + publishes, acks flow back."""
+    for shard in shards:
+        await shard.tick(now)
+    agg = server.aggregator
+    await wait_for(
+        lambda: all(
+            shard.shard_id in agg._shards
+            and agg._shards[shard.shard_id].enqueued >= shard.epoch
+            for shard in shards
+        ),
+        message="aggregator to enqueue every shard's tick",
+    )
+    await server.scheduler.run_once()
+    for shard in shards:
+        assert await shard.wait_acked(shard.epoch, timeout=5.0), (
+            f"shard {shard.shard_id} never got its ack past epoch {shard.acked}"
+        )
+
+
+async def run_control(fleet: MultiClusterFleet, ticks: int, **overrides):
+    now = [START]
+    server = control_server(fleet, lambda: now[0], **overrides)
+    for t in range(ticks):
+        now[0] = START + t * TICK
+        assert await server.scheduler.run_once()
+    return server
+
+
+# --------------------------------------------------------------- protocol
+class TestProtocolFraming:
+    def _blob(self, n: int = 5) -> "tuple[bytes, list]":
+        messages = []
+        blob = b""
+        for i in range(n):
+            body = json.dumps({"i": i, "pad": "x" * (17 * (i + 1))}).encode()
+            kind = [MSG_HELLO, MSG_DELTA, MSG_ACK, MSG_INVENTORY, MSG_WELCOME][i % 5]
+            messages.append((kind, body))
+            blob += encode_message(kind, body)
+        return blob, messages
+
+    def test_round_trip(self):
+        blob, messages = self._blob()
+        decoded, good = scan_messages(blob)
+        assert decoded == messages
+        assert good == len(blob)
+
+    def test_torn_tail_matrix(self):
+        """Every cut offset: only whole frames before the cut survive —
+        the durastore torn-tail discipline on the wire."""
+        blob, messages = self._blob()
+        boundaries = [0]
+        pos = 0
+        for kind, body in messages:
+            pos += 8 + 1 + len(body)
+            boundaries.append(pos)
+        for cut in range(len(blob) + 1):
+            decoded, good = scan_messages(blob[:cut])
+            whole = max(i for i, b in enumerate(boundaries) if b <= cut)
+            assert len(decoded) == whole, f"cut at {cut}"
+            assert good == boundaries[whole]
+            assert decoded == messages[:whole]
+
+    def test_bit_flip_matrix(self):
+        """A flipped bit anywhere in a frame kills that frame and the rest
+        of the stream (CRC, length, or type corruption) — never a
+        half-decoded message."""
+        blob, messages = self._blob()
+        boundaries = [0]
+        pos = 0
+        for kind, body in messages:
+            pos += 8 + 1 + len(body)
+            boundaries.append(pos)
+        for offset in range(0, len(blob), 7):
+            corrupt = bytearray(blob)
+            corrupt[offset] ^= 0x40
+            decoded, good = scan_messages(bytes(corrupt))
+            # Frames strictly before the corrupted one survive intact.
+            hit = max(i for i, b in enumerate(boundaries) if b <= offset)
+            assert len(decoded) <= hit
+            assert decoded == messages[: len(decoded)]
+            assert good <= boundaries[hit]
+
+    def test_stream_reader_clean_eof_and_torn(self):
+        async def main():
+            blob, messages = self._blob(2)
+
+            reader = asyncio.StreamReader()
+            reader.feed_data(blob)
+            reader.feed_eof()
+            got = []
+            while True:
+                message = await read_message(reader)
+                if message is None:
+                    break
+                got.append(message)
+            assert got == messages
+
+            # Mid-frame EOF: the partial message is DISCARDED via a raise.
+            reader = asyncio.StreamReader()
+            reader.feed_data(blob[: len(blob) - 3])
+            reader.feed_eof()
+            assert await read_message(reader) == messages[0]
+            with pytest.raises(ProtocolError):
+                await read_message(reader)
+
+        asyncio.run(main())
+
+    def test_crc_mismatch_raises(self):
+        async def main():
+            frame = bytearray(encode_message(MSG_ACK, b'{"epoch": 3}'))
+            frame[-1] ^= 0x01
+            reader = asyncio.StreamReader()
+            reader.feed_data(bytes(frame))
+            reader.feed_eof()
+            with pytest.raises(ProtocolError):
+                await read_message(reader)
+
+        asyncio.run(main())
+
+
+# ------------------------------------------------------------ acceptance
+class TestFederatedScan:
+    """N in-process shards vs the single-process control."""
+
+    def test_merged_store_bitexact_vs_single_process(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=3, seed=11)
+            control = await run_control(fleet, ticks=4)
+            try:
+                now = [START]
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                shards = [
+                    make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                    for c in fleet.clusters
+                ]
+                try:
+                    for t in range(4):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                    # The published view matches too: same store query on
+                    # key-aligned rows.
+                    keys = list(server.state.store.keys)
+                    rows_fed = server.state.store.rows_for(keys)
+                    rows_ctl = control.state.store.rows_for(keys)
+                    cpu_f, mem_f = server.state.store.query_recommendation(rows_fed, 95.0)
+                    cpu_c, mem_c = control.state.store.query_recommendation(rows_ctl, 95.0)
+                    np.testing.assert_array_equal(cpu_f, cpu_c)
+                    np.testing.assert_array_equal(mem_f, mem_c)
+                    # The read path serves the merged fleet.
+                    snapshot = server.state.peek()
+                    assert snapshot is not None
+                    assert len(snapshot.result.scans) == len(fleet.all_objects())
+                    # Obs loop: federation metrics fired and /healthz carries
+                    # the shard census.
+                    metrics = server.state.metrics
+                    assert metrics.value("krr_tpu_federation_connected_shards") == 3
+                    assert metrics.total("krr_tpu_federation_records_total") >= 12
+                    assert metrics.total("krr_tpu_federation_bytes_total") > 0
+                    status, _ct, body = await server.app.route("GET", "/healthz", {})
+                    payload = json.loads(body)
+                    assert status == 200
+                    assert sorted(payload["federation"]["shards"]) == ["c0", "c1", "c2"]
+                    for entry in payload["federation"]["shards"].values():
+                        assert entry["connected"] and not entry["stale"]
+                finally:
+                    for shard in shards:
+                        await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+    def test_mid_stream_disconnect_reconnect_exactly_once(self):
+        """Kill the uplink mid-tick: the shard re-sends from the acked
+        epoch, duplicates are discarded deterministically, and the merged
+        store stays bit-exact with the never-disconnected control."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=2, seed=23)
+            control = await run_control(fleet, ticks=5)
+            try:
+                now = [START]
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                shards = [
+                    make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                    for c in fleet.clusters
+                ]
+                try:
+                    for t in range(2):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    # Tick 2: shard 0 scans but its connection dies before
+                    # the send — the record stays buffered unacked.
+                    victim = shards[0]
+                    now[0] = START + 2 * TICK
+                    victim._disconnect()
+
+                    async def pump_noop():
+                        return None
+
+                    original_pump = victim._pump
+                    victim._pump = pump_noop  # swallow this tick's send
+                    try:
+                        await victim.tick(now[0])
+                    finally:
+                        victim._pump = original_pump
+                    assert len(victim._buffer) == 1 and not victim.connected
+                    await shards[1].tick(now[0])
+                    agg = server.aggregator
+                    await wait_for(
+                        lambda: agg._shards["c1"].enqueued >= shards[1].epoch,
+                        message="healthy shard's tick",
+                    )
+                    # The aggregate tick publishes the healthy shard while
+                    # the victim's tick is still in flight.
+                    assert await server.scheduler.run_once()
+                    # Ticks 3-4: the victim reconnects (same generation),
+                    # re-sends from the acked epoch — including the buffered
+                    # tick-2 record — and everything converges.
+                    for t in (3, 4):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                finally:
+                    for shard in shards:
+                        await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+    def test_dead_shard_serves_stale_while_healthy_publish(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=2, seed=31)
+            now = [START]
+            # Tight staleness: one missed cadence marks the shard stale.
+            server = aggregator_server(
+                fleet, lambda: now[0], federation_staleness_seconds=TICK + 1.0
+            )
+            await server.start(run_scheduler=False)
+            shards = [
+                make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                for c in fleet.clusters
+            ]
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await federated_round(server, shards, now[0])
+                dead = shards[0]
+                dead_keys = {object_key(obj) for obj in fleet.objects["c0"]}
+                dead_window_end = dead.last_end
+                await dead.close()
+                # Two more rounds without the dead shard.
+                for t in (2, 3):
+                    now[0] = START + t * TICK
+                    await federated_round(server, [shards[1]], now[0])
+                # Dead shard's workloads: still served, marked stale since
+                # their last applied window.
+                snapshot = server.state.peek()
+                assert snapshot is not None
+                assert len(snapshot.result.scans) == len(fleet.all_objects())
+                stale_marks = {
+                    object_key(scan.object): scan.stale_since
+                    for scan in snapshot.result.scans
+                    if scan.stale_since is not None
+                }
+                assert set(stale_marks) == dead_keys
+                assert all(since == dead_window_end for since in stale_marks.values())
+                # Healthy shard's rows kept advancing (fresh window end).
+                status, _ct, body = await server.app.route("GET", "/healthz", {})
+                payload = json.loads(body)
+                fed = payload["federation"]["shards"]
+                assert fed["c0"]["stale"] and not fed["c0"]["connected"]
+                assert fed["c1"]["connected"] and not fed["c1"]["stale"]
+                metrics = server.state.metrics
+                assert metrics.value("krr_tpu_federation_stale_shards") == 1
+                assert metrics.value("krr_tpu_stale_workloads") == len(dead_keys)
+            finally:
+                for shard in shards:
+                    with contextlib.suppress(Exception):
+                        await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_aggregator_restart_resumes_epoch_watermarks(self, tmp_path):
+        """Durable aggregator: acks flow only after the persist, the
+        watermarks ride the store's extra_meta, and a restarted aggregator
+        welcomes shards at exactly the persisted epoch — re-sent records
+        replay exactly-once and the store converges bit-exact."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=2, seed=43)
+            state_path = str(tmp_path / "state")
+            control = await run_control(fleet, ticks=4)
+            try:
+                now = [START]
+                server = aggregator_server(
+                    fleet, lambda: now[0], other_args={
+                        "history_duration": 1, "timeframe_duration": 1,
+                        "state_path": state_path,
+                    },
+                )
+                await server.start(run_scheduler=False)
+                shards = [
+                    make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                    for c in fleet.clusters
+                ]
+                try:
+                    for t in range(2):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    assert all(shard.acked == 2 for shard in shards)
+                    await server.shutdown()
+
+                    # Restart the aggregator from the persisted state dir;
+                    # shards keep their live buffers and reconnect.
+                    server = aggregator_server(
+                        fleet, lambda: now[0], other_args={
+                            "history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path,
+                        },
+                    )
+                    await server.start(run_scheduler=False)
+                    welcome = server.aggregator._shards
+                    assert welcome["c0"].acked == 2 and welcome["c1"].acked == 2
+                    for shard in shards:
+                        shard.host, shard.port = "127.0.0.1", server.aggregator.port
+                    for t in (2, 3):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                finally:
+                    for shard in shards:
+                        await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------- raw-wire exactly-once
+class TestRawWireExactlyOnce:
+    """Drive the protocol by hand: torn mid-record send, reconnect from the
+    acked epoch, duplicate discard — the decoder-level twin of the e2e."""
+
+    def _spec(self, config: Config):
+        return config.create_strategy().settings.cpu_spec()
+
+    def _delta_records(self, config: Config, keys: "list[str]", n: int) -> "tuple[list[bytes], DigestStore]":
+        spec = self._spec(config)
+        store = DigestStore(spec=spec)
+        store.track_deltas = True
+        store.capture_full_keys = True
+        rng = np.random.default_rng(5)
+        records = []
+        for epoch in range(1, n + 1):
+            counts = rng.integers(0, 4, size=(len(keys), spec.num_buckets)).astype(np.float32)
+            store.merge_window(
+                keys,
+                counts,
+                counts.sum(axis=1),
+                rng.uniform(0.1, 2.0, len(keys)).astype(np.float32),
+                rng.uniform(1.0, 8.0, len(keys)).astype(np.float32),
+                rng.uniform(64.0, 512.0, len(keys)).astype(np.float32),
+            )
+            ops = store.pending_ops()
+            # No reset flag: a fresh shard status starts at enqueued 0, so
+            # epoch 1 is accepted plainly — and a re-sent epoch 1 must ride
+            # the DUPLICATE path (resets bypass it by design: they re-anchor
+            # idempotently).
+            extra = {"window_end": START + epoch * TICK, "kind": "delta"}
+            records.append(
+                encode_ops(ops, epoch=epoch, extra=extra, num_buckets=spec.num_buckets)
+            )
+            store.clear_pending(len(ops))
+        return records, store
+
+    def test_torn_record_resend_duplicates_discarded(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=3)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            config = base_config()
+            spec = self._spec(config)
+            keys = ["cx/ns/app/main/Deployment", "cx/ns/db/main/StatefulSet"]
+            records, expected = self._delta_records(config, keys, 3)
+            hello = dict(
+                shard_id="raw",
+                generation="gen-1",
+                version=PROTOCOL_VERSION,
+                spec={
+                    "gamma": spec.gamma,
+                    "min_value": spec.min_value,
+                    "num_buckets": spec.num_buckets,
+                },
+                clusters=["cx"],
+            )
+            try:
+                port = server.aggregator.port
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.write(FED_MAGIC + encode_control(MSG_HELLO, **hello))
+                await writer.drain()
+                kind, body = await read_message(reader)
+                assert kind == MSG_WELCOME
+                assert decode_control(body) == {
+                    "acked_epoch": 0, "generation": None, "version": PROTOCOL_VERSION,
+                }
+                # Record 1 whole, record 2 TORN mid-frame, then die.
+                frame2 = encode_message(MSG_DELTA, records[1])
+                writer.write(encode_message(MSG_DELTA, records[0]) + frame2[: len(frame2) // 2])
+                await writer.drain()
+                writer.close()
+                agg = server.aggregator
+                await wait_for(
+                    lambda: agg._shards.get("raw") is not None
+                    and agg._shards["raw"].enqueued == 1
+                    and not agg._shards["raw"].connected,
+                    message="torn connection to drop with record 1 enqueued",
+                )
+                # The partial tick was discarded: only epoch 1 queued.
+                await server.scheduler.run_once()
+                assert agg._shards["raw"].applied == 1
+
+                # Reconnect: same generation → welcome acks epoch 1; re-send
+                # 1 (duplicate), 2, 3.
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.write(FED_MAGIC + encode_control(MSG_HELLO, **hello))
+                await writer.drain()
+                kind, body = await read_message(reader)
+                welcome = decode_control(body)
+                assert welcome["acked_epoch"] == 1
+                assert welcome["generation"] == "gen-1"
+                for payload in records:
+                    writer.write(encode_message(MSG_DELTA, payload))
+                await writer.drain()
+                await wait_for(
+                    lambda: agg._shards["raw"].enqueued == 3,
+                    message="records 2 and 3 to enqueue",
+                )
+                assert agg._shards["raw"].duplicates == 1
+                metrics = server.state.metrics
+                assert metrics.value(
+                    "krr_tpu_federation_duplicate_records_total", shard="raw"
+                ) == 1.0
+                await server.scheduler.run_once()
+                # Applied exactly once each: the merged rows equal the
+                # sender's local store bit-for-bit.
+                equal, detail = stores_bitexact_by_key(server.state.store, expected)
+                assert equal, detail
+                # The duplicate ack told the sender where it stands.
+                kind, body = await read_message(reader)
+                assert kind == MSG_ACK and decode_control(body)["epoch"] >= 1
+                writer.close()
+            finally:
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_epoch_gap_drops_connection(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=3)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            config = base_config()
+            spec = self._spec(config)
+            records, _ = self._delta_records(config, ["cx/ns/a/m/Deployment"], 3)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.aggregator.port
+                )
+                writer.write(
+                    FED_MAGIC
+                    + encode_control(
+                        MSG_HELLO,
+                        shard_id="gappy",
+                        generation="g",
+                        version=PROTOCOL_VERSION,
+                        spec={
+                            "gamma": spec.gamma,
+                            "min_value": spec.min_value,
+                            "num_buckets": spec.num_buckets,
+                        },
+                        clusters=["cx"],
+                    )
+                )
+                await writer.drain()
+                assert (await read_message(reader))[0] == MSG_WELCOME
+                writer.write(encode_message(MSG_DELTA, records[0]))
+                # Skip epoch 2: a gap the aggregator must refuse.
+                writer.write(encode_message(MSG_DELTA, records[2]))
+                await writer.drain()
+                agg = server.aggregator
+                await wait_for(
+                    lambda: "gappy" in agg._shards
+                    and not agg._shards["gappy"].connected,
+                    message="gap to drop the connection",
+                )
+                assert agg._shards["gappy"].enqueued == 1
+            finally:
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_spec_mismatch_refused(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=3)
+            server = aggregator_server(fleet, lambda: START)
+            await server.start(run_scheduler=False)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.aggregator.port
+                )
+                writer.write(
+                    FED_MAGIC
+                    + encode_control(
+                        MSG_HELLO,
+                        shard_id="alien",
+                        generation="g",
+                        version=PROTOCOL_VERSION,
+                        spec={"gamma": 2.0, "min_value": 1.0, "num_buckets": 4},
+                        clusters=[],
+                    )
+                )
+                await writer.drain()
+                kind, body = await read_message(reader)
+                assert kind == MSG_WELCOME
+                assert "spec" in decode_control(body)["error"]
+            finally:
+                await server.shutdown()
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------- shard details
+class TestShardBehavior:
+    def test_inventory_round_trips_through_protocol(self):
+        fleet = MultiClusterFleet(clusters=1, seed=9)
+        objects = fleet.all_objects()
+        from krr_tpu.federation.protocol import decode_inventory
+
+        decoded = decode_inventory(encode_inventory(objects))
+        assert [object_key(o) for o in decoded] == [object_key(o) for o in objects]
+        assert decoded[0].pods == objects[0].pods
+        assert decoded[0].allocations.requests == objects[0].allocations.requests
+
+    def test_shard_buffers_while_aggregator_down(self):
+        """No aggregator at all: ticks keep scanning and buffering; once
+        one appears, the whole backlog re-sends via the snapshot/reset path
+        (unknown generation) and converges."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=17)
+            control = await run_control(fleet, ticks=3)
+            try:
+                now = [START]
+                # A port nothing listens on (grab + release an ephemeral one).
+                probe = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+                dead_port = probe.sockets[0].getsockname()[1]
+                probe.close()
+                await probe.wait_closed()
+                shard = make_shard(fleet, "c0", dead_port, lambda: now[0])
+                for t in range(3):
+                    now[0] = START + t * TICK
+                    assert await shard.tick(now[0])
+                assert len(shard._buffer) == 3 and not shard.connected
+
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                try:
+                    shard.host, shard.port = "127.0.0.1", server.aggregator.port
+                    # The reconnect discovers an unknown generation → full
+                    # snapshot replaces the buffered deltas.
+                    await shard._pump()
+                    agg = server.aggregator
+                    await wait_for(
+                        lambda: "c0" in agg._shards
+                        and agg._shards["c0"].enqueued >= shard.epoch,
+                        message="snapshot to arrive",
+                    )
+                    await server.scheduler.run_once()
+                    assert await shard.wait_acked(shard.epoch, timeout=5.0)
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                finally:
+                    await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+    def test_backlog_collapses_to_snapshot_past_the_buffer_cap(self):
+        """A long aggregator outage must cost one store-sized snapshot,
+        not one buffered delta per tick: past the cap the backlog collapses
+        into a reset record, and reconnection still converges bit-exact."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=71)
+            ticks = 6
+            control = await run_control(fleet, ticks=ticks)
+            try:
+                now = [START]
+                probe = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+                dead_port = probe.sockets[0].getsockname()[1]
+                probe.close()
+                await probe.wait_closed()
+                shard = make_shard(
+                    fleet, "c0", dead_port, lambda: now[0],
+                    federation_queue_records=2,
+                )
+                assert shard.buffer_cap == 2
+                for t in range(ticks):
+                    now[0] = START + t * TICK
+                    assert await shard.tick(now[0])
+                # Collapsed: bounded by the cap (a snapshot plus the ticks
+                # since the last collapse), never one delta per outage tick.
+                assert len(shard._buffer) <= shard.buffer_cap < ticks
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                try:
+                    shard.host, shard.port = "127.0.0.1", server.aggregator.port
+                    await shard._pump()
+                    agg = server.aggregator
+                    await wait_for(
+                        lambda: "c0" in agg._shards
+                        and agg._shards["c0"].enqueued >= shard.epoch,
+                        message="collapsed snapshot to arrive",
+                    )
+                    await server.scheduler.run_once()
+                    assert await shard.wait_acked(shard.epoch, timeout=5.0)
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                finally:
+                    await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+    def test_shard_status_server_serves_health_and_metrics(self):
+        from krr_tpu.federation.shard import ShardStatusServer
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=73)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+            status_server = ShardStatusServer(shard)
+            await status_server.serve("127.0.0.1", 0)
+            try:
+                await federated_round(server, [shard], now[0])
+
+                async def fetch(path):
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", status_server.port
+                    )
+                    writer.write(
+                        f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+                    )
+                    await writer.drain()
+                    data = await reader.read()
+                    writer.close()
+                    head, _, body = data.partition(b"\r\n\r\n")
+                    return int(head.split()[1]), body
+
+                status, body = await fetch("/healthz")
+                payload = json.loads(body)
+                assert status == 200
+                assert payload["status"] == "ok" and payload["connected"]
+                assert payload["epoch"] == 1 and payload["acked_epoch"] == 1
+                status, body = await fetch("/metrics")
+                assert status == 200
+                text = body.decode()
+                assert "krr_tpu_federation_unacked_records 0" in text
+                assert 'krr_tpu_scans_total{kind="shard"} 1' in text
+                status, _body = await fetch("/nope")
+                assert status == 404
+            finally:
+                await status_server.close()
+                await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_failed_fetch_aborts_tick_and_refetches(self):
+        """Whole-shard failure domain: a tick whose fetch dies folds
+        nothing and ships nothing; the next tick refetches the union window
+        and the stream stays bit-exact."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=29)
+            control = await run_control(fleet, ticks=3)
+            try:
+                now = [START]
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+                try:
+                    now[0] = START
+                    await federated_round(server, [shard], now[0])
+
+                    source = shard.session.get_history_source("c0")
+                    original = source.gather_fleet
+
+                    async def boom(*args, **kwargs):
+                        raise RuntimeError("injected fetch failure")
+
+                    source.gather_fleet = boom
+                    now[0] = START + TICK
+                    assert await shard.run_once(now[0]) is None
+                    assert shard.epoch == 1  # nothing shipped
+                    source.gather_fleet = original
+
+                    for t in (2,):
+                        now[0] = START + t * TICK
+                        await federated_round(server, [shard], now[0])
+                    equal, detail = stores_bitexact_by_key(
+                        server.state.store, control.state.store
+                    )
+                    assert equal, detail
+                finally:
+                    await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+
+class TestResetScope:
+    def test_namespace_partition_reset_spares_sibling_rows(self):
+        """Two shards partition ONE cluster by namespace. Restarting one
+        (new generation → snapshot reset) must drop only ITS superseded
+        rows — a cluster-scoped drop would silently destroy the sibling's
+        accumulated history."""
+
+        async def main():
+            fleet = MultiClusterFleet(
+                clusters=1, namespaces_per_cluster=2, seed=61
+            )
+            ns_a, ns_b = "c0-ns0", "c0-ns1"
+            control = await run_control(fleet, ticks=4)
+            try:
+                now = [START]
+                server = aggregator_server(fleet, lambda: now[0])
+                await server.start(run_scheduler=False)
+                shard_a = make_namespace_shard(
+                    fleet, "c0", ns_a, server.aggregator.port, lambda: now[0]
+                )
+                shard_b = make_namespace_shard(
+                    fleet, "c0", ns_b, server.aggregator.port, lambda: now[0]
+                )
+                shards = [shard_a, shard_b]
+                try:
+                    for t in range(2):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    sibling_rows = {
+                        key: np.array(server.state.store.cpu_total[i])
+                        for i, key in enumerate(server.state.store.keys)
+                        if f"/{ns_b}/" in key
+                    }
+                    assert sibling_rows
+
+                    # "Restart" shard A: a fresh store/generation covering
+                    # the same namespace, re-syncing via snapshot reset.
+                    await shard_a.close()
+                    restarted = make_namespace_shard(
+                        fleet, "c0", ns_a, server.aggregator.port, lambda: now[0]
+                    )
+                    shards = [restarted, shard_b]
+                    for t in (2, 3):
+                        now[0] = START + t * TICK
+                        await federated_round(server, shards, now[0])
+                    # B's accumulated history survived A's reset: its rows
+                    # stay BIT-exact with the never-restarted control. (A's
+                    # own rows legitimately differ from the control — a
+                    # restarted shard's full backfill window anchors at
+                    # restart time — so they are compared against A's own
+                    # local store, the post-restart ground truth.)
+                    store = server.state.store
+                    ctl = control.state.store
+                    ctl_index = {key: i for i, key in enumerate(ctl.keys)}
+                    for i, key in enumerate(store.keys):
+                        if f"/{ns_b}/" in key:
+                            j = ctl_index[key]
+                            assert np.array_equal(
+                                store.cpu_counts[i], ctl.cpu_counts[j]
+                            ), key
+                            assert store.cpu_total[i] == ctl.cpu_total[j], key
+                    local = restarted.store
+                    local_index = {key: i for i, key in enumerate(local.keys)}
+                    for i, key in enumerate(store.keys):
+                        if f"/{ns_a}/" in key:
+                            j = local_index[key]
+                            assert np.array_equal(
+                                store.cpu_counts[i], local.cpu_counts[j]
+                            ), key
+                            assert store.cpu_total[i] == local.cpu_total[j], key
+                finally:
+                    for shard in shards:
+                        await shard.close()
+                    await server.shutdown()
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+
+
+class TestInventoryPersistence:
+    def test_dead_shard_rows_render_after_aggregator_restart(self, tmp_path):
+        """Aggregator restart with a shard that never reconnects: the
+        recovered rows must keep RENDERING (stale-marked) — the inventory
+        sidecar supplies the objects the dead shard can't re-send."""
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=2, seed=67)
+            state_path = str(tmp_path / "state")
+            now = [START]
+
+            def server_at(clock):
+                return aggregator_server(
+                    fleet, clock,
+                    federation_staleness_seconds=TICK + 1.0,
+                    other_args={
+                        "history_duration": 1, "timeframe_duration": 1,
+                        "state_path": state_path,
+                    },
+                )
+
+            server = server_at(lambda: now[0])
+            await server.start(run_scheduler=False)
+            shards = [
+                make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                for c in fleet.clusters
+            ]
+            dead = shards[0]
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await federated_round(server, shards, now[0])
+                dead_keys = {object_key(obj) for obj in fleet.objects["c0"]}
+                dead_window_end = dead.last_end
+                await dead.close()
+                await server.shutdown()
+
+                # Restart: only the healthy shard reconnects.
+                server = server_at(lambda: now[0])
+                await server.start(run_scheduler=False)
+                shards[1].host, shards[1].port = "127.0.0.1", server.aggregator.port
+                for t in (2, 3):
+                    now[0] = START + t * TICK
+                    await federated_round(server, [shards[1]], now[0])
+                snapshot = server.state.peek()
+                assert snapshot is not None
+                assert len(snapshot.result.scans) == len(fleet.all_objects())
+                stale_marks = {
+                    object_key(scan.object): scan.stale_since
+                    for scan in snapshot.result.scans
+                    if scan.stale_since is not None
+                }
+                assert set(stale_marks) == dead_keys
+                assert all(
+                    since == dead_window_end for since in stale_marks.values()
+                )
+            finally:
+                for shard in shards:
+                    with contextlib.suppress(Exception):
+                        await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+
+# ------------------------------------------------------- timeline fields
+class TestFederationObservability:
+    def test_aggregate_tick_lands_on_timeline(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=2, seed=37)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shards = [
+                make_shard(fleet, c, server.aggregator.port, lambda: now[0])
+                for c in fleet.clusters
+            ]
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await federated_round(server, shards, now[0])
+                records = server.state.timeline.records()
+                assert records, "aggregate ticks must record to the timeline"
+                newest = records[-1]
+                assert newest["kind"] == "aggregate"
+                fed = newest["federation"]
+                assert fed["shards"] == 2 and fed["connected"] == 2
+                assert fed["applied_records"] == 2
+                assert fed["wire_bytes"] > 0
+            finally:
+                for shard in shards:
+                    await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())

@@ -31,7 +31,7 @@ from krr_tpu.history.policy import HysteresisGate
 KEYS = ["c/default/web/main/Deployment", "c/prod/db/main/StatefulSet"]
 
 
-def _tick(journal, ts, cpu, mem=None, published=None, keys=KEYS):
+def _tick(journal, ts, cpu, mem=None, published=None, keys=KEYS, epoch=None):
     n = len(keys)
     journal.append_tick(
         ts,
@@ -39,6 +39,7 @@ def _tick(journal, ts, cpu, mem=None, published=None, keys=KEYS):
         np.asarray(cpu, np.float32),
         np.asarray(mem if mem is not None else [100.0] * n, np.float32),
         np.asarray(published if published is not None else [False] * n, bool),
+        epoch=epoch,
     )
 
 
@@ -94,6 +95,58 @@ class TestJournal:
         assert final.record_count == 5
         assert final.newest_ts == 220.0
         final.close()
+
+    def test_compaction_restamps_newest_epoch_marker(self, tmp_path):
+        """The retention rewrite must RE-STAMP the newest epoch marker:
+        only the newest tick can ever be journal-ahead-of-store (journal
+        first, persist second), and dropping its marker with the rewrite
+        used to degrade reconcile_epoch to the no-marker no-op — crash
+        reconciliation went heuristic exactly when a compaction landed
+        inside the crash window."""
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path, retention_seconds=500.0)
+        for i, ts in enumerate([100.0, 200.0, 300.0, 400.0, 500.0, 600.0]):
+            _tick(journal, ts, [0.2, 1.5], epoch=i + 1)
+        assert journal.last_epoch == 6
+        # Age out the two oldest ticks (4 of 12 on-disk records ≥ the 10%
+        # rewrite fraction) → the file compacts.
+        assert journal.compact(now=800.0) == 4
+        journal.close()
+
+        reopened = RecommendationJournal(path)
+        # The newest epoch marker survived the rewrite...
+        assert reopened.last_epoch == 6
+        # ...so a crash between the compaction and the tick's store persist
+        # reconciles EXACTLY: the store one epoch behind drops precisely
+        # the newest tick's records.
+        before = reopened.record_count
+        assert reopened.reconcile_epoch(5) == "journal_ahead"
+        assert before - reopened.record_count == 2
+        assert reopened.newest_ts == 500.0
+        # Appends stay aligned after the truncation.
+        _tick(reopened, 700.0, [0.3, 1.6], epoch=6)
+        reopened.close()
+        final = RecommendationJournal(path)
+        assert final.record_count == 8
+        assert final.last_epoch == 6
+        assert final.reconcile_epoch(6) == "consistent"
+        final.close()
+
+    def test_compaction_marker_preserves_store_parity(self, tmp_path):
+        """A compacted journal whose store persisted successfully must stay
+        'consistent' — the re-stamped marker cannot make parity look like
+        journal-ahead."""
+        path = str(tmp_path / "j")
+        journal = RecommendationJournal(path, retention_seconds=250.0)
+        for i, ts in enumerate([100.0, 200.0, 300.0, 400.0]):
+            _tick(journal, ts, [0.2, 1.5], epoch=i + 1)
+        assert journal.compact(now=500.0) == 4  # ts 100 and 200 age out
+        journal.close()
+        reopened = RecommendationJournal(path)
+        assert reopened.last_epoch == 4
+        assert reopened.reconcile_epoch(4) == "consistent"
+        assert reopened.record_count == 4
+        reopened.close()
 
     def test_corrupt_header_is_a_clear_error(self, tmp_path):
         path = str(tmp_path / "j")

@@ -117,6 +117,55 @@ class TestKubernetesLoader:
         objects = asyncio.run(loader.list_scannable_objects(["fake"]))
         assert objects and all(o.namespace == "prod" for o in objects)
 
+    def test_cluster_discovery_failure_is_counted_not_silent(self, fake_env, tmp_path):
+        """A cluster whose listing fails still degrades fail-soft to an
+        empty inventory — but the failure lands in
+        krr_tpu_discovery_cluster_failures_total{cluster} and the cluster
+        is named in last_failed_clusters (→ /healthz), instead of the
+        fleet silently scanning smaller."""
+        import yaml
+
+        from krr_tpu.obs.metrics import MetricsRegistry
+
+        # Two contexts: the healthy fake, and one pointing at a port
+        # nothing listens on.
+        kubeconfig = tmp_path / "config"
+        kubeconfig.write_text(yaml.dump({
+            "current-context": "fake",
+            "contexts": [
+                {"name": "fake", "context": {"cluster": "fake", "user": "fake"}},
+                {"name": "broken", "context": {"cluster": "broken", "user": "fake"}},
+            ],
+            "clusters": [
+                {"name": "fake", "cluster": {"server": fake_env["server"].url}},
+                {"name": "broken", "cluster": {"server": "http://127.0.0.1:1"}},
+            ],
+            "users": [{"name": "fake", "user": {"token": "test-token"}}],
+        }))
+        config = make_config(fake_env, kubeconfig=str(kubeconfig))
+        registry = MetricsRegistry()
+        loader = KubernetesLoader(config, metrics=registry)
+        objects = asyncio.run(loader.list_scannable_objects(["fake", "broken"]))
+        # The healthy cluster still scanned; the broken one degraded empty.
+        assert objects and all(o.cluster == "fake" for o in objects)
+        assert list(loader.last_failed_clusters) == ["broken"]
+        assert loader.last_failed_clusters["broken"]
+        assert (
+            registry.value(
+                "krr_tpu_discovery_cluster_failures_total", cluster="broken"
+            )
+            == 1.0
+        )
+        assert (
+            registry.value(
+                "krr_tpu_discovery_cluster_failures_total", cluster="fake"
+            )
+            is None
+        )
+        # A later healthy round clears the roll-up (per-round snapshot).
+        objects = asyncio.run(loader.list_scannable_objects(["fake"]))
+        assert objects and loader.last_failed_clusters == {}
+
 
 class TestPrometheusLoader:
     def test_gather_fleet(self, fake_env):

@@ -895,6 +895,114 @@ class TestStatePersistence:
         asyncio.run(main())
 
 
+class TestWindowGridRealign:
+    """--fetch-downsample over a persisted pre-flag cursor: the misaligned
+    grid used to stay forever-disengaged behind a single warning. The
+    one-shot --realign-window-grid drops the cursor + rows at startup so
+    the next tick runs a grid-ALIGNED full backfill and the flag engages."""
+
+    def _misaligned_state(self, serve_env, state_path):
+        """One serve tick at a clock 30 s off the step grid → the persisted
+        cursor is misaligned (end == now here: (now - start) is exactly the
+        history width, so the grid clamp keeps the off-grid edge)."""
+
+        async def main():
+            config = serve_config(
+                serve_env,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: ORIGIN + 3600.0 + 30.0)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                assert ks.state.last_end == ORIGIN + 3630.0  # off-grid
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_realign_flag_drops_cursor_for_aligned_backfill(self, serve_env, tmp_path):
+        state_path = str(tmp_path / "state")
+        self._misaligned_state(serve_env, state_path)
+
+        async def main():
+            config = serve_config(
+                serve_env,
+                fetch_downsample="auto",
+                realign_window_grid=True,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: ORIGIN + 7200.0 + 30.0)
+            try:
+                # Startup realigned: cursor gone, rows dropped — the next
+                # tick is a FULL scan whose downsample-aligned origin sits
+                # on the step grid.
+                assert ks.scheduler.state.last_end is None
+                assert not ks.state.store.keys
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_without_flag_misaligned_cursor_is_kept_and_warned(self, serve_env, tmp_path):
+        state_path = str(tmp_path / "state")
+        self._misaligned_state(serve_env, state_path)
+
+        async def main():
+            config = serve_config(
+                serve_env,
+                fetch_downsample="auto",
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: ORIGIN + 7200.0 + 30.0)
+            try:
+                # No data loss without the explicit flag: the cursor (and
+                # the rows) survive; downsampling just stays disengaged.
+                assert ks.scheduler.state.last_end == ORIGIN + 3630.0
+                assert ks.state.store.keys
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_aligned_cursor_is_untouched_by_the_flag(self, serve_env, tmp_path):
+        """The flag is a no-op on a healthy grid — it must never drop state
+        that doesn't need realigning."""
+        state_path = str(tmp_path / "state")
+
+        async def main():
+            config = serve_config(
+                serve_env,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: ORIGIN + 3600.0)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+            finally:
+                await ks.shutdown()
+
+            config = serve_config(
+                serve_env,
+                fetch_downsample="auto",
+                realign_window_grid=True,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            ks = KrrServer(config, clock=lambda: ORIGIN + 7200.0)
+            try:
+                assert ks.scheduler.state.last_end == ORIGIN + 3600.0
+                assert ks.state.store.keys
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
 class _PlainSource:
     """Deterministic injected history source (no gating, no windows)."""
 
