@@ -76,7 +76,14 @@ BENCH_SKIP_FETCHPLAN, BENCH_FETCHPLAN_WORKLOADS (default 3 — the adaptive
 fetch-engine leg: a real-loader fetch over HTTP where the planner coalesces
 AND shards, gated on plan-counter engagement, bit-exactness vs the
 ``--fetch-plan fixed`` control, and the AIMD autotuner seeing per-query
-verdicts, carried under ``secondary.fetchplan_*``). The
+verdicts, carried under ``secondary.fetchplan_*``), BENCH_SKIP_READPATH,
+BENCH_READPATH_WORKLOADS (default 400), BENCH_READPATH_CLIENTS (default 8),
+BENCH_READPATH_REQUESTS (default 120 — the read-path loadtest leg:
+concurrent keep-alive readers against a live serve during scan ticks,
+gated on steady-state cache hit rate, zero-render 304s, pushdown
+bit-exactness, LRU bounds, and the cached-vs-uncached RPS ratio, carried
+under ``secondary.readpath_*`` with a round-over-round p99 gate in
+``readpath_regression_vs_previous``). The
 e2e leg runs `bench_e2e.py` in a subprocess with BENCH_E2E_CONTAINERS
 defaulted to 10000 (fleet scale) unless already set.
 
@@ -167,6 +174,12 @@ SMOKE_DEFAULTS = {
     "BENCH_FED_SHARDS": "3",
     "BENCH_FED_TICKS": "4",
     "BENCH_FED_WORKLOADS": "2",
+    # Read-path leg: concurrent keep-alive readers against a live serve
+    # (cache hit rate, 304 zero-render, pushdown bit-exactness, LRU bound,
+    # cached-vs-uncached RPS), toy-sized but every gate EXECUTED.
+    "BENCH_READPATH_WORKLOADS": "12",
+    "BENCH_READPATH_CLIENTS": "4",
+    "BENCH_READPATH_REQUESTS": "36",
 }
 
 
@@ -1018,6 +1031,392 @@ def federation_leg(secondary: dict, check) -> None:
     )
 
 
+def readpath_leg(secondary: dict, check) -> None:
+    """High-QPS read-path loadtest (`krr_tpu.server.state.ResponseCache` +
+    the app's conditional-GET / pushdown / bounded-render machinery):
+    concurrent keep-alive readers hammer a LIVE serve — mixed formats,
+    filters, pagination, compressed variants, and conditional
+    revalidations — WHILE scheduler ticks publish underneath, against an
+    uncached (`--no-response-cache`) control serving the same fleet.
+    Records p50/p99 latency, RPS, cache hit rate, and bytes served under
+    ``secondary.readpath_*``. Six parity-style gates:
+
+    * steady-state cache hit rate ≥ 99% (hysteresis-quiet publishes keep
+      the epoch, so the warm cache survives live ticks);
+    * conditional revalidations return 304 with ZERO render work (the miss
+      counter must not move under an If-None-Match burst);
+    * filtered + paginated responses bit-identical to the pre-cache
+      render-then-slice path on the same snapshot;
+    * gzip variants round-trip to the identity bytes;
+    * the LRU stays inside its entry/byte bounds under a
+      filter-cardinality attack;
+    * cached RPS beats the uncached control (≥ 10× at fleet scale,
+      ≥ 2× at toy scale where render cost barely exceeds HTTP overhead).
+    """
+    import asyncio
+    import gzip as _gzip
+
+    import numpy as np
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.core.runner import ScanSession
+    from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+    from krr_tpu.models.objects import K8sObjectData
+    from krr_tpu.models.result import Result
+    from krr_tpu.server.app import KrrServer
+
+    workloads = int(os.environ.get("BENCH_READPATH_WORKLOADS", 400))
+    clients = int(os.environ.get("BENCH_READPATH_CLIENTS", 8))
+    requests_per_client = int(os.environ.get("BENCH_READPATH_REQUESTS", 120))
+    control_requests = max(8, requests_per_client // 6)
+
+    alloc = ResourceAllocations(
+        requests={ResourceType.CPU: None, ResourceType.Memory: None},
+        limits={ResourceType.CPU: None, ResourceType.Memory: None},
+    )
+    objects = [
+        K8sObjectData(
+            cluster="c", namespace=f"ns{i % 8}", name=f"w{i}", kind="Deployment",
+            container="main", pods=[f"w{i}-0"], allocations=alloc,
+        )
+        for i in range(workloads)
+    ]
+    rng = np.random.default_rng(61)
+    cpu_series = rng.gamma(2.0, 0.05, (workloads, 12))
+    mem_series = rng.uniform(5e7, 4e8, (workloads, 12))
+    by_name = {obj.name: i for i, obj in enumerate(objects)}
+
+    class Inventory:
+        async def list_clusters(self):
+            return ["c"]
+
+        async def list_scannable_objects(self, clusters):
+            return list(objects)
+
+    class Source:
+        """Deterministic: the full backfill window carries the fleet's
+        samples, delta windows are QUIET (no new samples — a no-op fold),
+        so every live publish is byte-identical and the epoch holds — the
+        hysteresis steady state the cache is designed for."""
+
+        async def gather_fleet(self, objs, history_seconds, step_seconds, **kw):
+            rows = [by_name[obj.name] for obj in objs]
+            if history_seconds < 3000:  # a delta tick, not the backfill
+                quiet = np.empty(0)
+                return {
+                    resource: [{obj.pods[0]: quiet} for obj in objs]
+                    for resource in (ResourceType.CPU, ResourceType.Memory)
+                }
+            return {
+                ResourceType.CPU: [{objs[j].pods[0]: cpu_series[i]} for j, i in enumerate(rows)],
+                ResourceType.Memory: [{objs[j].pods[0]: mem_series[i]} for j, i in enumerate(rows)],
+            }
+
+    def build_server(now, **overrides) -> KrrServer:
+        config = Config(
+            strategy="tdigest", quiet=True, server_port=0,
+            hysteresis_enabled=False,
+            response_cache_max_entries=64,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+            **overrides,
+        )
+        session = ScanSession(
+            config, inventory=Inventory(), history_factory=lambda cluster: Source()
+        )
+        return KrrServer(config, session=session, clock=lambda: now[0])
+
+    class Reader:
+        """Minimal keep-alive HTTP/1.1 client — dependency-free and thin,
+        so the measurement reads the SERVER, not a client library."""
+
+        def __init__(self, port: int):
+            self.port = port
+            self.reader = self.writer = None
+
+        async def connect(self):
+            self.reader, self.writer = await asyncio.open_connection("127.0.0.1", self.port)
+
+        async def get(self, target: str, headers: "tuple[tuple[str, str], ...]" = ()):
+            request = f"GET {target} HTTP/1.1\r\nHost: bench\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in headers
+            ) + "\r\n"
+            start = time.perf_counter()
+            self.writer.write(request.encode())
+            await self.writer.drain()
+            status_line = await self.reader.readline()
+            status = int(status_line.split()[1])
+            response_headers: dict[str, str] = {}
+            while True:
+                line = await self.reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1").partition(":")
+                response_headers[name.strip().lower()] = value.strip()
+            length = int(response_headers.get("content-length") or 0)
+            body = await self.reader.readexactly(length) if length else b""
+            return status, response_headers, body, time.perf_counter() - start
+
+        async def close(self):
+            if self.writer is not None:
+                self.writer.close()
+
+    GZIP = (("Accept-Encoding", "gzip"),)
+
+    async def run() -> dict:
+        now = [1_700_000_000.0]
+        ks = build_server(now)
+        await ks.start(run_scheduler=False)
+        control = build_server([now[0]], response_cache_enabled=False)
+        await control.start(run_scheduler=False)
+        try:
+            assert await ks.scheduler.run_once()
+            assert await control.scheduler.run_once()
+            metrics = ks.state.metrics
+            prime = Reader(ks.port)
+            await prime.connect()
+
+            _status, h, identity_body, _ = await prime.get("/recommendations")
+            etag = h["etag"]
+            #: The cacheable mix (distinct cache keys), primed once so the
+            #: timed phase measures STEADY STATE.
+            mix = [
+                ("/recommendations", GZIP),
+                ("/recommendations?format=yaml", ()),
+                ("/recommendations?namespace=ns1", ()),
+                ("/recommendations?limit=20&offset=40", ()),
+            ]
+            for target, headers in mix:
+                status, _h, _b, _lat = await prime.get(target, headers)
+                assert status == 200, (target, status)
+
+            # Gate: pushdown bit-identity vs the render-then-slice oracle.
+            snapshot = ks.state.peek()
+
+            def golden(fmt="json", namespaces=(), limit=None, offset=0) -> bytes:
+                scans = [
+                    s for s in snapshot.result.scans
+                    if not namespaces or s.object.namespace in namespaces
+                ]
+                scans = scans[offset:(offset + limit) if limit else None]
+                return Result(scans=scans).format(fmt).encode()
+
+            _s, _h, filtered, _lat = await prime.get("/recommendations?namespace=ns1")
+            _s, _h, paged, _lat = await prime.get("/recommendations?limit=20&offset=40")
+            _s, _h, fyaml, _lat = await prime.get("/recommendations?format=yaml&namespace=ns2")
+            pushdown_ok = (
+                filtered == golden(namespaces={"ns1"})
+                and paged == golden(limit=20, offset=40)
+                and fyaml == golden("yaml", namespaces={"ns2"})
+            )
+
+            # Gate: gzip round-trips to the identity bytes.
+            _s, gz_headers, gz_body, _lat = await prime.get("/recommendations", GZIP)
+            gzip_ok = (
+                gz_headers.get("content-encoding") == "gzip"
+                and _gzip.decompress(gz_body) == identity_body
+            )
+
+            # Gate: 304 revalidations do ZERO render work.
+            misses_before = metrics.total("krr_tpu_http_cache_misses_total")
+            revalidations = 0
+            for _ in range(32):
+                status, _h, body, _lat = await prime.get(
+                    "/recommendations", (("If-None-Match", etag),)
+                )
+                revalidations += int(status == 304 and body == b"")
+            zero_render_304 = (
+                revalidations == 32
+                and metrics.total("krr_tpu_http_cache_misses_total") == misses_before
+            )
+
+            # Timed steady-state phase: concurrent keep-alive readers over
+            # the full mix (bare identity + cached variants + conditionals)
+            # WHILE scheduler ticks publish underneath.
+            hits_before = metrics.total("krr_tpu_http_cache_hits_total")
+            misses_before = metrics.total("krr_tpu_http_cache_misses_total")
+            cycle = [
+                ("/recommendations", ()),
+                ("/recommendations", (("If-None-Match", etag),)),
+                *mix,
+            ]
+            latencies: list[float] = []
+            served_bytes = [0]
+
+            async def reader_task(reader: Reader, n: int) -> None:
+                for i in range(n):
+                    target, headers = cycle[i % len(cycle)]
+                    status, _h, body, latency = await reader.get(target, headers)
+                    assert status in (200, 304), (target, status)
+                    latencies.append(latency)
+                    served_bytes[0] += len(body)
+
+            readers = [Reader(ks.port) for _ in range(clients)]
+            for reader in readers:
+                await reader.connect()
+            wall_start = time.perf_counter()
+            tasks = [
+                asyncio.create_task(reader_task(reader, requests_per_client))
+                for reader in readers
+            ]
+            # Live publishes mid-load: byte-identical content keeps the
+            # epoch (suppression discipline), so the cache must stay warm.
+            for _ in range(2):
+                await asyncio.sleep(0.02)
+                now[0] += 120.0
+                assert await ks.scheduler.run_once()
+            await asyncio.gather(*tasks)
+            wall = time.perf_counter() - wall_start
+            for reader in readers:
+                await reader.close()
+
+            hits = metrics.total("krr_tpu_http_cache_hits_total") - hits_before
+            misses = metrics.total("krr_tpu_http_cache_misses_total") - misses_before
+            hit_pct = 100.0 * hits / max(1.0, hits + misses)
+
+            # Apples-to-apples ratio phase: the SAME 4-target cacheable mix
+            # the uncached control serves below, against the cached server —
+            # the mixed phase above includes near-free bare/304 requests
+            # that would inflate the cached side of the ratio.
+            mix_latencies: list[float] = []
+
+            async def mix_task(reader: Reader, n: int) -> None:
+                for i in range(n):
+                    target, headers = mix[i % len(mix)]
+                    status, _h, _b, latency = await reader.get(target, headers)
+                    assert status == 200, (target, status)
+                    mix_latencies.append(latency)
+
+            mix_readers = [Reader(ks.port) for _ in range(clients)]
+            for reader in mix_readers:
+                await reader.connect()
+            mix_start = time.perf_counter()
+            await asyncio.gather(
+                *[asyncio.create_task(mix_task(r, control_requests)) for r in mix_readers]
+            )
+            mix_wall = time.perf_counter() - mix_start
+            for reader in mix_readers:
+                await reader.close()
+            cacheable_rps = len(mix_latencies) / max(mix_wall, 1e-9)
+
+            # LRU bound under a filter-cardinality attack.
+            for i in range(3 * ks.config.response_cache_max_entries):
+                await prime.get(f"/recommendations?namespace=attack{i}")
+            cache = ks.state.response_cache
+            lru_ok = (
+                len(cache) <= ks.config.response_cache_max_entries
+                and cache.nbytes <= int(ks.config.response_cache_max_mb * (1 << 20))
+            )
+            await prime.close()
+
+            # Uncached control: the SAME cacheable mix, rendered per
+            # request (--no-response-cache), smaller request count (it is
+            # the slow side by design).
+            control_latencies: list[float] = []
+
+            async def control_task(reader: Reader, n: int) -> None:
+                for i in range(n):
+                    target, headers = mix[i % len(mix)]
+                    status, _h, _b, latency = await reader.get(target, headers)
+                    assert status == 200, (target, status)
+                    control_latencies.append(latency)
+
+            control_readers = [Reader(control.port) for _ in range(clients)]
+            for reader in control_readers:
+                await reader.connect()
+            control_start = time.perf_counter()
+            await asyncio.gather(
+                *[asyncio.create_task(control_task(r, control_requests)) for r in control_readers]
+            )
+            control_wall = time.perf_counter() - control_start
+            for reader in control_readers:
+                await reader.close()
+
+            # ``rps`` is the full production-like mix (bare + conditionals
+            # included); the vs-uncached ratio instead uses the dedicated
+            # cacheable-mix phase above, which mirrors the control exactly.
+            total_requests = len(latencies)
+            rps = total_requests / max(wall, 1e-9)
+            control_rps = len(control_latencies) / max(control_wall, 1e-9)
+            ordered = sorted(latencies)
+            timeline_records = ks.state.timeline.records()
+            readpath_recorded = any(
+                (r.get("readpath") or {}).get("requests", 0) > 0 for r in timeline_records
+            )
+            return {
+                "requests": total_requests,
+                "wall": wall,
+                "rps": rps,
+                "cacheable_rps": cacheable_rps,
+                "p50_ms": ordered[len(ordered) // 2] * 1e3,
+                "p99_ms": ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))] * 1e3,
+                "hit_pct": hit_pct,
+                "bytes": served_bytes[0],
+                "revalidations": revalidations,
+                "control_rps": control_rps,
+                "pushdown_ok": pushdown_ok,
+                "gzip_ok": gzip_ok,
+                "zero_render_304": zero_render_304,
+                "lru_ok": lru_ok,
+                "readpath_recorded": readpath_recorded,
+                "epoch": ks.state.peek().epoch,
+            }
+        finally:
+            await ks.shutdown()
+            await control.shutdown()
+
+    report = asyncio.run(run())
+    vs_uncached = report["cacheable_rps"] / max(report["control_rps"], 1e-9)
+    secondary["readpath_workloads"] = float(workloads)
+    secondary["readpath_clients"] = float(clients)
+    secondary["readpath_requests"] = float(report["requests"])
+    secondary["readpath_rps"] = round(report["rps"], 1)
+    secondary["readpath_cacheable_rps"] = round(report["cacheable_rps"], 1)
+    secondary["readpath_p50_ms"] = round(report["p50_ms"], 3)
+    secondary["readpath_p99_ms"] = round(report["p99_ms"], 3)
+    secondary["readpath_cache_hit_pct"] = round(report["hit_pct"], 2)
+    secondary["readpath_bytes_mb"] = round(report["bytes"] / 1e6, 3)
+    secondary["readpath_uncached_rps"] = round(report["control_rps"], 1)
+    secondary["readpath_rps_vs_uncached"] = round(vs_uncached, 1)
+    print(
+        f"bench: readpath {workloads} workloads x {clients} keep-alive readers: "
+        f"{report['requests']} requests in {report['wall']:.2f}s "
+        f"({report['rps']:.0f} rps mixed, p50 {report['p50_ms']:.2f} ms, "
+        f"p99 {report['p99_ms']:.2f} ms, hit rate {report['hit_pct']:.1f}%, "
+        f"epoch held at {report['epoch']}); cacheable mix "
+        f"{report['cacheable_rps']:.0f} rps vs uncached {report['control_rps']:.0f} rps "
+        f"-> x{vs_uncached:.1f}",
+        file=sys.stderr,
+    )
+    check(
+        "readpath_hit_rate>=99%",
+        report["hit_pct"] >= 99.0,
+        f"steady-state cache hit rate {report['hit_pct']:.1f}%",
+    )
+    check(
+        "readpath_304_zero_render",
+        report["zero_render_304"],
+        f"{report['revalidations']}/32 revalidations returned 304 without render work",
+    )
+    check("readpath_pushdown_bitexact", report["pushdown_ok"],
+          "filtered/paginated responses diverged from render-then-slice")
+    check("readpath_gzip_roundtrip", report["gzip_ok"],
+          "gzip variant did not round-trip to the identity bytes")
+    check("readpath_lru_bounded", report["lru_ok"],
+          "response cache exceeded its entry/byte bounds under filter cardinality")
+    check("readpath_timeline_recorded", report["readpath_recorded"],
+          "no timeline record carried read-path tick stats")
+    # The RPS ratio bar scales with fleet width: at toy (smoke) scale the
+    # render cost barely exceeds raw HTTP overhead, so 10x is a fleet-scale
+    # acceptance bar, not a smoke one.
+    bar = 10.0 if workloads >= 200 else 2.0
+    check(
+        f"readpath_rps>={bar:.0f}x_uncached",
+        vs_uncached >= bar,
+        f"cached {report['cacheable_rps']:.0f} rps vs uncached "
+        f"{report['control_rps']:.0f} rps (x{vs_uncached:.1f} < x{bar:.0f})",
+    )
+
+
 def obs_leg(secondary: dict, check) -> None:
     """Tracing-overhead leg: the SAME in-process digest scan (fake inventory
     + deterministic history source, streamed pipeline, tdigest
@@ -1720,6 +2119,13 @@ def main() -> None:
         # bytes trended.
         federation_leg(secondary, check)
 
+    if not os.environ.get("BENCH_SKIP_READPATH"):
+        # Read-path gates: concurrent keep-alive readers against a live
+        # serve during scan ticks — steady-state cache hit rate, zero-render
+        # 304s, pushdown bit-exactness, LRU bounds, and the cached-vs-
+        # uncached RPS ratio; p99 trended round-over-round.
+        readpath_leg(secondary, check)
+
     if not os.environ.get("BENCH_SKIP_STORE"):
         # Durable-store gates: delta append vs legacy full rewrite,
         # recovery-replay bit-exactness, and the SIGKILL kill-recover soak.
@@ -1839,6 +2245,10 @@ def main() -> None:
                 # fetch seconds vs the previous recorded round (same fleet
                 # width only), >15% slower flags a regression.
                 **_fetch_trendline_fields(secondary),
+                # The read-path twin: loadtest p99 vs the previous recorded
+                # round at the same readpath fleet width, >15% slower flags
+                # a regression.
+                **_readpath_trendline_fields(secondary),
                 "secondary": secondary,
             }
         )
@@ -1958,6 +2368,47 @@ def _fetch_trendline_fields(secondary: dict) -> dict:
                 "wire_regression_vs_previous": wire_regression,
             }
         )
+    return fields
+
+
+def _readpath_trendline_fields(secondary: dict) -> dict:
+    """The read-path p99 gate, mirroring the fetch-wall one: this run's
+    loadtest ``readpath_p99_ms`` vs the newest recorded round's at the SAME
+    readpath fleet width (a smoke run must not compare against a full
+    round). >15% slower flags ``readpath_regression_vs_previous`` — a cache
+    wired out of the hot path or a render-pool misbound shows up here as a
+    latency cliff, not a silent serving regression. Fields are emitted
+    unconditionally so gate scripts can read them without probing."""
+    fields = {
+        "readpath_vs_previous_round": None,
+        "previous_round_readpath_p99_ms": None,
+        "readpath_regression_vs_previous": False,
+    }
+    current = secondary.get("readpath_p99_ms")
+    previous = _previous_round_payload()
+    if previous is None or not isinstance(current, (int, float)) or current <= 0:
+        return fields
+    prev_file, payload = previous
+    prev_secondary = payload.get("secondary") or {}
+    prev_p99 = prev_secondary.get("readpath_p99_ms")
+    if not isinstance(prev_p99, (int, float)) or prev_p99 <= 0:
+        return fields
+    if prev_secondary.get("readpath_workloads") != secondary.get("readpath_workloads"):
+        return fields
+    vs = current / prev_p99  # >1 = slower than the previous round
+    regression = vs > 1.15
+    print(
+        f"bench: readpath p99 {current} ms vs {prev_file} {prev_p99} ms -> x{vs:.3f}"
+        + (" READPATH REGRESSION (>15% above previous round)" if regression else ""),
+        file=sys.stderr,
+    )
+    fields.update(
+        {
+            "readpath_vs_previous_round": round(vs, 3),
+            "previous_round_readpath_p99_ms": prev_p99,
+            "readpath_regression_vs_previous": regression,
+        }
+    )
     return fields
 
 

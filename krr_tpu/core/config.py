@@ -248,6 +248,29 @@ class Config(pd.BaseModel):
     #: successful remainder still folds and publishes. 100 restores the
     #: all-or-nothing pre-quarantine behavior.
     min_fetch_success_pct: float = pd.Field(50.0, ge=0, le=100)
+    # High-QPS read path (`krr_tpu.server.state.ResponseCache` + the app's
+    # bounded render pool).
+    #: Epoch-keyed rendered-response cache for GET /recommendations: False
+    #: restores the render-per-request behavior (the bench loadtest's
+    #: uncached control, and an escape hatch).
+    response_cache_enabled: bool = True
+    #: Entry bound on the response cache — one entry per (format,
+    #: canonicalized filters, page, encoding) combination, evicted LRU.
+    response_cache_max_entries: int = pd.Field(256, ge=1)
+    #: Byte budget (MiB) on cached response bodies — adversarial filter
+    #: cardinality must not OOM the server.
+    response_cache_max_mb: float = pd.Field(64.0, gt=0)
+    #: Concurrent cache-miss renders (worker threads) the read path allows.
+    server_render_concurrency: int = pd.Field(4, ge=1)
+    #: Requests allowed to WAIT behind a saturated render pool before the
+    #: rest shed with 503/Retry-After (0 = shed as soon as every worker is
+    #: busy).
+    server_render_queue: int = pd.Field(16, ge=0)
+    #: Read-path latency SLO: the per-tick GET /recommendations p99 must
+    #: stay under this many seconds (threshold objective, like
+    #: scan_latency). 0 disables the objective.
+    slo_read_p99_seconds: float = pd.Field(0.0, ge=0)
+
     # Durable digest store (`krr_tpu.core.durastore`) — the sharded
     # state-directory persistence behind the strategy's --state_path (the
     # on-disk FORMAT is the strategy's --store_format; these tune the

@@ -52,6 +52,35 @@ def split_object_key(key: str) -> "tuple[Optional[str], str, str, str, Optional[
     return cluster or None, namespace, name, container, kind or None
 
 
+def filter_key_indices(
+    keys,
+    namespaces=(),
+    workloads=(),
+    containers=(),
+) -> "list[int]":
+    """Row indices of ``keys`` (object-key strings, the store/snapshot key
+    table) whose namespace / workload name / container match the filter
+    sets (an empty set is a wildcard) — the serve read path's filter
+    pushdown: ``GET /recommendations?namespace=…`` resolves indices against
+    this key table and materializes ONLY the selected rows, instead of
+    iterating every rendered scan object per request. Parses through
+    :func:`split_object_key` so the HTTP filters and every other key
+    consumer (/history, the diff renderer) agree on the key grammar."""
+    if not (namespaces or workloads or containers):
+        return list(range(len(keys)))
+    out: list[int] = []
+    for i, key in enumerate(keys):
+        _cluster, namespace, name, container, _kind = split_object_key(key)
+        if namespaces and namespace not in namespaces:
+            continue
+        if workloads and name not in workloads:
+            continue
+        if containers and container not in containers:
+            continue
+        out.append(i)
+    return out
+
+
 class FsOps:
     """Every durability-critical filesystem syscall behind one injectable
     seam. The durable store (`krr_tpu.core.durastore`), :func:`atomic_write`,

@@ -493,7 +493,8 @@ def _server_options() -> list[click.Option]:
         "server_host", "server_port", "scan_interval_seconds", "discovery_interval_seconds",
         "history_retention_seconds", "hysteresis_dead_band_pct", "hysteresis_confirm_ticks",
         "trace_ring_scans", "store_shard_rows", "store_compact_wal_ratio",
-        "store_compact_min_wal_mb",
+        "store_compact_min_wal_mb", "response_cache_max_entries",
+        "response_cache_max_mb", "server_render_concurrency", "server_render_queue",
     )}
     return [
         PanelOption(
@@ -598,6 +599,61 @@ def _server_options() -> list[click.Option]:
             help=(
                 "Never compact the digest store's WAL below this many MiB — "
                 "tiny stores must not pay a base rewrite per handful of ticks."
+            ),
+        ),
+        PanelOption(
+            ["--response-cache/--no-response-cache", "response_cache_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "--no-response-cache disables the epoch-keyed rendered-"
+                "response cache on GET /recommendations: every non-fast-path "
+                "read renders per request (the uncached control / escape "
+                "hatch)."
+            ),
+        ),
+        PanelOption(
+            ["--response-cache-entries", "response_cache_max_entries"],
+            type=int,
+            default=defaults["response_cache_max_entries"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Entry bound on the response cache (one entry per format + "
+                "canonicalized filters + page + encoding, evicted LRU)."
+            ),
+        ),
+        PanelOption(
+            ["--response-cache-mb", "response_cache_max_mb"],
+            type=float,
+            default=defaults["response_cache_max_mb"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Byte budget (MiB) on cached response bodies — adversarial "
+                "filter cardinality must not OOM the server."
+            ),
+        ),
+        PanelOption(
+            ["--render-pool", "server_render_concurrency"],
+            type=int,
+            default=defaults["server_render_concurrency"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Concurrent cache-miss renders (worker threads) the read "
+                "path allows."
+            ),
+        ),
+        PanelOption(
+            ["--render-queue", "server_render_queue"],
+            type=int,
+            default=defaults["server_render_queue"],
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Requests allowed to wait behind a saturated render pool "
+                "before the rest shed with 503/Retry-After."
             ),
         ),
         PanelOption(
@@ -853,6 +909,17 @@ def _slo_options() -> list[click.Option]:
             show_default=True,
             panel="SLO Settings",
             help="Freshness SLO limit in seconds for the published window's age (0 = auto: three scan cadences).",
+        ),
+        PanelOption(
+            ["--slo-read-p99", "slo_read_p99_seconds"],
+            type=float,
+            default=Config.model_fields["slo_read_p99_seconds"].default,
+            show_default=True,
+            panel="SLO Settings",
+            help=(
+                "Read-path latency SLO limit in seconds for the per-tick "
+                "GET /recommendations p99 (0 = objective disabled)."
+            ),
         ),
         PanelOption(
             ["--slo-fast-window", "slo_fast_window_seconds"],

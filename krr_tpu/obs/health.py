@@ -329,6 +329,7 @@ def default_objectives(
     fetch_failure_budget: float,
     scan_latency_seconds: float,
     freshness_seconds: float,
+    read_p99_seconds: float = 0.0,
     clock: Callable[[], float] = time.time,
 ) -> "list[Objective]":
     """The stock objective set, fed by the shared registry:
@@ -337,6 +338,9 @@ def default_objectives(
     * ``fetch_failed_rows`` — ratio of terminally-failed object fetches.
     * ``scan_latency``   — the last scan's wall (summed legs) vs its limit.
     * ``freshness``      — age of the last published window vs its limit.
+    * ``read_p99``       — (opt-in: ``read_p99_seconds`` > 0) the last
+      tick's /recommendations p99 latency vs its limit — the read-path SLO
+      the bench loadtest leg gates offline.
     """
 
     def scan_failures() -> tuple[float, float]:
@@ -396,6 +400,36 @@ def default_objectives(
             limit=freshness_seconds,
         ),
     ]
+    if read_p99_seconds > 0:
+        # Same stale-gauge guard as scan_latency: the p99 gauge holds the
+        # LAST read-serving tick's value, so only a NEW completed scan may
+        # contribute an event, and only when that tick actually served
+        # reads (krr_tpu_http_read_requests > 0) — a quiet server must not
+        # dilute (or burn) the budget with replayed values.
+        read_seen = [0.0]
+
+        def read_p99() -> Optional[float]:
+            count = metrics.total("krr_tpu_scans_total")
+            if count <= read_seen[0]:
+                return None
+            read_seen[0] = count
+            if not (metrics.value("krr_tpu_http_read_requests") or 0.0):
+                return None
+            return metrics.value("krr_tpu_http_read_p99_seconds")
+
+        objectives.append(
+            Objective(
+                name="read_p99",
+                description=(
+                    "GET /recommendations p99 latency must stay under its "
+                    "limit: ticks whose read-path p99 breaches it burn this "
+                    "budget."
+                ),
+                budget=THRESHOLD_BUDGET,
+                value=read_p99,
+                limit=read_p99_seconds,
+            )
+        )
     return objectives
 
 
@@ -425,6 +459,7 @@ def engine_from_config(
         fetch_failure_budget=config.slo_fetch_failure_budget,
         scan_latency_seconds=latency,
         freshness_seconds=freshness,
+        read_p99_seconds=getattr(config, "slo_read_p99_seconds", 0.0),
         clock=clock,
     )
     if getattr(config, "scan_end_timestamp", None) is not None:

@@ -102,6 +102,16 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_fetch_downsample_fallback_total", "counter", "Downsampled stats queries that fell back to the raw fetch after a non-transient backend rejection (the namespaces are pinned to raw in the plan telemetry)."),
     ("krr_tpu_http_requests_total", "counter", "HTTP requests by route and status code."),
     ("krr_tpu_http_request_seconds", "histogram", "HTTP request latency by route.", DEFAULT_SECONDS_BUCKETS),
+    # High-QPS read path (`krr_tpu.server.state.ResponseCache` + the app's
+    # conditional-GET / bounded-render machinery).
+    ("krr_tpu_http_response_bytes_total", "counter", "HTTP response body bytes written to the wire by route and negotiated content encoding (identity|gzip|zstd); HEAD responses and 304 revalidations write none."),
+    ("krr_tpu_http_cache_hits_total", "counter", "Read-path response-cache lookups served from the epoch-keyed rendered-body cache (no render, no encode)."),
+    ("krr_tpu_http_cache_misses_total", "counter", "Read-path response-cache lookups that had to render (counted before the bounded render pool admits or sheds them)."),
+    ("krr_tpu_http_renders_shed_total", "counter", "Cache-miss renders shed with 503/Retry-After because the bounded render pool (width + wait queue) was saturated."),
+    ("krr_tpu_http_response_cache_entries", "gauge", "Entries resident in the epoch-keyed response cache (bounded by --response-cache-entries)."),
+    ("krr_tpu_http_response_cache_bytes", "gauge", "Body bytes resident in the epoch-keyed response cache (bounded by --response-cache-mb)."),
+    ("krr_tpu_http_read_requests", "gauge", "GET /recommendations requests served during the last completed scheduler tick's window (0 = a quiet tick; gates the read-p99 SLO sample)."),
+    ("krr_tpu_http_read_p99_seconds", "gauge", "Estimated p99 GET /recommendations request latency over the last completed tick's window (histogram-bucket interpolation; stale while krr_tpu_http_read_requests is 0)."),
     # Device-level compute observability (`krr_tpu.obs.device`).
     ("krr_tpu_compile_cache_hits_total", "counter", "Jitted programs served from the persistent XLA compilation cache instead of recompiling."),
     ("krr_tpu_compile_cache_misses_total", "counter", "Jitted programs the persistent XLA compilation cache had to compile and store."),
@@ -287,6 +297,35 @@ class MetricsRegistry:
                     else:
                         out.append(f"{name}{suffix} {_format_value(value)}")
         return "\n".join(out) + "\n"
+
+
+def histogram_quantile(
+    pairs: "list[tuple[float, float]]", q: float
+) -> Optional[float]:
+    """Quantile estimate from cumulative ``(le, count)`` pairs (the
+    :meth:`MetricsRegistry.histogram_buckets` representation, or a delta of
+    two such snapshots — cumulative minus cumulative stays cumulative).
+    Linear interpolation inside the winning bucket, Prometheus
+    ``histogram_quantile`` style; a quantile landing in the +Inf bucket
+    clamps to the last finite bound. None when the histogram holds no
+    observations."""
+    if not pairs:
+        return None
+    total = pairs[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    for bound, count in pairs:
+        if count >= rank:
+            if bound == float("inf"):
+                return prev_bound
+            span = count - prev_count
+            if span <= 0:
+                return bound
+            return prev_bound + (bound - prev_bound) * (rank - prev_count) / span
+        prev_bound, prev_count = bound, count
+    return prev_bound
 
 
 def record_build_info(registry: MetricsRegistry) -> None:

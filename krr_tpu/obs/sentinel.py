@@ -60,7 +60,15 @@ from krr_tpu.obs.profile import CATEGORIES
 #: silent fallback to identity transport multiplies wire bytes by the
 #: compression ratio while every timing band may stay green, and it must
 #: page as a trend verdict, not a mystery slowdown later).
-MONITORED = tuple(c for c in CATEGORIES if c != "idle") + ("wall", "wire_mb")
+#: ``read_p99_ms`` rides along the same way: the read path's per-tick p99
+#: (milliseconds — a value band like wire_mb, not a scan-seconds band), so
+#: a cache-hit-rate collapse or render-pool saturation pages as a trend
+#: verdict instead of a mystery latency complaint from clients.
+MONITORED = tuple(c for c in CATEGORIES if c != "idle") + ("wall", "wire_mb", "read_p99_ms")
+
+#: Value-band series (not scan-seconds): excluded from the seconds-ranked
+#: dominant pool, and rendered/reported in their own units.
+_VALUE_BANDS = {"wire_mb": "MB", "read_p99_ms": "ms"}
 
 #: Transport phases whose bands refine a fetch_transport attribution.
 _PHASE_DETAIL = ("connect", "request_write", "ttfb", "body_read", "queue_wait")
@@ -81,6 +89,11 @@ SUSPECT_LAYERS = {
         "wire bytes up at steady timings → compression fell back to identity "
         "(a proxy stripping Accept-Encoding?) or response volume grew — "
         "check the record's encodings and downsample engagement"
+    ),
+    "read_p99_ms": (
+        "read-path p99 up → response-cache hit rate collapsed (epoch churn? "
+        "filter-cardinality evictions?) or the render pool saturated — "
+        "check the record's readpath hits/misses/shed split"
     ),
 }
 
@@ -169,6 +182,13 @@ class RegressionSentinel:
         wire_bytes = record.get("wire_bytes") or 0
         if wire_bytes:
             values["wire_mb"] = float(wire_bytes) / 1e6
+        # Read-path p99 — same no-sample-when-absent discipline as wire_mb:
+        # a quiet tick (no /recommendations traffic) or a pre-read-path
+        # record contributes nothing, so the band warms only on ticks that
+        # actually served reads.
+        readpath = record.get("readpath") or {}
+        if readpath.get("requests") and readpath.get("p99_ms") is not None:
+            values["read_p99_ms"] = float(readpath["p99_ms"])
         for phase, seconds in (record.get("phases") or {}).items():
             if phase in _PHASE_DETAIL:
                 values[f"phase_{phase}"] = float(seconds)
@@ -234,11 +254,12 @@ class RegressionSentinel:
         if regressed:
             # Dominant = the category that ADDED the most wall, not the one
             # with the tightest band: attribution must name where the
-            # seconds went. wire_mb is a VALUE band in megabytes — ranked
-            # against seconds its raw excess would win almost every
-            # co-occurring regression at fleet scale, so it only becomes
-            # dominant when no timing category regressed alongside it.
-            timing = [name for name in regressed if name != "wire_mb"]
+            # seconds went. Value bands (wire_mb in megabytes, read_p99_ms
+            # in milliseconds) — ranked against seconds their raw excess
+            # would win almost every co-occurring regression at fleet
+            # scale, so they only become dominant when no timing category
+            # regressed alongside them.
+            timing = [name for name in regressed if name not in _VALUE_BANDS]
             pool = timing or regressed
             dominant = max(
                 pool, key=lambda name: deviations[name]["value"] - deviations[name]["median"]
@@ -256,7 +277,7 @@ class RegressionSentinel:
                 excess_seconds=round(
                     deviations[dominant]["value"] - deviations[dominant]["median"], 6
                 ),
-                excess_unit="MB" if dominant == "wire_mb" else "s",
+                excess_unit=_VALUE_BANDS.get(dominant, "s"),
                 regressed=regressed,
                 suspect=suspect,
             )
@@ -445,7 +466,7 @@ def render_trend_text(report: dict, records: "Optional[list[dict]]" = None) -> s
         for name, band in posture["series"].items():
             if name.startswith("phase_"):
                 continue
-            unit = "MB" if name == "wire_mb" else "s"
+            unit = _VALUE_BANDS.get(name, "s")
             lines.append(
                 f"    {name:<16} median {band['median']:>9.3f}{unit} "
                 f"± {band['band']:.3f}{unit}  (n={band['samples']})"

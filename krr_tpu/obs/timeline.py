@@ -433,6 +433,12 @@ def build_scan_record(
         # applied records and delta wire bytes — the trendable federation
         # cost beside the apply seconds already in `categories["fold"]`.
         record["federation"] = dict(stats["federation"])
+    if "readpath" in stats:
+        # Read-path serving deltas for the tick window (requests / 304s /
+        # cache hits / misses / sheds / bytes / p99) — the sentinel bands
+        # ``read_p99_ms`` over these so a read-latency regression pages as
+        # a trend verdict like any scan-cost regression.
+        record["readpath"] = dict(stats["readpath"])
     plan: dict[str, Any] = {
         "coalesced": int((plan_delta or {}).get("coalesced", 0)),
         "sharded": int((plan_delta or {}).get("sharded", 0)),

@@ -10,9 +10,19 @@ Routes:
 
 * ``GET /recommendations`` — the last published scan. Whole fleet by
   default (a byte copy of the snapshot's pre-rendered JSON); filter with
-  repeatable ``namespace=``, and ``workload=`` / ``container=``; pick a
-  machine format with ``format=json|yaml|pprint``. 503 until the first
-  scan publishes.
+  repeatable ``namespace=``, and ``workload=`` / ``container=``; paginate
+  with ``limit=``/``offset=``; pick a machine format with
+  ``format=json|yaml|pprint``. 503 until the first scan publishes.
+  High-QPS read path: every non-fast-path response is served from an
+  epoch-keyed rendered+encoded cache (`krr_tpu.server.state.ResponseCache`,
+  invalidated wholesale when a publish changes bytes), conditional GETs
+  (``ETag: "<epoch>-<changed-at-ms>"`` / ``If-None-Match``,
+  ``Last-Modified`` / ``If-Modified-Since``) answer 304 with zero render
+  work, responses
+  compress per ``Accept-Encoding`` (gzip always, zstd when importable),
+  and cache misses render through a bounded pool that sheds 503 +
+  ``Retry-After`` past saturation. HEAD is answered on every route with
+  identical status/headers and an empty body.
 * ``GET /history``   — per-workload journal of recommendation ticks (the
   raw series behind the hysteresis-gated snapshot); same filters, plus
   ``limit=`` for the newest N ticks per workload.
@@ -65,6 +75,7 @@ MAX_HEADER_LINES = 100
 
 _STATUS_REASONS = {
     200: "OK",
+    304: "Not Modified",
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
@@ -86,6 +97,137 @@ _METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def _json_body(payload: dict) -> bytes:
     return (json.dumps(payload) + "\n").encode()
+
+
+# ------------------------------------------------------- content negotiation
+def _zstd_compressor_factory():
+    """zstd compression when a zstd module is importable (the image may not
+    carry one) — the serve-side twin of the fetch plane's
+    `krr_tpu.integrations.prometheus.accept_encoding_for` negotiation."""
+    try:
+        import zstandard
+    except ImportError:
+        return None
+    return lambda: zstandard.ZstdCompressor()
+
+
+_ZSTD_FACTORY = _zstd_compressor_factory()
+
+#: Content encodings the read path can serve, most-preferred first.
+SUPPORTED_ENCODINGS: "tuple[str, ...]" = (
+    ("zstd", "gzip") if _ZSTD_FACTORY is not None else ("gzip",)
+)
+
+
+def negotiate_encoding(accept_encoding: str) -> str:
+    """Pick the response ``Content-Encoding`` for a request's
+    ``Accept-Encoding`` header: zstd when offered and importable, else gzip,
+    else identity. Minimal q-value handling: an encoding offered with
+    ``q=0`` is refused, ``*`` matches anything not explicitly listed."""
+    if not accept_encoding:
+        return "identity"
+    offered: dict[str, float] = {}
+    for token in accept_encoding.split(","):
+        name, _, params = token.strip().partition(";")
+        name = name.strip().lower()
+        if not name:
+            continue
+        q = 1.0
+        params = params.strip()
+        if params.startswith("q="):
+            try:
+                q = float(params[2:])
+            except ValueError:
+                q = 0.0
+        offered[name] = q
+    for candidate in SUPPORTED_ENCODINGS:
+        q = offered[candidate] if candidate in offered else offered.get("*", 0.0)
+        if q > 0:
+            return candidate
+    return "identity"
+
+
+def encode_body(body: bytes, encoding: str) -> bytes:
+    """Compress an identity body for a negotiated encoding. gzip uses
+    ``mtime=0`` so cached variants are deterministic bytes — the bench's
+    round-trip gate and the cache-correctness tests compare them exactly."""
+    if encoding == "gzip":
+        import gzip
+
+        return gzip.compress(body, mtime=0)
+    if encoding == "zstd":
+        return _ZSTD_FACTORY().compress(body)
+    return body
+
+
+def _http_date(ts: float) -> str:
+    from email.utils import formatdate
+
+    return formatdate(ts, usegmt=True)
+
+
+def _parse_http_date(value: str) -> Optional[float]:
+    from email.utils import parsedate_to_datetime
+
+    try:
+        return parsedate_to_datetime(value).timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
+def _conditional_hit(headers: "dict[str, str]", etag: str, changed_at: float) -> bool:
+    """Whether the request's validators prove the client's copy current:
+    ``If-None-Match`` (exact or weak ``W/`` match, or ``*``) wins over
+    ``If-Modified-Since`` (second-granularity HTTP dates, so the comparison
+    truncates ``changed_at``), per RFC 9110 precedence."""
+    if_none_match = headers.get("if-none-match")
+    if if_none_match is not None:
+        candidates = {tag.strip().removeprefix("W/") for tag in if_none_match.split(",")}
+        return "*" in candidates or etag in candidates
+    since = headers.get("if-modified-since")
+    if since:
+        parsed = _parse_http_date(since)
+        return parsed is not None and int(changed_at) <= parsed
+    return False
+
+
+class RenderShed(Exception):
+    """Raised when the bounded render pool is saturated (every worker busy
+    AND the wait queue full): the request sheds with 503/``Retry-After``
+    instead of joining an unbounded ``asyncio.to_thread`` stampede."""
+
+
+class RenderPool:
+    """Semaphore-bounded worker-thread renders for cache-miss reads.
+
+    At most ``width`` renders run concurrently and at most ``queue_limit``
+    callers wait behind them; everything past that raises
+    :class:`RenderShed` (counted in ``krr_tpu_http_renders_shed_total``).
+    Bounding matters more than fairness here: a render is tens of ms at
+    fleet scale, and an unbounded thread fan-out under a cache-cold burst
+    is exactly the stampede the cache exists to prevent."""
+
+    def __init__(self, width: int, queue_limit: int, metrics=None) -> None:
+        self.width = max(1, int(width))
+        self.queue_limit = max(0, int(queue_limit))
+        self.metrics = metrics
+        self._semaphore = asyncio.Semaphore(self.width)
+        self._waiting = 0
+
+    async def run(self, fn):
+        if self._semaphore.locked() and self._waiting >= self.queue_limit:
+            if self.metrics is not None:
+                self.metrics.inc("krr_tpu_http_renders_shed_total")
+            raise RenderShed()
+        self._waiting += 1
+        try:
+            await self._semaphore.acquire()
+        finally:
+            self._waiting -= 1
+        try:
+            return await asyncio.to_thread(fn)
+        finally:
+            self._semaphore.release()
 
 
 def _count_param(
@@ -133,11 +275,18 @@ class HttpApp:
         drift_confirm_ticks: int = 2,
         hysteresis_enabled: bool = True,
         tracer: NullTracer = NULL_TRACER,
+        render_concurrency: int = 4,
+        render_queue: int = 16,
     ) -> None:
         self.state = state
         self.logger = logger
         self.stale_after_seconds = stale_after_seconds
         self.clock = clock
+        #: Bounded worker pool for cache-miss read renders (`RenderPool`):
+        #: past width + queue, requests shed 503/Retry-After.
+        self.render_pool = RenderPool(
+            render_concurrency, render_queue, metrics=state.metrics
+        )
         #: The scan session's tracer ring, exported by GET /debug/trace.
         self.tracer = tracer
         #: The gate knobs, echoed by /drift so its out-of-band/regime flags
@@ -166,11 +315,26 @@ class HttpApp:
 
     # -------------------------------------------------------------- routes
     async def route(
-        self, method: str, path: str, query: dict[str, list[str]]
-    ) -> tuple[int, str, bytes]:
-        """Dispatch → (status, content_type, body)."""
-        if method != "GET":
-            return 405, "application/json", _json_body({"error": "only GET is supported"})
+        self,
+        method: str,
+        path: str,
+        query: dict[str, list[str]],
+        headers: "Optional[dict[str, str]]" = None,
+    ):
+        """Dispatch → ``(status, content_type, body)`` or ``(status,
+        content_type, body, extra_headers)`` (the connection handler
+        normalizes; see :meth:`_normalize`). HEAD dispatches exactly like
+        GET — the handler suppresses the body bytes while keeping the
+        status, Content-Length, and validators identical, so load-balancer
+        HEAD probes see the same read path GET clients do."""
+        if method not in ("GET", "HEAD"):
+            return (
+                405,
+                "application/json",
+                _json_body({"error": "only GET and HEAD are supported"}),
+                {"Allow": "GET, HEAD"},
+            )
+        headers = headers or {}
         if path == "/healthz":
             return await self._healthz()
         if path == "/metrics":
@@ -181,11 +345,11 @@ class HttpApp:
         if path == "/statusz":
             return await self._statusz(query)
         if path == "/recommendations":
-            return await self._recommendations(query)
+            return await self._recommendations(query, headers)
         if path == "/history":
-            return await self._history(query)
+            return await self._history(query, headers)
         if path == "/drift":
-            return await self._drift()
+            return await self._drift(headers)
         if path == "/debug/trace":
             return await self._debug_trace(query)
         if path == "/debug/profile":
@@ -193,6 +357,14 @@ class HttpApp:
         if path == "/debug/timeline":
             return await self._debug_timeline(query)
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
+
+    @staticmethod
+    def _normalize(response) -> "tuple[int, str, bytes, dict[str, str]]":
+        """Pad 3-tuple route responses with empty extra headers."""
+        if len(response) == 3:
+            status, content_type, body = response
+            return status, content_type, body, {}
+        return response
 
     async def _debug_trace(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
         """The last N completed scan ticks' spans as Chrome trace-event JSON
@@ -367,6 +539,10 @@ class HttpApp:
         body = {
             "status": status,
             "uptime_seconds": round(time.time() - self.state.started_at, 3),
+            # The publish epoch — the read path's cache key and ETag value
+            # (conditional clients can learn the current epoch from a cheap
+            # /healthz probe instead of a full fetch).
+            "epoch": snapshot.epoch if snapshot is not None else None,
             "scans": len(snapshot.result.scans) if snapshot is not None else 0,
             "last_scan_unix": snapshot.window_end if snapshot is not None else None,
             "last_scan_id": self.state.last_scan_id,
@@ -403,45 +579,191 @@ class HttpApp:
             # Federation mode: per-shard connected/epoch/lag — the failure
             # domain IS the shard, so liveness must name the silent one.
             body["federation"] = self.state.federation.status(float(self.clock()))
-        return (200 if status in ("ok", "degraded") else 503), "application/json", _json_body(body)
+        extra = (
+            {"X-KRR-Epoch": str(snapshot.epoch)} if snapshot is not None else {}
+        )
+        return (
+            (200 if status in ("ok", "degraded") else 503),
+            "application/json",
+            _json_body(body),
+            extra,
+        )
 
-    async def _recommendations(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+    def _snapshot_validators(self, snapshot, encoding: str = "identity") -> "dict[str, str]":
+        # The ETag carries the epoch AND the content change's millisecond
+        # timestamp: the epoch alone is only unique within one process
+        # lifetime (a restarted memory-only server recounts from 0, and a
+        # client — or shared proxy cache — holding a pre-restart ETag would
+        # false-304 once the new process counted back up to the old value
+        # with different bytes). epoch+changed_at can't collide across
+        # restarts; suppressed republishes carry both forward, so the tag
+        # stays stable at steady state. Non-identity variants suffix the
+        # encoding (the Apache mod_deflate convention): distinct
+        # representations must carry distinct strong tags, or an ETag-keyed
+        # intermediary could freshen the wrong variant off a 304.
+        suffix = "" if encoding == "identity" else f"-{encoding}"
+        return {
+            "ETag": f'"{snapshot.epoch}-{int(snapshot.changed_at * 1000.0)}{suffix}"',
+            "Last-Modified": _http_date(snapshot.changed_at),
+            "X-KRR-Epoch": str(snapshot.epoch),
+            "Vary": "Accept-Encoding",
+        }
+
+    async def _rendered(self, render):
+        """Bounded-pool admission with the shared shed response:
+        ``(body, None)`` on success, ``(None, 503-response)`` when the pool
+        is saturated — one place defines what shedding looks like."""
+        try:
+            return await self.render_pool.run(render), None
+        except RenderShed:
+            return None, (
+                503,
+                "application/json",
+                _json_body({"error": "render pool saturated; retry shortly"}),
+                {"Retry-After": "1"},
+            )
+
+    async def _recommendations(
+        self, query: dict[str, list[str]], headers: "dict[str, str]"
+    ):
         snapshot = await self.state.snapshot()
         if snapshot is None:
             return 503, "application/json", _json_body(
                 {"error": "no scan has completed yet; retry shortly"}
-            )
+            ), {"Retry-After": "1"}
+        # Repeated format= params are pinned last-wins (the [-1]).
         fmt = (query.get("format") or ["json"])[-1]
         content_type = _FORMATS.get(fmt)
         if content_type is None:
             return 400, "application/json", _json_body(
                 {"error": f"unknown format {fmt!r}; one of {sorted(_FORMATS)}"}
             )
-        namespaces = set(query.get("namespace", ()))
-        workloads = set(query.get("workload", ()))
-        containers = set(query.get("container", ()))
-        if fmt == "json" and not namespaces and not workloads and not containers:
-            # The hot path: rendered AND encoded at publish time.
-            return 200, content_type, snapshot.body_json
+        # Pagination pushdown: the shared count-param hygiene (non-integer
+        # or negative → 400), 0/absent meaning "all"/"from the start".
+        limit, error = _count_param(query, "limit")
+        if error is not None:
+            return error
+        offset, error = _count_param(query, "offset")
+        if error is not None:
+            return error
+        offset = offset or 0
+        namespaces = frozenset(query.get("namespace", ()))
+        workloads = frozenset(query.get("workload", ()))
+        containers = frozenset(query.get("container", ()))
 
-        def render() -> bytes:
-            # Filter + score recompute + render + encode all in the worker
-            # thread — at fleet scale even the filter pass over 100k scans
+        # Negotiated BEFORE the conditional check: the ETag is
+        # per-representation (encoding-suffixed), so a client revalidates
+        # against the tag of the variant it would be served now.
+        encoding = negotiate_encoding(headers.get("accept-encoding", ""))
+        validators = self._snapshot_validators(snapshot, encoding)
+        if _conditional_hit(headers, validators["ETag"], snapshot.changed_at):
+            # Revalidation: zero render work, zero body bytes — the whole
+            # point of the epoch ETag. 304 carries the same validators.
+            return 304, content_type, b"", validators
+
+        unfiltered = not (namespaces or workloads or containers)
+        unpaged = limit is None and not offset
+        if unfiltered and unpaged and fmt == "json" and encoding == "identity":
+            # The pre-rendered fast path: a byte copy of the publish-time
+            # body, no cache entry needed.
+            return 200, content_type, snapshot.body_json, validators
+
+        cache = self.state.response_cache
+        cache_key = (
+            fmt,
+            tuple(sorted(namespaces)),
+            tuple(sorted(workloads)),
+            tuple(sorted(containers)),
+            limit,
+            offset,
+        )
+        cached_identity: "Optional[bytes]" = None
+        if cache is not None:
+            body = cache.get(snapshot.epoch, (*cache_key, encoding))
+            if body is not None:
+                extra = dict(validators)
+                if encoding != "identity":
+                    extra["Content-Encoding"] = encoding
+                return 200, content_type, body, extra
+            if encoding != "identity":
+                # An encoded-variant miss whose identity sibling is already
+                # cached only needs the COMPRESSION leg, not a re-render.
+                cached_identity = cache.peek(snapshot.epoch, (*cache_key, "identity"))
+
+        def render() -> "tuple[bytes, bytes]":
+            # Pushdown + render + encode (+ compress) all in the worker
+            # thread — at fleet scale even the filter pass over 100k keys
             # is tens of ms the event loop can't afford.
-            if not namespaces and not workloads and not containers:
-                return snapshot.result.format(fmt).encode()
-            scans = [
-                scan
-                for scan in snapshot.result.scans
-                if (not namespaces or scan.object.namespace in namespaces)
-                and (not workloads or scan.object.name in workloads)
-                and (not containers or scan.object.container in containers)
-            ]
-            return Result(scans=scans).format(fmt).encode()
+            identity = cached_identity
+            if identity is None:
+                identity = self._render_recommendations(
+                    snapshot, fmt, namespaces, workloads, containers, limit, offset
+                )
+            return identity, encode_body(identity, encoding)
 
-        return 200, content_type, await asyncio.to_thread(render)
+        rendered, shed = await self._rendered(render)
+        if shed is not None:
+            return shed
+        identity, encoded = rendered
+        if cache is not None:
+            # Identity and the negotiated variant cached side by side: a
+            # later reader with either Accept-Encoding hits without
+            # re-rendering OR re-compressing.
+            cache.put(snapshot.epoch, (*cache_key, "identity"), identity)
+            if encoding != "identity":
+                cache.put(snapshot.epoch, (*cache_key, encoding), encoded)
+        extra = dict(validators)
+        if encoding != "identity":
+            extra["Content-Encoding"] = encoding
+        return 200, content_type, encoded, extra
 
-    async def _history(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+    @staticmethod
+    def _render_recommendations(
+        snapshot, fmt, namespaces, workloads, containers, limit, offset
+    ) -> bytes:
+        """The identity body for one (format, filters, page) combination.
+        Filters resolve to row indices against the snapshot's KEY TABLE
+        (`krr_tpu.core.streaming.filter_key_indices` — the same key grammar
+        the digest store rows carry) and pagination slices the index list,
+        so only the selected scan objects are ever touched; the selected
+        subset renders through the identical ``Result`` path the pre-cache
+        code used, which is what keeps filtered responses bit-identical to
+        render-then-slice. NOTE the published scans go through the
+        hysteresis gate — re-querying ``DigestStore.query_recommendation``
+        per request would serve RAW values the gate withheld, so the
+        pushdown stops at the key table and reuses the published scans."""
+        from krr_tpu.core.streaming import filter_key_indices, object_key
+
+        unfiltered = not (namespaces or workloads or containers)
+        if unfiltered and limit is None and not offset:
+            if fmt == "json":
+                return snapshot.body_json
+            return snapshot.result.format(fmt).encode()
+        scans = snapshot.result.scans
+        keys = snapshot.keys
+        if len(keys) != len(scans):  # snapshots built without a key table
+            keys = [object_key(scan.object) for scan in scans]
+        indices = filter_key_indices(keys, namespaces, workloads, containers)
+        window = indices[offset : (offset + limit) if limit is not None else None]
+        return Result(scans=[scans[i] for i in window]).format(fmt).encode()
+
+    def _journal_validators(self, journal) -> "tuple[dict[str, str], float]":
+        """(validators, changed_at) for the journal-backed routes. The
+        journal gains records every tick — including hysteresis-suppressed
+        ones — so the publish epoch alone would false-304 a grown journal;
+        the ETag carries the journal's record count and newest timestamp
+        alongside it."""
+        snapshot = self.state.peek()
+        epoch = snapshot.epoch if snapshot is not None else 0
+        newest = journal.newest_ts or self.state.started_at
+        etag = f'"{epoch}-{journal.record_count}-{newest}"'
+        return {
+            "ETag": etag,
+            "Last-Modified": _http_date(newest),
+            "X-KRR-Epoch": str(epoch),
+        }, float(newest)
+
+    async def _history(self, query: dict[str, list[str]], headers: "dict[str, str]"):
         """Per-workload journal series: every recompute's raw recommendation
         with its published flag — the audit trail behind the gated snapshot."""
         journal = self.state.journal
@@ -450,10 +772,12 @@ class HttpApp:
         namespaces = set(query.get("namespace", ()))
         workloads = set(query.get("workload", ()))
         containers = set(query.get("container", ()))
-        try:
-            limit = int((query.get("limit") or ["0"])[-1])
-        except ValueError:
-            return 400, "application/json", _json_body({"error": "limit must be an integer"})
+        limit, error = _count_param(query, "limit")
+        if error is not None:
+            return error
+        validators, changed_at = self._journal_validators(journal)
+        if _conditional_hit(headers, validators["ETag"], changed_at):
+            return 304, "application/json", b"", validators
 
         def render() -> bytes:
             from krr_tpu.core.streaming import split_object_key
@@ -483,7 +807,7 @@ class HttpApp:
                         continue
                     if containers and container not in containers:
                         continue
-                if limit > 0:
+                if limit:
                     group = group[-limit:]
                 payload["workloads"].append(
                     {
@@ -507,13 +831,22 @@ class HttpApp:
                 )
             return _json_body(payload)
 
-        return 200, "application/json", await asyncio.to_thread(render)
+        # Journal renders walk every record per request and have no
+        # response cache — the bounded pool (not a bare to_thread) is what
+        # keeps a cache-cold burst from stampeding worker threads.
+        body, shed = await self._rendered(render)
+        if shed is not None:
+            return shed
+        return 200, "application/json", body, validators
 
-    async def _drift(self) -> tuple[int, str, bytes]:
+    async def _drift(self, headers: "dict[str, str]"):
         """Fleet drift posture from the journal (`krr_tpu.history.drift`)."""
         journal = self.state.journal
         if journal is None:
             return 404, "application/json", _json_body({"error": "no journal on this server"})
+        validators, changed_at = self._journal_validators(journal)
+        if _conditional_hit(headers, validators["ETag"], changed_at):
+            return 304, "application/json", b"", validators
 
         def render() -> bytes:
             from krr_tpu.history.drift import fleet_drift
@@ -539,7 +872,10 @@ class HttpApp:
             }
             return _json_body(payload)
 
-        return 200, "application/json", await asyncio.to_thread(render)
+        body, shed = await self._rendered(render)
+        if shed is not None:
+            return shed
+        return 200, "application/json", body, validators
 
     # ------------------------------------------------------------ plumbing
     async def handle_connection(
@@ -622,7 +958,9 @@ class HttpApp:
         query = urllib.parse.parse_qs(split.query, keep_blank_values=False)
 
         t0 = time.perf_counter()
-        status, content_type, body = await self.route(method, split.path, query)
+        status, content_type, body, extra_headers = self._normalize(
+            await self.route(method, split.path, query, headers)
+        )
         route_label = (
             split.path
             if split.path
@@ -633,25 +971,47 @@ class HttpApp:
         self.state.metrics.observe(
             "krr_tpu_http_request_seconds", time.perf_counter() - t0, route=route_label
         )
+        # Bytes actually written to the wire, by negotiated encoding (a HEAD
+        # response writes none; 304s count their zero-length bodies for free).
+        head_only = method == "HEAD"
+        if not head_only and body:
+            self.state.metrics.inc(
+                "krr_tpu_http_response_bytes_total",
+                len(body),
+                route=route_label,
+                encoding=extra_headers.get("Content-Encoding", "identity"),
+            )
 
         keep_alive = headers.get("connection", "" if version == "HTTP/1.1" else "close").lower() != "close"
-        self._respond(writer, status, content_type, body, keep_alive)
+        self._respond(writer, status, content_type, body, keep_alive, extra_headers, head_only=head_only)
         await writer.drain()
         return keep_alive
 
     @staticmethod
     def _respond(
-        writer: asyncio.StreamWriter, status: int, content_type: str, body: bytes, keep_alive: bool
+        writer: asyncio.StreamWriter,
+        status: int,
+        content_type: str,
+        body: bytes,
+        keep_alive: bool,
+        extra_headers: "Optional[dict[str, str]]" = None,
+        *,
+        head_only: bool = False,
     ) -> None:
+        """``head_only`` (a HEAD request) sends the IDENTICAL status line and
+        headers — Content-Length and validators included, which is what
+        load-balancer probes key on — with the body bytes suppressed."""
         reason = _STATUS_REASONS.get(status, "OK")
-        head = (
-            f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: {content_type}\r\n"
-            f"Content-Length: {len(body)}\r\n"
-            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
-            "\r\n"
-        )
-        writer.write(head.encode("latin-1") + body)
+        lines = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+        ]
+        for name, value in (extra_headers or {}).items():
+            lines.append(f"{name}: {value}")
+        lines.append(f"Connection: {'keep-alive' if keep_alive else 'close'}")
+        head = "\r\n".join(lines) + "\r\n\r\n"
+        writer.write(head.encode("latin-1") + (b"" if head_only else body))
 
 
 class KrrServer:
@@ -731,6 +1091,20 @@ class KrrServer:
             # per-query telemetry into the same exposition /metrics serves.
             metrics=self.session.metrics,
         )
+        # The read path's epoch-keyed response cache (`ResponseCache`), and
+        # the epoch floor: seeding from the durable store's persist epoch
+        # keeps ETags monotonic across restarts, so a pre-restart client's
+        # If-None-Match can never false-304 against new content.
+        if config.response_cache_enabled:
+            from krr_tpu.server.state import ResponseCache
+
+            self.state.response_cache = ResponseCache(
+                max_entries=config.response_cache_max_entries,
+                max_bytes=int(config.response_cache_max_mb * (1 << 20)),
+                metrics=self.session.metrics,
+            )
+        if self.durable is not None and self.durable.fmt == "sharded":
+            self.state.seed_epoch(self.durable.epoch)
         # Epoch reconciliation: a crash between the journal append and the
         # store persist leaves the journal one publish ahead — truncate it
         # back to the store's durable epoch (deterministic) before the
@@ -866,6 +1240,8 @@ class KrrServer:
             drift_confirm_ticks=config.hysteresis_confirm_ticks,
             hysteresis_enabled=config.hysteresis_enabled,
             tracer=self.session.tracer,
+            render_concurrency=config.server_render_concurrency,
+            render_queue=config.server_render_queue,
         )
         self._server: Optional[asyncio.AbstractServer] = None
 

@@ -150,6 +150,12 @@ class ScanScheduler:
         self._plan_totals: "dict[str, float]" = {
             "coalesced": 0.0, "sharded": 0.0, "downsampled": 0.0,
         }
+        #: Read-path counter totals (and /recommendations latency-histogram
+        #: cumulative buckets) at the last recorded tick — the timeline
+        #: record carries per-TICK served/hit/miss/shed/bytes deltas and a
+        #: per-tick p99, the same delta discipline as the plan counters.
+        self._read_totals: "dict[str, float]" = {}
+        self._read_buckets: "Optional[dict[float, float]]" = None
         #: key → grid-aligned start of the first window its fetch missed:
         #: the catch-up fetch's left edge. Persisted in the store's
         #: extra_meta (same atomic save as the cursor) — a restart must
@@ -392,7 +398,7 @@ class ScanScheduler:
         metrics = self.state.metrics
         journal = self.state.journal
 
-        def render() -> "tuple[Result, bytes, object]":
+        def render() -> "tuple[Result, bytes, bytes, object, list[str]]":
             # Query + gate + journal + recommend + render + encode in ONE
             # worker-thread hop: the whole-fleet JSON is multi-MB at scale,
             # and any leg of it on the event loop stalls every in-flight
@@ -495,11 +501,18 @@ class ScanScheduler:
                         if since is not None:
                             scan.stale_since = since
                 result = Result(scans=scans)
-            return result, result.format("json").encode(), decision
+            body = result.format("json").encode()
+            # Digested here, in the worker thread: publish() then decides
+            # changed-vs-identical with an O(1) compare under the write
+            # lock instead of a fleet-sized memcmp on the event loop.
+            import hashlib
+
+            digest = hashlib.blake2b(body, digest_size=16).digest()
+            return result, body, digest, decision, keys
 
         tracer = self.session.tracer
         with tracer.span("compute", rows=len(objects)):
-            result, body, decision = await asyncio.to_thread(render)
+            result, body, digest, decision, keys = await asyncio.to_thread(render)
         with tracer.span("publish") as publish_span:
             changed = int(np.count_nonzero(decision.changed))
             suppressed = int(np.count_nonzero(decision.suppressed))
@@ -518,8 +531,18 @@ class ScanScheduler:
                     (newest - oldest) if newest is not None and oldest is not None else 0.0,
                 )
             publish_span.set(changed=changed, suppressed=suppressed)
+            # The epoch and changed_at are stamped by the state's publish:
+            # byte-identical republishes (suppressed ticks) keep the
+            # previous epoch, so the read path's ETags/cache stay warm.
             await self.state.publish(
-                Snapshot(result=result, body_json=body, window_end=window_end, published_at=time.time())
+                Snapshot(
+                    result=result,
+                    body_json=body,
+                    window_end=window_end,
+                    published_at=time.time(),
+                    keys=tuple(keys),
+                    body_digest=digest,
+                )
             )
 
     async def tick(self) -> bool:
@@ -990,6 +1013,70 @@ class ScanScheduler:
         )
         return True
 
+    # ----------------------------------------------- read-path tick stats
+    def _readpath_tick_stats(self) -> dict:
+        """Per-tick /recommendations serving stats from the shared registry:
+        requests/304s/cache hits/misses/sheds/bytes as deltas since the
+        last recorded tick, plus the tick's p99 request latency estimated
+        from the route's histogram-bucket deltas. Feeds the timeline record
+        (so the sentinel can band read latency), the
+        ``krr_tpu_http_read_p99_seconds`` gauge (the optional
+        ``--slo-read-p99`` objective's value), and the
+        ``krr_tpu_http_read_requests`` gauge that gates both on "did this
+        tick actually serve reads"."""
+        from krr_tpu.obs.metrics import histogram_quantile
+
+        metrics = self.state.metrics
+        route = ("route", "/recommendations")
+
+        def route_sum(name: str, **extra: str) -> float:
+            want = {route, *((k, v) for k, v in extra.items())}
+            return sum(
+                value
+                for series, value in metrics.series(name).items()
+                if want <= set(series)
+            )
+
+        totals = {
+            "requests": route_sum("krr_tpu_http_requests_total"),
+            "not_modified": route_sum("krr_tpu_http_requests_total", code="304"),
+            "bytes": route_sum("krr_tpu_http_response_bytes_total"),
+            "cache_hits": metrics.total("krr_tpu_http_cache_hits_total"),
+            "cache_misses": metrics.total("krr_tpu_http_cache_misses_total"),
+            "renders_shed": metrics.total("krr_tpu_http_renders_shed_total"),
+        }
+        delta = {
+            key: max(0.0, value - self._read_totals.get(key, 0.0))
+            for key, value in totals.items()
+        }
+        self._read_totals = totals
+        buckets = metrics.histogram_buckets(
+            "krr_tpu_http_request_seconds", route="/recommendations"
+        )
+        p99 = None
+        if buckets:
+            previous = self._read_buckets or {}
+            # Cumulative-minus-cumulative stays cumulative: the diff pairs
+            # are this tick's own histogram.
+            tick_pairs = [
+                (bound, count - previous.get(bound, 0.0)) for bound, count in buckets
+            ]
+            self._read_buckets = dict(buckets)
+            p99 = histogram_quantile(tick_pairs, 0.99)
+        stats = {
+            "requests": int(delta["requests"]),
+            "not_modified": int(delta["not_modified"]),
+            "cache_hits": int(delta["cache_hits"]),
+            "cache_misses": int(delta["cache_misses"]),
+            "shed": int(delta["renders_shed"]),
+            "bytes": int(delta["bytes"]),
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        }
+        metrics.set("krr_tpu_http_read_requests", stats["requests"])
+        if stats["requests"] and p99 is not None:
+            metrics.set("krr_tpu_http_read_p99_seconds", p99)
+        return stats
+
     # ----------------------------------------------- flight recorder hook
     async def _observe_timeline(self) -> None:
         """Distill the just-completed tick into one timeline record (from
@@ -1054,6 +1141,12 @@ class ScanScheduler:
         else:
             self.state.consecutive_scan_failures = 0
         if did_scan:
+            # Stash the tick's read-path serving stats BEFORE the recorder
+            # distills them: the timeline record (and through it the
+            # sentinel's read_p99_ms band) and the read-p99 SLO gauge both
+            # ride this delta.
+            if self.last_tick_stats is not None:
+                self.last_tick_stats["readpath"] = self._readpath_tick_stats()
             try:
                 await self._observe_timeline()
             except asyncio.CancelledError:

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import dataclasses
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
@@ -78,12 +80,140 @@ class Snapshot:
     publish time (via the machine formatter) — the hot unfiltered response
     is a byte copy, not a per-request model dump or UTF-8 encode (multi-MB
     at fleet scale, and the handler runs on the event loop).
+
+    ``keys`` are the object keys (`krr_tpu.core.streaming.object_key`) in
+    scan order — the read path's filter/pagination pushdown resolves row
+    indices against this key table instead of iterating the pydantic scan
+    objects. ``epoch`` and ``changed_at`` are stamped by
+    :meth:`ServerState.publish`: the epoch advances only when ``body_json``
+    actually changed bytes (a hysteresis-suppressed tick republishes under
+    the SAME epoch, so conditional GETs keep answering 304 and the response
+    cache stays warm), and ``changed_at`` is the publish time of that last
+    byte change (the ``Last-Modified`` validator).
     """
 
     result: "Result"
     body_json: bytes
     window_end: float  # unix ts of the scan window's right edge
     published_at: float
+    keys: "tuple[str, ...]" = ()
+    epoch: int = 0
+    changed_at: float = 0.0
+    #: BLAKE2b-128 of ``body_json``, computed in the scheduler's render
+    #: worker thread so :meth:`ServerState.publish` can decide
+    #: changed-vs-identical with an O(1) digest compare under the write
+    #: lock instead of a multi-MB memcmp on the event loop. Empty (direct
+    #: constructions, tests) falls back to the byte compare.
+    body_digest: bytes = b""
+
+
+class ResponseCache:
+    """Epoch-keyed LRU of fully rendered AND encoded response bodies.
+
+    One entry per ``(format, canonicalized filters, limit, offset,
+    content-encoding)`` — identity and pre-compressed variants live side by
+    side as sibling keys, so a gzip reader and a curl reader never force
+    each other's re-render. The WHOLE cache belongs to one publish epoch:
+    the first access (get or put) under a newer epoch drops every entry —
+    invalidation is wholesale and O(1) decisions, keyed on the same
+    monotonic epoch the ETag advertises, so a cached body can never outlive
+    the snapshot it was rendered from.
+
+    Bounded two ways (adversarial filter cardinality must not OOM the
+    server): at most ``max_entries`` entries and at most ``max_bytes`` of
+    body bytes, evicted LRU-first. A single body larger than the byte
+    budget is served but not retained.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_entries: int = 256,
+        max_bytes: int = 64 << 20,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.max_entries = max(1, int(max_entries))
+        self.max_bytes = max(1, int(max_bytes))
+        self.metrics = metrics
+        self._epoch: Optional[int] = None
+        self._entries: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def _gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set("krr_tpu_http_response_cache_entries", len(self._entries))
+            self.metrics.set("krr_tpu_http_response_cache_bytes", self._bytes)
+
+    def invalidate(self, epoch: int) -> None:
+        """Drop every entry and re-key the cache to ``epoch`` (the publish
+        path calls this on a content-changing publish; get/put also detect
+        a NEWER epoch lazily, so a direct-constructed state stays safe)."""
+        self._entries.clear()
+        self._bytes = 0
+        self._epoch = int(epoch)
+        self._gauges()
+
+    def _sync_epoch(self, epoch: int) -> None:
+        # Forward-only: epochs are monotonic, so an OLDER epoch here is a
+        # stale in-flight request that read its snapshot before the latest
+        # publish — it must neither wipe the fresh entries nor re-key the
+        # cache backward (its get misses, its put is dropped).
+        if self._epoch is None or epoch > self._epoch:
+            self.invalidate(epoch)
+
+    def get(self, epoch: int, key: tuple) -> Optional[bytes]:
+        epoch = int(epoch)
+        self._sync_epoch(epoch)
+        body = self._entries.get(key) if epoch == self._epoch else None
+        if self.metrics is not None:
+            self.metrics.inc(
+                "krr_tpu_http_cache_hits_total" if body is not None
+                else "krr_tpu_http_cache_misses_total"
+            )
+        if body is not None:
+            self._entries.move_to_end(key)
+        return body
+
+    def peek(self, epoch: int, key: tuple) -> Optional[bytes]:
+        """Uncounted sibling probe — the encoded-variant miss path checks
+        whether the identity body is already cached (compress-only, no
+        re-render) without double-counting hit/miss metrics. Refreshes
+        recency; never re-keys the epoch."""
+        if int(epoch) != self._epoch:
+            return None
+        body = self._entries.get(key)
+        if body is not None:
+            self._entries.move_to_end(key)
+        return body
+
+    def put(self, epoch: int, key: tuple, body: bytes) -> None:
+        epoch = int(epoch)
+        self._sync_epoch(epoch)
+        if epoch != self._epoch:
+            return  # a stale render must not poison the newer cache
+        if len(body) > self.max_bytes:
+            # Never retained — and never inserted either: running the LRU
+            # loop with an un-fittable MRU entry would evict every OTHER
+            # entry first and wipe the warm cache on each oversized request.
+            return
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= len(old)
+        self._entries[key] = body
+        self._bytes += len(body)
+        while self._entries and (
+            len(self._entries) > self.max_entries or self._bytes > self.max_bytes
+        ):
+            _evicted_key, evicted = self._entries.popitem(last=False)
+            self._bytes -= len(evicted)
+        self._gauges()
 
 
 class ServerState:
@@ -173,10 +303,56 @@ class ServerState:
         #: serve runs with ``--federation-listen``: /healthz and /statusz
         #: render its per-shard connected/epoch/lag state. None otherwise.
         self.federation = None
+        #: The publish epoch — the read path's cache key and the ETag's
+        #: leading component. Advances ONLY when a publish changes the
+        #: rendered bytes (hysteresis makes that rare, which is what makes
+        #: the response cache hit ≈ always). The serve composition root
+        #: seeds it from the durable store's persist epoch so the exposed
+        #: epoch stays monotonic across restarts; memory-only servers
+        #: restart at 0 — safe for validators because the ETag also carries
+        #: the content change's millisecond timestamp (see
+        #: ``HttpApp._snapshot_validators``), which can't collide across
+        #: restarts.
+        self.publish_epoch: int = 0
+        #: The epoch-keyed rendered-response cache (`ResponseCache`). None =
+        #: caching disabled (--no-response-cache, or states built without a
+        #: server): every non-fast-path read renders.
+        self.response_cache: Optional[ResponseCache] = None
         self._snapshot: Optional[Snapshot] = None
+
+    def seed_epoch(self, epoch: int) -> None:
+        """Raise the publish-epoch floor (the composition root passes the
+        durable store's persisted epoch) so the epoch exposed on
+        ``X-KRR-Epoch`` / ``/healthz`` keeps counting forward across
+        restarts instead of replaying values operators already saw."""
+        self.publish_epoch = max(self.publish_epoch, int(epoch))
+
+    @staticmethod
+    def _same_body(previous: Snapshot, snapshot: Snapshot) -> bool:
+        # Digest compare when both sides carry one (the scheduler path —
+        # O(1) under the lock); byte compare otherwise (small direct
+        # constructions).
+        if previous.body_digest and snapshot.body_digest:
+            return previous.body_digest == snapshot.body_digest
+        return previous.body_json == snapshot.body_json
 
     async def publish(self, snapshot: Snapshot) -> None:
         async with self.rwlock.write():
+            previous = self._snapshot
+            if previous is not None and self._same_body(previous, snapshot):
+                # Byte-identical republish (the common suppressed tick):
+                # same epoch, same Last-Modified — conditional GETs keep
+                # 304ing and every cached render stays valid.
+                snapshot = dataclasses.replace(
+                    snapshot, epoch=previous.epoch, changed_at=previous.changed_at
+                )
+            else:
+                self.publish_epoch += 1
+                snapshot = dataclasses.replace(
+                    snapshot, epoch=self.publish_epoch, changed_at=snapshot.published_at
+                )
+                if self.response_cache is not None:
+                    self.response_cache.invalidate(self.publish_epoch)
             self._snapshot = snapshot
 
     async def snapshot(self) -> Optional[Snapshot]:

@@ -124,6 +124,21 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("federation_records", 0) >= 12, secondary
     assert secondary.get("federation_wire_bytes", 0) > 0, secondary
     assert "federation_fold_seconds" in secondary, secondary
+    # The read-path loadtest leg ran end-to-end: keep-alive readers hit the
+    # epoch-keyed response cache at steady state (≥ 99%), conditional
+    # revalidations did zero render work, pushdown stayed bit-exact, the
+    # LRU stayed bounded, and the cached server beat the uncached control
+    # (gate failures are rc 1; assert the fields so a leg-skipping refactor
+    # can't pass silently).
+    assert secondary.get("readpath_cache_hit_pct", 0) >= 99.0, secondary
+    assert secondary.get("readpath_p99_ms", 0) > 0, secondary
+    assert secondary.get("readpath_rps", 0) > 0, secondary
+    assert secondary.get("readpath_rps_vs_uncached", 0) >= 2.0, secondary
+    assert secondary.get("readpath_bytes_mb", 0) > 0, secondary
+    # The readpath trendline gate fields are emitted unconditionally (null /
+    # False when the previous round ran at a different readpath width).
+    assert "readpath_vs_previous_round" in payload
+    assert "readpath_regression_vs_previous" in payload
     # The durable-store leg ran end-to-end: the per-tick delta append beat
     # the legacy full rewrite, recovery replay was bit-exact, and the
     # SIGKILL kill-recover soak (real serve subprocesses killed mid-run)

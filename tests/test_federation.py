@@ -309,7 +309,7 @@ class TestFederatedScan:
                     assert metrics.value("krr_tpu_federation_connected_shards") == 3
                     assert metrics.total("krr_tpu_federation_records_total") >= 12
                     assert metrics.total("krr_tpu_federation_bytes_total") > 0
-                    status, _ct, body = await server.app.route("GET", "/healthz", {})
+                    status, _ct, body, _hdrs = await server.app.route("GET", "/healthz", {})
                     payload = json.loads(body)
                     assert status == 200
                     assert sorted(payload["federation"]["shards"]) == ["c0", "c1", "c2"]
@@ -426,7 +426,7 @@ class TestFederatedScan:
                 assert set(stale_marks) == dead_keys
                 assert all(since == dead_window_end for since in stale_marks.values())
                 # Healthy shard's rows kept advancing (fresh window end).
-                status, _ct, body = await server.app.route("GET", "/healthz", {})
+                status, _ct, body, _hdrs = await server.app.route("GET", "/healthz", {})
                 payload = json.loads(body)
                 fed = payload["federation"]["shards"]
                 assert fed["c0"]["stale"] and not fed["c0"]["connected"]

@@ -1,0 +1,689 @@
+"""The high-QPS read-path matrix: epoch-keyed response caches, conditional
+GETs, filter/pagination pushdown, and the bounded render pool.
+
+Everything runs against injected sources over a REAL listening server (the
+HTTP plumbing — headers, HEAD, content negotiation — is part of what is
+under test). The correctness contracts pinned here:
+
+* invalidation on publish — an old-epoch ETag revalidates to a full 200
+  with the new body and the new validators;
+* suppressed-publish ticks (hysteresis) keep serving 304s under the SAME
+  epoch, so steady state is zero render work;
+* filtered + paginated responses are bit-identical to the pre-cache
+  render-then-slice path;
+* compressed variants round-trip to the identity bytes;
+* the LRU stays inside its entry and byte bounds under a
+  filter-cardinality attack;
+* past the render pool's width + queue, cache misses shed 503/Retry-After.
+"""
+
+import asyncio
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from krr_tpu.core.config import Config
+from krr_tpu.core.runner import ScanSession
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.models.result import Result
+from krr_tpu.server.app import KrrServer
+from krr_tpu.server.state import ResponseCache
+
+
+def _object(name="web", namespace="default", container="main"):
+    return K8sObjectData(
+        cluster="c", namespace=namespace, name=name, kind="Deployment",
+        container=container, pods=[f"{name}-0"],
+        allocations=ResourceAllocations(
+            requests={ResourceType.CPU: None, ResourceType.Memory: None},
+            limits={ResourceType.CPU: None, ResourceType.Memory: None},
+        ),
+    )
+
+
+FLEET = [
+    _object("web", "default"),
+    _object("db", "prod"),
+    _object("cache", "prod", container="redis"),
+    _object("batch", "jobs"),
+]
+
+
+class _Inventory:
+    def __init__(self, objects):
+        self.objects = objects
+
+    async def list_clusters(self):
+        return ["c"]
+
+    async def list_scannable_objects(self, clusters):
+        return list(self.objects)
+
+
+class _Source:
+    """Deterministic history source whose level is mutable (bump ``cpu`` to
+    force a content-changing publish)."""
+
+    def __init__(self, cpu=0.2):
+        self.cpu = cpu
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+        return {
+            ResourceType.CPU: [{obj.pods[0]: np.full(10, self.cpu)} for obj in objects],
+            ResourceType.Memory: [{obj.pods[0]: np.full(10, 1e8)} for obj in objects],
+        }
+
+
+class _NoisySource:
+    """Stationary sub-dead-band wiggle (the hysteresis steady state)."""
+
+    def __init__(self):
+        self._rng = np.random.default_rng(7)
+
+    async def gather_fleet(self, objects, history_seconds, step_seconds, **kwargs):
+        return {
+            ResourceType.CPU: [
+                {obj.pods[0]: self._rng.uniform(0.19, 0.21, 12)} for obj in objects
+            ],
+            ResourceType.Memory: [{obj.pods[0]: np.full(12, 1e8)} for obj in objects],
+        }
+
+
+def _server(source, now, objects=None, **config_overrides) -> KrrServer:
+    other_args = config_overrides.pop(
+        "other_args", {"history_duration": 1, "timeframe_duration": 1}
+    )
+    config = Config(
+        strategy="tdigest", quiet=True, server_port=0,
+        hysteresis_enabled=config_overrides.pop("hysteresis_enabled", False),
+        other_args=other_args,
+        **config_overrides,
+    )
+    session = ScanSession(
+        config, inventory=_Inventory(objects or FLEET),
+        history_factory=lambda cluster: source,
+    )
+    return KrrServer(config, session=session, clock=lambda: now[0])
+
+
+async def http_get(port: int, path: str, params=None, headers=None, method="GET"):
+    import httpx
+
+    async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+        return await client.request(method, path, params=params or {}, headers=headers or {})
+
+
+def _golden(snapshot, fmt="json", namespaces=(), workloads=(), containers=(),
+            limit=None, offset=0) -> bytes:
+    """The pre-cache render-then-slice path, verbatim: filter the published
+    scan objects, slice, rebuild a Result, format — the bit-identity oracle
+    for the pushdown."""
+    scans = [
+        scan for scan in snapshot.result.scans
+        if (not namespaces or scan.object.namespace in namespaces)
+        and (not workloads or scan.object.name in workloads)
+        and (not containers or scan.object.container in containers)
+    ]
+    scans = scans[offset:(offset + limit) if limit else None]
+    return Result(scans=scans).format(fmt).encode()
+
+
+class TestConditionalGets:
+    def test_etag_304_and_invalidation_on_publish(self):
+        async def main():
+            source = _Source()
+            now = [1_700_000_000.0]
+            ks = _server(source, now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                r = await http_get(ks.port, "/recommendations")
+                assert r.status_code == 200
+                etag = r.headers["etag"]
+                # "<epoch>-<changed-at-ms>": the ms suffix keeps the tag
+                # unique across restarts (epoch alone recounts from 0).
+                assert etag.startswith('"1-') and r.headers["x-krr-epoch"] == "1"
+                last_modified = r.headers["last-modified"]
+                body = r.content
+                health = (await http_get(ks.port, "/healthz")).json()
+                assert health["epoch"] == 1
+
+                # Revalidation: 304, no body, validators intact. ETag wins;
+                # If-Modified-Since alone also revalidates.
+                r = await http_get(ks.port, "/recommendations", headers={"If-None-Match": etag})
+                assert r.status_code == 304 and r.content == b""
+                assert r.headers["etag"] == etag
+                r = await http_get(
+                    ks.port, "/recommendations", headers={"If-Modified-Since": last_modified}
+                )
+                assert r.status_code == 304
+
+                # A content-changing publish advances the epoch: the old
+                # ETag revalidates to a FULL 200 with the new body.
+                source.cpu = 5.0
+                now[0] += 120.0
+                assert await ks.scheduler.tick()
+                r = await http_get(ks.port, "/recommendations", headers={"If-None-Match": etag})
+                assert r.status_code == 200
+                assert r.headers["etag"].startswith('"2-')
+                assert r.content != body
+                assert json.loads(r.content) == json.loads(ks.state.peek().body_json)
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_suppressed_publish_ticks_keep_serving_304(self):
+        """Hysteresis steady state: the journal records every tick, the
+        published bytes never move — so the epoch holds and conditional
+        clients keep getting 304s for free."""
+
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_NoisySource(), now, hysteresis_enabled=True)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                etag = (await http_get(ks.port, "/recommendations")).headers["etag"]
+                for _ in range(3):
+                    now[0] += 120.0
+                    assert await ks.scheduler.tick()
+                    r = await http_get(
+                        ks.port, "/recommendations", headers={"If-None-Match": etag}
+                    )
+                    assert r.status_code == 304
+                assert ks.state.peek().epoch == 1  # never advanced
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_history_and_drift_conditionals_track_the_journal(self):
+        """/history and /drift content grows with the JOURNAL (suppressed
+        ticks included), so their validator must change per tick even while
+        the publish epoch holds — the epoch alone would false-304."""
+
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_NoisySource(), now, hysteresis_enabled=True)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                r = await http_get(ks.port, "/history")
+                etag = r.headers["etag"]
+                assert r.status_code == 200
+                r = await http_get(ks.port, "/history", headers={"If-None-Match": etag})
+                assert r.status_code == 304
+                r = await http_get(ks.port, "/drift")
+                drift_etag = r.headers["etag"]
+                assert (await http_get(
+                    ks.port, "/drift", headers={"If-None-Match": drift_etag}
+                )).status_code == 304
+
+                now[0] += 120.0
+                assert await ks.scheduler.tick()  # suppressed publish, journal grew
+                assert ks.state.peek().epoch == 1
+                r = await http_get(ks.port, "/history", headers={"If-None-Match": etag})
+                assert r.status_code == 200 and r.headers["etag"] != etag
+                r = await http_get(ks.port, "/drift", headers={"If-None-Match": drift_etag})
+                assert r.status_code == 200
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestPushdown:
+    def test_filtered_and_paginated_responses_bit_identical_to_render_then_slice(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                snapshot = ks.state.peek()
+                cases = [
+                    (dict(namespace="prod"), dict(namespaces={"prod"})),
+                    ([("namespace", "prod"), ("namespace", "jobs")],
+                     dict(namespaces={"prod", "jobs"})),
+                    (dict(workload="web"), dict(workloads={"web"})),
+                    (dict(container="redis"), dict(containers={"redis"})),
+                    (dict(namespace="prod", container="redis"),
+                     dict(namespaces={"prod"}, containers={"redis"})),
+                    (dict(namespace="nope"), dict(namespaces={"nope"})),
+                    (dict(limit="2"), dict(limit=2)),
+                    (dict(limit="2", offset="1"), dict(limit=2, offset=1)),
+                    (dict(offset="3"), dict(offset=3)),
+                    (dict(offset="99"), dict(offset=99)),
+                    (dict(namespace="prod", limit="1", offset="1"),
+                     dict(namespaces={"prod"}, limit=1, offset=1)),
+                ]
+                for params, golden_kwargs in cases:
+                    r = await http_get(ks.port, "/recommendations", params)
+                    assert r.status_code == 200, (params, r.content)
+                    assert r.content == _golden(snapshot, **golden_kwargs), params
+                # Non-JSON formats ride the same pushdown.
+                r = await http_get(
+                    ks.port, "/recommendations", {"format": "yaml", "namespace": "prod"}
+                )
+                assert r.content == _golden(snapshot, "yaml", namespaces={"prod"})
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_repeated_format_param_is_last_wins(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                r = await http_get(
+                    ks.port, "/recommendations", [("format", "yaml"), ("format", "json")]
+                )
+                assert r.headers["content-type"].startswith("application/json")
+                json.loads(r.content)  # actually JSON
+                r = await http_get(
+                    ks.port, "/recommendations", [("format", "json"), ("format", "yaml")]
+                )
+                assert r.headers["content-type"].startswith("application/x-yaml")
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_bad_limit_offset_are_clean_400s(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                for params in (
+                    {"limit": "x"}, {"limit": "-1"}, {"offset": "y"},
+                    {"offset": "-3"}, {"limit": "1.5"},
+                ):
+                    r = await http_get(ks.port, "/recommendations", params)
+                    assert r.status_code == 400, params
+                    assert "must be" in r.json()["error"]
+                # /history limit rides the same validator now.
+                r = await http_get(ks.port, "/history", {"limit": "-2"})
+                assert r.status_code == 400
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestCache:
+    def test_cache_hit_serves_identical_bytes_without_rerender(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                metrics = ks.state.metrics
+                first = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                assert metrics.value("krr_tpu_http_cache_misses_total") == 1
+                assert metrics.value("krr_tpu_http_cache_hits_total") is None
+                second = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                assert metrics.value("krr_tpu_http_cache_hits_total") == 1
+                assert first.content == second.content
+                # The bare-JSON identity fast path bypasses the cache
+                # entirely (httpx's default Accept-Encoding: gzip would
+                # legitimately ride the cache as a compressed variant).
+                await http_get(ks.port, "/recommendations",
+                               headers={"Accept-Encoding": "identity"})
+                assert metrics.value("krr_tpu_http_cache_misses_total") == 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_gzip_variant_round_trips_to_identity_bytes(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                identity = await http_get(
+                    ks.port, "/recommendations",
+                    {"namespace": "prod"}, headers={"Accept-Encoding": "identity"},
+                )
+                assert "content-encoding" not in identity.headers
+                compressed = await http_get(
+                    ks.port, "/recommendations",
+                    {"namespace": "prod"}, headers={"Accept-Encoding": "gzip"},
+                )
+                assert compressed.headers["content-encoding"] == "gzip"
+                assert compressed.headers["vary"] == "Accept-Encoding"
+                # httpx transparently decodes: decoded equality proves the
+                # round trip (the raw-socket test below pins the wire bytes).
+                assert compressed.content == identity.content
+                # Both variants are now cached side by side: repeats hit.
+                hits_before = ks.state.metrics.value("krr_tpu_http_cache_hits_total") or 0
+                await http_get(ks.port, "/recommendations", {"namespace": "prod"},
+                               headers={"Accept-Encoding": "gzip"})
+                await http_get(ks.port, "/recommendations", {"namespace": "prod"},
+                               headers={"Accept-Encoding": "identity"})
+                assert ks.state.metrics.value("krr_tpu_http_cache_hits_total") == hits_before + 2
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_gzip_bytes_equal_offline_compression_of_identity(self):
+        """The cached gzip variant is a deterministic (mtime=0) compression
+        of the identity body — decompressing the wire bytes must restore
+        the identity bytes exactly."""
+
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                # Raw socket client: see the wire bytes httpx would decode.
+                reader, writer = await asyncio.open_connection("127.0.0.1", ks.port)
+                writer.write(
+                    b"GET /recommendations?namespace=prod HTTP/1.1\r\n"
+                    b"Host: x\r\nAccept-Encoding: gzip\r\nConnection: close\r\n\r\n"
+                )
+                await writer.drain()
+                blob = await asyncio.wait_for(reader.read(), timeout=10)
+                writer.close()
+                head, _, wire_body = blob.partition(b"\r\n\r\n")
+                assert b"Content-Encoding: gzip" in head
+                identity = await http_get(
+                    ks.port, "/recommendations", {"namespace": "prod"},
+                    headers={"Accept-Encoding": "identity"},
+                )
+                assert gzip.decompress(wire_body) == identity.content
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_lru_bounded_under_filter_cardinality_attack(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(
+                _Source(), now,
+                response_cache_max_entries=8, response_cache_max_mb=0.25,
+            )
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                cache = ks.state.response_cache
+                assert cache is not None and cache.max_entries == 8
+                for i in range(50):
+                    r = await http_get(ks.port, "/recommendations", {"namespace": f"ns{i}"})
+                    assert r.status_code == 200
+                assert len(cache) <= 8
+                assert cache.nbytes <= int(0.25 * (1 << 20))
+                metrics = ks.state.metrics
+                assert metrics.value("krr_tpu_http_response_cache_entries") <= 8
+                assert metrics.value("krr_tpu_http_response_cache_bytes") <= int(0.25 * (1 << 20))
+                # Bounded, not broken: a repeated recent filter still hits.
+                await http_get(ks.port, "/recommendations", {"namespace": "ns49"})
+                assert metrics.value("krr_tpu_http_cache_hits_total") >= 1
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_encoded_miss_reuses_cached_identity_render(self):
+        """A gzip-variant miss whose identity sibling is already cached only
+        pays the compression leg — never a second render."""
+
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                calls = []
+                original = ks.app._render_recommendations
+
+                def counting(*args, **kwargs):
+                    calls.append(1)
+                    return original(*args, **kwargs)
+
+                ks.app._render_recommendations = counting
+                first = await http_get(ks.port, "/recommendations", {"namespace": "prod"},
+                                       headers={"Accept-Encoding": "identity"})
+                assert len(calls) == 1
+                compressed = await http_get(ks.port, "/recommendations", {"namespace": "prod"},
+                                            headers={"Accept-Encoding": "gzip"})
+                assert compressed.headers["content-encoding"] == "gzip"
+                assert compressed.content == first.content  # decoded equality
+                assert len(calls) == 1  # compress-only: no re-render
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_no_response_cache_flag_disables_caching(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now, response_cache_enabled=False)
+            await ks.start(run_scheduler=False)
+            try:
+                assert ks.state.response_cache is None
+                assert await ks.scheduler.tick()
+                for _ in range(2):
+                    r = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                    assert r.status_code == 200
+                metrics = ks.state.metrics
+                assert metrics.value("krr_tpu_http_cache_hits_total") is None
+                assert metrics.value("krr_tpu_http_cache_misses_total") is None
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_unit_lru_eviction_order_and_oversized_bodies(self):
+        cache = ResponseCache(max_entries=2, max_bytes=100)
+        cache.put(1, ("a",), b"x" * 40)
+        cache.put(1, ("b",), b"y" * 40)
+        assert cache.get(1, ("a",)) is not None  # refresh a
+        cache.put(1, ("c",), b"z" * 40)  # evicts b (LRU), then fits bytes
+        assert cache.get(1, ("b",)) is None
+        assert cache.get(1, ("a",)) is not None
+        # A single body over the byte budget is not retained — and must
+        # not flush the warm entries on its way out either.
+        cache.put(1, ("big",), b"w" * 200)
+        assert cache.peek(1, ("big",)) is None
+        assert len(cache) == 2 and cache.nbytes == 80
+        # A NEWER epoch wipes wholesale.
+        cache.put(2, ("a",), b"x")
+        assert cache.get(2, ("a",)) is not None
+        # Stale readers/writers (an in-flight request that read its snapshot
+        # before the latest publish) neither see the fresh entries, nor wipe
+        # them, nor poison the cache with an old-epoch body.
+        assert cache.get(1, ("a",)) is None
+        assert cache.peek(2, ("a",)) is not None  # fresh entry survived
+        cache.put(1, ("stale",), b"old")
+        assert cache.peek(2, ("stale",)) is None and len(cache) == 1
+        cache.put(3, ("d",), b"q")
+        assert len(cache) == 1 and cache.peek(3, ("d",)) is not None
+
+
+class TestHeadAndShed:
+    def test_head_matches_get_headers_with_empty_body(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                identity = {"Accept-Encoding": "identity"}
+                get = await http_get(ks.port, "/recommendations", headers=identity)
+                head = await http_get(
+                    ks.port, "/recommendations", method="HEAD", headers=identity
+                )
+                assert head.status_code == 200 and head.content == b""
+                assert head.headers["content-length"] == str(len(get.content))
+                assert head.headers["etag"] == get.headers["etag"]
+                # Every route answers HEAD (the load-balancer probe case).
+                for path in ("/healthz", "/metrics", "/history", "/drift", "/statusz"):
+                    r = await http_get(ks.port, path, method="HEAD")
+                    assert r.status_code in (200, 404), path
+                    assert r.content == b"", path
+                # Other methods stay rejected, now with Allow.
+                r = await http_get(ks.port, "/recommendations", method="POST")
+                assert r.status_code == 405 and r.headers["allow"] == "GET, HEAD"
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_saturated_render_pool_sheds_503_with_retry_after(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(
+                _Source(), now,
+                server_render_concurrency=1, server_render_queue=0,
+                response_cache_enabled=False,
+            )
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                pool = ks.app.render_pool
+                await pool._semaphore.acquire()  # a render is "in flight"
+                try:
+                    r = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                    assert r.status_code == 503
+                    assert r.headers["retry-after"] == "1"
+                    assert ks.state.metrics.value("krr_tpu_http_renders_shed_total") == 1
+                    # Journal renders ride the same bounded pool.
+                    r = await http_get(ks.port, "/history")
+                    assert r.status_code == 503 and r.headers["retry-after"] == "1"
+                    # The pre-rendered fast path and 304s never touch the
+                    # pool: bare identity JSON still serves while renders shed.
+                    r = await http_get(ks.port, "/recommendations",
+                                       headers={"Accept-Encoding": "identity"})
+                    assert r.status_code == 200
+                finally:
+                    pool._semaphore.release()
+                r = await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                assert r.status_code == 200
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+
+class TestReadpathObservability:
+    def test_tick_stats_land_on_the_timeline_and_gauges(self):
+        async def main():
+            now = [1_700_000_000.0]
+            ks = _server(_Source(), now, slo_read_p99_seconds=60.0)
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.run_once()
+                etag = (await http_get(ks.port, "/recommendations")).headers["etag"]
+                await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                await http_get(ks.port, "/recommendations", {"namespace": "prod"})
+                await http_get(ks.port, "/recommendations", headers={"If-None-Match": etag})
+                now[0] += 120.0
+                assert await ks.scheduler.run_once()
+                record = ks.state.timeline.records()[-1]
+                readpath = record["readpath"]
+                assert readpath["requests"] == 4
+                assert readpath["not_modified"] == 1
+                # httpx's default Accept-Encoding: gzip routes even the bare
+                # fetch through the cache: 2 misses (bare + first filtered),
+                # 1 hit (second filtered), 1 revalidation.
+                assert readpath["cache_misses"] == 2
+                assert readpath["cache_hits"] == 1
+                assert readpath["bytes"] > 0
+                assert readpath["p99_ms"] is not None and readpath["p99_ms"] > 0
+                metrics = ks.state.metrics
+                assert metrics.value("krr_tpu_http_read_requests") == 4
+                assert metrics.value("krr_tpu_http_read_p99_seconds") > 0
+                # The opt-in SLO objective sampled the gauge.
+                engine = ks.state.slo
+                names = [o.name for o in engine.objectives]
+                assert "read_p99" in names
+                status = engine.status()
+                obj = next(o for o in status["objectives"] if o["name"] == "read_p99")
+                assert obj["events"]["total"] >= 1 and not obj["firing"]
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_sentinel_bands_read_p99(self):
+        from krr_tpu.obs.sentinel import trend_report
+
+        def record(i, p99):
+            return {
+                "v": 1, "ts": 1e9 + i * 300.0, "scan_id": f"s{i}", "kind": "delta",
+                "wall": 1.0,
+                "categories": {"fetch_transport": 0.5, "compute": 0.3},
+                "phases": {}, "rows": 8, "failed_rows": 0, "wire_bytes": 1 << 20,
+                "queries": 4, "retries": 0,
+                "publish": {"changed": 0, "suppressed": 0},
+                "persist": {"seconds": 0.0, "bytes": 0, "epoch": None, "failing": False},
+                "readpath": {"requests": 100, "p99_ms": p99, "cache_hits": 99,
+                             "cache_misses": 1, "shed": 0, "bytes": 1 << 20,
+                             "not_modified": 0},
+            }
+
+        records = [record(i, 2.0 + 0.01 * (i % 3)) for i in range(30)]
+        records.append(record(30, 80.0))  # read-latency regression
+        report = trend_report(records, warmup_scans=8)
+        verdicts = [v for v in report["regressions"] if v["dominant"] == "read_p99_ms"]
+        assert verdicts and verdicts[0]["excess_unit"] == "ms"
+        assert "cache" in verdicts[0]["suspect"] or "render pool" in verdicts[0]["suspect"]
+        clean = trend_report(records[:-1], warmup_scans=8)
+        assert clean["regressed"] == 0
+
+
+class TestEpochAcrossRestart:
+    def test_durable_restart_keeps_etags_monotonic(self, tmp_path):
+        """A restarted --state_path server seeds its publish epoch from the
+        durable store's persist epoch, so a pre-restart ETag can never
+        false-304 against different post-restart content."""
+        state_path = str(tmp_path / "state")
+
+        async def main():
+            now = [1_700_000_000.0]
+            source = _Source()
+            ks = _server(
+                source, now,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            await ks.start(run_scheduler=False)
+            try:
+                assert await ks.scheduler.tick()
+                now[0] += 120.0
+                assert await ks.scheduler.tick()
+                first_epoch = ks.state.peek().epoch
+                durable_epoch = ks.durable.epoch
+            finally:
+                await ks.shutdown()
+
+            now[0] += 120.0
+            resumed = _server(
+                source, now,
+                other_args={"history_duration": 1, "timeframe_duration": 1,
+                            "state_path": state_path},
+            )
+            await resumed.start(run_scheduler=False)
+            try:
+                assert resumed.state.publish_epoch >= durable_epoch >= first_epoch
+                assert await resumed.scheduler.tick()
+                assert resumed.state.peek().epoch > first_epoch
+            finally:
+                await resumed.shutdown()
+
+        asyncio.run(main())
