@@ -158,6 +158,11 @@ SMOKE_DEFAULTS = {
     # serve ticks, at toy scale but with every gate EXECUTED.
     "BENCH_CHAOS_TICKS": "8",
     "BENCH_CHAOS_WORKLOADS": "2",
+    # Discovery leg: watch-reconcile vs per-round relist at equal fleet
+    # width with injected churn (bit-exactness + reconcile-beats-relist
+    # gates EXECUTED at toy scale).
+    "BENCH_DISCOVERY_WORKLOADS": "120",
+    "BENCH_DISCOVERY_ROUNDS": "3",
     # Durable-store legs: delta-append vs legacy full rewrite + recovery
     # replay at toy row counts, and the kill-recover-verify soak (real
     # SIGKILLed serve subprocesses) with a reduced kill budget.
@@ -605,6 +610,140 @@ def store_kill_leg(secondary: dict, check) -> None:
     )
 
 
+def discovery_leg(secondary: dict, check) -> None:
+    """Watch-driven discovery gates (`--discovery-mode watch`): at the same
+    fleet width, with the same injected churn per round, the watch
+    reconcile must (a) stay BIT-identical — objects and staged order — to a
+    fresh relist at every round, and (b) beat the relist's wall (the whole
+    point of an O(churn) resident inventory is that the per-tick discovery
+    cost stops scaling with the fleet). Trended as ``secondary.discovery_*``.
+    """
+    import asyncio
+    import statistics
+    import tempfile
+    import time as _time
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.integrations.kubernetes import KubernetesLoader
+    from tests.fakes.chaos import write_kubeconfig
+    from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    workloads = int(os.environ.get("BENCH_DISCOVERY_WORKLOADS", 400))
+    rounds = max(2, int(os.environ.get("BENCH_DISCOVERY_ROUNDS", 5)))
+    namespaces = max(2, min(8, workloads // 20))
+    churn = max(1, workloads // 50)
+
+    cluster = FakeCluster()
+    created: "list[tuple[str, str]]" = []  # (name, namespace), oldest first
+    serial = [0]
+
+    def add_one() -> None:
+        namespace = f"ns-{serial[0] % namespaces}"
+        name = f"wl-{serial[0]}"
+        serial[0] += 1
+        cluster.add_workload_with_pods("Deployment", name, namespace, pod_count=2)
+        created.append((name, namespace))
+
+    def drop_one() -> None:
+        name, namespace = created.pop(0)
+        cluster.delete_workload("Deployment", name, namespace)
+        cluster.delete_pod(f"{name}-0", namespace)
+        cluster.delete_pod(f"{name}-1", namespace)
+
+    for _ in range(workloads):
+        add_one()
+
+    backend = FakeBackend(cluster, FakeMetrics())
+    server = ServerThread(backend).start()
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            kubeconfig = write_kubeconfig(os.path.join(tmp, "kubeconfig"), server.url)
+
+            def config(**overrides) -> Config:
+                return Config(kubeconfig=kubeconfig, quiet=True, **overrides)
+
+            async def run() -> dict:
+                watch = KubernetesLoader(
+                    config(
+                        discovery_mode="watch",
+                        # The verify audit stays out of the measurement: the
+                        # reconcile path itself is what's on the clock.
+                        discovery_verify_interval_seconds=3600.0,
+                    )
+                )
+                relist = KubernetesLoader(config())
+                relist_walls: list[float] = []
+                reconcile_walls: list[float] = []
+                bitexact = True
+                try:
+                    await watch.list_scannable_objects(["fake"])  # cold seed
+                    for _round in range(rounds):
+                        for _ in range(churn):
+                            drop_one()
+                            add_one()
+                        t0 = _time.perf_counter()
+                        relisted = await relist.list_scannable_objects(["fake"])
+                        relist_walls.append(_time.perf_counter() - t0)
+                        expected = [obj.model_dump() for obj in relisted]
+                        # Wait for watch delivery OUTSIDE the timed window —
+                        # the reconcile being measured is the steady-state
+                        # tick cost, not event-propagation latency.
+                        deadline = _time.monotonic() + 30.0
+                        while _time.monotonic() < deadline:
+                            watched = await watch.list_scannable_objects(["fake"])
+                            if [obj.model_dump() for obj in watched] == expected:
+                                break
+                            await asyncio.sleep(0.02)
+                        t0 = _time.perf_counter()
+                        watched = await watch.list_scannable_objects(["fake"])
+                        reconcile_walls.append(_time.perf_counter() - t0)
+                        bitexact = bitexact and (
+                            [obj.model_dump() for obj in watched] == expected
+                        )
+                finally:
+                    await watch.close()
+                    await relist.close()
+                return {
+                    "relist_seconds": statistics.median(relist_walls),
+                    "reconcile_seconds": statistics.median(reconcile_walls),
+                    "bitexact": bitexact,
+                    "objects": len(created) * 1,
+                }
+
+            report = asyncio.run(run())
+    finally:
+        server.stop()
+
+    relist_seconds = report["relist_seconds"]
+    reconcile_seconds = report["reconcile_seconds"]
+    check(
+        "discovery_bitexact",
+        report["bitexact"],
+        "watch reconcile diverged from the fresh relist",
+    )
+    check(
+        "discovery_reconcile_beats_relist",
+        reconcile_seconds < relist_seconds,
+        f"reconcile {reconcile_seconds:.4f}s vs relist {relist_seconds:.4f}s",
+    )
+    secondary["discovery_workloads"] = float(workloads)
+    secondary["discovery_churn_per_round"] = float(churn)
+    secondary["discovery_relist_seconds"] = round(relist_seconds, 4)
+    secondary["discovery_reconcile_seconds"] = round(reconcile_seconds, 4)
+    secondary["discovery_speedup"] = round(relist_seconds / max(reconcile_seconds, 1e-9), 1)
+    secondary["discovery_bitexact"] = 1.0 if report["bitexact"] else 0.0
+    secondary["discovery_reconcile_beats_relist"] = (
+        1.0 if reconcile_seconds < relist_seconds else 0.0
+    )
+    print(
+        f"bench: discovery leg {workloads} workloads x {rounds} rounds "
+        f"(churn {churn}/round): reconcile {reconcile_seconds * 1e3:.1f}ms vs "
+        f"relist {relist_seconds * 1e3:.1f}ms "
+        f"({secondary['discovery_speedup']}x), bitexact={report['bitexact']}",
+        file=sys.stderr,
+    )
+
+
 def fetchplan_leg(secondary: dict, check) -> None:
     """Adaptive fetch-engine gates (`krr_tpu.core.fetchplan` + the
     prometheus loader's plan/pump/limiter wiring), at toy scale with every
@@ -668,9 +807,14 @@ def fetchplan_leg(secondary: dict, check) -> None:
                     **overrides,
                 )
 
-            objects = asyncio.run(
-                KubernetesLoader(config()).list_scannable_objects(["fake"])
-            )
+            async def discover_once():
+                loader = KubernetesLoader(config())
+                try:
+                    return await loader.list_scannable_objects(["fake"])
+                finally:
+                    await loader.close()  # pooled clients outlive calls now
+
+            objects = asyncio.run(discover_once())
 
             def gather(cfg, registry=None):
                 async def fetch():
@@ -796,9 +940,14 @@ def wire_leg(secondary: dict, check) -> None:
                     **overrides,
                 )
 
-            objects = asyncio.run(
-                KubernetesLoader(config()).list_scannable_objects(["fake"])
-            )
+            async def discover_once():
+                loader = KubernetesLoader(config())
+                try:
+                    return await loader.list_scannable_objects(["fake"])
+                finally:
+                    await loader.close()  # pooled clients outlive calls now
+
+            objects = asyncio.run(discover_once())
 
             def gather(cfg, registry):
                 async def fetch():
@@ -2099,6 +2248,12 @@ def main() -> None:
         # bit-exactness, and the breaker-bounded hard-down tick wall — the
         # standing regression gate for the fault-isolation machinery.
         chaos_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_DISCOVERY"):
+        # Discovery gates: the watch-mode reconcile must stay bit-identical
+        # to a fresh relist through injected churn AND beat the relist wall
+        # at equal fleet width — the O(churn) claim, measured.
+        discovery_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_FETCHPLAN"):
         # Adaptive fetch-engine gates: planner engagement (coalesce + shard

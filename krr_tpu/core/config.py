@@ -207,6 +207,30 @@ class Config(pd.BaseModel):
     #: server-side selector queries.
     bulk_pod_discovery: bool = True
 
+    #: Inventory maintenance strategy: "relist" re-fetches every workload
+    #: kind and pod index per discovery round (the classic shape — request
+    #: shapes byte-identical to previous releases); "watch" keeps a resident
+    #: inventory fed by Kubernetes watch streams (one list+watch per
+    #: workload kind plus metadata-only pod watches per active namespace,
+    #: with resourceVersion bookmarks) so each discovery tick is an
+    #: in-memory O(churn) reconcile — the relist remains the cold-start
+    #: seed and the 410/desync resync path. Watch mode always resolves
+    #: pods client-side (the bulk-discovery selection path).
+    discovery_mode: Literal["relist", "watch"] = "relist"
+    #: Watch-mode ground-truth audit cadence: every this many seconds a
+    #: FULL relist diffs the watched inventory against the apiserver —
+    #: divergence is logged, counted
+    #: (``krr_tpu_discovery_verify_divergences_total``), and repaired by
+    #: adopting the relist. 0 = auto: four discovery intervals.
+    discovery_verify_interval_seconds: float = pd.Field(0.0, ge=0)
+    #: Where the watch-mode inventory snapshot (+ resourceVersions) persists
+    #: so a warm restart skips the cold relist. None = serve derives
+    #: ``discovery-inventory.json`` inside the sharded state directory
+    #: (``<state_path>.discovery-inventory.json`` beside a legacy file);
+    #: standalone loaders without a state path keep the inventory
+    #: memory-only.
+    discovery_snapshot_path: Optional[str] = None
+
     #: One Prometheus range query per (namespace, resource) with client-side
     #: (pod, container) routing — O(namespaces) round trips; False = one query
     #: per (workload, resource). A failed batched query falls back to the

@@ -729,7 +729,10 @@ class ScanSession:
         return final_objects, fleet
 
     async def close(self) -> None:
-        """Close every successfully-built history source that supports it."""
+        """Close every successfully-built history source that supports it,
+        and the inventory (pooled apiserver clients + watch streams — the
+        loaders used to be per-round throwaways; now they live as long as
+        the session)."""
         for source in self._history_sources.values():
             close = getattr(source, "close", None)
             if close is not None and not isinstance(source, Exception):
@@ -737,6 +740,12 @@ class ScanSession:
                     await close()
                 except Exception:
                     self.logger.debug_exception()
+        inventory_close = getattr(self._inventory, "close", None)
+        if inventory_close is not None:
+            try:
+                await inventory_close()
+            except Exception:
+                self.logger.debug_exception()
 
 
 class Runner:

@@ -124,6 +124,13 @@ class FederatedShard:
         self.last_end: Optional[float] = None
         self._objects = None
         self._discovered_at = -float("inf")
+        #: Watch-driven discovery (`--discovery-mode watch`): shards ride
+        #: the SAME resident inventory source as the serve scheduler — the
+        #: reconcile runs every tick, and churn compaction / inventory
+        #: re-sends are gated on the inventory generation so a quiet
+        #: fleet's ticks stream no redundant inventory records.
+        self.discovery_mode = str(getattr(config, "discovery_mode", "relist"))
+        self._inventory_generation = None
         #: (epoch, framed DELTA message) awaiting the aggregator's ack.
         #: Bounded: past ``federation_queue_records`` buffered records the
         #: backlog COLLAPSES into one snapshot record (`_collapse_buffer`)
@@ -172,11 +179,23 @@ class FederatedShard:
         self._objects = objects
         self._discovered_at = now
         self.metrics.set("krr_tpu_fleet_objects", len(objects))
+        # Compaction and the inventory re-send are gated on the inventory
+        # generation when the source exposes one (watch mode, where
+        # discovery runs every tick): only actual churn pays the store
+        # compaction or streams a fresh inventory record. Relist sources
+        # (generation None) keep today's per-discovery behavior.
+        generation_fn = getattr(
+            self.session.get_inventory(), "inventory_generation", None
+        )
+        generation = generation_fn() if callable(generation_fn) else None
+        if generation is not None and generation == self._inventory_generation:
+            return
         # Churn compaction: the captured drop ops ride the next delta
         # record, so deleted workloads leave the AGGREGATOR's store too.
         dropped = self.store.compact({object_key(obj) for obj in objects})
         if dropped:
             self.metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
+        self._inventory_generation = generation
         self._inventory_dirty = True
 
     async def tick(self, now: Optional[float] = None) -> bool:
@@ -190,7 +209,11 @@ class FederatedShard:
         step = self._step_seconds()
         self.session.begin_scan()
 
-        if self._objects is None or now - self._discovered_at >= self.discovery_interval:
+        if (
+            self._objects is None
+            or now - self._discovered_at >= self.discovery_interval
+            or self.discovery_mode == "watch"
+        ):
             await self._discover(now)
         objects = self._objects or []
 

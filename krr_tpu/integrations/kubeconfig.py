@@ -98,7 +98,12 @@ class ClusterCredentials:
             ctx.load_cert_chain(self.client_cert_file, self.client_key_file)
         return ctx
 
-    def make_client(self, timeout: float = 30.0, max_connections: int = 32) -> httpx.AsyncClient:
+    def make_client(
+        self, timeout: float = 30.0, max_connections: Optional[int] = 32
+    ) -> httpx.AsyncClient:
+        """``max_connections=None`` builds an UNCAPPED pool — the watch
+        client's shape: one long-lived stream per watched resource, where a
+        cap would let stream count starve ordinary list requests."""
         return httpx.AsyncClient(
             base_url=self.server.rstrip("/"),
             headers=self.auth_headers(),

@@ -540,6 +540,34 @@ def _server_options() -> list[click.Option]:
             help="Seconds between fleet re-discoveries (workload churn pickup + digest store compaction).",
         ),
         PanelOption(
+            ["--discovery-mode", "discovery_mode"],
+            type=click.Choice(["relist", "watch"]),
+            default="relist",
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Inventory maintenance: 'relist' re-fetches the whole fleet "
+                "per discovery round (the classic shape); 'watch' keeps a "
+                "resident inventory fed by Kubernetes watch streams so each "
+                "discovery tick is an in-memory O(churn) reconcile, with "
+                "the relist kept as the cold-start seed and the 410/desync "
+                "resync path."
+            ),
+        ),
+        PanelOption(
+            ["--discovery-verify-interval", "discovery_verify_interval_seconds"],
+            type=float,
+            default=0.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Watch-mode ground-truth audit cadence: every this many "
+                "seconds a full relist diffs the watched inventory against "
+                "the apiserver, counting + repairing any divergence. "
+                "0 = auto (four discovery intervals)."
+            ),
+        ),
+        PanelOption(
             ["--min-fetch-success-pct", "min_fetch_success_pct"],
             type=float,
             default=50.0,
@@ -1111,6 +1139,29 @@ def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command
             panel="Server Settings",
             help="Seconds between fleet re-discoveries on this shard.",
         ),
+        PanelOption(
+            ["--discovery-mode", "discovery_mode"],
+            type=click.Choice(["relist", "watch"]),
+            default="relist",
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Shard inventory maintenance: 'watch' reconciles a resident "
+                "watch-fed inventory per tick (O(churn)); 'relist' re-fetches "
+                "per discovery interval."
+            ),
+        ),
+        PanelOption(
+            ["--discovery-verify-interval", "discovery_verify_interval_seconds"],
+            type=float,
+            default=0.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Watch-mode verify-relist cadence on this shard "
+                "(0 = auto: four discovery intervals)."
+            ),
+        ),
     ]
     # Shards take the scan commands' common options minus the one-shot-only
     # flags (no formatter — output is the delta stream; no --statusz dump).
@@ -1542,8 +1593,18 @@ def _make_strategy_command(strategy_name: str, strategy_type: Any) -> click.Comm
             metrics_target=config.metrics_dump_path,
             logger=runner.logger,
         )
+        async def run_and_close() -> None:
+            # Close the session INSIDE the loop: discovery loaders (and
+            # their HTTP clients) are pooled across rounds now, so the
+            # one-shot path must close them before asyncio.run tears the
+            # loop down under their open transports.
+            try:
+                await runner.run()
+            finally:
+                await runner.session.close()
+
         try:
-            asyncio.run(runner.run())
+            asyncio.run(run_and_close())
         finally:
             # Dump even when the scan raised: a partial trace of a failed
             # scan is exactly what --trace exists to capture.

@@ -428,6 +428,12 @@ def build_scan_record(
         if compressed_only and wire > 0 and decoded > 0
         else None
     )
+    if "discovery" in stats:
+        # Discovery posture for the tick: the active mode (relist|watch),
+        # watch event deltas (adds/updates/drops/bookmarks), watch restarts
+        # and relist fallbacks, and inventory/watch freshness ages — the
+        # trendable side of watch-driven incremental discovery.
+        record["discovery"] = dict(stats["discovery"])
     if "federation" in stats:
         # Aggregate ticks (federation mode): shard census + per-tick
         # applied records and delta wire bytes — the trendable federation

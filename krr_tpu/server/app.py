@@ -489,6 +489,7 @@ class HttpApp:
             "persist_failures": self.state.persist_failures,
             "last_persist_error": self.state.last_persist_error,
             "discovery_failed_clusters": dict(self.state.discovery_failed_clusters),
+            "discovery": dict(self.state.discovery),
         }
         if self.state.federation is not None:
             payload["federation"] = self.state.federation.status(float(self.clock()))
@@ -564,6 +565,10 @@ class HttpApp:
             # whose discovery listing failed (the fleet is silently smaller
             # than configured until it recovers).
             "discovery_failed_clusters": dict(self.state.discovery_failed_clusters),
+            # Discovery posture: the active mode and, in watch mode, how
+            # fresh the resident inventory and its watch streams are
+            # (inventory_age_seconds / watch_lag_seconds).
+            "discovery": dict(self.state.discovery),
             "stale_workloads": len(self.state.stale_workloads),
             "consecutive_scan_failures": self.state.consecutive_scan_failures,
             "last_scan_error": self.state.last_scan_error,
@@ -1054,6 +1059,15 @@ class KrrServer:
         journal_path = config.history_path
         if journal_path is None and state_path:
             journal_path = f"{state_path}.journal"
+        # Watch-mode discovery persists its inventory snapshot (+ watch
+        # resourceVersions) beside the window cursor, so a warm restart
+        # skips the cold relist entirely. Derived after the durable store
+        # opens (the sharded/legacy layout decides the sidecar name).
+        self._derive_discovery_snapshot_path = (
+            getattr(config, "discovery_mode", "relist") == "watch"
+            and state_path
+            and not getattr(config, "discovery_snapshot_path", None)
+        )
         # Serve always records traces: the ring is what GET /debug/trace
         # serves, and the per-tick span cost is noise next to a scan. The
         # swap happens before any scan, so lazily-built Prometheus loaders
@@ -1080,6 +1094,14 @@ class KrrServer:
         else:
             self.durable = None
             store = DigestStore(spec=settings.cpu_spec())
+        if self._derive_discovery_snapshot_path:
+            import os.path as _os_path
+
+            config.discovery_snapshot_path = (
+                _os_path.join(state_path, "discovery-inventory.json")
+                if self.durable is not None and self.durable.fmt == "sharded"
+                else f"{state_path}.discovery-inventory.json"
+            )
         self.state = ServerState(
             store,
             journal=RecommendationJournal(
@@ -1124,6 +1146,11 @@ class KrrServer:
         self.state.slo = engine_from_config(
             self.session.metrics, config, clock=clock, logger=self.logger
         )
+        # The discovery posture is visible from the first /healthz on —
+        # a restarted server that resume-publishes before its first full
+        # tick must not render an empty block. The scheduler's per-tick
+        # stats refine it (ages, event deltas) as ticks complete.
+        self.state.discovery = {"mode": getattr(config, "discovery_mode", "relist")}
         # The scan flight recorder + regression sentinel
         # (`krr_tpu.obs.timeline` / `krr_tpu.obs.sentinel`): the durable
         # timeline lives beside the durable store (inside the sharded state

@@ -156,6 +156,17 @@ class ScanScheduler:
         #: per-tick p99, the same delta discipline as the plan counters.
         self._read_totals: "dict[str, float]" = {}
         self._read_buckets: "Optional[dict[float, float]]" = None
+        #: Watch-driven discovery (``--discovery-mode watch``): the
+        #: reconcile runs EVERY tick (it is O(churn) in-memory work), and
+        #: churn compaction only runs when the inventory generation moved —
+        #: watch deletes feed the existing store drop ops, and a quiet
+        #: fleet's ticks skip the fleet-sized masked copy entirely.
+        self.discovery_mode = str(getattr(config, "discovery_mode", "relist"))
+        self._compacted_generation: "Optional[int]" = None
+        #: Cumulative discovery counter totals at the last recorded tick —
+        #: the timeline's ``discovery`` block carries per-TICK event/relist
+        #: deltas, the same delta discipline as the plan counters.
+        self._discovery_totals: "dict[str, float]" = {}
         #: key → grid-aligned start of the first window its fetch missed:
         #: the catch-up fetch's left edge. Persisted in the store's
         #: extra_meta (same atomic save as the cursor) — a restart must
@@ -237,13 +248,12 @@ class ScanScheduler:
     async def _discover(self, now: float) -> None:
         objects = await self.session.discover()
         metrics = self.state.metrics
+        inventory = self.session.get_inventory()
         # Per-cluster discovery failures (fail-soft listings degraded to an
         # empty cluster): surface the FAILING CLUSTERS on /healthz instead
         # of silently scanning a smaller fleet (the loader also counts them
         # in krr_tpu_discovery_cluster_failures_total).
-        failed_clusters = getattr(
-            self.session.get_inventory(), "last_failed_clusters", None
-        )
+        failed_clusters = getattr(inventory, "last_failed_clusters", None)
         self.state.discovery_failed_clusters = dict(failed_clusters or {})
         if not objects and self.state.store.keys:
             # Discovery is fail-soft per cluster (a listing error degrades to
@@ -267,10 +277,17 @@ class ScanScheduler:
         # every discovery (including a state_path-resumed first one, whose
         # store may carry rows for long-gone workloads). Off the loop: at
         # fleet scale the masked copy of the [N x B] matrix is seconds of
-        # numpy work that would stall every in-flight query.
+        # numpy work that would stall every in-flight query. In watch mode
+        # discovery runs EVERY tick, so the compaction is gated on the
+        # inventory generation: only churn (watch deletes included) pays it.
+        generation_fn = getattr(inventory, "inventory_generation", None)
+        generation = generation_fn() if callable(generation_fn) else None
+        if generation is not None and generation == self._compacted_generation:
+            return
         dropped = await asyncio.to_thread(
             self.state.store.compact, {object_key(obj) for obj in objects}
         )
+        self._compacted_generation = generation
         if dropped:
             metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
             self.logger.info(f"Compacted {dropped} stale rows out of the digest store")
@@ -693,7 +710,15 @@ class ScanScheduler:
         self.session.begin_scan()
 
         t0 = time.perf_counter()
-        if self._objects is None or now - self._discovered_at >= self.discovery_interval:
+        # Watch mode reconciles EVERY tick — the whole point of the resident
+        # inventory is that re-discovery became O(churn) in-memory work, so
+        # workload churn lands on the next scan instead of the next
+        # discovery interval.
+        if (
+            self._objects is None
+            or now - self._discovered_at >= self.discovery_interval
+            or self.discovery_mode == "watch"
+        ):
             await self._discover(now)
         objects = self._objects or []
         t1 = time.perf_counter()
@@ -994,6 +1019,7 @@ class ScanScheduler:
             "failed_rows": len(failed_keys),
             "backfilled": len(fresh),
             "stale": len(self._quarantine),
+            "discovery": self._discovery_tick_stats(now),
             "publish_changed": self.state.last_publish_changed,
             "publish_suppressed": self.state.last_publish_suppressed,
             "persist_seconds": persist_seconds,
@@ -1012,6 +1038,48 @@ class ScanScheduler:
             f"fold {t3 - t2:.2f}s, compute {t4 - t3:.2f}s"
         )
         return True
+
+    # ----------------------------------------------- discovery tick stats
+    def _discovery_tick_stats(self, now: float) -> dict:
+        """Per-tick discovery posture for the timeline record, /healthz, and
+        /statusz: the active mode, this tick's watch event deltas
+        (adds/updates/drops/bookmarks), watch restarts and relist fallbacks
+        since the last tick, and the inventory/watch freshness ages."""
+        metrics = self.state.metrics
+        inventory = self.session.get_inventory()
+        status_fn = getattr(inventory, "discovery_status", None)
+        status = status_fn() if callable(status_fn) else {}
+
+        def events_total(type_: str) -> float:
+            return sum(
+                value
+                for series, value in metrics.series(
+                    "krr_tpu_discovery_watch_events_total"
+                ).items()
+                if ("type", type_) in set(series)
+            )
+
+        totals = {
+            "adds": events_total("added"),
+            "updates": events_total("modified"),
+            "drops": events_total("deleted"),
+            "bookmarks": events_total("bookmark"),
+            "watch_restarts": metrics.total("krr_tpu_discovery_watch_restarts_total"),
+            "relists": metrics.total("krr_tpu_discovery_relists_total"),
+        }
+        delta = {
+            key: int(max(0.0, value - self._discovery_totals.get(key, 0.0)))
+            for key, value in totals.items()
+        }
+        self._discovery_totals = totals
+        stats: dict = {"mode": status.get("mode", self.discovery_mode), **delta}
+        if self._discovered_at > -float("inf"):
+            stats["inventory_age_seconds"] = round(max(0.0, now - self._discovered_at), 3)
+        if status.get("watch_lag_seconds") is not None:
+            stats["watch_lag_seconds"] = status["watch_lag_seconds"]
+        # The read side (/healthz, /statusz) shows the LIVE posture.
+        self.state.discovery = dict(stats)
+        return stats
 
     # ----------------------------------------------- read-path tick stats
     def _readpath_tick_stats(self) -> dict:

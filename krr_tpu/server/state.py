@@ -299,6 +299,11 @@ class ServerState:
         #: ``krr_tpu_discovery_cluster_failures_total``. Owned by the
         #: scheduler's discovery leg.
         self.discovery_failed_clusters: dict[str, str] = {}
+        #: The scheduler's per-tick discovery posture (mode, watch event
+        #: deltas, inventory/watch freshness ages) — rendered on /healthz
+        #: and /statusz so "is the watch inventory fresh?" never needs a
+        #: log grep. Empty until the first tick.
+        self.discovery: dict = {}
         #: The federation aggregator (`krr_tpu.federation.aggregator`) when
         #: serve runs with ``--federation-listen``: /healthz and /statusz
         #: render its per-shard connected/epoch/lag state. None otherwise.

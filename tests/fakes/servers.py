@@ -13,7 +13,9 @@ discovery + proxied queries can be tested end-to-end.
 from __future__ import annotations
 
 import asyncio
+import copy
 import gzip
+import json
 import re
 import threading
 from dataclasses import dataclass, field
@@ -47,9 +49,30 @@ def make_pod(name: str, namespace: str, labels: dict[str, str]) -> dict[str, Any
     return {"metadata": {"name": name, "namespace": namespace, "labels": labels}}
 
 
+#: Workload kind → the FakeCluster attribute (and watch "resource") it lives in.
+KIND_ATTRS = {
+    "Deployment": "deployments",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "Job": "jobs",
+}
+
+
 @dataclass
 class FakeCluster:
-    """Mutable fixture state served by the fake apiserver."""
+    """Mutable fixture state served by the fake apiserver.
+
+    Two mutation styles coexist:
+
+    * direct list mutation (``cluster.deployments.append(...)``) — the
+      historical relist-mode idiom; the watch stream never hears about it,
+      which is exactly the "divergence behind the watcher's back" fault the
+      verify relist must catch;
+    * the event-recording mutators (:meth:`add_workload`, :meth:`delete_pod`,
+      …) — each bumps the cluster ``resource_version``, stamps it on the
+      object, and appends a watch event, so connected watch streams see the
+      change like they would against a real apiserver.
+    """
 
     deployments: list[dict[str, Any]] = field(default_factory=list)
     statefulsets: list[dict[str, Any]] = field(default_factory=list)
@@ -58,6 +81,116 @@ class FakeCluster:
     pods: list[dict[str, Any]] = field(default_factory=list)
     services: list[dict[str, Any]] = field(default_factory=list)
     ingresses: list[dict[str, Any]] = field(default_factory=list)
+    #: Monotonic cluster-wide resourceVersion (etcd revision analogue):
+    #: stamped on every list response and every recorded watch event.
+    resource_version: int = 1000
+    #: Recorded watch events: ``{"rv", "resource", "namespace", "type",
+    #: "object"}`` dicts (objects are DEEP COPIES — a watch serializes, so a
+    #: later in-place fixture mutation must not rewrite delivered history).
+    events: list = field(default_factory=list)
+    #: Watch-cache compaction floor: a watch request whose resourceVersion
+    #: is OLDER than this gets the apiserver's ``410 Gone`` (the client must
+    #: relist) — scripted via :meth:`compact_watch`.
+    watch_min_rv: int = 0
+
+    # --------------------------------------------- event-recording mutators
+    def _record(self, resource: str, namespace: str, type_: str, obj: Optional[dict]) -> int:
+        self.resource_version += 1
+        if obj is not None:
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.resource_version)
+        self.events.append(
+            {
+                "rv": self.resource_version,
+                "resource": resource,
+                "namespace": namespace,
+                "type": type_,
+                "object": copy.deepcopy(obj) if obj is not None else None,
+            }
+        )
+        return self.resource_version
+
+    def _workload_list(self, kind: str) -> list[dict[str, Any]]:
+        return getattr(self, KIND_ATTRS[kind])
+
+    def add_workload(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        containers: Optional[list[dict[str, Any]]] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> dict[str, Any]:
+        workload = make_workload(kind, name, namespace, containers, labels)
+        self._workload_list(kind).append(workload)
+        self._record(KIND_ATTRS[kind], namespace, "ADDED", workload)
+        return workload
+
+    def _find_workload(self, kind: str, name: str, namespace: str) -> dict[str, Any]:
+        for item in self._workload_list(kind):
+            metadata = item["metadata"]
+            if metadata["name"] == name and metadata["namespace"] == namespace:
+                return item
+        raise KeyError(f"{kind} {namespace}/{name} not in the fixture")
+
+    def update_workload(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
+        """Re-announce a workload AFTER the caller mutated it in place —
+        records the MODIFIED event (position in the list, and thus in the
+        relist order, is unchanged, like a real update)."""
+        item = self._find_workload(kind, name, namespace)
+        self._record(KIND_ATTRS[kind], namespace, "MODIFIED", item)
+        return item
+
+    def delete_workload(self, kind: str, name: str, namespace: str = "default") -> None:
+        item = self._find_workload(kind, name, namespace)
+        self._workload_list(kind).remove(item)
+        self._record(KIND_ATTRS[kind], namespace, "DELETED", item)
+
+    def add_pod(self, name: str, namespace: str, labels: dict[str, str]) -> dict[str, Any]:
+        pod = make_pod(name, namespace, labels)
+        self.pods.append(pod)
+        self._record("pods", namespace, "ADDED", pod)
+        return pod
+
+    def update_pod(self, name: str, namespace: str, labels: dict[str, str]) -> dict[str, Any]:
+        for pod in self.pods:
+            metadata = pod["metadata"]
+            if metadata["name"] == name and metadata["namespace"] == namespace:
+                metadata["labels"] = dict(labels)
+                self._record("pods", namespace, "MODIFIED", pod)
+                return pod
+        raise KeyError(f"pod {namespace}/{name} not in the fixture")
+
+    def delete_pod(self, name: str, namespace: str) -> None:
+        for pod in self.pods:
+            metadata = pod["metadata"]
+            if metadata["name"] == name and metadata["namespace"] == namespace:
+                self.pods.remove(pod)
+                self._record("pods", namespace, "DELETED", pod)
+                return
+        raise KeyError(f"pod {namespace}/{name} not in the fixture")
+
+    def bookmark(self) -> int:
+        """Advance the cluster resourceVersion with NO object change and
+        record a BOOKMARK event every connected stream relays — the
+        progress-notification mechanism that lets an idle watcher survive a
+        later compaction without a relist."""
+        self.resource_version += 1
+        self.events.append(
+            {
+                "rv": self.resource_version,
+                "resource": None,
+                "namespace": None,
+                "type": "BOOKMARK",
+                "object": None,
+            }
+        )
+        return self.resource_version
+
+    def compact_watch(self) -> int:
+        """Compact the watch cache up to the CURRENT resourceVersion: any
+        later watch request starting below it is answered ``410 Gone``."""
+        self.watch_min_rv = self.resource_version
+        return self.watch_min_rv
 
     def add_workload_with_pods(
         self,
@@ -67,12 +200,11 @@ class FakeCluster:
         pod_count: int = 2,
         containers: Optional[list[dict[str, Any]]] = None,
     ) -> list[str]:
-        workload = make_workload(kind, name, namespace, containers)
-        getattr(self, {"Deployment": "deployments", "StatefulSet": "statefulsets",
-                       "DaemonSet": "daemonsets", "Job": "jobs"}[kind]).append(workload)
+        workload = self.add_workload(kind, name, namespace, containers)
         pod_names = [f"{name}-{i}" for i in range(pod_count)]
         labels = workload["metadata"]["labels"]
-        self.pods.extend(make_pod(p, namespace, labels) for p in pod_names)
+        for pod in pod_names:
+            self.add_pod(pod, namespace, labels)
         return pod_names
 
 
@@ -300,11 +432,33 @@ class FakeBackend:
         self.cluster = cluster
         self.metrics = metrics
         self.pod_request_count = 0
+        #: Workload LIST requests served (watch requests excluded) — lets
+        #: tests pin that a snapshot warm restart skipped the cold relist.
+        self.list_request_count = 0
+        #: Watch streams opened, by resource — the reconnect/resync ladder's
+        #: observable side.
+        self.watch_request_count = 0
+        #: Scripted mid-stream disconnect: bumping the generation
+        #: (``disconnect_watches``) makes every CONNECTED watch handler
+        #: close its stream at the next poll.
+        self.watch_disconnect_generation = 0
+        #: When set, each watch connection closes after relaying this many
+        #: object events (bookmarks excluded) — a chattier disconnect fault.
+        self.watch_max_events: Optional[int] = None
+        #: While True, connected watch streams deliver NOTHING (the events
+        #: queue up server-side): lets tests mutate + compact + disconnect
+        #: deterministically without racing the 20ms delivery poll.
+        self.pause_watch_events = False
         #: Stale-discovery fault: while set (``freeze_discovery``), workload
         #: and pod listings serve this snapshot instead of the live cluster,
         #: so inventory mutations stay invisible — the apiserver cache gone
         #: stale.
         self.frozen_cluster: Optional[FakeCluster] = None
+
+    def disconnect_watches(self) -> None:
+        """Close every connected watch stream (mid-stream disconnect fault):
+        clients must reconnect from their last seen resourceVersion."""
+        self.watch_disconnect_generation += 1
 
     def freeze_discovery(self, frozen: bool) -> None:
         """Toggle the stale-discovery fault: freeze captures a deep copy of
@@ -346,19 +500,120 @@ class FakeBackend:
             page, metadata = items, {}
         if selector is not None:
             page = [p for p in page if _matches_selector(p["metadata"].get("labels", {}), selector)]
+        # Every list carries the cluster-wide resourceVersion, like a real
+        # apiserver — the watch seed the client resumes its stream from.
+        metadata["resourceVersion"] = str(self._inventory.resource_version)
         return web.json_response({"items": page, "metadata": metadata})
 
     def _workload_handler(self, attr: str):
         async def handler(request: web.Request) -> web.Response:
-            return await self._list(getattr(self._inventory, attr), request.match_info.get("namespace"))
+            if request.query.get("watch"):
+                return await self._watch(request, attr, request.match_info.get("namespace"))
+            self.list_request_count += 1
+            return await self._list(
+                getattr(self._inventory, attr), request.match_info.get("namespace"), request=request
+            )
 
         return handler
 
+    #: Inject N transient pod-list 500s, then succeed — the poisoned-future
+    #: eviction scenario (a failed cached fetch must not replay its
+    #: exception for the loader's lifetime).
+    fail_pod_lists: int = 0
+
     async def list_pods(self, request: web.Request) -> web.Response:
-        self.pod_request_count += 1
         namespace = request.match_info["namespace"]
+        if request.query.get("watch"):
+            return await self._watch(request, "pods", namespace)
+        self.pod_request_count += 1
+        if self.fail_pod_lists > 0:
+            self.fail_pod_lists -= 1
+            return web.json_response({"error": "injected pod list failure"}, status=500)
         pods = [p for p in self._inventory.pods if p["metadata"]["namespace"] == namespace]
         return await self._list(pods, request=request, selector=request.query.get("labelSelector"))
+
+    # ---------------------------------------------------------- k8s watches
+    async def _watch(self, request: web.Request, resource: str, namespace: Optional[str]):
+        """Stream watch events as JSON lines, apiserver-style: events with
+        ``resourceVersion`` greater than the requested one, in order, then
+        poll for new ones until the server-side timeout, a scripted
+        disconnect, or the per-connection event cap. A request starting
+        BELOW the compaction floor is answered ``410 Gone`` — the client's
+        cue to relist."""
+        self.watch_request_count += 1
+        cluster = self.cluster  # watches track the LIVE cluster's event log
+        rv = int(request.query.get("resourceVersion") or 0)
+        if rv < cluster.watch_min_rv:
+            return web.json_response(
+                {
+                    "kind": "Status",
+                    "code": 410,
+                    "reason": "Expired",
+                    "message": f"too old resource version: {rv} ({cluster.watch_min_rv})",
+                },
+                status=410,
+            )
+        bookmarks = request.query.get("allowWatchBookmarks") in ("true", "1")
+        timeout = min(float(request.query.get("timeoutSeconds") or 300.0), 300.0)
+        response = web.StreamResponse()
+        response.content_type = "application/json"
+        await response.prepare(request)
+        generation = self.watch_disconnect_generation
+        deadline = asyncio.get_event_loop().time() + timeout
+        index = 0
+        sent_objects = 0
+        # Skip history at or below the requested resourceVersion.
+        while index < len(cluster.events) and cluster.events[index]["rv"] <= rv:
+            index += 1
+        try:
+            while True:
+                if self.watch_disconnect_generation != generation:
+                    break  # scripted mid-stream disconnect
+                if self.pause_watch_events:
+                    await asyncio.sleep(0.02)
+                    continue
+                progressed = False
+                while index < len(cluster.events):
+                    event = cluster.events[index]
+                    index += 1
+                    if event["type"] == "BOOKMARK":
+                        if bookmarks:
+                            await response.write(
+                                json.dumps(
+                                    {
+                                        "type": "BOOKMARK",
+                                        "object": {
+                                            "metadata": {"resourceVersion": str(event["rv"])}
+                                        },
+                                    }
+                                ).encode()
+                                + b"\n"
+                            )
+                        continue
+                    if event["resource"] != resource:
+                        continue
+                    if namespace is not None and event["namespace"] != namespace:
+                        continue
+                    await response.write(
+                        json.dumps({"type": event["type"], "object": event["object"]}).encode()
+                        + b"\n"
+                    )
+                    progressed = True
+                    sent_objects += 1
+                    if (
+                        self.watch_max_events is not None
+                        and sent_objects >= self.watch_max_events
+                    ):
+                        return response  # per-connection cap: disconnect
+                if not progressed and asyncio.get_event_loop().time() >= deadline:
+                    break  # server-side watch timeout: clean stream end
+                transport = request.transport
+                if transport is None or transport.is_closing():
+                    break  # the client hung up — stop polling for it
+                await asyncio.sleep(0.02)
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise
+        return response
 
     async def list_services(self, request: web.Request) -> web.Response:
         return await self._list(

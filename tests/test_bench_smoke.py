@@ -95,6 +95,15 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("chaos_breaker_opens", 0) >= 1, secondary
     assert secondary.get("chaos_recovered_bitexact") == 1.0, secondary
     assert 0 < secondary.get("chaos_down_tick_seconds", 0) < 10.0, secondary
+    # The discovery leg ran end-to-end: the watch-mode reconcile stayed
+    # bit-identical to a fresh relist through injected churn AND beat the
+    # relist wall at equal fleet width (gate failures are rc 1; assert the
+    # fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("discovery_bitexact") == 1.0, secondary
+    assert secondary.get("discovery_reconcile_beats_relist") == 1.0, secondary
+    assert secondary.get("discovery_relist_seconds", 0) > 0, secondary
+    assert secondary.get("discovery_reconcile_seconds", 0) > 0, secondary
+    assert secondary.get("discovery_speedup", 0) > 1.0, secondary
     # The adaptive fetch-engine leg ran end-to-end: the planner coalesced
     # AND sharded at toy scale, the result was bit-exact vs the fixed-plan
     # control, and the AIMD autotuner saw per-query verdicts (gate failures

@@ -1,0 +1,653 @@
+"""Watch-driven incremental discovery (`--discovery-mode watch`).
+
+The correctness bar under test: at every reconcile the watch-maintained
+inventory must be BIT-IDENTICAL — same objects, same staged order — to what
+a fresh relist would return, through every rung of the resync ladder:
+ordinary churn, bookmark-only progress, mid-stream disconnects, forced
+``410 Gone`` resyncs, a divergence injected behind the watcher's back
+(caught by the verify relist), and a warm restart from the persisted
+snapshot. A serve-level churn soak then pins the same discipline end to
+end: watch-mode scheduler ticks publish byte-identical results (and leave a
+bit-identical digest store) vs a relist-mode control through a fault
+timeline.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from krr_tpu.core.config import Config
+from krr_tpu.integrations.kubernetes import KubernetesLoader
+from krr_tpu.obs.metrics import MetricsRegistry
+
+from .fakes.servers import KIND_ATTRS, FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+
+# ------------------------------------------------------------------ helpers
+def _dump(objects):
+    return [obj.model_dump() for obj in objects]
+
+
+def _brief(objects):
+    return [(o.kind, o.namespace, o.name, o.container, tuple(o.pods)) for o in objects]
+
+
+def _cluster_keys(cluster: FakeCluster) -> set:
+    return {
+        (kind, item["metadata"]["namespace"], item["metadata"]["name"])
+        for kind, attr in KIND_ATTRS.items()
+        for item in getattr(cluster, attr)
+        if item["metadata"]["namespace"] != "kube-system"
+    }
+
+
+def _write_kubeconfig(path, url: str) -> str:
+    path.write_text(
+        yaml.dump(
+            {
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+                "clusters": [{"name": "fake", "cluster": {"server": url}}],
+                "users": [{"name": "fake", "user": {"token": "t"}}],
+            }
+        )
+    )
+    return str(path)
+
+
+@pytest.fixture()
+def watch_env(tmp_path):
+    """A function-scoped fake cluster (each test owns its event log) with a
+    couple of workloads across namespaces."""
+    cluster = FakeCluster()
+    cluster.add_workload_with_pods("Deployment", "web", "apps", pod_count=2)
+    cluster.add_workload_with_pods("Deployment", "worker", "apps", pod_count=1)
+    cluster.add_workload_with_pods("StatefulSet", "db", "data", pod_count=2)
+    cluster.add_workload_with_pods("Job", "migrate", "data", pod_count=1)
+    cluster.add_workload_with_pods("DaemonSet", "kubelet-helper", "kube-system", pod_count=1)
+    backend = FakeBackend(cluster, FakeMetrics())
+    server = ServerThread(backend).start()
+    kubeconfig = _write_kubeconfig(tmp_path / "kubeconfig", server.url)
+    yield {
+        "cluster": cluster,
+        "backend": backend,
+        "server": server,
+        "kubeconfig": kubeconfig,
+        "tmp_path": tmp_path,
+    }
+    server.stop()
+
+
+def _config(env, **overrides) -> Config:
+    defaults = dict(kubeconfig=env["kubeconfig"], quiet=True)
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+async def _wait_bitexact(watch_loader, relist_loader, timeout=10.0):
+    """Poll until the watch reconcile is bit-identical to a fresh relist
+    (watch delivery is asynchronous); the final assert carries the diff."""
+    deadline = time.time() + timeout
+    while True:
+        watched = await watch_loader.list_scannable_objects(["fake"])
+        relisted = await relist_loader.list_scannable_objects(["fake"])
+        if _dump(watched) == _dump(relisted):
+            return watched, relisted
+        if time.time() > deadline:
+            assert _brief(watched) == _brief(relisted)
+            assert _dump(watched) == _dump(relisted)
+        await asyncio.sleep(0.03)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -------------------------------------------------------------- reconcile
+class TestWatchReconcile:
+    def test_cold_seed_bit_identical_to_relist(self, watch_env):
+        async def main():
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"))
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                watched = await watch.list_scannable_objects(["fake"])
+                relisted = await relist.list_scannable_objects(["fake"])
+                assert _dump(watched) == _dump(relisted)
+                assert len(watched) > 0
+                # kube-system stays excluded, like the relist path.
+                assert all(obj.namespace != "kube-system" for obj in watched)
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_churn_reconciles_bit_exact(self, watch_env):
+        cluster = watch_env["cluster"]
+
+        async def main():
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"))
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                await _wait_bitexact(watch, relist)
+                # Adds, an in-place update, pod churn (add/delete/relabel),
+                # and a delete+recreate (lands at the END of the relist
+                # order — the insertion-order discipline under test).
+                cluster.add_workload_with_pods("Deployment", "api", "apps", pod_count=2)
+                workload = cluster._find_workload("Deployment", "web", "apps")
+                workload["spec"]["template"]["spec"]["containers"].append(
+                    {"name": "sidecar", "resources": {}}
+                )
+                cluster.update_workload("Deployment", "web", "apps")
+                cluster.delete_pod("web-1", "apps")
+                cluster.add_pod("web-9", "apps", {"app": "web"})
+                cluster.update_pod("worker-0", "apps", {"app": "none"})  # unselects it
+                cluster.delete_workload("StatefulSet", "db", "data")
+                cluster.delete_pod("db-0", "data")
+                cluster.delete_pod("db-1", "data")
+                cluster.add_workload_with_pods("StatefulSet", "db", "data", pod_count=1)
+                watched, _ = await _wait_bitexact(watch, relist)
+                names = [(o.kind, o.name) for o in watched]
+                assert ("Deployment", "api") in names
+                # The watch fed the change without any additional workload
+                # LIST (the pod/workload lists here all came from the relist
+                # control loader + the one cold seed).
+                worker = next(o for o in watched if o.name == "worker")
+                assert worker.pods == []  # the relabel unselected its pod
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_streamed_batches_match_staged_order(self, watch_env):
+        async def main():
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"))
+            try:
+                staged = await watch.list_scannable_objects(["fake"])
+                batches = []
+                async for ordinal, positions, objects in watch.stream_scannable_objects(["fake"]):
+                    batches.append((ordinal, positions, objects))
+                flat = sorted(
+                    (
+                        (ordinal, position, obj)
+                        for ordinal, positions, objects in batches
+                        for position, obj in zip(positions, objects)
+                    ),
+                    key=lambda t: (t[0], t[1]),
+                )
+                assert _dump([obj for _o, _p, obj in flat]) == _dump(staged)
+                # One batch per namespace, like the relist streamed path.
+                assert all(
+                    len({obj.namespace for obj in objects}) == 1
+                    for _ordinal, _positions, objects in batches
+                )
+            finally:
+                await watch.close()
+
+        _run(main())
+
+
+# ---------------------------------------------------------- resync ladder
+class TestResyncLadder:
+    def test_bookmark_progress_survives_compaction_without_relist(self, watch_env):
+        cluster = watch_env["cluster"]
+        backend = watch_env["backend"]
+
+        async def main():
+            registry = MetricsRegistry()
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"), metrics=registry)
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                await _wait_bitexact(watch, relist)
+                seed_relists = registry.total("krr_tpu_discovery_relists_total")
+                # Bookmark-only progress: no object churn, but every stream's
+                # resourceVersion advances past the compaction floor. All 6
+                # streams (4 workload kinds + the apps/data pod watches)
+                # must have relayed the bookmark before it becomes the floor.
+                cluster.bookmark()
+                deadline = time.time() + 5.0
+                def bookmarks() -> float:
+                    return sum(
+                        value
+                        for series, value in registry.series(
+                            "krr_tpu_discovery_watch_events_total"
+                        ).items()
+                        if ("type", "bookmark") in set(series)
+                    )
+                while time.time() < deadline and bookmarks() < 6:
+                    await asyncio.sleep(0.02)
+                assert bookmarks() >= 6
+                cluster.compact_watch()
+                # …so the reconnect after a disconnect needs NO relist.
+                backend.disconnect_watches()
+                await asyncio.sleep(0.3)
+                await _wait_bitexact(watch, relist)
+                assert registry.total("krr_tpu_discovery_relists_total") == seed_relists
+                assert (registry.value("krr_tpu_discovery_relists_total", reason="410") or 0) == 0
+                assert registry.total("krr_tpu_discovery_watch_restarts_total") >= 1
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_disconnect_catches_up_bit_exact(self, watch_env):
+        cluster = watch_env["cluster"]
+        backend = watch_env["backend"]
+
+        async def main():
+            registry = MetricsRegistry()
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"), metrics=registry)
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                await _wait_bitexact(watch, relist)
+                backend.disconnect_watches()
+                cluster.add_workload_with_pods("Deployment", "after-drop", "apps", pod_count=1)
+                cluster.delete_workload("Job", "migrate", "data")
+                watched, _ = await _wait_bitexact(watch, relist)
+                assert any(o.name == "after-drop" for o in watched)
+                assert registry.total("krr_tpu_discovery_watch_restarts_total") >= 1
+                assert (registry.value("krr_tpu_discovery_relists_total", reason="410") or 0) == 0
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_410_gone_forces_relist_and_stays_bit_exact(self, watch_env):
+        cluster = watch_env["cluster"]
+        backend = watch_env["backend"]
+
+        async def main():
+            registry = MetricsRegistry()
+            watch = KubernetesLoader(_config(watch_env, discovery_mode="watch"), metrics=registry)
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                await _wait_bitexact(watch, relist)
+                # Pause delivery, then mutate + compact past the watchers'
+                # resourceVersions and disconnect: the reconnect finds its
+                # history compacted (410) and must relist. The pause makes
+                # the sequence race-free — no stream can consume the new
+                # events before the compaction floor moves past them.
+                backend.pause_watch_events = True
+                cluster.add_workload_with_pods("Deployment", "survivor", "apps", pod_count=1)
+                cluster.compact_watch()
+                backend.disconnect_watches()
+                backend.pause_watch_events = False
+                watched, _ = await _wait_bitexact(watch, relist)
+                assert any(o.name == "survivor" for o in watched)
+                assert (registry.value("krr_tpu_discovery_relists_total", reason="410") or 0) >= 1
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_verify_relist_catches_divergence_behind_the_watcher(self, watch_env):
+        cluster = watch_env["cluster"]
+
+        async def main():
+            registry = MetricsRegistry()
+            watch = KubernetesLoader(
+                _config(
+                    watch_env,
+                    discovery_mode="watch",
+                    discovery_verify_interval_seconds=1.0,
+                ),
+                metrics=registry,
+            )
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                await _wait_bitexact(watch, relist)
+                # Divergence injected BEHIND the watch stream: a direct list
+                # append records no event, so the watcher cannot see it…
+                from .fakes.servers import make_workload
+
+                cluster.deployments.append(make_workload("Deployment", "ghost", "apps"))
+                watched = await watch.list_scannable_objects(["fake"])
+                assert all(o.name != "ghost" for o in watched)  # invisible to the watch
+                # …until the verify relist audits ground truth.
+                await asyncio.sleep(1.1)
+                watched, _ = await _wait_bitexact(watch, relist)
+                assert any(o.name == "ghost" for o in watched)
+                assert registry.total("krr_tpu_discovery_verify_divergences_total") >= 1
+                assert (registry.value("krr_tpu_discovery_relists_total", reason="verify") or 0) >= 1
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(main())
+
+    def test_warm_restart_from_snapshot_skips_cold_relist(self, watch_env):
+        cluster = watch_env["cluster"]
+        backend = watch_env["backend"]
+        snapshot_path = str(watch_env["tmp_path"] / "discovery-inventory.json")
+
+        async def first():
+            watch = KubernetesLoader(
+                _config(watch_env, discovery_mode="watch", discovery_snapshot_path=snapshot_path)
+            )
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                watched, _ = await _wait_bitexact(watch, relist)
+                return _dump(watched)
+            finally:
+                await watch.close()  # persists the final snapshot
+                await relist.close()
+
+        expected = _run(first())
+        payload = json.loads(open(snapshot_path).read())
+        assert payload["v"] == 1 and payload["clusters"]
+
+        lists_before = backend.list_request_count
+
+        async def second():
+            watch = KubernetesLoader(
+                _config(watch_env, discovery_mode="watch", discovery_snapshot_path=snapshot_path)
+            )
+            try:
+                watched = await watch.list_scannable_objects(["fake"])
+                assert _dump(watched) == expected
+                # The warm start issued NO workload LIST requests — the
+                # snapshot seeded the inventory and the watches resumed from
+                # the persisted resourceVersions.
+                assert backend.list_request_count == lists_before
+                # …and the watches are LIVE: post-restart churn still lands.
+                cluster.add_workload_with_pods("Deployment", "post-restart", "apps", pod_count=1)
+                deadline = time.time() + 5.0
+                while time.time() < deadline:
+                    watched = await watch.list_scannable_objects(["fake"])
+                    if any(o.name == "post-restart" for o in watched):
+                        break
+                    await asyncio.sleep(0.03)
+                assert any(o.name == "post-restart" for o in watched)
+            finally:
+                await watch.close()
+
+        pods_before = backend.pod_request_count
+        _run(second())
+        assert backend.pod_request_count == pods_before  # no pod relists either
+
+    def test_stale_snapshot_rides_the_410_rung(self, watch_env):
+        """A snapshot whose resourceVersions predate a watch-cache
+        compaction still warm-starts — the 410 answers trigger per-stream
+        relists that converge back to ground truth."""
+        cluster = watch_env["cluster"]
+        snapshot_path = str(watch_env["tmp_path"] / "discovery-inventory.json")
+
+        async def first():
+            watch = KubernetesLoader(
+                _config(watch_env, discovery_mode="watch", discovery_snapshot_path=snapshot_path)
+            )
+            try:
+                await watch.list_scannable_objects(["fake"])
+            finally:
+                await watch.close()
+
+        _run(first())
+        # Invalidate the snapshot's resourceVersions: churn + compact.
+        cluster.add_workload_with_pods("Deployment", "newer", "apps", pod_count=1)
+        cluster.compact_watch()
+
+        async def second():
+            registry = MetricsRegistry()
+            watch = KubernetesLoader(
+                _config(watch_env, discovery_mode="watch", discovery_snapshot_path=snapshot_path),
+                metrics=registry,
+            )
+            relist = KubernetesLoader(_config(watch_env))
+            try:
+                watched, _ = await _wait_bitexact(watch, relist)
+                assert any(o.name == "newer" for o in watched)
+                assert (registry.value("krr_tpu_discovery_relists_total", reason="410") or 0) >= 1
+            finally:
+                await watch.close()
+                await relist.close()
+
+        _run(second())
+
+
+# ------------------------------------------------- pooled relist satellites
+class TestPooledRelist:
+    def test_pooled_loader_sees_churn_across_rounds(self, watch_env):
+        """Relist mode pools the ClusterLoader (and its HTTP client) across
+        rounds; the per-round begin_round() invalidation keeps pod indexes
+        fresh, so churn between rounds is fully visible."""
+        cluster = watch_env["cluster"]
+
+        async def main():
+            loader = KubernetesLoader(_config(watch_env))
+            first = await loader.list_scannable_objects(["fake"])
+            pods_first = watch_env["backend"].pod_request_count
+            cluster.add_workload_with_pods("Deployment", "round2", "apps", pod_count=1)
+            cluster.delete_pod("web-0", "apps")
+            second = await loader.list_scannable_objects(["fake"])
+            pods_second = watch_env["backend"].pod_request_count
+            await loader.close()
+            return first, second, pods_first, pods_second
+
+        first, second, pods_first, pods_second = _run(main())
+        assert any(o.name == "round2" for o in second)
+        assert all(o.name != "round2" for o in first)
+        web = next(o for o in second if o.name == "web")
+        assert "web-0" not in web.pods  # the pod index really refreshed
+        assert pods_second > pods_first  # per-round invalidation refetched
+
+    def test_failed_pod_fetch_is_not_cached(self, watch_env):
+        """Satellite: a pod list that raises must evict its cached future —
+        a retry within the same round succeeds instead of replaying the
+        cached exception."""
+        backend = watch_env["backend"]
+
+        async def main():
+            from krr_tpu.integrations.kubernetes import ClusterLoader
+
+            loader = ClusterLoader(cluster="fake", config=_config(watch_env))
+            try:
+                backend.fail_pod_lists = 1
+                with pytest.raises(Exception):
+                    await loader._namespace_pod_labels("apps")
+                index = await loader._namespace_pod_labels("apps")  # retry: fresh fetch
+                assert index.select({"matchLabels": {"app": "web"}})
+                backend.fail_pod_lists = 1
+                with pytest.raises(Exception):
+                    await loader._list_pods("data", "app=db")
+                assert await loader._list_pods("data", "app=db") == ["db-0", "db-1"]
+            finally:
+                await loader.close()
+
+        _run(main())
+
+
+# ------------------------------------------------------- serve churn soak
+def _build_soak_fleet():
+    """Deterministic two-namespace fleet + pre-registered series for the
+    workloads the churn script later adds — so the watch run and the relist
+    control share byte-identical ground truth."""
+    from .fakes.chaos import ArchetypeSpec, build_fleet
+
+    fleet = build_fleet(
+        (
+            ArchetypeSpec("diurnal", workloads=2, pods=1),
+            ArchetypeSpec("oom-loop", workloads=2, pods=1),
+        ),
+        samples=240,
+        seed=31,
+    )
+    rng = np.random.default_rng(77)
+    fleet.metrics.set_series(
+        "diurnal", "main", "late-0", cpu=rng.gamma(2.0, 0.1, 240), memory=rng.uniform(1e8, 2e8, 240)
+    )
+    return fleet
+
+
+async def _wait_soak_inventory(server, cluster, timeout=8.0):
+    inventory = server.session.get_inventory()
+    expected = _cluster_keys(cluster)
+    deadline = time.time() + timeout
+    while True:
+        objects = await inventory.list_scannable_objects(["fake"])
+        if {(o.kind, o.namespace, o.name) for o in objects} == expected:
+            return
+        if time.time() > deadline:
+            raise AssertionError(
+                f"inventory never converged: have "
+                f"{ {(o.kind, o.namespace, o.name) for o in objects} }, want {expected}"
+            )
+        await asyncio.sleep(0.03)
+
+
+def _run_churn_soak(mode: str, tmp_path, ticks: int = 7):
+    """One serve soak (real KrrServer, pinned clock) through a scripted
+    churn + fault timeline; returns (report, published body bytes)."""
+    from .fakes.chaos import FaultSpec, FaultTimeline, run_soak, write_kubeconfig
+
+    fleet = _build_soak_fleet()
+    server = ServerThread(fleet.backend).start()
+    try:
+        kubeconfig = write_kubeconfig(str(tmp_path / f"kubeconfig-{mode}"), server.url)
+        config = Config(
+            kubeconfig=kubeconfig,
+            prometheus_url=server.url,
+            strategy="tdigest",
+            quiet=True,
+            server_port=0,
+            scan_interval_seconds=300.0,
+            # The relist control re-discovers every tick, so both modes see
+            # churn at identical tick boundaries.
+            discovery_interval_seconds=0.001,
+            # …but the verify audit stays OUT of the soak: every event must
+            # ride the watch stream, not a 4ms auto-verify cadence.
+            discovery_verify_interval_seconds=3600.0,
+            discovery_mode=mode,
+            hysteresis_enabled=False,
+            prometheus_retry_deadline_seconds=1.0,
+            prometheus_backoff_cap_seconds=0.2,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        timeline = FaultTimeline([(4, 4, FaultSpec(fail_namespaces=frozenset({"oom-loop"})))])
+        cluster = fleet.cluster
+        backend = fleet.backend
+
+        async def on_tick(server_obj, sample):
+            if sample.tick == 1:
+                # Churn: a new workload appears (backfill leg next tick)…
+                cluster.add_workload("Deployment", "late", "diurnal")
+                cluster.add_pod("late-0", "diurnal", {"app": "late"})
+            elif sample.tick == 2:
+                # …and one disappears (watch delete → store drop op).
+                cluster.delete_workload("Deployment", "diurnal-1", "diurnal")
+                cluster.delete_pod("diurnal-1-0", "diurnal")
+            elif sample.tick == 3 and mode == "watch":
+                # Mid-soak disconnect: reconnect + catch-up, no relist.
+                backend.disconnect_watches()
+            await _wait_soak_inventory(server_obj, cluster)
+
+        report = asyncio.run(
+            run_soak(
+                config, backend, timeline, ticks=ticks, tick_seconds=300.0, on_tick=on_tick
+            )
+        )
+        snapshot = report.state.peek()
+        return report, (snapshot.body_json if snapshot is not None else b"")
+    finally:
+        server.stop()
+
+
+def test_watch_mode_soak_bit_exact_vs_relist_control(tmp_path):
+    from .fakes.chaos import stores_bitexact
+
+    watch_report, watch_body = _run_churn_soak("watch", tmp_path)
+    relist_report, relist_body = _run_churn_soak("relist", tmp_path)
+
+    equal, detail = stores_bitexact(watch_report.store, relist_report.store)
+    assert equal, f"watch-mode store diverged from the relist control: {detail}"
+    assert watch_body == relist_body, "published bytes diverged"
+    assert watch_body  # something actually published
+
+    counts = watch_report.counts()
+    assert counts["scanned"] >= 6
+    assert counts["degraded"] >= 1  # the fault tick quarantined, not aborted
+    # The discovery posture surfaced on the read side.
+    assert watch_report.state.discovery.get("mode") == "watch"
+    assert relist_report.state.discovery.get("mode") == "relist"
+    metrics = watch_report.metrics
+    assert (metrics.value("krr_tpu_discovery_relists_total", reason="seed") or 0) >= 1
+    # Every churn step rode the watch stream (no verify relist fired).
+    assert metrics.total("krr_tpu_discovery_watch_events_total") >= 4
+    assert (metrics.value("krr_tpu_discovery_relists_total", reason="verify") or 0) == 0
+    assert metrics.total("krr_tpu_discovery_watch_restarts_total") >= 1  # the disconnect
+    # Churn compaction ran off the watch deletes (the dropped workload's
+    # rows left the store) — and the store ends at the control's row count.
+    assert (metrics.total("krr_tpu_store_compacted_rows_total") or 0) >= 1
+
+
+def test_serve_derives_snapshot_path_and_warm_restarts(tmp_path):
+    """The serve composition derives ``discovery-inventory.json`` inside the
+    sharded state directory; a second serve over the same state dir
+    warm-starts the inventory with zero workload LIST requests."""
+    from .fakes.chaos import ORIGIN, run_soak, write_kubeconfig
+
+    fleet = _build_soak_fleet()
+    server = ServerThread(fleet.backend).start()
+    try:
+        kubeconfig = write_kubeconfig(str(tmp_path / "kubeconfig"), server.url)
+        state_path = str(tmp_path / "state")
+
+        def config() -> Config:
+            return Config(
+                kubeconfig=kubeconfig,
+                prometheus_url=server.url,
+                strategy="tdigest",
+                quiet=True,
+                server_port=0,
+                scan_interval_seconds=300.0,
+                discovery_interval_seconds=0.05,  # snapshot save rate limit
+                discovery_verify_interval_seconds=3600.0,
+                discovery_mode="watch",
+                hysteresis_enabled=False,
+                other_args={
+                    "history_duration": 1,
+                    "timeframe_duration": 1,
+                    "state_path": state_path,
+                },
+            )
+
+        asyncio.run(run_soak(config(), fleet.backend, None, ticks=2, tick_seconds=300.0))
+        snapshot_file = tmp_path / "state" / "discovery-inventory.json"
+        assert snapshot_file.exists(), "serve did not derive the snapshot path"
+        payload = json.loads(snapshot_file.read_text())
+        assert payload["v"] == 1 and payload["clusters"]
+
+        lists_before = fleet.backend.list_request_count
+        # A later pinned start: the restarted server's windows are past the
+        # persisted cursor, so its ticks actually scan (and reconcile).
+        report = asyncio.run(
+            run_soak(
+                config(), fleet.backend, None, ticks=2, tick_seconds=300.0,
+                start=ORIGIN + 3600.0 + 600.0,
+            )
+        )
+        assert fleet.backend.list_request_count == lists_before  # warm start
+        assert report.state.discovery.get("mode") == "watch"
+        assert (
+            report.metrics.value("krr_tpu_discovery_relists_total", reason="seed") or 0
+        ) == 0
+    finally:
+        server.stop()
+
+
+def test_watch_mode_soak_timeline_carries_discovery_block(tmp_path):
+    watch_report, _body = _run_churn_soak("watch", tmp_path, ticks=4)
+    records = watch_report.state.timeline.records()
+    assert records, "no timeline records"
+    blocks = [r.get("discovery") for r in records if r.get("discovery")]
+    assert blocks, "timeline records carry no discovery block"
+    assert all(b["mode"] == "watch" for b in blocks)
+    assert any(b.get("adds", 0) > 0 for b in blocks)  # the churn tick's delta
+    assert all("inventory_age_seconds" in b for b in blocks)

@@ -262,7 +262,14 @@ def _config(env, **overrides) -> Config:
 
 
 def _objects(env):
-    return asyncio.run(KubernetesLoader(_config(env)).list_scannable_objects(["fake"]))
+    async def discover_once():
+        loader = KubernetesLoader(_config(env))
+        try:
+            return await loader.list_scannable_objects(["fake"])
+        finally:
+            await loader.close()  # pooled clients outlive calls now
+
+    return asyncio.run(discover_once())
 
 
 def _gather_digests(env, config, objects, registry=None, *, points: int = 61):
