@@ -185,6 +185,11 @@ SMOKE_DEFAULTS = {
     "BENCH_READPATH_WORKLOADS": "12",
     "BENCH_READPATH_CLIENTS": "4",
     "BENCH_READPATH_REQUESTS": "36",
+    # Push-ingest leg: remote-write-fed serve vs the range-fetched pull
+    # control (bit-exactness + zero-range-queries + push-beats-pull gates;
+    # decode/ingest samples-per-second ceiling trended).
+    "BENCH_INGEST_WORKLOADS": "24",
+    "BENCH_INGEST_ROUNDS": "3",
 }
 
 
@@ -740,6 +745,196 @@ def discovery_leg(secondary: dict, check) -> None:
         f"(churn {churn}/round): reconcile {reconcile_seconds * 1e3:.1f}ms vs "
         f"relist {relist_seconds * 1e3:.1f}ms "
         f"({secondary['discovery_speedup']}x), bitexact={report['bitexact']}",
+        file=sys.stderr,
+    )
+
+
+def ingest_leg(secondary: dict, check) -> None:
+    """Push-ingest gates (`--metrics-mode push`, `krr_tpu.ingest`): a
+    remote-write-fed serve and a range-fetched pull control run the same
+    fleet over byte-identical fake series. Three parity-style gates:
+
+    * every round's published result AND the resident digest store stay
+      BIT-identical between the push and pull stacks (the audit's contract,
+      measured end to end);
+    * steady-state push ticks (after the first round's verify audit) issue
+      ZERO range queries — pinned on the fake Prometheus request counter;
+    * the push tick wall beats the range-fetched control's (the point of
+      folding buffered samples instead of re-fetching windows).
+
+    The decode+route+buffer ceiling (samples/s through ``ingest_body``) is
+    trended as ``secondary.ingest_samples_per_second``.
+    """
+    import asyncio
+    import statistics
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from krr_tpu.core.config import Config
+    from krr_tpu.ingest import IngestPlane
+    from krr_tpu.server.app import KrrServer
+    from tests.fakes.chaos import write_kubeconfig
+    from tests.fakes.remote_write import RemoteWriteSender
+    from tests.fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+    workloads = int(os.environ.get("BENCH_INGEST_WORKLOADS", 200))
+    rounds = max(2, int(os.environ.get("BENCH_INGEST_ROUNDS", 5)))
+    series_len = max(180, 62 + rounds * 10)
+    origin = FakeBackend.SERIES_ORIGIN
+
+    def build_env(series: dict):
+        cluster = FakeCluster()
+        metrics = FakeMetrics()
+        metrics.enforce_range = True
+        for i in range(workloads):
+            namespace = f"ns-{i % 8}"
+            for pod in cluster.add_workload_with_pods(
+                "Deployment", f"wl-{i}", namespace, pod_count=2
+            ):
+                cpu, mem = series[(namespace, pod)]
+                metrics.set_series(namespace, "main", pod, cpu=cpu, memory=mem)
+        return cluster, metrics
+
+    rng = np.random.default_rng(77)
+    series = {}
+    for i in range(workloads):
+        namespace = f"ns-{i % 8}"
+        for p in range(2):
+            series[(namespace, f"wl-{i}-{p}")] = (
+                rng.gamma(2.0, 0.05, series_len),
+                rng.uniform(5e7, 4e8, series_len),
+            )
+    push_cluster, push_metrics = build_env(series)
+    pull_cluster, pull_metrics = build_env(series)
+    push_server = ServerThread(FakeBackend(push_cluster, push_metrics)).start()
+    pull_server = ServerThread(FakeBackend(pull_cluster, pull_metrics)).start()
+
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            push_kube = write_kubeconfig(os.path.join(tmp, "kube-push"), push_server.url)
+            pull_kube = write_kubeconfig(os.path.join(tmp, "kube-pull"), pull_server.url)
+
+            def config(kubeconfig, prometheus_url, **overrides) -> Config:
+                return Config(
+                    kubeconfig=kubeconfig, prometheus_url=prometheus_url,
+                    strategy="tdigest", quiet=True, server_port=0,
+                    hysteresis_enabled=False,
+                    prometheus_breaker_cooldown_seconds=0.02,
+                    other_args={"history_duration": 1, "timeframe_duration": 1},
+                    **overrides,
+                )
+
+            async def run() -> dict:
+                now = [origin + 3600.0]
+                push_ks = KrrServer(
+                    config(
+                        push_kube, push_server.url,
+                        metrics_mode="push", ingest_port=0,
+                        # One verify round (the first push tick: the audit's
+                        # range control is part of the contract), then pure
+                        # push — the zero-query regime under measurement.
+                        ingest_verify_interval_seconds=1e9,
+                    ),
+                    clock=lambda: now[0],
+                )
+                pull_ks = KrrServer(
+                    config(pull_kube, pull_server.url), clock=lambda: now[0]
+                )
+                await push_ks.start(run_scheduler=False)
+                await pull_ks.start(run_scheduler=False)
+                try:
+                    sender = RemoteWriteSender(push_metrics)
+                    ingest_port = push_ks.ingest_listener.port
+                    assert await push_ks.scheduler.tick()
+                    assert await pull_ks.scheduler.tick()
+                    push_walls: list[float] = []
+                    pull_walls: list[float] = []
+                    bitexact = True
+                    steady_requests = 0
+                    for r in range(1, rounds + 1):
+                        now[0] = origin + 3600.0 + 600.0 * r
+                        i0, i1 = 61 + (r - 1) * 10, 60 + r * 10
+                        status = await sender.push(ingest_port, i0, i1)
+                        assert status == 204, f"push round {r}: HTTP {status}"
+                        requests_before = push_metrics.request_count
+                        t0 = _time.perf_counter()
+                        assert await push_ks.scheduler.tick()
+                        push_walls.append(_time.perf_counter() - t0)
+                        if r > 1:  # round 1 runs the verify audit's fetch
+                            steady_requests += push_metrics.request_count - requests_before
+                        t0 = _time.perf_counter()
+                        assert await pull_ks.scheduler.tick()
+                        pull_walls.append(_time.perf_counter() - t0)
+                        bitexact = bitexact and (
+                            push_ks.state.peek().result.format("json")
+                            == pull_ks.state.peek().result.format("json")
+                        )
+                    store_equal = all(
+                        np.array_equal(getattr(push_ks.state.store, field),
+                                       getattr(pull_ks.state.store, field))
+                        for field in ("cpu_counts", "cpu_total", "cpu_peak",
+                                      "mem_total", "mem_peak")
+                    )
+                    ingest_stats = push_ks.ingest.stats()
+                    return {
+                        "push_seconds": statistics.median(push_walls),
+                        "pull_seconds": statistics.median(pull_walls),
+                        "bitexact": bitexact and store_equal,
+                        "steady_requests": steady_requests,
+                        "rejected": sum(ingest_stats["rejected"].values()),
+                    }
+                finally:
+                    await push_ks.shutdown()
+                    await pull_ks.shutdown()
+
+            report = asyncio.run(run())
+    finally:
+        push_server.stop()
+        pull_server.stop()
+
+    # Decode+route+buffer ceiling, off the serve path: successive window
+    # bodies through a fresh plane, wall-clocked end to end.
+    plane = IngestPlane(max_samples_per_series=1 << 20)
+    sender = RemoteWriteSender(push_metrics)
+    chunk = 30
+    bodies = [
+        sender.frames(i, min(i + chunk - 1, series_len - 1))
+        for i in range(0, series_len, chunk)
+    ]
+    t0 = _time.perf_counter()
+    accepted = sum(plane.ingest_body(body) for body in bodies)
+    ingest_wall = _time.perf_counter() - t0
+    samples_per_second = accepted / max(ingest_wall, 1e-9)
+
+    check("push_ingest_bitexact", report["bitexact"], "push stack diverged from pull control")
+    check(
+        "push_zero_range_queries",
+        report["steady_requests"] == 0,
+        f"{report['steady_requests']} range queries during steady-state push ticks",
+    )
+    check(
+        "push_tick_beats_pull",
+        report["push_seconds"] < report["pull_seconds"],
+        f"push {report['push_seconds']:.4f}s vs pull {report['pull_seconds']:.4f}s",
+    )
+    secondary["ingest_workloads"] = float(workloads)
+    secondary["ingest_rounds"] = float(rounds)
+    secondary["ingest_push_tick_seconds"] = round(report["push_seconds"], 4)
+    secondary["ingest_pull_tick_seconds"] = round(report["pull_seconds"], 4)
+    secondary["ingest_tick_speedup"] = round(
+        report["pull_seconds"] / max(report["push_seconds"], 1e-9), 1
+    )
+    secondary["ingest_samples_per_second"] = round(samples_per_second)
+    secondary["ingest_bitexact"] = 1.0 if report["bitexact"] else 0.0
+    secondary["ingest_zero_range_queries"] = 1.0 if report["steady_requests"] == 0 else 0.0
+    secondary["ingest_rejected_samples"] = float(report["rejected"])
+    print(
+        f"bench: ingest leg {workloads} workloads x {rounds} rounds: push tick "
+        f"{report['push_seconds'] * 1e3:.1f}ms vs pull {report['pull_seconds'] * 1e3:.1f}ms "
+        f"({secondary['ingest_tick_speedup']}x), decode ceiling "
+        f"{samples_per_second / 1e6:.2f}M samples/s, bitexact={report['bitexact']}",
         file=sys.stderr,
     )
 
@@ -2254,6 +2449,14 @@ def main() -> None:
         # to a fresh relist through injected churn AND beat the relist wall
         # at equal fleet width — the O(churn) claim, measured.
         discovery_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_INGEST"):
+        # Push-ingest gates: remote-write-fed serve vs the range-fetched
+        # pull control — published results + resident store bit-exact,
+        # steady-state push ticks issue zero range queries, and the push
+        # tick wall beats the pull control's; decode samples/s ceiling
+        # trended.
+        ingest_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_FETCHPLAN"):
         # Adaptive fetch-engine gates: planner engagement (coalesce + shard

@@ -231,6 +231,39 @@ class Config(pd.BaseModel):
     #: memory-only.
     discovery_snapshot_path: Optional[str] = None
 
+    # Push-based metrics ingest (`krr_tpu.ingest`)
+    #: How serve ticks get their samples. "pull" issues Prometheus range
+    #: queries every tick (the classic shape). "push" runs a remote-write
+    #: listener and folds buffered samples at tick time — a steady-state
+    #: tick issues ZERO range queries; the range path remains the cold-start
+    #: seed, the per-series-watermark gap backfill, and the periodic
+    #: divergence audit's ground truth.
+    metrics_mode: Literal["pull", "push"] = "pull"
+    #: Remote-write listener bind port (push mode). 0 = ephemeral (tests;
+    #: the chosen port is logged and shown on /statusz).
+    ingest_port: int = pd.Field(9201, ge=0, le=65535)
+    #: Push-mode ground-truth audit cadence: every this many seconds the
+    #: tick's push-fed windows are ALSO range-fetched and compared row for
+    #: row — divergence is logged, counted
+    #: (``krr_tpu_ingest_verify_divergences_total``), and repaired by
+    #: adopting the range rows and invalidating the diverged series buffers.
+    #: 0 = auto: four scan intervals. Mirrors the discovery audit's ladder.
+    ingest_verify_interval_seconds: float = pd.Field(0.0, ge=0)
+    #: Largest accepted remote-write POST body (compressed bytes); larger
+    #: declarations are refused with 413 before the body is read.
+    ingest_max_body_bytes: int = pd.Field(16 << 20, gt=0)
+    #: Staleness horizon for grid evaluation: a grid point takes the newest
+    #: buffered sample no older than this (the Prometheus staleness default,
+    #: so push folds see what a range query would have returned).
+    ingest_lookback_seconds: float = pd.Field(300.0, gt=0)
+    #: Per-series buffer cap; overflow sheds the oldest samples (counted)
+    #: and pulls the series' completeness watermark forward so affected
+    #: windows fall back to the range path instead of folding short.
+    ingest_max_samples_per_series: int = pd.Field(8192, gt=0)
+    #: Resident series cap: new series beyond it are rejected (counted) —
+    #: a mislabeled fleet can't balloon the plane.
+    ingest_max_series: int = pd.Field(500_000, gt=0)
+
     #: One Prometheus range query per (namespace, resource) with client-side
     #: (pod, container) routing — O(namespaces) round trips; False = one query
     #: per (workload, resource). A failed batched query falls back to the

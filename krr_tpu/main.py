@@ -568,6 +568,84 @@ def _server_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--metrics-mode", "metrics_mode"],
+            type=click.Choice(["pull", "push"]),
+            default="pull",
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Metric acquisition: 'pull' range-queries Prometheus each "
+                "tick (the classic shape); 'push' runs a remote-write "
+                "listener that buffers samples as they arrive so a "
+                "steady-state tick folds the buffered window with zero "
+                "range queries, keeping the range path as the cold-start "
+                "seed and the gap-backfill ladder."
+            ),
+        ),
+        PanelOption(
+            ["--ingest-port", "ingest_port"],
+            type=int,
+            default=9201,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Port the remote-write ingest listener binds in push mode "
+                "(0 = ephemeral)."
+            ),
+        ),
+        PanelOption(
+            ["--ingest-verify-interval", "ingest_verify_interval_seconds"],
+            type=float,
+            default=0.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Push-mode ground-truth audit cadence: every this many "
+                "seconds the push-fed windows are compared against a "
+                "range-fetched control, counting + repairing any drift. "
+                "0 = auto (four scan intervals)."
+            ),
+        ),
+        PanelOption(
+            ["--ingest-max-body-bytes", "ingest_max_body_bytes"],
+            type=int,
+            default=16 << 20,
+            show_default=True,
+            panel="Server Settings",
+            help="Largest remote-write POST body the listener accepts (413 past it).",
+        ),
+        PanelOption(
+            ["--ingest-lookback", "ingest_lookback_seconds"],
+            type=float,
+            default=300.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Staleness window for push-fed grid evaluation — a grid "
+                "point sees the newest sample at most this old, matching "
+                "Prometheus range-query semantics."
+            ),
+        ),
+        PanelOption(
+            ["--ingest-max-samples-per-series", "ingest_max_samples_per_series"],
+            type=int,
+            default=8192,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Per-series ingest buffer cap; overflow sheds oldest samples "
+                "and the affected windows fall back to range fetches."
+            ),
+        ),
+        PanelOption(
+            ["--ingest-max-series", "ingest_max_series"],
+            type=int,
+            default=500_000,
+            show_default=True,
+            panel="Server Settings",
+            help="Resident-series ceiling; new series past it are rejected with a counter.",
+        ),
+        PanelOption(
             ["--min-fetch-success-pct", "min_fetch_success_pct"],
             type=float,
             default=50.0,

@@ -434,6 +434,12 @@ def build_scan_record(
         # and relist fallbacks, and inventory/watch freshness ages — the
         # trendable side of watch-driven incremental discovery.
         record["discovery"] = dict(stats["discovery"])
+    if stats.get("ingest"):
+        # Push-ingest posture for the tick (--metrics-mode push): how many
+        # windows folded from the plane vs rode range legs, the audit
+        # verdict when one ran, and the plane's freshness/buffer state —
+        # the trendable side of the zero-range-query steady state.
+        record["ingest"] = dict(stats["ingest"])
     if "federation" in stats:
         # Aggregate ticks (federation mode): shard census + per-tick
         # applied records and delta wire bytes — the trendable federation

@@ -490,6 +490,7 @@ class HttpApp:
             "last_persist_error": self.state.last_persist_error,
             "discovery_failed_clusters": dict(self.state.discovery_failed_clusters),
             "discovery": dict(self.state.discovery),
+            "ingest": dict(self.state.ingest),
         }
         if self.state.federation is not None:
             payload["federation"] = self.state.federation.status(float(self.clock()))
@@ -569,6 +570,11 @@ class HttpApp:
             # fresh the resident inventory and its watch streams are
             # (inventory_age_seconds / watch_lag_seconds).
             "discovery": dict(self.state.discovery),
+            # Push-ingest posture: the active metrics mode and, in push
+            # mode, the plane's freshness/series/rejection state — a
+            # stalled remote-writer shows up here before it shows up as
+            # range-backfill fetch spikes.
+            "ingest": dict(self.state.ingest),
             "stale_workloads": len(self.state.stale_workloads),
             "consecutive_scan_failures": self.state.consecutive_scan_failures,
             "last_scan_error": self.state.last_scan_error,
@@ -1246,6 +1252,32 @@ class KrrServer:
             )
             self.aggregator.seed(store.extra_meta.get("federation"))
             self.state.federation = self.aggregator
+        # Push ingest plane (`krr_tpu.ingest`): --metrics-mode push runs a
+        # remote-write listener whose buffered streams feed delta ticks
+        # directly — steady-state ticks issue zero range queries, and the
+        # range path remains the seed / gap-backfill / audit ground truth.
+        self.ingest = None
+        self.ingest_listener = None
+        if getattr(config, "metrics_mode", "pull") == "push":
+            from krr_tpu.ingest import IngestPlane, RemoteWriteListener
+
+            self.ingest = IngestPlane(
+                lookback_seconds=config.ingest_lookback_seconds,
+                max_samples_per_series=config.ingest_max_samples_per_series,
+                max_series=config.ingest_max_series,
+                metrics=self.session.metrics,
+            )
+            self.ingest_listener = RemoteWriteListener(
+                self.ingest,
+                host=config.server_host,
+                port=config.ingest_port,
+                max_body_bytes=config.ingest_max_body_bytes,
+                metrics=self.session.metrics,
+                logger=self.logger,
+            )
+        # The ingest posture is visible from the first /healthz on; the
+        # scheduler's per-tick stats refine it as ticks complete.
+        self.state.ingest = {"mode": getattr(config, "metrics_mode", "pull")}
         self.scheduler = ScanScheduler(
             self.session,
             self.state,
@@ -1255,6 +1287,7 @@ class KrrServer:
             logger=self.logger,
             durable=self.durable,
             aggregator=self.aggregator,
+            ingest=self.ingest,
         )
         self.app = HttpApp(
             self.state,
@@ -1290,6 +1323,15 @@ class KrrServer:
                 f"Federation aggregator listening on {host}:{self.aggregator.port} "
                 f"(shard staleness budget {self.aggregator.staleness:.0f}s)"
             )
+        if self.ingest_listener is not None:
+            await self.ingest_listener.start()
+            self.state.ingest["port"] = self.ingest_listener.port
+            self.logger.info(
+                f"Remote-write ingest listening on "
+                f"{self.ingest_listener.host}:{self.ingest_listener.port} "
+                f"(POST /api/v1/write; audit every "
+                f"{self.scheduler.ingest_verify_interval:.0f}s)"
+            )
         if run_scheduler:
             self.scheduler.start()
         self.logger.info(
@@ -1303,6 +1345,8 @@ class KrrServer:
         consistent — see ``ScanScheduler.stop``), then the listener, then
         the outbound clients."""
         await self.scheduler.stop()
+        if self.ingest_listener is not None:
+            await self.ingest_listener.stop()
         if self._server is not None:
             self._server.close()
             # Established keep-alive connections survive close(); abort

@@ -308,6 +308,12 @@ class ServerState:
         #: serve runs with ``--federation-listen``: /healthz and /statusz
         #: render its per-shard connected/epoch/lag state. None otherwise.
         self.federation = None
+        #: Push-ingest posture (`krr_tpu.ingest`, ``--metrics-mode push``):
+        #: the active mode, the listener's bound port, and the scheduler's
+        #: per-tick plane stats (series, buffered samples, freshness,
+        #: rejection counts) — rendered on /healthz and /statusz so "is the
+        #: push plane keeping up?" never needs a log grep.
+        self.ingest: dict = {}
         #: The publish epoch — the read path's cache key and the ETag's
         #: leading component. Advances ONLY when a publish changes the
         #: rendered bytes (hysteresis makes that rare, which is what makes

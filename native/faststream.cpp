@@ -658,3 +658,348 @@ long krr_stream_fold_into(void* handle, const long* rows, long n_series, double*
 void krr_stream_free(void* handle) { delete static_cast<Stream*>(handle); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Prometheus remote-write scanner: snappy block format + the WriteRequest
+// protobuf, hand-rolled beside the JSON scanner above (same ownership rules:
+// caller-allocated output buffers, negative return codes, Python fallback on
+// capacity shortfall). The wire is snappy-compressed protobuf --
+// WriteRequest{ repeated TimeSeries{ repeated Label{name,value},
+// repeated Sample{double value, int64 timestamp_ms} } } -- and the decode is
+// a single pass: decompress into one scratch buffer sized from the snappy
+// preamble, then walk the protobuf emitting flat sample/label arrays. No
+// digesting here: the ingest plane evaluates samples onto the serve grid
+// later, so the decoder's job is only a faithful, bounded, crash-proof
+// unpack (malformed bytes are a -2, never UB -- every read is bounds-checked
+// against the decoded buffer).
+
+namespace {
+
+// Parse the uvarint at [p, end); advances *p. False on truncation/overflow
+// (>10 bytes or a value that doesn't fit uint64).
+bool read_varint(const unsigned char** p, const unsigned char* end, unsigned long long* out) {
+  unsigned long long v = 0;
+  int shift = 0;
+  while (*p < end && shift < 64) {
+    unsigned char b = *(*p)++;
+    v |= static_cast<unsigned long long>(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Snappy BLOCK format (the remote-write framing): uvarint uncompressed
+// length, then literal / copy tags. Decompresses [src, src_end) into dst
+// (caller-sized to the preamble's length). Returns false on any malformed
+// element: truncated tag payloads, copies reaching before the output start,
+// or output over/underrun.
+bool snappy_decompress(const unsigned char* src, const unsigned char* src_end,
+                       unsigned char* dst, long long dst_len) {
+  unsigned long long expect = 0;
+  if (!read_varint(&src, src_end, &expect) ||
+      expect != static_cast<unsigned long long>(dst_len)) {
+    return false;
+  }
+  long long out = 0;
+  while (src < src_end) {
+    unsigned char tag = *src++;
+    long long len;
+    if ((tag & 3) == 0) {  // literal
+      len = (tag >> 2) + 1;
+      if (len > 60) {
+        int extra = static_cast<int>(len - 60);  // 1..4 length bytes, LE
+        if (src_end - src < extra) return false;
+        len = 0;
+        for (int i = 0; i < extra; i++) len |= static_cast<long long>(src[i]) << (8 * i);
+        len += 1;
+        src += extra;
+      }
+      if (src_end - src < len || dst_len - out < len) return false;
+      std::memcpy(dst + out, src, static_cast<size_t>(len));
+      src += len;
+      out += len;
+    } else {  // copy: 1/2/4-byte offsets
+      long long offset;
+      if ((tag & 3) == 1) {
+        len = ((tag >> 2) & 7) + 4;
+        if (src >= src_end) return false;
+        offset = (static_cast<long long>(tag >> 5) << 8) | *src++;
+      } else if ((tag & 3) == 2) {
+        len = (tag >> 2) + 1;
+        if (src_end - src < 2) return false;
+        offset = src[0] | (static_cast<long long>(src[1]) << 8);
+        src += 2;
+      } else {
+        len = (tag >> 2) + 1;
+        if (src_end - src < 4) return false;
+        offset = src[0] | (static_cast<long long>(src[1]) << 8) |
+                 (static_cast<long long>(src[2]) << 16) | (static_cast<long long>(src[3]) << 24);
+        src += 4;
+      }
+      if (offset <= 0 || offset > out || dst_len - out < len) return false;
+      // Overlapping copies are the RLE idiom (offset < len): byte-at-a-time
+      // forward copy is the defined semantics.
+      const unsigned char* from = dst + out - offset;
+      for (long long i = 0; i < len; i++) dst[out + i] = from[i];
+      out += len;
+    }
+  }
+  return out == dst_len;
+}
+
+// Skip one protobuf field of wire type `wt` at [p, end). Groups (wt 3/4) and
+// unknown types are malformed -- nothing in the remote-write schema emits
+// them, and skipping blind would desync the stream.
+bool skip_field(const unsigned char** p, const unsigned char* end, unsigned int wt) {
+  unsigned long long n = 0;
+  switch (wt) {
+    case 0:  // varint
+      return read_varint(p, end, &n);
+    case 1:  // fixed64
+      if (end - *p < 8) return false;
+      *p += 8;
+      return true;
+    case 2:  // length-delimited
+      if (!read_varint(p, end, &n) || static_cast<unsigned long long>(end - *p) < n) return false;
+      *p += n;
+      return true;
+    case 5:  // fixed32
+      if (end - *p < 4) return false;
+      *p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+struct RwOut {
+  char* names;
+  long long names_cap;
+  long long names_len = 0;
+  double* values;
+  long long* timestamps;
+  long long values_cap;
+  long long values_n = 0;
+  long long* lens;
+  long long series_cap;
+  long long series_n = 0;
+};
+
+// One Label submessage: append "name\tvalue" (with the leading separator the
+// caller chose) to the names arena. Separator bytes inside a label would
+// corrupt the record framing, so they are malformed here AND in the Python
+// twin -- the parity contract covers rejects too.
+int parse_label(const unsigned char* p, const unsigned char* end, RwOut& o, bool first) {
+  const unsigned char* name = nullptr;
+  const unsigned char* value = nullptr;
+  unsigned long long name_len = 0, value_len = 0;
+  while (p < end) {
+    unsigned long long key = 0;
+    if (!read_varint(&p, end, &key)) return -2;
+    unsigned int field = static_cast<unsigned int>(key >> 3), wt = key & 7;
+    if ((field == 1 || field == 2) && wt == 2) {
+      unsigned long long n = 0;
+      if (!read_varint(&p, end, &n) || static_cast<unsigned long long>(end - p) < n) return -2;
+      if (field == 1) {
+        name = p;
+        name_len = n;
+      } else {
+        value = p;
+        value_len = n;
+      }
+      p += n;
+    } else if (!skip_field(&p, end, wt)) {
+      return -2;
+    }
+  }
+  for (unsigned long long i = 0; i < name_len; i++) {
+    if (name[i] == '\t' || name[i] == '\n') return -2;
+  }
+  for (unsigned long long i = 0; i < value_len; i++) {
+    if (value[i] == '\t' || value[i] == '\n') return -2;
+  }
+  long long need = o.names_len + static_cast<long long>(name_len + value_len) + 2 + (first ? 0 : 1);
+  if (need > o.names_cap) return -1;
+  if (!first) o.names[o.names_len++] = '\t';
+  if (name_len) std::memcpy(o.names + o.names_len, name, name_len);
+  o.names_len += name_len;
+  o.names[o.names_len++] = '\t';
+  if (value_len) std::memcpy(o.names + o.names_len, value, value_len);
+  o.names_len += value_len;
+  return 0;
+}
+
+// One Sample submessage. Missing fields take protobuf defaults (value 0.0,
+// timestamp 0), matching the Python twin.
+int parse_sample(const unsigned char* p, const unsigned char* end, RwOut& o) {
+  double v = 0.0;
+  long long ts = 0;
+  while (p < end) {
+    unsigned long long key = 0;
+    if (!read_varint(&p, end, &key)) return -2;
+    unsigned int field = static_cast<unsigned int>(key >> 3), wt = key & 7;
+    if (field == 1 && wt == 1) {
+      if (end - p < 8) return -2;
+      std::memcpy(&v, p, 8);  // protobuf doubles are little-endian IEEE 754
+      p += 8;
+    } else if (field == 2 && wt == 0) {
+      unsigned long long raw = 0;
+      if (!read_varint(&p, end, &raw)) return -2;
+      ts = static_cast<long long>(raw);  // int64: two's-complement passthrough
+    } else if (!skip_field(&p, end, wt)) {
+      return -2;
+    }
+  }
+  if (o.values_n >= o.values_cap) return -1;
+  o.values[o.values_n] = v;
+  o.timestamps[o.values_n] = ts;
+  o.values_n++;
+  return 0;
+}
+
+int parse_timeseries(const unsigned char* p, const unsigned char* end, RwOut& o) {
+  if (o.series_n >= o.series_cap) return -1;
+  long long samples_before = o.values_n;
+  bool first_label = true;
+  while (p < end) {
+    unsigned long long key = 0;
+    if (!read_varint(&p, end, &key)) return -2;
+    unsigned int field = static_cast<unsigned int>(key >> 3), wt = key & 7;
+    if ((field == 1 || field == 2) && wt == 2) {
+      unsigned long long n = 0;
+      if (!read_varint(&p, end, &n) || static_cast<unsigned long long>(end - p) < n) return -2;
+      int rc = field == 1 ? parse_label(p, p + n, o, first_label)
+                          : parse_sample(p, p + n, o);
+      if (rc != 0) return rc;
+      if (field == 1) first_label = false;
+      p += n;
+    } else if (!skip_field(&p, end, wt)) {
+      return -2;
+    }
+  }
+  o.lens[o.series_n] = o.values_n - samples_before;
+  o.series_n++;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// The snappy preamble's uncompressed length, or -2 when the body is too
+// short / the varint is malformed. Callers size the decode buffers from it.
+long long krr_rw_uncompressed_len(const unsigned char* body, long long body_len) {
+  const unsigned char* p = body;
+  unsigned long long n = 0;
+  if (!read_varint(&p, body + body_len, &n) || n > (1ULL << 62)) return -2;
+  return static_cast<long long>(n);
+}
+
+// Decode one remote-write body (snappy-compressed WriteRequest) into flat
+// arrays:
+//   names        — '\n'-joined per-series records of '\t'-joined label
+//                  name/value fields, in wire order (the record framing the
+//                  JSON scanner's readout uses)
+//   values/timestamps — every sample, series-major (timestamps in ms)
+//   lens         — per-series sample counts
+// Returns the series count (>= 0), -1 when a caller buffer is too small
+// (retry via the Python fallback), -2 on malformed snappy/protobuf bytes, or
+// -3 when the uncompressed length exceeds max_decoded (a decompression bomb
+// — reject, don't allocate).
+long long krr_rw_decode(const unsigned char* body, long long body_len, long long max_decoded,
+                        char* names, long long names_cap, double* values,
+                        long long* timestamps, long long values_cap, long long* lens,
+                        long long series_cap, long long* out_values_n,
+                        long long* out_names_len) {
+  long long decoded_len = krr_rw_uncompressed_len(body, body_len);
+  if (decoded_len < 0) return -2;
+  if (decoded_len > max_decoded) return -3;
+  unsigned char* decoded =
+      static_cast<unsigned char*>(std::malloc(decoded_len ? static_cast<size_t>(decoded_len) : 1));
+  if (!decoded) return -2;
+  if (!snappy_decompress(body, body + body_len, decoded, decoded_len)) {
+    std::free(decoded);
+    return -2;
+  }
+
+  RwOut o;
+  o.names = names;
+  o.names_cap = names_cap;
+  o.values = values;
+  o.timestamps = timestamps;
+  o.values_cap = values_cap;
+  o.lens = lens;
+  o.series_cap = series_cap;
+
+  const unsigned char* p = decoded;
+  const unsigned char* end = decoded + decoded_len;
+  int rc = 0;
+  while (p < end) {
+    unsigned long long key = 0;
+    if (!read_varint(&p, end, &key)) {
+      rc = -2;
+      break;
+    }
+    unsigned int field = static_cast<unsigned int>(key >> 3), wt = key & 7;
+    if (field == 1 && wt == 2) {  // repeated TimeSeries
+      unsigned long long n = 0;
+      if (!read_varint(&p, end, &n) || static_cast<unsigned long long>(end - p) < n) {
+        rc = -2;
+        break;
+      }
+      if (o.names_len >= o.names_cap) {
+        rc = -1;
+        break;
+      }
+      if (o.series_n > 0) o.names[o.names_len++] = '\n';
+      rc = parse_timeseries(p, p + n, o);
+      if (rc != 0) break;
+      p += n;
+    } else if (!skip_field(&p, end, wt)) {  // metadata etc.: skipped
+      rc = -2;
+      break;
+    }
+  }
+  std::free(decoded);
+  if (rc != 0) return rc;
+  *out_values_n = o.values_n;
+  *out_names_len = o.names_len;
+  return o.series_n;
+}
+
+// Digest a plain double array with the EXACT arithmetic of
+// krr_parse_matrix_digest's sample sink (same expression order, same libm
+// calls) — the push ingest plane's fold path, so push-fed windows bucket
+// bit-identically to range-fetched ones regardless of borderline log()
+// roundings. counts ([num_buckets]) must be zero-initialized by the caller.
+// Returns 0, or -2 on invalid digest parameters.
+long long krr_digest_array(const double* values, long long n, double gamma,
+                           double min_value, long long num_buckets,
+                           double* counts, double* out_total, double* out_peak) {
+  if (num_buckets < 2 || gamma <= 1.0 || min_value <= 0.0) return -2;
+  const double inv_log_gamma = 1.0 / std::log(gamma);
+  const double inv_min = 1.0 / min_value;
+  double peak = -HUGE_VAL;
+  for (long long i = 0; i < n; ++i) {
+    const double v = values[i];
+    long long idx = 0;
+    if (v > min_value) {
+      long long raw =
+          static_cast<long long>(std::floor(std::log(v * inv_min) * inv_log_gamma));
+      if (raw < 0) raw = 0;
+      if (raw > num_buckets - 2) raw = num_buckets - 2;
+      idx = 1 + raw;
+    }
+    counts[idx] += 1.0;
+    if (v > peak) peak = v;
+  }
+  *out_total = static_cast<double>(n);
+  *out_peak = peak;
+  return 0;
+}
+
+}  // extern "C"

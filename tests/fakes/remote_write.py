@@ -1,0 +1,179 @@
+"""Fake Prometheus remote-write sender: hand-rolled protobuf + snappy encoder.
+
+Builds on-the-wire `WriteRequest` bodies (snappy block-compressed protobuf,
+remote-write 1.0) from a :class:`FakeMetrics` series table, so ingest tests
+drive the listener with byte-realistic frames without a protobuf or snappy
+dependency. The compressor emits literal-only snappy (always valid, never
+clever); `encode_write_request` mirrors the real field numbering:
+
+    WriteRequest{1: repeated TimeSeries}
+    TimeSeries{1: repeated Label, 2: repeated Sample}
+    Label{1: name, 2: value}          Sample{1: double value, 2: int64 ts_ms}
+
+Samples ride the same grid the fake Prometheus serves (`SERIES_ORIGIN` +
+i*step), so a push-fed window and a range-fetched window see identical data —
+the bit-exactness gate's precondition.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .servers import FakeBackend, FakeMetrics
+
+#: The two series shapes the recommender consumes, labelled the way a real
+#: kube-prometheus stack ships them (the ingest router matches on these).
+CPU_METRIC = "node_namespace_pod_container:container_cpu_usage_seconds_total:sum_irate"
+MEM_METRIC = "container_memory_working_set_bytes"
+
+
+# ---------------------------------------------------------------- primitives
+def uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy block encoding: length preamble + 60-bit-capped
+    literal runs. Valid input for any conformant decoder; no copy tags."""
+    out = bytearray(uvarint(len(data)))
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos : pos + 65536]
+        pos += len(chunk)
+        if len(chunk) <= 60:
+            out.append((len(chunk) - 1) << 2)
+        else:  # tag 60+k: k little-endian length bytes follow
+            out.append(61 << 2)
+            out += struct.pack("<H", len(chunk) - 1)
+        out += chunk
+    return bytes(out)
+
+
+def _pb_field(field: int, payload: bytes) -> bytes:
+    return uvarint(field << 3 | 2) + uvarint(len(payload)) + payload
+
+
+def encode_label(name: str, value: str) -> bytes:
+    return _pb_field(1, name.encode()) + _pb_field(2, value.encode())
+
+
+def encode_sample(value: float, ts_ms: int) -> bytes:
+    return (
+        uvarint(1 << 3 | 1)
+        + struct.pack("<d", value)
+        + uvarint(2 << 3 | 0)
+        + uvarint(ts_ms & (1 << 64) - 1)  # int64 two's complement
+    )
+
+
+def encode_timeseries(labels: list[tuple[str, str]], samples: list[tuple[float, int]]) -> bytes:
+    body = b"".join(_pb_field(1, encode_label(n, v)) for n, v in labels)
+    body += b"".join(_pb_field(2, encode_sample(v, ts)) for v, ts in samples)
+    return body
+
+
+def encode_write_request(series: list[tuple[list[tuple[str, str]], list[tuple[float, int]]]]) -> bytes:
+    return b"".join(_pb_field(1, encode_timeseries(labels, samples)) for labels, samples in series)
+
+
+def build_body(series) -> bytes:
+    """series → the on-the-wire POST body (snappy over protobuf)."""
+    return snappy_compress(encode_write_request(series))
+
+
+# ------------------------------------------------------------------- sender
+def cpu_labels(namespace: str, pod: str, container: str) -> list[tuple[str, str]]:
+    return [
+        ("__name__", CPU_METRIC),
+        ("container", container),
+        ("namespace", namespace),
+        ("pod", pod),
+    ]
+
+
+def mem_labels(namespace: str, pod: str, container: str) -> list[tuple[str, str]]:
+    # The cadvisor label baggage the router's mem filters require
+    # (job/metrics_path, a non-empty image).
+    return [
+        ("__name__", MEM_METRIC),
+        ("container", container),
+        ("image", "registry.example/app:1"),
+        ("job", "kubelet"),
+        ("metrics_path", "/metrics/cadvisor"),
+        ("namespace", namespace),
+        ("pod", pod),
+    ]
+
+
+class RemoteWriteSender:
+    """Streams a FakeMetrics series table to an ingest listener, one grid
+    index range at a time — the push twin of the fake's range-query serving
+    (same origin, same step, same values)."""
+
+    def __init__(
+        self,
+        metrics: FakeMetrics,
+        step_seconds: float = 60.0,
+        origin: float = FakeBackend.SERIES_ORIGIN,
+        container_override: str | None = None,
+    ):
+        self.metrics = metrics
+        self.step_seconds = float(step_seconds)
+        self.origin = float(origin)
+        self.container_override = container_override
+
+    def ts_ms(self, index: int) -> int:
+        return int(round((self.origin + index * self.step_seconds) * 1000.0))
+
+    def frames(self, i0: int, i1: int) -> bytes:
+        """One body carrying sample indices [i0, i1] (inclusive, clipped to
+        each series' length) for every series the fake serves."""
+        series = []
+        for (namespace, container, pod), (cpu, mem) in sorted(self.metrics.series.items()):
+            container = self.container_override or container
+            for labels, values in (
+                (cpu_labels(namespace, pod, container), cpu),
+                (mem_labels(namespace, pod, container), mem),
+            ):
+                lo, hi = max(i0, 0), min(i1, len(values) - 1)
+                samples = [(float(values[i]), self.ts_ms(i)) for i in range(lo, hi + 1)]
+                if samples:
+                    series.append((labels, samples))
+        return build_body(series)
+
+    async def push(self, port: int, i0: int, i1: int, host: str = "127.0.0.1") -> int:
+        """POST indices [i0, i1] to a listener; returns the HTTP status."""
+        return await post_body(port, self.frames(i0, i1), host=host)
+
+
+async def post_body(
+    port: int, body: bytes, host: str = "127.0.0.1", path: str = "/api/v1/write"
+) -> int:
+    import httpx
+
+    async with httpx.AsyncClient(timeout=30) as client:
+        r = await client.post(
+            f"http://{host}:{port}{path}",
+            content=body,
+            headers={
+                "Content-Type": "application/x-protobuf",
+                "Content-Encoding": "snappy",
+                "X-Prometheus-Remote-Write-Version": "0.1.0",
+            },
+        )
+        return r.status_code
+
+
+def grid_samples(values: np.ndarray, i0: int, i1: int, sender: RemoteWriteSender) -> list[tuple[float, int]]:
+    """Convenience for hand-built series: values[i0..i1] on the sender grid."""
+    return [(float(values[i]), sender.ts_ms(i)) for i in range(i0, i1 + 1)]

@@ -104,6 +104,17 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("discovery_relist_seconds", 0) > 0, secondary
     assert secondary.get("discovery_reconcile_seconds", 0) > 0, secondary
     assert secondary.get("discovery_speedup", 0) > 1.0, secondary
+    # The push-ingest leg ran end-to-end: the remote-write-fed serve stayed
+    # bit-identical to the range-fetched pull control, steady-state push
+    # ticks issued zero range queries, the push tick beat the pull wall,
+    # and the decode ceiling was measured (gate failures are rc 1; assert
+    # the fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("ingest_bitexact") == 1.0, secondary
+    assert secondary.get("ingest_zero_range_queries") == 1.0, secondary
+    assert secondary.get("ingest_push_tick_seconds", 0) > 0, secondary
+    assert secondary.get("ingest_pull_tick_seconds", 0) > 0, secondary
+    assert secondary.get("ingest_tick_speedup", 0) > 1.0, secondary
+    assert secondary.get("ingest_samples_per_second", 0) > 0, secondary
     # The adaptive fetch-engine leg ran end-to-end: the planner coalesced
     # AND sharded at toy scale, the result was bit-exact vs the fixed-plan
     # control, and the AIMD autotuner saw per-query verdicts (gate failures

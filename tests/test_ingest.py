@@ -1,0 +1,679 @@
+"""The push-ingest plane: remote-write decode parity, malformed-input
+hardening, listener protocol conformance, plane watermark semantics, and the
+end-to-end push-vs-pull bit-exactness gate.
+
+The headline tests run TWO hermetic serve stacks over byte-identical fake
+series — one in ``--metrics-mode push`` fed by the fake remote-write sender,
+one classic pull control — and assert the push server's published results and
+digest store are bit-identical to the control at every tick, that a
+steady-state push tick issues ZERO range queries (pinned on the fake
+Prometheus request counter), that a simulated ingest gap falls back to the
+range ladder and still lands bit-exact, and that the ``--ingest-verify-
+interval`` audit counts and repairs an injected divergence.
+"""
+
+import asyncio
+import json
+import math
+import struct
+
+import numpy as np
+import pytest
+import yaml
+
+from krr_tpu.core.config import Config
+from krr_tpu.ingest import IngestPlane, RemoteWriteListener, route_record
+from krr_tpu.ingest.plane import BUFFER_OVERFLOW, DUPLICATE, OUT_OF_ORDER, SERIES_LIMIT
+from krr_tpu.integrations.native import (
+    RemoteWriteError,
+    RemoteWriteTooLarge,
+    _load_library,
+    decode_remote_write,
+    decode_remote_write_native,
+    decode_remote_write_python,
+    digest_samples,
+)
+from krr_tpu.models.allocations import ResourceAllocations, ResourceType
+from krr_tpu.models.objects import K8sObjectData
+from krr_tpu.server.app import KrrServer
+from krr_tpu.server.metrics import MetricsRegistry
+
+from .fakes.remote_write import (
+    CPU_METRIC,
+    MEM_METRIC,
+    RemoteWriteSender,
+    build_body,
+    cpu_labels,
+    encode_write_request,
+    mem_labels,
+    post_body,
+    snappy_compress,
+    uvarint,
+)
+from .fakes.servers import FakeBackend, FakeCluster, FakeMetrics, ServerThread
+
+ORIGIN = FakeBackend.SERIES_ORIGIN
+STEP = 60.0
+
+needs_native = pytest.mark.skipif(
+    _load_library() is None, reason="native library not built"
+)
+
+
+def _decoded_bytes(decoded):
+    """Canonical byte image of a decoded tuple — bitwise comparison that
+    treats NaN payloads and signed zeros exactly."""
+    names, values, timestamps, lens = decoded
+    return (names, values.tobytes(), timestamps.tobytes(), lens.tobytes())
+
+
+def _sample_series():
+    """A spread of shapes: normal samples, NaN, negative value, negative
+    timestamp, a labels-only series with zero samples."""
+    return [
+        (cpu_labels("default", "web-0", "main"), [(0.25, 1_700_000_000_000), (float("nan"), 1_700_000_060_000), (-1.5, 1_700_000_120_000)]),
+        (mem_labels("prod", "db-0", "main"), [(2.0e8, -5_000)]),
+        ([("__name__", "labels_only"), ("job", "x")], []),
+    ]
+
+
+# ------------------------------------------------------------ decoder parity
+class TestDecoderParity:
+    @needs_native
+    def test_sender_frames_bit_identical(self):
+        metrics = FakeMetrics()
+        rng = np.random.default_rng(7)
+        metrics.set_series("default", "main", "web-0", cpu=rng.gamma(2.0, 0.05, 24), memory=rng.uniform(5e7, 2e8, 24))
+        metrics.set_series("prod", "main", "db-0", cpu=rng.gamma(2.0, 0.2, 24), memory=rng.uniform(1e8, 4e8, 24))
+        body = RemoteWriteSender(metrics).frames(0, 23)
+        native = decode_remote_write_native(body)
+        assert native is not None
+        assert _decoded_bytes(native) == _decoded_bytes(decode_remote_write_python(body))
+
+    @needs_native
+    def test_edge_shapes_bit_identical(self):
+        body = build_body(_sample_series())
+        native = decode_remote_write_native(body)
+        assert native is not None
+        python = decode_remote_write_python(body)
+        assert _decoded_bytes(native) == _decoded_bytes(python)
+        names, values, timestamps, lens = python
+        assert list(lens) == [3, 1, 0]
+        assert math.isnan(values[1]) and timestamps[3] == -5_000
+
+    @needs_native
+    def test_copy_tag_snappy_bit_identical(self):
+        """The fake sender is literal-only, so the copy-tag arms need
+        handcrafted streams: 1-, 2-, and 4-byte-offset copies plus an
+        OVERLAPPING copy (offset < length), each decompressing to a valid
+        WriteRequest and decoding bit-identically through both scanners."""
+        # A label value of 'a'*70 gives the compressor a long repeat to
+        # copy; the surrounding protobuf framing rides in literals.
+        wire = encode_write_request(
+            [([("__name__", CPU_METRIC), ("container", "main"), ("namespace", "ns"), ("pod", "a" * 70)], [(1.0, 1_700_000_000_000)])]
+        )
+        run = wire.index(b"a" * 70)
+
+        def literal(data: bytes) -> bytes:
+            if len(data) <= 60:
+                return bytes([(len(data) - 1) << 2]) + data
+            assert len(data) <= 256  # tag 60: one extra little-endian length byte
+            return bytes([60 << 2, len(data) - 1]) + data
+
+        # Overlapping copy: emit one 'a', then copy offset=1 len=69 —
+        # byte-at-a-time forward extension of the run.
+        head = wire[: run + 1]
+        tail = wire[run + 70 :]
+        # 2-byte-offset copies cap at length 64: 69 = 64 + 5.
+        two_byte_copies = (
+            bytes([((64 - 1) << 2) | 2]) + struct.pack("<H", 1)
+            + bytes([((5 - 1) << 2) | 2]) + struct.pack("<H", 1)
+        )
+        body = uvarint(len(wire)) + literal(head) + two_byte_copies + literal(tail)
+        ref = decode_remote_write_python(snappy_compress(wire))
+        assert _decoded_bytes(decode_remote_write_python(body)) == _decoded_bytes(ref)
+        native = decode_remote_write_native(body)
+        assert native is not None and _decoded_bytes(native) == _decoded_bytes(ref)
+
+        # 1-byte-offset copy (len 4-11, offset < 2048) and 4-byte-offset
+        # copy, splitting the same run: 1 literal 'a', overlap-copy 7 via
+        # tag 1, then the remaining 62 via a 4-byte-offset copy.
+        one_byte_copy = bytes([((7 - 4) << 2) | 1 | (0 << 5), 1])
+        four_byte_copy = bytes([((62 - 1) << 2) | 3]) + struct.pack("<I", 8)
+        body2 = uvarint(len(wire)) + literal(head) + one_byte_copy + four_byte_copy + literal(tail)
+        assert _decoded_bytes(decode_remote_write_python(body2)) == _decoded_bytes(ref)
+        native2 = decode_remote_write_native(body2)
+        assert native2 is not None and _decoded_bytes(native2) == _decoded_bytes(ref)
+
+
+# ------------------------------------------------------- malformed hardening
+class TestMalformedInput:
+    def _agree(self, body: bytes):
+        """Both decoders must agree: same tuple or both RemoteWriteError."""
+        outcomes = []
+        for fn in (decode_remote_write_python, decode_remote_write):
+            try:
+                outcomes.append(("ok", _decoded_bytes(fn(body))))
+            except RemoteWriteError as e:
+                outcomes.append(("err", type(e) is RemoteWriteTooLarge))
+        assert outcomes[0] == outcomes[1], f"decoders disagree on {body!r}"
+        return outcomes[0]
+
+    def test_every_truncation_rejected_or_agreed(self):
+        body = build_body(_sample_series())
+        for cut in range(len(body)):
+            self._agree(body[:cut])
+
+    def test_bitflips_never_crash(self):
+        body = build_body(_sample_series())
+        for pos in range(len(body)):
+            flipped = bytearray(body)
+            flipped[pos] ^= 0xFF
+            self._agree(bytes(flipped))
+
+    def test_oversized_preamble_is_too_large(self):
+        # 0xFF runs parse as a huge uvarint length preamble: the decoders
+        # must refuse to allocate, not try.
+        for fn in (decode_remote_write_python, decode_remote_write):
+            with pytest.raises(RemoteWriteTooLarge):
+                fn(b"\xff\xff\xff\xff\xff\xff garbage")
+
+    def test_decoded_cap_enforced(self):
+        body = build_body(_sample_series())
+        for fn in (decode_remote_write_python, decode_remote_write):
+            with pytest.raises(RemoteWriteTooLarge):
+                fn(body, 8)
+
+    def test_separator_bytes_inside_labels_rejected(self):
+        for poison in ("with\ttab", "with\nnewline"):
+            body = build_body([([("__name__", poison)], [(1.0, 0)])])
+            for fn in (decode_remote_write_python, decode_remote_write):
+                with pytest.raises(RemoteWriteError):
+                    fn(body)
+
+    def test_malformed_body_counted_not_buffered(self):
+        plane = IngestPlane()
+        with pytest.raises(RemoteWriteError):
+            plane.ingest_body(b"\x0bgarbage-not-snappy-framed")
+        stats = plane.stats()
+        assert stats["decode_errors_total"] == 1
+        assert stats["series"] == 0 and stats["buffered_samples"] == 0
+
+
+# ------------------------------------------------------------------- router
+class TestRouter:
+    def test_routes_and_rejections(self):
+        assert route_record(b"\t".join([b"__name__", CPU_METRIC.encode(), b"container", b"main", b"namespace", b"ns", b"pod", b"p"])) == ("cpu", "ns", "p", "main")
+        mem = [b"__name__", MEM_METRIC.encode(), b"container", b"main", b"image", b"img", b"job", b"kubelet", b"metrics_path", b"/metrics/cadvisor", b"namespace", b"ns", b"pod", b"p"]
+        assert route_record(b"\t".join(mem)) == ("mem", "ns", "p", "main")
+        assert route_record(b"\t".join([b"__name__", b"up"])) == "unknown_metric"
+        # cadvisor filters: wrong job, wrong path, empty image all drop.
+        for field, bad in ((b"kubelet", b"node"), (b"/metrics/cadvisor", b"/metrics"), (b"img", b"")):
+            rec = b"\t".join(bad if part == field else part for part in mem)
+            assert route_record(rec) == "filtered"
+        assert route_record(b"\t".join([b"__name__", CPU_METRIC.encode(), b"container", b"", b"namespace", b"ns", b"pod", b"p"])) == "missing_labels"
+        assert route_record(b"odd\tcount\tfields") == "malformed_labels"
+        assert route_record(b"\xff\xfe\tx") == "malformed_labels"
+
+
+# ----------------------------------------------------------------- the plane
+def _obj(name="web", namespace="default", pods=("web-0",)):
+    return K8sObjectData(
+        cluster="c", namespace=namespace, name=name, kind="Deployment", container="main",
+        pods=list(pods),
+        allocations=ResourceAllocations(
+            requests={ResourceType.CPU: None, ResourceType.Memory: None},
+            limits={ResourceType.CPU: None, ResourceType.Memory: None},
+        ),
+    )
+
+
+def _cpu_body(pod, samples, namespace="default", container="main"):
+    return build_body([(cpu_labels(namespace, pod, container), samples)])
+
+
+class TestIngestPlane:
+    def test_out_of_order_and_duplicates_dropped_with_counters(self):
+        plane = IngestPlane()
+        plane.ingest_body(_cpu_body("web-0", [(1.0, 1000), (2.0, 2000), (3.0, 2000), (4.0, 1500), (5.0, 3000)]))
+        stats = plane.stats()
+        assert stats["rejected"] == {DUPLICATE: 1, OUT_OF_ORDER: 1}
+        assert stats["samples_total"] == 3 and stats["buffered_samples"] == 3
+        series = plane._series[("cpu", "default", "web-0", "main")]
+        assert series.ts == [1000, 2000, 3000] and series.values == [1.0, 2.0, 5.0]
+
+    def test_nonfinite_tombstones_advance_watermark(self):
+        plane = IngestPlane()
+        plane.ingest_body(_cpu_body("web-0", [(1.0, 1000), (float("nan"), 2000), (float("inf"), 3000)]))
+        stats = plane.stats()
+        assert stats["tombstones_total"] == 2 and stats["buffered_samples"] == 1
+        series = plane._series[("cpu", "default", "web-0", "main")]
+        assert series.last_ts == 3000  # the stream is alive past the NaN
+
+    def test_unknown_label_sets_rejected_per_series(self):
+        plane = IngestPlane()
+        body = build_body([([("__name__", "up"), ("job", "x")], [(1.0, 1000), (1.0, 2000)])])
+        assert plane.ingest_body(body) == 0
+        assert plane.stats()["rejected"] == {"unknown_metric": 2}
+
+    def test_series_limit(self):
+        plane = IngestPlane(max_series=1)
+        plane.ingest_body(_cpu_body("web-0", [(1.0, 1000)]))
+        plane.ingest_body(_cpu_body("web-1", [(1.0, 1000)]))
+        stats = plane.stats()
+        assert stats["series"] == 1 and stats["rejected"] == {SERIES_LIMIT: 1}
+
+    def test_overflow_sheds_oldest_and_stays_honest(self):
+        plane = IngestPlane(max_samples_per_series=4)
+        samples = [(float(i), i * 60_000) for i in range(1, 7)]
+        plane.ingest_body(build_body([
+            (cpu_labels("default", "web-0", "main"), samples),
+            (mem_labels("default", "web-0", "main"), samples),
+        ]))
+        assert plane.stats()["rejected"] == {BUFFER_OVERFLOW: 4}
+        obj = _obj()
+        # Coverage truthfully starts at the SURVIVING oldest sample: a
+        # window reaching before it is not push-ready.
+        assert plane.push_ready(obj, 180.0, 360.0)
+        assert not plane.push_ready(obj, 120.0, 360.0)
+
+    def test_push_ready_needs_both_resources_every_pod(self):
+        plane = IngestPlane()
+        obj = _obj(pods=("web-0", "web-1"))
+        samples = [(1.0, 0), (1.0, 600_000)]
+        plane.ingest_body(build_body([(cpu_labels("default", "web-0", "main"), samples), (mem_labels("default", "web-0", "main"), samples)]))
+        assert not plane.push_ready(obj, 0.0, 600.0)  # web-1 missing
+        plane.ingest_body(build_body([(cpu_labels("default", "web-1", "main"), samples)]))
+        assert not plane.push_ready(obj, 0.0, 600.0)  # web-1 mem missing
+        plane.ingest_body(build_body([(mem_labels("default", "web-1", "main"), samples)]))
+        assert plane.push_ready(obj, 0.0, 600.0)
+        assert not plane.push_ready(obj, 0.0, 660.0)  # watermark short of end
+        assert plane.push_ready(_obj(name="empty", pods=()), 0.0, 600.0)  # vacuous
+
+    def test_fold_matches_direct_digest(self):
+        plane = IngestPlane()
+        rng = np.random.default_rng(3)
+        cpu = rng.gamma(2.0, 0.05, 11)
+        mem = rng.uniform(5e7, 2e8, 11)
+        series = [
+            (cpu_labels("default", "web-0", "main"), [(float(cpu[i]), i * 60_000) for i in range(11)]),
+            (mem_labels("default", "web-0", "main"), [(float(mem[i]), i * 60_000) for i in range(11)]),
+        ]
+        plane.ingest_body(build_body(series))
+        fleet = plane.fold_fleet([_obj()], [0], 0.0, 600.0, 60.0, 1.02, 1e-7, 256)
+        counts, total, peak = digest_samples(cpu, 1.02, 1e-7, 256)
+        assert np.array_equal(fleet.cpu_counts[0], counts)
+        assert fleet.cpu_total[0] == total and fleet.cpu_peak[0] == peak
+        assert fleet.mem_total[0] == 11.0 and fleet.mem_peak[0] == float(mem.max())
+
+    def test_prune_sheds_history_not_coverage(self):
+        plane = IngestPlane()
+        plane.ingest_body(_cpu_body("web-0", [(float(i), i * 60_000) for i in range(10)]))
+        assert plane.prune(300_000) == 5
+        assert plane.stats()["buffered_samples"] == 5
+        # joined_ms keeps the ORIGINAL join: completeness over already-
+        # covered history stays true (those windows folded before pruning).
+        assert plane.push_ready(_obj(), 540.0, 540.0) is False  # mem absent
+        assert plane._series[("cpu", "default", "web-0", "main")].joined_ms == 0
+
+    def test_freshness(self):
+        plane = IngestPlane()
+        assert plane.freshness_seconds(100.0) is None
+        plane.ingest_body(_cpu_body("web-0", [(1.0, 60_000)]))
+        assert plane.freshness_seconds(100.0) == pytest.approx(40.0)
+
+
+# --------------------------------------------------------- listener protocol
+async def _raw_request(port: int, raw: bytes) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(raw)
+    await writer.drain()
+    data = await reader.read(65536)
+    writer.close()
+    return data
+
+
+class TestListener:
+    def test_protocol_conformance(self):
+        async def main():
+            registry = MetricsRegistry()
+            plane = IngestPlane(metrics=registry)
+            listener = RemoteWriteListener(plane, host="127.0.0.1", port=0, max_body_bytes=4096, metrics=registry)
+            await listener.start()
+            try:
+                port = listener.port
+                good = _cpu_body("web-0", [(1.0, 1000), (2.0, 2000)])
+                assert await post_body(port, good) == 204
+                assert plane.stats()["samples_total"] == 2
+                assert registry.value("krr_tpu_ingest_requests_total", code="204") == 1
+                assert registry.value("krr_tpu_ingest_samples_total") == 2
+
+                # Wrong path / wrong method.
+                assert await post_body(port, good, path="/nope") == 404
+                assert (await _raw_request(port, b"GET /api/v1/write HTTP/1.1\r\nHost: x\r\n\r\n")).startswith(b"HTTP/1.1 405")
+                # Missing Content-Length.
+                assert (await _raw_request(port, b"POST /api/v1/write HTTP/1.1\r\nHost: x\r\n\r\n")).startswith(b"HTTP/1.1 411")
+                # Declared body over the cap: refused BEFORE reading it.
+                assert (await _raw_request(port, b"POST /api/v1/write HTTP/1.1\r\nContent-Length: 999999\r\n\r\n")).startswith(b"HTTP/1.1 413")
+                # Valid snappy framing over garbage protobuf: 400.
+                assert await post_body(port, snappy_compress(b"\x99\x98\x97 not protobuf")) == 400
+                # 0xff garbage parses as a huge snappy preamble: 413.
+                assert await post_body(port, b"\xff\xff\xff\xff\xff garbage") == 413
+                assert registry.value("krr_tpu_ingest_requests_total", code="400") >= 1
+                assert registry.value("krr_tpu_ingest_requests_total", code="413") >= 1
+
+                # Keep-alive: two POSTs down one connection both answered.
+                body = good
+                req = (
+                    f"POST /api/v1/write HTTP/1.1\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body
+                )
+                data = await _raw_request(port, req + req)
+                assert data.count(b"HTTP/1.1 204") == 2
+                # The listener survives all of the above.
+                assert await post_body(port, good) == 204
+            finally:
+                await listener.stop()
+
+        asyncio.run(main())
+
+
+# ----------------------------------------------------- e2e: push serve stack
+def _build_env(tmp_path_factory, tag: str, series: dict):
+    cluster = FakeCluster()
+    metrics = FakeMetrics()
+    metrics.enforce_range = True
+    web_pods = cluster.add_workload_with_pods("Deployment", "web", "default", pod_count=2)
+    db_pods = cluster.add_workload_with_pods("StatefulSet", "db", "prod", pod_count=1)
+    for pod in web_pods:
+        cpu, mem = series[("default", pod)]
+        metrics.set_series("default", "main", pod, cpu=cpu, memory=mem)
+    for pod in db_pods:
+        cpu, mem = series[("prod", pod)]
+        metrics.set_series("prod", "main", pod, cpu=cpu, memory=mem)
+    server = ServerThread(FakeBackend(cluster, metrics)).start()
+    kubeconfig = tmp_path_factory.mktemp(tag) / "config"
+    kubeconfig.write_text(yaml.dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "fake", "user": "fake"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": server.url}}],
+        "users": [{"name": "fake", "user": {"token": "t"}}],
+    }))
+    return {"server": server, "cluster": cluster, "metrics": metrics, "kubeconfig": str(kubeconfig)}
+
+
+@pytest.fixture(scope="module")
+def push_pull_envs(tmp_path_factory):
+    """Two hermetic serve stacks over BYTE-IDENTICAL series: the push stack
+    under test and its pull control."""
+    rng = np.random.default_rng(4242)
+    series = {}
+    for ns, pod, scale in (("default", "web-0", 0.05), ("default", "web-1", 0.05), ("prod", "db-0", 0.2)):
+        series[(ns, pod)] = (rng.gamma(2.0, scale, 180), rng.uniform(5e7, 4e8, 180))
+    push = _build_env(tmp_path_factory, "push", series)
+    pull = _build_env(tmp_path_factory, "pull", series)
+    yield {"push": push, "pull": pull}
+    push["server"].stop()
+    pull["server"].stop()
+
+
+def _config(env, **overrides) -> Config:
+    defaults = dict(
+        kubeconfig=env["kubeconfig"],
+        prometheus_url=env["server"].url,
+        strategy="tdigest",
+        quiet=True,
+        server_port=0,
+        prometheus_breaker_cooldown_seconds=0.02,
+        hysteresis_enabled=False,
+        other_args={"history_duration": 1, "timeframe_duration": 1},
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+async def _get(port: int, path: str):
+    import httpx
+
+    async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+        return await client.get(path)
+
+
+async def _recs(port: int) -> dict:
+    r = await _get(port, "/recommendations")
+    assert r.status_code == 200
+    return r.json()
+
+
+def _assert_stores_bit_identical(push_store, pull_store):
+    assert np.array_equal(push_store.cpu_counts, pull_store.cpu_counts)
+    assert np.array_equal(push_store.cpu_total, pull_store.cpu_total)
+    assert np.array_equal(push_store.cpu_peak, pull_store.cpu_peak)
+    assert np.array_equal(push_store.mem_total, pull_store.mem_total)
+    assert np.array_equal(push_store.mem_peak, pull_store.mem_peak)
+
+
+class TestPushServe:
+    def test_push_bitexact_zero_queries_and_posture(self, push_pull_envs):
+        """The acceptance gate: seed tick ranges on both stacks; a push-fed
+        delta tick folds from the listener's buffered samples, audits clean
+        against the range control, publishes bit-identically to the pull
+        stack — and the NEXT steady-state push tick issues zero range
+        queries while staying bit-exact."""
+        push_env, pull_env = push_pull_envs["push"], push_pull_envs["pull"]
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            push_ks = KrrServer(
+                _config(push_env, metrics_mode="push", ingest_port=0, ingest_verify_interval_seconds=1e9),
+                clock=lambda: now[0],
+            )
+            pull_ks = KrrServer(_config(pull_env), clock=lambda: now[0])
+            await push_ks.start(run_scheduler=False)
+            await pull_ks.start(run_scheduler=False)
+            try:
+                assert push_ks.ingest_listener is not None and push_ks.ingest_listener.port > 0
+                sender = RemoteWriteSender(push_env["metrics"])
+                ingest_port = push_ks.ingest_listener.port
+
+                # Seed: both stacks range-fetch the full window.
+                assert await push_ks.scheduler.tick()
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+
+                # Delta window [3660, 4200] = grid indices 61..70. Stream it
+                # through remote-write; the tick folds it WITHOUT fetching
+                # (the one range round here is the first audit's control).
+                now[0] = ORIGIN + 4200.0
+                assert await sender.push(ingest_port, 61, 70) == 204
+                assert await push_ks.scheduler.tick()
+                ingest = push_ks.scheduler.last_tick_stats["ingest"]
+                assert ingest["push_objects"] == 2
+                assert ingest["verify"] == {"audited": 2, "divergent": 0}
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+                _assert_stores_bit_identical(push_ks.state.store, pull_ks.state.store)
+
+                # Steady state: the audit already ran, so this tick is pure
+                # push — the fake Prometheus sees ZERO new requests.
+                now[0] = ORIGIN + 4800.0
+                assert await sender.push(ingest_port, 71, 80) == 204
+                before = push_env["metrics"].request_count
+                assert await push_ks.scheduler.tick()
+                assert push_env["metrics"].request_count == before, "steady-state push tick issued range queries"
+                ingest = push_ks.scheduler.last_tick_stats["ingest"]
+                assert ingest["push_objects"] == 2 and ingest["verify"] is None
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+                _assert_stores_bit_identical(push_ks.state.store, pull_ks.state.store)
+
+                # Posture: /healthz + /statusz + /metrics + timeline carry
+                # the ingest plane's state.
+                health = (await _get(push_ks.port, "/healthz")).json()
+                assert health["ingest"]["mode"] == "push"
+                assert health["ingest"]["port"] == ingest_port
+                assert health["ingest"]["push_objects"] == 2
+                statusz = (await _get(push_ks.port, "/statusz")).json()
+                assert statusz["server"]["ingest"]["mode"] == "push"
+                metrics_text = (await _get(push_ks.port, "/metrics")).text
+                assert "krr_tpu_ingest_push_objects_total" in metrics_text
+                assert "krr_tpu_ingest_freshness_seconds" in metrics_text
+                # Timeline record carries the ingest block (records append
+                # on the run_once loop; the tests drive tick() directly, so
+                # pin the record-building seam itself).
+                from krr_tpu.obs.timeline import build_scan_record
+
+                record = build_scan_record(None, push_ks.scheduler.last_tick_stats)
+                assert record["ingest"]["mode"] == "push"
+                assert record["ingest"]["push_objects"] == 2
+
+                pull_health = (await _get(pull_ks.port, "/healthz")).json()
+                assert pull_health["ingest"]["mode"] == "pull"
+            finally:
+                await push_ks.shutdown()
+                await pull_ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_gap_falls_back_to_range_and_stays_bitexact(self, push_pull_envs):
+        """A listener outage (nothing pushed) must NOT stall or skew the
+        scan: the watermarks flag the gap, the tick range-fetches as usual,
+        and a later resumed push window folds bit-exact again. A PARTIAL
+        gap (one workload pushed, one not) splits the legs."""
+        push_env, pull_env = push_pull_envs["push"], push_pull_envs["pull"]
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            push_ks = KrrServer(
+                _config(push_env, metrics_mode="push", ingest_port=0, ingest_verify_interval_seconds=1e9),
+                clock=lambda: now[0],
+            )
+            pull_ks = KrrServer(_config(pull_env), clock=lambda: now[0])
+            await push_ks.start(run_scheduler=False)
+            await pull_ks.start(run_scheduler=False)
+            try:
+                sender = RemoteWriteSender(push_env["metrics"])
+                ingest_port = push_ks.ingest_listener.port
+                assert await push_ks.scheduler.tick() and await pull_ks.scheduler.tick()
+
+                # Gap: nothing pushed — every object falls back to range.
+                now[0] = ORIGIN + 4200.0
+                before = push_env["metrics"].request_count
+                assert await push_ks.scheduler.tick()
+                assert push_env["metrics"].request_count > before
+                assert push_ks.scheduler.last_tick_stats["ingest"]["push_objects"] == 0
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+
+                # Partial gap: only the default-namespace series push the
+                # next window; prod/db stays on the range leg.
+                sub = FakeMetrics()
+                sub.series = {k: v for k, v in push_env["metrics"].series.items() if k[0] == "default"}
+                now[0] = ORIGIN + 4800.0
+                assert await RemoteWriteSender(sub).push(ingest_port, 71, 80) == 204
+                assert await push_ks.scheduler.tick()
+                assert push_ks.scheduler.last_tick_stats["ingest"]["push_objects"] == 1
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+
+                # Resume: the full fleet pushes, range path goes quiet again.
+                now[0] = ORIGIN + 5400.0
+                assert await sender.push(ingest_port, 81, 90) == 204
+                before = push_env["metrics"].request_count
+                assert await push_ks.scheduler.tick()
+                assert push_env["metrics"].request_count == before
+                assert push_ks.scheduler.last_tick_stats["ingest"]["push_objects"] == 2
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+                _assert_stores_bit_identical(push_ks.state.store, pull_ks.state.store)
+            finally:
+                await push_ks.shutdown()
+                await pull_ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_audit_counts_and_repairs_divergence(self, push_pull_envs):
+        """Poison one buffered series after the samples land: the
+        ``--ingest-verify-interval`` audit must catch the drift against the
+        range-fetched control, count it, publish the GROUND TRUTH (so the
+        poisoned fold never reaches a result), and invalidate the buffers
+        so the next window range-backfills."""
+        push_env, pull_env = push_pull_envs["push"], push_pull_envs["pull"]
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            push_ks = KrrServer(
+                _config(push_env, metrics_mode="push", ingest_port=0, ingest_verify_interval_seconds=1e-6),
+                clock=lambda: now[0],
+            )
+            pull_ks = KrrServer(_config(pull_env), clock=lambda: now[0])
+            await push_ks.start(run_scheduler=False)
+            await pull_ks.start(run_scheduler=False)
+            try:
+                sender = RemoteWriteSender(push_env["metrics"])
+                ingest_port = push_ks.ingest_listener.port
+                assert await push_ks.scheduler.tick() and await pull_ks.scheduler.tick()
+
+                now[0] = ORIGIN + 4200.0
+                assert await sender.push(ingest_port, 61, 70) == 204
+                # Poison the db cpu buffer: every sample doubled.
+                series = push_ks.ingest._series[("cpu", "prod", "db-0", "main")]
+                series.values = [v * 2.0 for v in series.values]
+                series_count_before = push_ks.ingest.stats()["series"]
+
+                assert await push_ks.scheduler.tick()
+                ingest = push_ks.scheduler.last_tick_stats["ingest"]
+                assert ingest["verify"] == {"audited": 2, "divergent": 1}
+                assert push_ks.state.metrics.value("krr_tpu_ingest_verify_divergences_total") == 1
+                # Published result is the repaired ground truth.
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+                _assert_stores_bit_identical(push_ks.state.store, pull_ks.state.store)
+                # The diverged object's buffers dropped (both resources).
+                assert push_ks.ingest.stats()["series"] == series_count_before - 2
+                assert ("cpu", "prod", "db-0", "main") not in push_ks.ingest._series
+
+                # Next window: only web pushes — db (buffers invalidated,
+                # nothing new sent) range-backfills, and everything stays
+                # bit-exact.
+                sub = FakeMetrics()
+                sub.series = {k: v for k, v in push_env["metrics"].series.items() if k[0] == "default"}
+                now[0] = ORIGIN + 4800.0
+                assert await RemoteWriteSender(sub).push(ingest_port, 71, 80) == 204
+                assert await push_ks.scheduler.tick()
+                assert push_ks.scheduler.last_tick_stats["ingest"]["push_objects"] == 1
+                assert await pull_ks.scheduler.tick()
+                assert await _recs(push_ks.port) == await _recs(pull_ks.port)
+                _assert_stores_bit_identical(push_ks.state.store, pull_ks.state.store)
+            finally:
+                await push_ks.shutdown()
+                await pull_ks.shutdown()
+
+        asyncio.run(main())
+
+    def test_rejected_samples_surface_on_exposition(self, push_pull_envs):
+        """Out-of-order pushes and unroutable series land on the rejected
+        counter, visible on the push server's own /metrics."""
+        push_env = push_pull_envs["push"]
+
+        async def main():
+            now = [ORIGIN + 3600.0]
+            ks = KrrServer(
+                _config(push_env, metrics_mode="push", ingest_port=0),
+                clock=lambda: now[0],
+            )
+            await ks.start(run_scheduler=False)
+            try:
+                port = ks.ingest_listener.port
+                body = build_body([
+                    (cpu_labels("default", "web-0", "main"), [(1.0, 2_000_000), (1.0, 1_000_000)]),
+                    ([("__name__", "up")], [(1.0, 1_000_000)]),
+                ])
+                assert await post_body(port, body) == 204
+                text = (await _get(ks.port, "/metrics")).text
+                assert 'krr_tpu_ingest_rejected_samples_total{reason="out_of_order"} 1' in text
+                assert 'krr_tpu_ingest_rejected_samples_total{reason="unknown_metric"} 1' in text
+                assert "krr_tpu_ingest_requests_total" in text
+            finally:
+                await ks.shutdown()
+
+        asyncio.run(main())
