@@ -83,7 +83,14 @@ concurrent keep-alive readers against a live serve during scan ticks,
 gated on steady-state cache hit rate, zero-render 304s, pushdown
 bit-exactness, LRU bounds, and the cached-vs-uncached RPS ratio, carried
 under ``secondary.readpath_*`` with a round-over-round p99 gate in
-``readpath_regression_vs_previous``). The
+``readpath_regression_vs_previous``), BENCH_SKIP_HA, BENCH_HA_TICKS
+(default 4), BENCH_HA_WORKLOADS (default 2), BENCH_HA_CLIENTS (default 4),
+BENCH_HA_REQUESTS (default 40 — the HA/replica leg: a 2-node
+consistent-hash ring with a primary|standby aggregator pair, a mid-soak
+primary kill plus duplicate injection, and a read replica subscribed to
+the epoch feed, gated on merged-view bit-exactness vs the single-process
+control, zero lost epochs with exactly-once apply, and replica RPS within
+10% of its source, carried under ``secondary.ha_*``). The
 e2e leg runs `bench_e2e.py` in a subprocess with BENCH_E2E_CONTAINERS
 defaulted to 10000 (fleet scale) unless already set.
 
@@ -179,6 +186,13 @@ SMOKE_DEFAULTS = {
     "BENCH_FED_SHARDS": "3",
     "BENCH_FED_TICKS": "4",
     "BENCH_FED_WORKLOADS": "2",
+    # HA leg: 2-node ring (primary|standby pair + single) with a mid-soak
+    # primary kill, duplicate injection, and a read replica (bit-exactness,
+    # zero-lost-epochs, replica RPS scaling gates), toy-sized.
+    "BENCH_HA_TICKS": "4",
+    "BENCH_HA_WORKLOADS": "2",
+    "BENCH_HA_CLIENTS": "2",
+    "BENCH_HA_REQUESTS": "16",
     # Read-path leg: concurrent keep-alive readers against a live serve
     # (cache hit rate, 304 zero-render, pushdown bit-exactness, LRU bound,
     # cached-vs-uncached RPS), toy-sized but every gate EXECUTED.
@@ -191,6 +205,46 @@ SMOKE_DEFAULTS = {
     "BENCH_INGEST_WORKLOADS": "24",
     "BENCH_INGEST_ROUNDS": "3",
 }
+
+
+class KeepAliveReader:
+    """Minimal keep-alive HTTP/1.1 client — dependency-free and thin, so
+    read-path measurements read the SERVER, not a client library. Shared by
+    the readpath and HA legs (the replica-vs-primary RPS comparison must use
+    the identical client on both sides)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.reader = self.writer = None
+
+    async def connect(self):
+        import asyncio
+
+        self.reader, self.writer = await asyncio.open_connection("127.0.0.1", self.port)
+
+    async def get(self, target: str, headers: "tuple[tuple[str, str], ...]" = ()):
+        request = f"GET {target} HTTP/1.1\r\nHost: bench\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in headers
+        ) + "\r\n"
+        start = time.perf_counter()
+        self.writer.write(request.encode())
+        await self.writer.drain()
+        status_line = await self.reader.readline()
+        status = int(status_line.split()[1])
+        response_headers: dict[str, str] = {}
+        while True:
+            line = await self.reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            response_headers[name.strip().lower()] = value.strip()
+        length = int(response_headers.get("content-length") or 0)
+        body = await self.reader.readexactly(length) if length else b""
+        return status, response_headers, body, time.perf_counter() - start
+
+    async def close(self):
+        if self.writer is not None:
+            self.writer.close()
 
 
 def journal_leg(secondary: dict) -> None:
@@ -1375,6 +1429,363 @@ def federation_leg(secondary: dict, check) -> None:
     )
 
 
+
+def ha_leg(secondary: dict, check) -> None:
+    """HA aggregation + read-replica gates (`krr_tpu.federation.ring` /
+    `krr_tpu.federation.replica`): a 2-node consistent-hash ring — node
+    ``a0`` an HA primary|standby pair sharing the replicated delta-WAL
+    stream, node ``a1`` a single aggregator — fed by one shard per
+    cluster, plus one stateless read replica subscribed to ``a1``'s epoch
+    feed. The soak kills ``a0``'s primary mid-run and force-feeds the
+    standby a duplicate record (disconnect after enqueue, before the
+    aggregate tick acks) to exercise the exactly-once watermark. Gates:
+
+    * ``ha_bitexact`` — the union of the surviving aggregators' stores
+      and served response scans is bit-identical, per key, to a
+      single-process control over the same fleet;
+    * ``ha_failover_zero_lost_epochs`` — after the kill, every shard
+      epoch is acked and applied exactly once at the survivors, with the
+      injected duplicate COUNTED (never double-applied: bit-exactness
+      above would fail);
+    * ``replica_rps_scaling`` — the replica serves the identical bytes
+      at >= 90% of its source aggregator's RPS under the same keep-alive
+      client mix, so N replicas multiply read capacity.
+
+    Trended under ``secondary.ha_*``: tick count, duplicate count,
+    replica/primary RPS and their ratio.
+    """
+    import asyncio
+    import time as _time
+
+    import numpy as np
+
+    from krr_tpu.core.runner import ScanSession
+    from krr_tpu.core.config import Config
+    from krr_tpu.federation.replica import ReplicaServer
+    from krr_tpu.federation.shard import FederatedShard
+    from krr_tpu.server.app import KrrServer
+    from tests.fakes.federation import (
+        FleetInventory,
+        MultiClusterFleet,
+        ORIGIN,
+        history_factory,
+    )
+
+    ticks = max(3, int(os.environ.get("BENCH_HA_TICKS", 4)))
+    workloads = max(1, int(os.environ.get("BENCH_HA_WORKLOADS", 2)))
+    clients = max(2, int(os.environ.get("BENCH_HA_CLIENTS", 4)))
+    requests_per_client = max(8, int(os.environ.get("BENCH_HA_REQUESTS", 40)))
+    tick_seconds = 300.0
+    start = ORIGIN + 3600.0
+    fleet = MultiClusterFleet(
+        clusters=2,
+        namespaces_per_cluster=2,
+        workloads_per_namespace=workloads,
+        seed=59,
+    )
+
+    def config(**overrides) -> Config:
+        defaults = dict(
+            strategy="tdigest",
+            quiet=True,
+            server_port=0,
+            scan_interval_seconds=tick_seconds,
+            hysteresis_enabled=False,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        defaults.update(overrides)
+        return Config(**defaults)
+
+    def scans_by_key(state) -> dict:
+        body = json.loads(state.peek().body_json.decode())
+        return {
+            "{cluster}/{namespace}/{name}/{container}/{kind}".format(**scan["object"]): scan
+            for scan in body["scans"]
+        }
+
+    async def run() -> dict:
+        now = [start]
+
+        def aggregator() -> KrrServer:
+            return KrrServer(
+                config(federation_listen="127.0.0.1:0"),
+                session=ScanSession(
+                    config(),
+                    inventory=FleetInventory(fleet, clusters=[]),
+                    history_factory=history_factory(fleet),
+                ),
+                clock=lambda: now[0],
+            )
+
+        # Single-process control over the whole fleet.
+        control = KrrServer(
+            config(),
+            session=ScanSession(
+                config(),
+                inventory=FleetInventory(fleet),
+                history_factory=history_factory(fleet),
+            ),
+            clock=lambda: now[0],
+        )
+        for t in range(ticks):
+            now[0] = start + t * tick_seconds
+            assert await control.scheduler.run_once()
+
+        now[0] = start
+        primary, standby, single = aggregator(), aggregator(), aggregator()
+        for server in (primary, standby, single):
+            await server.start(run_scheduler=False)
+        ring_spec = (
+            f"a0=127.0.0.1:{primary.aggregator.port}|127.0.0.1:{standby.aggregator.port},"
+            f"a1=127.0.0.1:{single.aggregator.port}"
+        )
+        shards = [
+            FederatedShard(
+                config(clusters=[c], federation_ring=ring_spec),
+                session=ScanSession(
+                    config(clusters=[c]),
+                    inventory=FleetInventory(fleet, clusters=[c]),
+                    history_factory=history_factory(fleet),
+                ),
+                clock=lambda: now[0],
+                shard_id=c,
+            )
+            for c in fleet.clusters
+        ]
+        replica = ReplicaServer(
+            config(
+                federation_aggregator=f"127.0.0.1:{single.aggregator.port}",
+                federation_shard_id="bench-replica",
+            ),
+            clock=lambda: now[0],
+        )
+        await replica.start()
+        primary_dead = [False]
+
+        async def wait(predicate, message, timeout=30.0):
+            deadline = _time.monotonic() + timeout
+            while not predicate():
+                assert _time.monotonic() < deadline, f"ha: timed out waiting for {message}"
+                await asyncio.sleep(0.01)
+
+        def live_servers():
+            return [standby, single] if primary_dead[0] else [primary, standby, single]
+
+        async def ring_round(t: int) -> None:
+            now[0] = start + t * tick_seconds
+            for shard in shards:
+                assert await shard.tick(now[0])
+            by_port = {s.aggregator.port: s for s in live_servers()}
+
+            def enqueued() -> bool:
+                for shard in shards:
+                    for uplink in shard._uplinks:
+                        server = by_port.get(uplink.port)
+                        if server is None:
+                            continue  # the killed primary
+                        status = server.aggregator._shards.get(uplink.stream_id)
+                        if status is None or status.enqueued < shard.epoch:
+                            return False
+                return True
+
+            await wait(enqueued, f"tick {t} records to enqueue everywhere")
+            for server in live_servers():
+                assert await server.scheduler.run_once()
+            for shard in shards:
+                for uplink in shard._uplinks:
+                    if uplink.port in by_port:
+                        await wait(
+                            lambda u=uplink, s=shard: u.acked >= s.epoch,
+                            f"tick {t} acks",
+                        )
+
+        try:
+            await ring_round(0)
+
+            # Duplicate injection: tick, wait for the standby to ENQUEUE the
+            # epoch-2 records, then tear its connections before the aggregate
+            # tick acks them. The reconnect's WELCOME reports the APPLIED
+            # watermark (1), so the shard re-sends epoch 2 — which the standby
+            # must count as a duplicate and never double-apply.
+            now[0] = start + 1 * tick_seconds
+            for shard in shards:
+                assert await shard.tick(now[0])
+            await wait(
+                lambda: all(
+                    server.aggregator._shards.get(f"{s.shard_id}/{node}") is not None
+                    and server.aggregator._shards[f"{s.shard_id}/{node}"].enqueued >= s.epoch
+                    for s in shards
+                    for server, node in ((primary, "a0"), (standby, "a0"), (single, "a1"))
+                ),
+                "tick 2 records to enqueue before the tear",
+            )
+            for shard in shards:
+                shard._node_uplinks["a0"][1]._disconnect()
+                await shard._pump()
+            await wait(
+                lambda: sum(s.duplicates for s in standby.aggregator._shards.values())
+                >= len(shards),
+                "re-sent records to count as duplicates",
+            )
+            for server in (primary, standby, single):
+                assert await server.scheduler.run_once()
+            for shard in shards:
+                assert await shard.wait_acked(shard.epoch, timeout=10.0)
+            duplicates = int(
+                sum(s.duplicates for s in standby.aggregator._shards.values())
+            )
+
+            # Kill the HA pair's primary; the soak continues on the standby.
+            await primary.shutdown()
+            primary_dead[0] = True
+            for t in range(2, ticks):
+                await ring_round(t)
+
+            # Gate 1: union of the surviving ring stores + served scans is
+            # bit-exact, per key, against the single-process control.
+            control_store = control.state.store
+            control_index = {k: i for i, k in enumerate(control_store.keys)}
+            arrays = ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak")
+            merged_keys: list = []
+            bitexact, detail = True, ""
+            for server in (standby, single):
+                store = server.state.store
+                for i, key in enumerate(store.keys):
+                    merged_keys.append(key)
+                    j = control_index.get(key)
+                    if j is None:
+                        bitexact, detail = False, f"unexpected key {key}"
+                        continue
+                    for attr in arrays:
+                        if not np.array_equal(
+                            getattr(store, attr)[i], getattr(control_store, attr)[j]
+                        ):
+                            bitexact, detail = False, f"{attr} differs at {key}"
+            if sorted(merged_keys) != sorted(control_store.keys):
+                bitexact, detail = False, "merged ring keys != control keys"
+            control_scans = scans_by_key(control.state)
+            served: dict = {}
+            for server in (standby, single):
+                served.update(scans_by_key(server.state))
+            if served != control_scans:
+                bitexact, detail = False, "served response scans != control scans"
+
+            # Gate 2: zero lost epochs, exactly-once apply at the survivors.
+            survivor_ports = {standby.aggregator.port, single.aggregator.port}
+            lost = [
+                (uplink.stream_id, uplink.port, uplink.acked, shard.epoch)
+                for shard in shards
+                for uplink in shard._uplinks
+                if uplink.port in survivor_ports and uplink.acked != shard.epoch
+            ]
+            applied_ok = all(
+                s.applied == ticks
+                for server in (standby, single)
+                for s in server.aggregator._shards.values()
+            )
+
+            # Gate 3: replica converges on the source's published epoch and
+            # serves byte-identical bodies at matching RPS.
+            await wait(
+                lambda: replica.state.publish_epoch == single.state.publish_epoch
+                and replica.state.publish_epoch > 0,
+                "replica to converge on the source epoch",
+            )
+
+            async def one_get(port: int):
+                reader = KeepAliveReader(port)
+                await reader.connect()
+                try:
+                    return await reader.get("/recommendations")
+                finally:
+                    await reader.close()
+
+            src_status, src_headers, src_body, _ = await one_get(single.port)
+            rep_status, rep_headers, rep_body, _ = await one_get(replica.port)
+            replica_identical = (
+                src_status == rep_status == 200
+                and src_body == rep_body
+                and src_headers.get("etag") == rep_headers.get("etag")
+                and src_headers.get("x-krr-epoch") == rep_headers.get("x-krr-epoch")
+            )
+
+            async def measure_rps(port: int) -> float:
+                readers = [KeepAliveReader(port) for _ in range(clients)]
+                for r in readers:
+                    await r.connect()
+                latencies: list = []
+
+                async def worker(r) -> None:
+                    for _ in range(requests_per_client):
+                        status, _headers, body, latency = await r.get("/recommendations")
+                        assert status == 200 and body, f"ha reader got {status}"
+                        latencies.append(latency)
+
+                begun = _time.perf_counter()
+                await asyncio.gather(*(worker(r) for r in readers))
+                wall = _time.perf_counter() - begun
+                for r in readers:
+                    await r.close()
+                return len(latencies) / max(wall, 1e-9)
+
+            # Interleave best-of-two on each side to damp scheduler noise —
+            # the gate compares the two, not an absolute throughput.
+            primary_rps = max(await measure_rps(single.port), await measure_rps(single.port))
+            replica_rps = max(await measure_rps(replica.port), await measure_rps(replica.port))
+
+            return {
+                "bitexact": bitexact,
+                "detail": detail,
+                "duplicates": duplicates,
+                "lost": lost,
+                "applied_ok": applied_ok,
+                "replica_identical": replica_identical,
+                "primary_rps": primary_rps,
+                "replica_rps": replica_rps,
+                "rows": len(control_store.keys),
+            }
+        finally:
+            for shard in shards:
+                await shard.close()
+            await replica.shutdown()
+            for server in (primary, standby, single):
+                await server.shutdown()
+            await control.shutdown()
+
+    report = asyncio.run(run())
+    ratio = report["replica_rps"] / max(report["primary_rps"], 1e-9)
+    secondary["ha_ticks"] = float(ticks)
+    secondary["ha_rows"] = float(report["rows"])
+    secondary["ha_duplicates"] = float(report["duplicates"])
+    secondary["ha_primary_rps"] = round(report["primary_rps"], 1)
+    secondary["ha_replica_rps"] = round(report["replica_rps"], 1)
+    secondary["ha_replica_rps_ratio"] = round(ratio, 3)
+    secondary["ha_bitexact"] = 1.0 if report["bitexact"] else 0.0
+    secondary["ha_failover_zero_lost_epochs"] = (
+        1.0 if not report["lost"] and report["applied_ok"] else 0.0
+    )
+    print(
+        f"bench: ha 2-node ring x {ticks} ticks -> primary killed, "
+        f"{report['duplicates']} duplicate(s) absorbed, merged bit-exact: "
+        f"{report['bitexact']}; replica {report['replica_rps']:.0f} rps vs "
+        f"source {report['primary_rps']:.0f} rps (ratio {ratio:.2f})",
+        file=sys.stderr,
+    )
+    check("ha_bitexact", report["bitexact"], report["detail"])
+    check(
+        "ha_failover_zero_lost_epochs",
+        not report["lost"] and report["applied_ok"] and report["duplicates"] >= 2,
+        f"lost={report['lost']}, applied_ok={report['applied_ok']}, "
+        f"duplicates={report['duplicates']}",
+    )
+    check(
+        "replica_rps_scaling",
+        report["replica_identical"] and ratio >= 0.9,
+        f"identical={report['replica_identical']}, replica={report['replica_rps']:.0f} "
+        f"rps, source={report['primary_rps']:.0f} rps, ratio={ratio:.2f}",
+    )
+
+
 def readpath_leg(secondary: dict, check) -> None:
     """High-QPS read-path loadtest (`krr_tpu.server.state.ResponseCache` +
     the app's conditional-GET / pushdown / bounded-render machinery):
@@ -1469,40 +1880,7 @@ def readpath_leg(secondary: dict, check) -> None:
         )
         return KrrServer(config, session=session, clock=lambda: now[0])
 
-    class Reader:
-        """Minimal keep-alive HTTP/1.1 client — dependency-free and thin,
-        so the measurement reads the SERVER, not a client library."""
-
-        def __init__(self, port: int):
-            self.port = port
-            self.reader = self.writer = None
-
-        async def connect(self):
-            self.reader, self.writer = await asyncio.open_connection("127.0.0.1", self.port)
-
-        async def get(self, target: str, headers: "tuple[tuple[str, str], ...]" = ()):
-            request = f"GET {target} HTTP/1.1\r\nHost: bench\r\n" + "".join(
-                f"{k}: {v}\r\n" for k, v in headers
-            ) + "\r\n"
-            start = time.perf_counter()
-            self.writer.write(request.encode())
-            await self.writer.drain()
-            status_line = await self.reader.readline()
-            status = int(status_line.split()[1])
-            response_headers: dict[str, str] = {}
-            while True:
-                line = await self.reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                name, _, value = line.decode("latin-1").partition(":")
-                response_headers[name.strip().lower()] = value.strip()
-            length = int(response_headers.get("content-length") or 0)
-            body = await self.reader.readexactly(length) if length else b""
-            return status, response_headers, body, time.perf_counter() - start
-
-        async def close(self):
-            if self.writer is not None:
-                self.writer.close()
+    Reader = KeepAliveReader
 
     GZIP = (("Accept-Encoding", "gzip"),)
 
@@ -2476,6 +2854,14 @@ def main() -> None:
         # vs the single-process control, aggregate fold cost and delta wire
         # bytes trended.
         federation_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_HA"):
+        # HA + replica gates: key-range partitioned ring with a standby
+        # takeover and duplicate injection (merged view bit-exact vs the
+        # single-process control, zero lost epochs, exactly-once apply),
+        # plus a read replica serving byte-identical responses at >= 90%
+        # of its source aggregator's RPS.
+        ha_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_READPATH"):
         # Read-path gates: concurrent keep-alive readers against a live

@@ -406,6 +406,25 @@ class Config(pd.BaseModel):
     #: one snapshot record (bounded memory through an aggregator outage
     #: of any length).
     federation_queue_records: int = pd.Field(4096, ge=1)
+    #: Key-range partitioned aggregation plane
+    #: (`krr_tpu.federation.ring`): ``name=host:port[|host:port...],...``
+    #: names each aggregator and its endpoint(s) — a shard splits every
+    #: tick's delta record by consistent-hash key owner and streams each
+    #: partition to its owning aggregator; a node listing extra endpoints
+    #: replicates its stream to standbys (HA failover with zero lost
+    #: epochs). Mutually exclusive with ``federation_aggregator`` on a
+    #: shard (the ring subsumes the single-aggregator case).
+    federation_ring: Optional[str] = None
+    #: Ceiling on the federation reconnect backoff ladder (uplinks AND
+    #: replica feeds): waits grow 0.25·2^(n−1) seconds, capped here before
+    #: ±50% jitter — the same retry semantics as
+    #: ``prometheus_backoff_cap_seconds``.
+    federation_backoff_cap_seconds: float = pd.Field(5.0, gt=0)
+    #: ``host:port`` of a HIGHER-tier aggregator this serve process
+    #: uplinks its OWN store's deltas to (requires ``federation_listen``):
+    #: region aggregators uplink to a global one over the same shard
+    #: protocol, so the tiers compose without a second wire format.
+    federation_uplink: Optional[str] = None
 
     #: One-shot recovery flag for ``--fetch-downsample`` over a persisted
     #: window cursor that predates the flag (unaligned grid): drop the

@@ -47,8 +47,10 @@ from krr_tpu.core.durastore import apply_ops, decode_ops
 from krr_tpu.core.streaming import object_key
 from krr_tpu.federation.protocol import (
     FED_MAGIC,
+    FRAME_OVERHEAD,
     MSG_ACK,
     MSG_DELTA,
+    MSG_EPOCH,
     MSG_HELLO,
     MSG_INVENTORY,
     MSG_WELCOME,
@@ -57,6 +59,8 @@ from krr_tpu.federation.protocol import (
     decode_control,
     decode_inventory,
     encode_control,
+    encode_epoch_feed,
+    encode_message,
     read_message,
 )
 from krr_tpu.utils.logging import KrrLogger
@@ -142,6 +146,14 @@ class Aggregator:
         #: Wire bytes at the last aggregate tick (per-tick deltas for the
         #: timeline record).
         self._bytes_at_tick = 0
+        #: Epoch-feed subscribers (``krr-tpu replica`` connections) and the
+        #: newest published epoch's pre-built MSG_EPOCH frame — broadcast
+        #: on publish, replayed to late subscribers at handshake so a fresh
+        #: replica serves immediately instead of waiting for the next
+        #: changed publish.
+        self._replicas: "set[asyncio.StreamWriter]" = set()
+        self._feed_frame: Optional[bytes] = None
+        self._feed_epoch = 0
 
     def seed(self, meta: Optional[dict]) -> None:
         """Restore per-shard watermarks persisted in the store's
@@ -262,7 +274,13 @@ class Aggregator:
             message = await read_message(reader)
             if message is None or message[0] != MSG_HELLO:
                 raise ProtocolError("expected HELLO")
-            status = await self._handshake(decode_control(message[1]), writer)
+            hello = decode_control(message[1])
+            if hello.get("role") == "replica":
+                # An epoch-feed subscriber, not a shard: no digest spec, no
+                # deltas — it reads the publish stream until it hangs up.
+                await self._serve_replica(hello, reader, writer)
+                return
+            status = await self._handshake(hello, writer)
             while True:
                 message = await read_message(reader)
                 if message is None:
@@ -360,6 +378,117 @@ class Aggregator:
             f"(generation {str(generation)[:12]}, acked epoch {status.acked})"
         )
         return status
+
+    # ------------------------------------------------------------ epoch feed
+    async def _serve_replica(
+        self, hello: dict, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """One epoch-feed subscription: version-checked WELCOME, the newest
+        published epoch immediately (the catch-up snapshot — same wire
+        format as every later broadcast), then each changed publish until
+        the replica hangs up. The feed carries everything a stateless
+        replica needs to serve the read path byte-identically: rendered
+        body, pre-compressed variants, and the epoch/changed_at pair the
+        validators derive from."""
+        replica_id = str(hello.get("shard_id") or "replica")
+        if int(hello.get("version", 0)) != PROTOCOL_VERSION:
+            writer.write(
+                encode_control(
+                    MSG_WELCOME,
+                    error=f"protocol version {hello.get('version')} != {PROTOCOL_VERSION}",
+                )
+            )
+            await writer.drain()
+            raise ProtocolError(f"replica {replica_id}: protocol version mismatch")
+        if self._feed_frame is None:
+            # Published before any replica subscribed (or restored from
+            # durable state): build the catch-up frame from the live
+            # snapshot so the subscriber doesn't wait for the next publish.
+            snapshot = self.state.peek()
+            if snapshot is not None and snapshot.epoch > 0:
+                self._feed_frame = await asyncio.to_thread(
+                    self._build_feed_frame, snapshot
+                )
+                self._feed_epoch = snapshot.epoch
+        writer.write(
+            encode_control(
+                MSG_WELCOME, version=PROTOCOL_VERSION, epoch=self._feed_epoch
+            )
+        )
+        if self._feed_frame is not None:
+            writer.write(self._feed_frame)
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "krr_tpu_replica_feed_bytes_total",
+                    len(self._feed_frame) - FRAME_OVERHEAD,
+                )
+        await writer.drain()
+        self._replicas.add(writer)
+        if self.metrics is not None:
+            self.metrics.set("krr_tpu_replica_subscribers", len(self._replicas))
+        self._info(
+            f"federation: replica {replica_id} subscribed "
+            f"(feed epoch {self._feed_epoch})"
+        )
+        try:
+            while True:
+                message = await read_message(reader)
+                if message is None:
+                    break  # clean unsubscribe
+        finally:
+            self._replicas.discard(writer)
+            if self.metrics is not None:
+                self.metrics.set("krr_tpu_replica_subscribers", len(self._replicas))
+
+    def _build_feed_frame(self, snapshot) -> bytes:
+        """One published epoch as a framed MSG_EPOCH (worker thread: body
+        copy + gzip + npz). The gzip variant is built with the SAME encoder
+        the serve read path uses (deterministic mtime=0), so a replica
+        cache warmed from the feed serves bytes identical to the primary's."""
+        from krr_tpu.server.app import encode_body
+
+        payload = encode_epoch_feed(
+            epoch=snapshot.epoch,
+            changed_at=snapshot.changed_at,
+            window_end=float(snapshot.window_end or 0.0),
+            published_at=snapshot.published_at,
+            keys=list(snapshot.keys),
+            body=snapshot.body_json,
+            variants={"gzip": encode_body(snapshot.body_json, "gzip")},
+        )
+        return encode_message(MSG_EPOCH, payload)
+
+    async def broadcast_epoch(self) -> None:
+        """Push the current published epoch to every subscriber — called by
+        the aggregate tick after a publish. Suppressed-epoch publishes
+        (byte-identical body) re-use the previous epoch number, so the
+        `_feed_epoch` guard makes re-broadcasts free; the frame is built
+        once per CHANGED epoch even with zero subscribers, so a late
+        subscriber's catch-up frame is always current."""
+        snapshot = self.state.peek()
+        if snapshot is None or snapshot.epoch <= 0:
+            return
+        if snapshot.epoch == self._feed_epoch and self._feed_frame is not None:
+            return
+        frame = await asyncio.to_thread(self._build_feed_frame, snapshot)
+        self._feed_epoch = snapshot.epoch
+        self._feed_frame = frame
+        dead = []
+        for writer in list(self._replicas):
+            try:
+                writer.write(frame)
+                await writer.drain()
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "krr_tpu_replica_feed_bytes_total", len(frame) - FRAME_OVERHEAD
+                    )
+            except (OSError, ConnectionError):
+                dead.append(writer)
+        for writer in dead:
+            self._replicas.discard(writer)
+            writer.close()
+        if dead and self.metrics is not None:
+            self.metrics.set("krr_tpu_replica_subscribers", len(self._replicas))
 
     async def _on_inventory(self, status: ShardStatus, body: bytes) -> None:
         # Decoded off the loop: a 100k-object inventory is tens of MB of
@@ -635,6 +764,7 @@ class Aggregator:
             "stale_shards": self.stale_shard_count(now),
             "applied_records": applied,
             "wire_bytes": delta_bytes,
+            "replicas": len(self._replicas),
         }
 
     def status(self, now: Optional[float] = None) -> dict:
@@ -670,5 +800,7 @@ class Aggregator:
                 for s in statuses
             },
             "staleness_seconds": self.staleness,
+            "replicas": len(self._replicas),
+            "feed_epoch": self._feed_epoch,
         }
 

@@ -63,8 +63,15 @@ MSG_WELCOME = b"W"
 MSG_INVENTORY = b"I"
 MSG_DELTA = b"D"
 MSG_ACK = b"A"
+#: Epoch feed (aggregator → replica): one published epoch — rendered body,
+#: pre-compressed variants, and the publish metadata a replica needs to
+#: serve byte-identical responses/ETags. Subscribed via a HELLO carrying
+#: ``role="replica"`` (a replica has no digest spec and sends no deltas).
+MSG_EPOCH = b"E"
 
-_KNOWN_TYPES = frozenset((MSG_HELLO, MSG_WELCOME, MSG_INVENTORY, MSG_DELTA, MSG_ACK))
+_KNOWN_TYPES = frozenset(
+    (MSG_HELLO, MSG_WELCOME, MSG_INVENTORY, MSG_DELTA, MSG_ACK, MSG_EPOCH)
+)
 
 #: Hard per-message bound: a frame past it is a corrupt length field or a
 #: hostile peer, not a fleet-scale delta (100k rows tick ≈ 5 MB).
@@ -186,3 +193,68 @@ def decode_inventory(body: bytes) -> "list[K8sObjectData]":
         return [K8sObjectData(**item) for item in items]
     except (UnicodeDecodeError, ValueError, TypeError) as e:
         raise ProtocolError(f"undecodable inventory: {e}") from e
+
+
+# -------------------------------------------------------------- epoch feed
+def encode_epoch_feed(
+    *,
+    epoch: int,
+    changed_at: float,
+    window_end: float,
+    published_at: float,
+    keys: "list[str]",
+    body: bytes,
+    variants: "Optional[dict[str, bytes]]" = None,
+) -> bytes:
+    """Serialize one published epoch for the replica feed (MSG_EPOCH body):
+    the rendered JSON body, any pre-compressed variants (the replica warms
+    its response cache with them — same bytes the aggregator would serve),
+    and the exact publish metadata (``epoch``/``changed_at`` drive the
+    ETag, so replicas emit byte-identical validators). Packed with
+    ``np.savez`` like a delta record so the payload byte-arrays ride
+    uncopied."""
+    import io
+
+    import numpy as np
+
+    meta = json.dumps(
+        {
+            "epoch": int(epoch),
+            "changed_at": float(changed_at),
+            "window_end": float(window_end),
+            "published_at": float(published_at),
+            "keys": list(keys),
+            "variants": sorted(variants) if variants else [],
+        },
+        sort_keys=True,
+    ).encode("utf-8")
+    arrays = {
+        "meta": np.frombuffer(meta, dtype=np.uint8),
+        "body": np.frombuffer(body, dtype=np.uint8),
+    }
+    for encoding, blob in (variants or {}).items():
+        arrays[f"v_{encoding}"] = np.frombuffer(blob, dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_epoch_feed(payload: bytes) -> "tuple[dict, bytes, dict[str, bytes]]":
+    """Inverse of :func:`encode_epoch_feed` → ``(meta, body, variants)``."""
+    import io
+
+    import numpy as np
+
+    try:
+        with np.load(io.BytesIO(payload)) as bundle:
+            meta = json.loads(bundle["meta"].tobytes().decode("utf-8"))
+            body = bundle["body"].tobytes()
+            variants = {
+                str(encoding): bundle[f"v_{encoding}"].tobytes()
+                for encoding in meta.get("variants", [])
+            }
+    except (KeyError, ValueError, OSError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"undecodable epoch feed: {e}") from e
+    if not isinstance(meta, dict):
+        raise ProtocolError("epoch feed meta is not a JSON object")
+    return meta, body, variants

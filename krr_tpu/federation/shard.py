@@ -5,26 +5,41 @@ serve half: it owns a private :class:`~krr_tpu.core.streaming.DigestStore`
 with delta capture ON, runs the existing discover → fetch → fold pipeline
 (`krr_tpu.core.runner.ScanSession`) over ITS clusters on the same
 grid-clamped window math the scheduler uses, and after each fold encodes
-the tick's captured mutation ops into one WAL-format record
-(`krr_tpu.core.durastore.encode_ops`) streamed to the central aggregator
+the tick's captured mutation ops into WAL-format records
+(`krr_tpu.core.durastore.encode_ops`) streamed to the aggregation plane
 (`krr_tpu.federation.protocol`).
 
-Delivery discipline (the exactly-once half the shard owns):
+The aggregation plane is one or many: without ``--federation-ring`` the
+shard streams every record to the single ``--aggregator`` endpoint;
+with a ring (`krr_tpu.federation.ring`) it splits each tick's captured
+ops by owning aggregator and streams each partition over its OWN
+:class:`Uplink` with independent epoch watermarks — and a ring node that
+names standby endpoints gets the same records on every endpoint (a
+replicated WAL on the wire), so a standby takes over the key range with
+zero lost epochs.
+
+Delivery discipline (the exactly-once half the shard owns), per uplink:
 
 * every tick's record appends to an UNACKED buffer before it is sent; the
   buffer only drops records the aggregator has ACKED (records are already
   sparse-encoded bytes, so the buffer costs roughly one WAL delta per
-  unacked tick);
+  unacked tick — and ring endpoints of one node SHARE the frame bytes);
 * a lost connection just marks the stream down — ticks keep scanning and
-  buffering; the next pump reconnects, handshakes, and re-sends everything
-  past the aggregator's acked epoch (duplicates on the wire are discarded
-  deterministically by the aggregator's epoch watermark);
+  buffering; the next pump reconnects (capped jittered backoff, so N
+  shards don't thundering-herd a restarted aggregator's handshake),
+  handshakes, and re-sends everything past that endpoint's acked epoch
+  (duplicates on the wire are discarded deterministically by the
+  aggregator's epoch watermark);
+* an endpoint whose WELCOME acked epoch is BEHIND what the shard already
+  pruned (a standby that took over mid-stream, or a restart from older
+  durable state) cannot be resumed by deltas — the uplink re-anchors from
+  a snapshot of its partition, flagged ``reset``;
 * a shard whose GENERATION the aggregator doesn't recognize (first
   contact, or the aggregator met a previous incarnation) cannot replay
-  history its store never captured — it re-syncs from state: the current
-  store encodes as one snapshot record flagged ``reset``, which makes the
-  aggregator drop the shard's old rows before applying (bit-exact: the
-  snapshot IS the sum of every window the shard folded).
+  history its store never captured — same re-sync: the partition encodes
+  as one snapshot record flagged ``reset``, which makes the aggregator
+  drop the shard's old rows before applying (bit-exact: the snapshot IS
+  the sum of every window the shard folded).
 
 Failure domain: the whole shard. A failed fetch aborts the tick (nothing
 folds, nothing ships, the window refetches next tick) — per-workload
@@ -40,9 +55,12 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import os
+import random
 import time
 from collections import deque
-from typing import Optional
+from typing import Callable, Optional
+
+import numpy as np
 
 from krr_tpu.core.config import Config
 from krr_tpu.core.durastore import encode_ops
@@ -64,6 +82,7 @@ from krr_tpu.federation.protocol import (
     encode_message,
     read_message,
 )
+from krr_tpu.federation.ring import HashRing, RingNode, parse_ring, partition_ops
 from krr_tpu.utils.logging import KrrLogger
 
 
@@ -75,8 +94,314 @@ def parse_endpoint(value: str, flag: str) -> "tuple[str, int]":
     return host.strip("[]") or "127.0.0.1", int(port)
 
 
+class Uplink:
+    """One KRRFED1 stream: buffered, acked, auto-reconnecting delivery of
+    already-framed delta records to one aggregator endpoint.
+
+    The shard owns the ENCODING (one record per ring node per tick) and
+    each uplink owns the DELIVERY state for one endpoint: the unacked
+    buffer, the acked watermark, the connection, and the reconnect
+    backoff. Endpoints of the same ring node receive the same ``offer``
+    calls with the same frame objects — the replicated WAL costs one set
+    of record bytes regardless of standby count. The region→global tier
+    reuses this class verbatim: an aggregator-backed server constructs a
+    standalone Uplink and offers its own store's captured ops.
+
+    Reconnect backoff mirrors the Prometheus retry ladder's semantics
+    (``0.25·2^(n−1)`` capped pre-jitter, ±50% jitter): after an aggregator
+    restart, N shards' handshakes decorrelate instead of herding. A
+    successful connect — or an explicit endpoint repoint via
+    :meth:`reset_backoff` — re-arms immediate attempts.
+    """
+
+    def __init__(
+        self,
+        *,
+        stream_id: str,
+        host: str,
+        port: int,
+        generation: str,
+        hello_spec: dict,
+        snapshot_fn: Callable[[], "Optional[tuple[int, bytes]]"],
+        metrics,
+        logger: KrrLogger,
+        buffer_cap: int,
+        backoff_cap: float,
+        node: str = "default",
+        clusters_fn: Optional[Callable[[], list]] = None,
+        inventory_fn: Optional[Callable[[], "Optional[list]"]] = None,
+        on_ack: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.stream_id = stream_id
+        self.node = node
+        self.host = host
+        self.port = port
+        self.generation = generation
+        self.hello_spec = dict(hello_spec)
+        self.snapshot_fn = snapshot_fn
+        self.clusters_fn = clusters_fn
+        self.inventory_fn = inventory_fn
+        self.metrics = metrics
+        self.logger = logger
+        #: (epoch, framed DELTA message) awaiting this endpoint's ack.
+        #: Bounded: past ``buffer_cap`` records the backlog COLLAPSES into
+        #: one snapshot record — a days-long endpoint outage must cost one
+        #: partition-sized record, not one delta per tick until OOM.
+        self.buffer: "deque[tuple[int, bytes]]" = deque()
+        self.buffer_cap = int(buffer_cap)
+        self.backoff_cap = float(backoff_cap)
+        self.acked = 0
+        self._sent_through = 0
+        self._inventory_dirty = True
+        self._on_ack = on_ack
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._recv_task: Optional[asyncio.Task] = None
+        self._attempts = 0
+        self._next_attempt = 0.0
+
+    # ---------------------------------------------------------------- state
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    def reset_backoff(self) -> None:
+        """Re-arm immediate connect attempts (endpoint repointed, or the
+        caller knows the aggregator just came back)."""
+        self._attempts = 0
+        self._next_attempt = 0.0
+
+    def mark_inventory_dirty(self) -> None:
+        self._inventory_dirty = True
+
+    async def offer(self, epoch: int, frame: bytes) -> None:
+        """Buffer one framed record for delivery (shared bytes across the
+        node's endpoints — append only, no copy)."""
+        self.buffer.append((epoch, frame))
+        if len(self.buffer) > self.buffer_cap:
+            await self._collapse()
+
+    async def _collapse(self) -> None:
+        """Replace the whole unacked backlog with ONE snapshot record at
+        the current epoch. The snapshot is flagged ``reset`` (the
+        aggregator drops this stream's superseded rows first), so it is
+        bit-exact — the partition state IS the sum of every buffered delta
+        plus the acked history — and bounded by the partition size instead
+        of the outage length. The aggregator accepts reset records at any
+        epoch, so the collapsed epoch sequence re-anchors cleanly."""
+        dropped = len(self.buffer)
+        self.buffer.clear()
+        snapshot = await asyncio.to_thread(self.snapshot_fn)
+        if snapshot is not None:
+            self.buffer.append(snapshot)
+            self._sent_through = min(self._sent_through, snapshot[0] - 1)
+        self.logger.warning(
+            f"[{self.stream_id}] unacked backlog to {self.host}:{self.port} hit "
+            f"{dropped} records (--federation-queue-records {self.buffer_cap}) — "
+            f"collapsed into one snapshot record; the aggregator re-syncs from it"
+        )
+
+    async def _resync(self) -> None:
+        """Re-anchor this endpoint from a partition snapshot: buffered
+        deltas are useless to it (unknown generation, or an acked epoch
+        regressed behind our pruned buffer) and the reset-flagged snapshot
+        reconstructs the partition exactly at the current epoch."""
+        self.buffer.clear()
+        self.acked = 0
+        self._sent_through = 0
+        snapshot = await asyncio.to_thread(self.snapshot_fn)
+        if snapshot is not None:
+            self.buffer.append(snapshot)
+            self._sent_through = self.acked = snapshot[0] - 1
+
+    # ------------------------------------------------------------ transport
+    async def _connect(self) -> None:
+        if self._recv_task is not None and not self._recv_task.done():
+            self._recv_task.cancel()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                FED_MAGIC
+                + encode_control(
+                    MSG_HELLO,
+                    shard_id=self.stream_id,
+                    generation=self.generation,
+                    version=PROTOCOL_VERSION,
+                    spec=self.hello_spec,
+                    clusters=self.clusters_fn() if self.clusters_fn else [],
+                )
+            )
+            await writer.drain()
+            message = await read_message(reader)
+            if message is None or message[0] != MSG_WELCOME:
+                raise ProtocolError("aggregator closed the handshake without WELCOME")
+            welcome = decode_control(message[1])
+            if "error" in welcome:
+                raise ProtocolError(
+                    f"aggregator refused the handshake: {welcome['error']}"
+                )
+        except BaseException:
+            writer.close()
+            raise
+        self._inventory_dirty = True
+        if welcome.get("generation") != self.generation:
+            # The aggregator never met THIS store: nothing it acked maps to
+            # our epochs. Re-sync from state — drop the buffered deltas
+            # (the snapshot subsumes them) and ship the partition as one
+            # reset record.
+            await self._resync()
+            self.logger.info(
+                f"[{self.stream_id}] aggregator at {self.host}:{self.port} does "
+                f"not know generation {self.generation} — re-syncing from a snapshot"
+            )
+        else:
+            acked = int(welcome.get("acked_epoch", 0))
+            if acked < self.acked:
+                # The endpoint REGRESSED (standby takeover, or a restart
+                # from older durable state): epochs in (acked, self.acked]
+                # are pruned from our buffer, so the next buffered delta
+                # would be a gap. The snapshot re-anchors it losslessly.
+                await self._resync()
+                self.logger.info(
+                    f"[{self.stream_id}] aggregator at {self.host}:{self.port} "
+                    f"acked epoch {acked} behind our pruned buffer ({self.acked}) "
+                    f"— re-syncing from a snapshot"
+                )
+            else:
+                self.acked = max(self.acked, acked)
+                self._prune_acked()
+                # Re-send everything past the ack (the torn-stream heal):
+                # the aggregator discards any duplicate it already enqueued.
+                self._sent_through = self.acked
+        self._reader, self._writer = reader, writer
+        self._recv_task = asyncio.ensure_future(self._recv_loop(reader))
+        self.metrics.inc("krr_tpu_federation_reconnects_total")
+
+    def _prune_acked(self) -> None:
+        while self.buffer and self.buffer[0][0] <= self.acked:
+            self.buffer.popleft()
+
+    async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                message = await read_message(reader)
+                if message is None:
+                    break
+                kind, body = message
+                if kind == MSG_ACK:
+                    ack = decode_control(body)
+                    self.acked = max(self.acked, int(ack.get("epoch", 0)))
+                    self._prune_acked()
+                    if self._on_ack is not None:
+                        self._on_ack()
+        except (ProtocolError, OSError):
+            pass  # the connection is dead; the next pump reconnects
+        finally:
+            # CancelledError propagates (close() owns the suppression —
+            # swallowing it here would make the task complete "normally"
+            # and break outer cancellation scopes). Only tear down OUR
+            # connection: a reconnect may already have installed a fresh
+            # reader/writer by the time this loop unwinds.
+            if self._reader is reader:
+                self._disconnect()
+
+    def _disconnect(self) -> None:
+        writer, self._reader, self._writer = self._writer, None, None
+        if writer is not None:
+            writer.close()
+
+    async def pump(self) -> None:
+        """Send whatever is due: (re)connect when the backoff window
+        allows, the current inventory when it changed, then every buffered
+        record past ``_sent_through``. Send failures just mark the stream
+        down — the next pump retries."""
+        if self._writer is None:
+            if time.monotonic() < self._next_attempt:
+                return
+            try:
+                await self._connect()
+            except (OSError, ProtocolError, asyncio.IncompleteReadError) as e:
+                self._attempts += 1
+                # PR 7's retry semantics (`prometheus.py::_retrying`): cap
+                # pre-jitter so deep ladders stay bounded, ±50% jitter so a
+                # fleet of shards reconnecting to a restarted aggregator
+                # decorrelates instead of re-herding every cycle.
+                wait = min(
+                    0.25 * 2 ** (self._attempts - 1), self.backoff_cap
+                ) * random.uniform(0.5, 1.5)
+                self._next_attempt = time.monotonic() + wait
+                self.metrics.inc("krr_tpu_federation_uplink_retries_total")
+                self.logger.warning(
+                    f"[{self.stream_id}] cannot reach aggregator at "
+                    f"{self.host}:{self.port}: {e} — buffering "
+                    f"({len(self.buffer)} unacked record(s)), retrying in {wait:.2f}s"
+                )
+                return
+            self._attempts = 0
+        writer = self._writer
+        try:
+            if self._inventory_dirty and self.inventory_fn is not None:
+                objects = self.inventory_fn()
+                if objects is not None:
+                    # Serialized off the loop (a fleet-scale inventory is
+                    # tens of MB of model_dump + JSON — the aggregator
+                    # offloads the same-size decode for the same reason).
+                    body = await asyncio.to_thread(encode_inventory, objects)
+                    if writer is not self._writer:
+                        return  # connection turned over under the encode
+                    writer.write(encode_message(MSG_INVENTORY, body))
+                    self._inventory_dirty = False
+            for epoch, frame in list(self.buffer):
+                if epoch <= self._sent_through:
+                    continue
+                writer.write(frame)
+                self._sent_through = epoch
+                self.metrics.inc(
+                    "krr_tpu_federation_sent_bytes_total", len(frame) - FRAME_OVERHEAD
+                )
+            await writer.drain()
+        except (OSError, ConnectionError):
+            self.logger.warning(
+                f"[{self.stream_id}] connection to {self.host}:{self.port} dropped "
+                f"mid-send — re-sending from epoch {self.acked} on reconnect"
+            )
+            self._disconnect()
+
+    async def wait_acked(self, epoch: int, timeout: float = 30.0) -> bool:
+        """Block until this endpoint acked ``epoch``, pumping while waiting
+        so a downed connection heals (standalone users — the region tier)."""
+        deadline = time.monotonic() + timeout
+        while self.acked < epoch:
+            if time.monotonic() >= deadline:
+                return False
+            await self.pump()
+            await asyncio.sleep(0.05)
+        return True
+
+    def status(self, epoch: int) -> dict:
+        """This endpoint's posture for the shard's /healthz ``aggregators``
+        block: who it streams to and how far behind the shard's current
+        epoch its acks run."""
+        return {
+            "node": self.node,
+            "endpoint": f"{self.host}:{self.port}",
+            "connected": self.connected,
+            "acked_epoch": self.acked,
+            "epoch_lag": max(0, int(epoch) - int(self.acked)),
+            "unacked_records": len(self.buffer),
+        }
+
+    async def close(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._recv_task
+            self._recv_task = None
+        self._disconnect()
+
+
 class FederatedShard:
-    """One scanner shard: local scan state + the delta stream uplink."""
+    """One scanner shard: local scan state + delta stream uplink(s)."""
 
     def __init__(
         self,
@@ -100,22 +425,33 @@ class FederatedShard:
         self.spec = settings.cpu_spec()
         self.store = DigestStore(spec=self.spec)
         self.store.track_deltas = True
-        # Records land in the aggregator's MERGED store (other shards' rows
-        # interleave): whole-store folds must carry their key lists.
+        # Records land in an aggregator's MERGED store (other shards' rows
+        # interleave): whole-store folds must carry their key lists — and
+        # ring partitioning needs every op's keys to split it.
         self.store.capture_full_keys = True
         if not (shard_id or config.federation_shard_id):
             clusters = config.clusters if isinstance(config.clusters, list) else None
             shard_id = "/".join(clusters) if clusters else "default"
         self.shard_id = shard_id or config.federation_shard_id
         #: Fresh per store lifetime: a restarted shard can't re-send ticks
-        #: its in-memory store never captured, so the aggregator must not
-        #: resume its old epoch watermark against us.
+        #: its in-memory store never captured, so no aggregator may resume
+        #: its old epoch watermark against us.
         self.generation = os.urandom(8).hex()
-        if not config.federation_aggregator:
-            raise ValueError("shard needs --aggregator (federation_aggregator) host:port")
-        self.host, self.port = parse_endpoint(
-            config.federation_aggregator, "--aggregator"
-        )
+        ring_spec = getattr(config, "federation_ring", None)
+        if ring_spec:
+            self.nodes = parse_ring(ring_spec)
+            #: key → aggregator-name assignment; None in single-aggregator
+            #: mode (no partition pass on the tick path).
+            self.ring: Optional[HashRing] = HashRing(self.nodes)
+        elif config.federation_aggregator:
+            host, port = parse_endpoint(config.federation_aggregator, "--aggregator")
+            self.nodes = [RingNode(name="default", endpoints=((host, port),))]
+            self.ring = None
+        else:
+            raise ValueError(
+                "shard needs --aggregator (federation_aggregator) host:port "
+                "or --federation-ring name=host:port[,name=...]"
+            )
         self.scan_interval = float(config.scan_interval_seconds)
         self.discovery_interval = float(config.discovery_interval_seconds)
         self.metrics = self.session.metrics
@@ -131,26 +467,137 @@ class FederatedShard:
         #: fleet's ticks stream no redundant inventory records.
         self.discovery_mode = str(getattr(config, "discovery_mode", "relist"))
         self._inventory_generation = None
-        #: (epoch, framed DELTA message) awaiting the aggregator's ack.
-        #: Bounded: past ``federation_queue_records`` buffered records the
-        #: backlog COLLAPSES into one snapshot record (`_collapse_buffer`)
-        #: — a days-long aggregator outage must cost one store-sized
-        #: record, not one delta per tick until the shard OOMs.
-        self._buffer: "deque[tuple[int, bytes]]" = deque()
         self.buffer_cap = int(getattr(config, "federation_queue_records", 4096))
-        self.acked = 0
-        self._sent_through = 0
-        self._inventory_dirty = True
-        #: Set when the aggregator met us under a different (or no)
-        #: generation: the next record we encode carries ``reset`` so the
-        #: aggregator drops our old rows before applying.
+        self.backoff_cap = float(
+            getattr(config, "federation_backoff_cap_seconds", 5.0) or 5.0
+        )
+        #: Set until the first record is encoded: a fresh shard incarnation
+        #: whose aggregators may hold a previous incarnation's rows flags
+        #: record 1 ``reset`` so they drop those rows before applying.
         self._needs_reset = True
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._recv_task: Optional[asyncio.Task] = None
-        self._ack_event: Optional[asyncio.Event] = None
+        self._ack_event = asyncio.Event()
+        hello_spec = {
+            "gamma": self.spec.gamma,
+            "min_value": self.spec.min_value,
+            "num_buckets": self.spec.num_buckets,
+        }
+        #: Delivery streams: one per (ring node × endpoint). In
+        #: single-aggregator mode this is exactly one uplink; ring
+        #: endpoints of one node share record bytes and differ only in
+        #: delivery state. Stream ids are suffixed per node in ring mode so
+        #: two nodes' streams never collide at a shared endpoint.
+        self._uplinks: "list[Uplink]" = []
+        self._node_uplinks: "dict[str, list[Uplink]]" = {}
+        for node in self.nodes:
+            stream_id = (
+                self.shard_id if self.ring is None else f"{self.shard_id}/{node.name}"
+            )
+            per_node: "list[Uplink]" = []
+            for host, port in node.endpoints:
+                uplink = Uplink(
+                    stream_id=stream_id,
+                    node=node.name,
+                    host=host,
+                    port=port,
+                    generation=self.generation,
+                    hello_spec=hello_spec,
+                    snapshot_fn=(
+                        self._snapshot_record
+                        if self.ring is None
+                        else (lambda name=node.name: self._snapshot_record_for(name))
+                    ),
+                    clusters_fn=self._hello_clusters,
+                    inventory_fn=(lambda name=node.name: self._inventory_for(name)),
+                    metrics=self.metrics,
+                    logger=self.logger,
+                    buffer_cap=self.buffer_cap,
+                    backoff_cap=self.backoff_cap,
+                    on_ack=self._note_ack,
+                )
+                per_node.append(uplink)
+                self._uplinks.append(uplink)
+            self._node_uplinks[node.name] = per_node
         self.consecutive_failures = 0
         self.last_error: Optional[str] = None
+
+    # ---------------------------------------------------- legacy delegation
+    # Single-aggregator callers (tests, bench) address the shard's one
+    # stream directly: host/port repoints, buffer length asserts, acked
+    # reads. They delegate to the uplinks so the attributes keep meaning
+    # what they meant before the ring existed.
+    @property
+    def host(self) -> str:
+        return self._uplinks[0].host
+
+    @host.setter
+    def host(self, value: str) -> None:
+        for uplink in self._uplinks:
+            uplink.host = value
+            uplink.reset_backoff()
+
+    @property
+    def port(self) -> int:
+        return self._uplinks[0].port
+
+    @port.setter
+    def port(self, value: int) -> None:
+        for uplink in self._uplinks:
+            uplink.port = int(value)
+            uplink.reset_backoff()
+
+    @property
+    def acked(self) -> int:
+        """The fleet-safe watermark: the SLOWEST endpoint's acked epoch
+        (every aggregator holds everything at or below it)."""
+        return min(uplink.acked for uplink in self._uplinks)
+
+    @acked.setter
+    def acked(self, value: int) -> None:
+        for uplink in self._uplinks:
+            uplink.acked = int(value)
+
+    @property
+    def _buffer(self) -> "deque[tuple[int, bytes]]":
+        if len(self._uplinks) == 1:
+            return self._uplinks[0].buffer
+        raise AttributeError(
+            "per-uplink buffers in ring mode — use shard._uplinks[i].buffer"
+        )
+
+    @property
+    def connected(self) -> bool:
+        return all(uplink.connected for uplink in self._uplinks)
+
+    @property
+    def unacked_records(self) -> int:
+        return sum(len(uplink.buffer) for uplink in self._uplinks)
+
+    def _disconnect(self) -> None:
+        """Drop every uplink's connection (tests simulate a mid-stream
+        death; the next pump reconnects and re-sends past the acks)."""
+        for uplink in self._uplinks:
+            uplink._disconnect()
+
+    def _note_ack(self) -> None:
+        self.metrics.set("krr_tpu_federation_unacked_records", self.unacked_records)
+        self._ack_event.set()
+
+    def _hello_clusters(self) -> list:
+        return sorted({obj.cluster or "" for obj in (self._objects or [])}) or (
+            self.config.clusters if isinstance(self.config.clusters, list) else []
+        )
+
+    def _inventory_for(self, name: str) -> "Optional[list]":
+        """The inventory one ring node receives: only the objects whose
+        keys it owns (an aggregator renders exactly its partition — full
+        inventories would grow empty rows for unowned keys there)."""
+        if self._objects is None:
+            return None
+        if self.ring is None:
+            return self._objects
+        return [
+            obj for obj in self._objects if self.ring.owner(object_key(obj)) == name
+        ]
 
     # ------------------------------------------------------------- scanning
     def _step_seconds(self) -> float:
@@ -191,18 +638,20 @@ class FederatedShard:
         if generation is not None and generation == self._inventory_generation:
             return
         # Churn compaction: the captured drop ops ride the next delta
-        # record, so deleted workloads leave the AGGREGATOR's store too.
+        # record, so deleted workloads leave the aggregators' stores too.
         dropped = self.store.compact({object_key(obj) for obj in objects})
         if dropped:
             self.metrics.inc("krr_tpu_store_compacted_rows_total", dropped)
         self._inventory_generation = generation
-        self._inventory_dirty = True
+        for uplink in self._uplinks:
+            uplink.mark_inventory_dirty()
 
     async def tick(self, now: Optional[float] = None) -> bool:
         """One scan tick: (maybe) re-discover, fetch the due window, fold,
-        encode the captured deltas as one record, buffer + send it. Returns
-        False when no new window was due (the pump still runs, so a downed
-        connection keeps retrying between due windows)."""
+        encode the captured deltas as one record per aggregator, buffer +
+        send them. Returns False when no new window was due (the pump
+        still runs, so a downed connection keeps retrying between due
+        windows)."""
         if now is None:
             now = float(self.clock())
         settings = self.session.strategy.settings
@@ -292,68 +741,84 @@ class FederatedShard:
         return True
 
     async def _encode_tick(self, *, extra: dict) -> None:
-        """Capture → record → buffer: one epoch per encoded record. The
-        CSR encode runs off the loop (fleet-scale records are real numpy +
-        zip work that would stall ack processing)."""
+        """Capture → partition → record per aggregator → buffer: one epoch
+        per tick, shared by every node's record (and every endpoint's
+        delivery), so ``wait_acked(self.epoch)`` means "the whole tick
+        landed everywhere". The partition split and CSR encodes run off the
+        loop (fleet-scale records are real numpy + zip work that would
+        stall ack processing). Nodes with no ops this tick still get an
+        empty record — it carries the window metadata their staleness
+        accounting rides on, and keeps the per-node epoch sequence gapless.
+        """
         ops = self.store.pending_ops()
         if self._needs_reset:
             extra = {**extra, "reset": True}
             self._needs_reset = False
-        payload = await asyncio.to_thread(
-            encode_ops,
-            ops,
-            epoch=self.epoch + 1,
-            extra=extra,
-            num_buckets=self.spec.num_buckets,
-        )
-        self.epoch += 1
-        self.store.clear_pending(len(ops))
-        self._buffer.append((self.epoch, encode_message(MSG_DELTA, payload)))
-        if len(self._buffer) > self.buffer_cap:
-            await self._collapse_buffer()
-        self.metrics.set("krr_tpu_federation_unacked_records", len(self._buffer))
-
-    async def _collapse_buffer(self) -> None:
-        """Replace the whole unacked backlog with ONE snapshot record at
-        the current epoch. The snapshot is flagged ``reset`` (the
-        aggregator drops the shard's superseded rows first), so it is
-        bit-exact — the store IS the sum of every buffered delta plus the
-        acked history — and bounded by the store size instead of the
-        outage length. The aggregator accepts reset records at any epoch,
-        so the collapsed epoch sequence re-anchors cleanly."""
-        dropped = len(self._buffer)
-        self._buffer.clear()
-        snapshot = await asyncio.to_thread(self._snapshot_record)
-        if snapshot is not None:
-            self._buffer.append(snapshot)
-            self._sent_through = min(self._sent_through, snapshot[0] - 1)
+        if self.ring is None:
+            parts = {"default": ops}
         else:
-            self._needs_reset = True
-        self.logger.warning(
-            f"[shard {self.shard_id}] unacked backlog hit {dropped} records "
-            f"(--federation-queue-records {self.buffer_cap}) — collapsed into "
-            f"one snapshot record; the aggregator re-syncs from it"
-        )
+            parts = await asyncio.to_thread(partition_ops, ops, self.ring.owner)
+        epoch = self.epoch + 1
+        for name, uplinks in self._node_uplinks.items():
+            payload = await asyncio.to_thread(
+                encode_ops,
+                parts.get(name, []),
+                epoch=epoch,
+                extra=extra,
+                num_buckets=self.spec.num_buckets,
+            )
+            frame = encode_message(MSG_DELTA, payload)
+            for uplink in uplinks:
+                await uplink.offer(epoch, frame)
+        self.epoch = epoch
+        self.store.clear_pending(len(ops))
+        if self.ring is not None:
+            spread = self.ring.spread(self.store.keys)
+            self.metrics.set("krr_tpu_federation_ring_nodes", len(spread))
+            for name, count in spread.items():
+                self.metrics.set("krr_tpu_federation_ring_keys", count, node=name)
+        self.metrics.set("krr_tpu_federation_unacked_records", self.unacked_records)
 
     def _snapshot_record(self) -> "Optional[tuple[int, bytes]]":
         """The whole store as ONE reset record at the current epoch — the
-        generation-resync path. Applying it to fresh aggregator rows
-        reconstructs the shard's accumulated state exactly (the store IS
-        the sum of its folded windows)."""
+        single-aggregator resync path."""
+        return self._snapshot_record_for(None)
+
+    def _snapshot_record_for(self, owner: "Optional[str]") -> "Optional[tuple[int, bytes]]":
+        """One ring node's partition (or the whole store for ``None``) as
+        ONE reset record at the current epoch — the resync path. Applying
+        it to fresh aggregator rows reconstructs the partition exactly (the
+        store IS the sum of its folded windows). An EMPTY partition at a
+        live epoch still yields a record: its reset drops whatever stale
+        rows the endpoint holds for this stream, and it re-anchors the
+        epoch sequence. Only at epoch 0 (nothing ever encoded — record 1's
+        ``reset`` flag covers first contact) is there nothing to say."""
         store = self.store
-        if not store.keys:
-            return None
-        ops = [
-            (
-                "fold",
-                list(store.keys),
+        if owner is None or self.ring is None:
+            keys = list(store.keys)
+            arrays = (
                 store.cpu_counts,
                 store.cpu_total,
                 store.cpu_peak,
                 store.mem_total,
                 store.mem_peak,
             )
-        ]
+        else:
+            rows = [
+                i for i, key in enumerate(store.keys) if self.ring.owner(key) == owner
+            ]
+            idx = np.asarray(rows, dtype=np.int64)
+            keys = [store.keys[i] for i in rows]
+            arrays = (
+                store.cpu_counts[idx],
+                store.cpu_total[idx],
+                store.cpu_peak[idx],
+                store.mem_total[idx],
+                store.mem_peak[idx],
+            )
+        ops = [("fold", keys, *arrays)] if keys else []
+        if not ops and self.epoch <= 0:
+            return None
         payload = encode_ops(
             ops,
             epoch=self.epoch,
@@ -364,7 +829,7 @@ class FederatedShard:
 
     async def run_once(self, now: Optional[float] = None) -> "Optional[bool]":
         """One guarded tick (the shard loop's unit): failures count and
-        degrade — the stream pump still runs so the uplink heals while the
+        degrade — the stream pump still runs so the uplinks heal while the
         backend is down."""
         try:
             did_scan = await self.tick(now)
@@ -386,161 +851,13 @@ class FederatedShard:
             return did_scan
 
     # ------------------------------------------------------------- transport
-    async def _connect(self) -> None:
-        if self._recv_task is not None and not self._recv_task.done():
-            self._recv_task.cancel()
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        try:
-            writer.write(
-                FED_MAGIC
-                + encode_control(
-                    MSG_HELLO,
-                    shard_id=self.shard_id,
-                    generation=self.generation,
-                    version=PROTOCOL_VERSION,
-                    spec={
-                        "gamma": self.spec.gamma,
-                        "min_value": self.spec.min_value,
-                        "num_buckets": self.spec.num_buckets,
-                    },
-                    clusters=sorted(
-                        {obj.cluster or "" for obj in (self._objects or [])}
-                    )
-                    or (
-                        self.config.clusters
-                        if isinstance(self.config.clusters, list)
-                        else []
-                    ),
-                )
-            )
-            await writer.drain()
-            message = await read_message(reader)
-            if message is None or message[0] != MSG_WELCOME:
-                raise ProtocolError("aggregator closed the handshake without WELCOME")
-            welcome = decode_control(message[1])
-            if "error" in welcome:
-                raise ProtocolError(f"aggregator refused the handshake: {welcome['error']}")
-        except BaseException:
-            writer.close()
-            raise
-        self._inventory_dirty = True
-        if welcome.get("generation") != self.generation:
-            # The aggregator never met THIS store: nothing it acked maps to
-            # our epochs. Re-sync from state — drop the buffered deltas
-            # (the snapshot subsumes them) and ship the whole store as one
-            # reset record; an empty young store just flags the next delta.
-            self._buffer.clear()
-            self.acked = 0
-            self._sent_through = 0
-            snapshot = await asyncio.to_thread(self._snapshot_record)
-            if snapshot is not None:
-                self._buffer.append(snapshot)
-                self._sent_through = snapshot[0] - 1
-                self.acked = snapshot[0] - 1
-            else:
-                self._needs_reset = True
-            self.logger.info(
-                f"[shard {self.shard_id}] aggregator does not know generation "
-                f"{self.generation} — re-syncing from a full snapshot"
-            )
-        else:
-            acked = int(welcome.get("acked_epoch", 0))
-            self.acked = max(self.acked, acked)
-            self._prune_acked()
-            # Re-send everything past the ack (the torn-stream heal): the
-            # aggregator discards any duplicate it already enqueued.
-            self._sent_through = self.acked
-        self._reader, self._writer = reader, writer
-        self._recv_task = asyncio.ensure_future(self._recv_loop(reader))
-        self.metrics.inc("krr_tpu_federation_reconnects_total")
-        self.metrics.set("krr_tpu_federation_unacked_records", len(self._buffer))
-
-    def _prune_acked(self) -> None:
-        while self._buffer and self._buffer[0][0] <= self.acked:
-            self._buffer.popleft()
-
-    async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
-        try:
-            while True:
-                message = await read_message(reader)
-                if message is None:
-                    break
-                kind, body = message
-                if kind == MSG_ACK:
-                    ack = decode_control(body)
-                    self.acked = max(self.acked, int(ack.get("epoch", 0)))
-                    self._prune_acked()
-                    self.metrics.set(
-                        "krr_tpu_federation_unacked_records", len(self._buffer)
-                    )
-                    if self._ack_event is not None:
-                        self._ack_event.set()
-        except (ProtocolError, OSError):
-            pass  # the connection is dead; the next pump reconnects
-        finally:
-            # CancelledError propagates (close() owns the suppression —
-            # swallowing it here would make the task complete "normally"
-            # and break outer cancellation scopes). Only tear down OUR
-            # connection: a reconnect may already have installed a fresh
-            # reader/writer by the time this loop unwinds.
-            if self._reader is reader:
-                self._disconnect()
-
-    def _disconnect(self) -> None:
-        writer, self._reader, self._writer = self._writer, None, None
-        if writer is not None:
-            writer.close()
-
-    @property
-    def connected(self) -> bool:
-        return self._writer is not None
-
     async def _pump(self) -> None:
-        """Send whatever is due: (re)connect, the current inventory when it
-        changed, then every buffered record past ``_sent_through``. Send
-        failures just mark the stream down — the next pump retries."""
-        if self._writer is None:
-            try:
-                await self._connect()
-            except (OSError, ProtocolError, asyncio.IncompleteReadError) as e:
-                self.logger.warning(
-                    f"[shard {self.shard_id}] cannot reach aggregator at "
-                    f"{self.host}:{self.port}: {e} — buffering "
-                    f"({len(self._buffer)} unacked record(s))"
-                )
-                return
-        writer = self._writer
-        try:
-            if self._inventory_dirty and self._objects is not None:
-                # Serialized off the loop (a fleet-scale inventory is tens
-                # of MB of model_dump + JSON — the aggregator offloads the
-                # same-size decode for the same reason).
-                body = await asyncio.to_thread(encode_inventory, self._objects)
-                if writer is not self._writer:
-                    return  # connection turned over under the encode
-                writer.write(encode_message(MSG_INVENTORY, body))
-                self._inventory_dirty = False
-            for epoch, frame in list(self._buffer):
-                if epoch <= self._sent_through:
-                    continue
-                writer.write(frame)
-                self._sent_through = epoch
-                self.metrics.inc(
-                    "krr_tpu_federation_sent_bytes_total", len(frame) - FRAME_OVERHEAD
-                )
-            await writer.drain()
-        except (OSError, ConnectionError):
-            self.logger.warning(
-                f"[shard {self.shard_id}] connection to the aggregator dropped "
-                f"mid-send — re-sending from epoch {self.acked} on reconnect"
-            )
-            self._disconnect()
+        for uplink in self._uplinks:
+            await uplink.pump()
 
     async def wait_acked(self, epoch: int, timeout: float = 30.0) -> bool:
-        """Block until the aggregator has acked ``epoch`` (tests, graceful
-        shutdown). Pumps while waiting so a downed connection heals."""
-        if self._ack_event is None:
-            self._ack_event = asyncio.Event()
+        """Block until EVERY endpoint has acked ``epoch`` (tests, graceful
+        shutdown). Pumps while waiting so downed connections heal."""
         deadline = time.monotonic() + timeout
         while self.acked < epoch:
             if time.monotonic() >= deadline:
@@ -552,7 +869,10 @@ class FederatedShard:
         return True
 
     def status(self) -> dict:
-        """The shard's /healthz body: scan + uplink posture."""
+        """The shard's /healthz body: scan posture plus a per-aggregator
+        delivery block (which node/endpoint each stream feeds and its
+        acked-vs-current epoch lag), so ring placement is debuggable from
+        the SHARD side."""
         return {
             "status": (
                 "ok"
@@ -564,7 +884,11 @@ class FederatedShard:
             "connected": self.connected,
             "epoch": self.epoch,
             "acked_epoch": self.acked,
-            "unacked_records": len(self._buffer),
+            "unacked_records": self.unacked_records,
+            "aggregators": [uplink.status(self.epoch) for uplink in self._uplinks],
+            "ring": (
+                {"nodes": sorted(self._node_uplinks)} if self.ring is not None else None
+            ),
             "last_window_end": self.last_end,
             "consecutive_scan_failures": self.consecutive_failures,
             "last_scan_error": self.last_error,
@@ -572,12 +896,8 @@ class FederatedShard:
         }
 
     async def close(self) -> None:
-        if self._recv_task is not None:
-            self._recv_task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._recv_task
-            self._recv_task = None
-        self._disconnect()
+        for uplink in self._uplinks:
+            await uplink.close()
         await self.session.close()
 
 
@@ -658,9 +978,13 @@ async def run_shard(config: Config, *, logger: Optional[KrrLogger] = None) -> No
     shard = FederatedShard(config, logger=logger)
     status_server = ShardStatusServer(shard)
     await status_server.serve(config.server_host, config.server_port)
+    targets = ", ".join(
+        f"{uplink.stream_id}→{uplink.host}:{uplink.port}"
+        for uplink in shard._uplinks
+    )
     shard.logger.info(
         f"Shard {shard.shard_id} scanning every {shard.scan_interval:.0f}s, "
-        f"streaming deltas to {shard.host}:{shard.port}; status on "
+        f"streaming deltas to {targets}; status on "
         f"http://{config.server_host}:{status_server.port} (/healthz, /metrics)"
     )
     stop = asyncio.Event()

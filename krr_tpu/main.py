@@ -852,6 +852,18 @@ def _server_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--federation-uplink", "federation_uplink"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "host:port of a HIGHER-tier aggregator this serve uplinks "
+                "its own merged store's deltas to (requires "
+                "--federation-listen): region aggregators uplink to a "
+                "global one over the same shard protocol, so tiers compose "
+                "without a second wire format."
+            ),
+        ),
+        PanelOption(
             ["--realign-window-grid", "realign_window_grid"],
             is_flag=True,
             default=False,
@@ -1145,8 +1157,11 @@ def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command
                 **kwargs,
             )
             config.create_strategy()  # validate strategy settings up front
-            if not config.federation_aggregator:
-                raise click.UsageError("--aggregator host:port is required")
+            if not (config.federation_aggregator or config.federation_ring):
+                raise click.UsageError(
+                    "--aggregator host:port (or --federation-ring "
+                    "name=host:port[,name=...]) is required"
+                )
         except pydantic.ValidationError as e:
             details = "; ".join(
                 f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
@@ -1162,12 +1177,39 @@ def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command
             help="host:port of the krr-tpu serve --federation-listen aggregator (required).",
         ),
         PanelOption(
+            ["--federation-ring", "federation_ring"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "Key-range partitioned aggregation plane: "
+                "name=host:port[|host:port...],name2=... names each "
+                "aggregator and its endpoint(s). The shard splits every "
+                "tick's delta record by consistent-hash key owner and "
+                "streams each partition to its owner; extra endpoints on a "
+                "node replicate its stream to standbys (HA failover with "
+                "zero lost epochs). Subsumes --aggregator."
+            ),
+        ),
+        PanelOption(
             ["--shard-id", "federation_shard_id"],
             default=None,
             panel="Server Settings",
             help=(
                 "Shard identity in the federation (epoch watermarks key on "
                 "it). Default: the configured cluster list."
+            ),
+        ),
+        PanelOption(
+            ["--uplink-backoff-cap-seconds", "federation_backoff_cap_seconds"],
+            type=float,
+            default=5.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "Ceiling on the uplink reconnect backoff ladder: waits grow "
+                "0.25*2^(n-1) seconds, capped here before +/-50% jitter — "
+                "the same retry semantics as the Prometheus "
+                "--backoff-cap-seconds."
             ),
         ),
         PanelOption(
@@ -1252,6 +1294,148 @@ def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command
             "Run one federation scanner shard: discover+fetch+fold its "
             "clusters locally and stream each tick's delta ops to a central "
             "`krr-tpu serve --federation-listen` aggregator."
+        ),
+    )
+
+
+def _make_replica_command() -> click.Command:
+    """``krr-tpu replica``: a stateless read replica (`krr_tpu.federation.replica`).
+
+    Subscribes to a serve/aggregator's published-epoch feed and serves the
+    full HTTP read path (response cache, conditional GETs, pushdown,
+    pre-compressed variants) from the installed snapshots — byte-identical
+    bodies and validators, no scheduler, no store, no metric backend. N
+    replicas behind a load balancer multiply read RPS horizontally.
+    """
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+        from krr_tpu.federation.replica import run_replica
+
+        try:
+            config = Config(format="json", **kwargs)
+            if not config.federation_aggregator:
+                raise click.UsageError("--source host:port is required")
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+        asyncio.run(run_replica(config, logger=config.create_logger()))
+
+    replica_options = [
+        PanelOption(
+            ["--source", "federation_aggregator"],
+            default=None,
+            panel="Server Settings",
+            help=(
+                "host:port of the serve/aggregator federation listener "
+                "publishing the epoch feed (required)."
+            ),
+        ),
+        PanelOption(
+            ["--replica-id", "federation_shard_id"],
+            default=None,
+            panel="Server Settings",
+            help="Replica identity in the feed handshake. Default: a random id.",
+        ),
+        PanelOption(
+            ["--host", "server_host"],
+            default="127.0.0.1",
+            show_default=True,
+            panel="Server Settings",
+            help="Address to bind the replica's HTTP server to.",
+        ),
+        PanelOption(
+            ["--port", "server_port"],
+            type=int,
+            default=8080,
+            show_default=True,
+            panel="Server Settings",
+            help="Replica HTTP port (0 = ephemeral, logged at startup).",
+        ),
+        PanelOption(
+            ["--scan-interval", "scan_interval_seconds"],
+            type=float,
+            default=900.0,
+            show_default=True,
+            panel="Server Settings",
+            help=(
+                "The SOURCE's publish cadence — three missed cadences "
+                "without an installed epoch marks /healthz stale."
+            ),
+        ),
+        PanelOption(
+            ["--backoff-cap-seconds", "federation_backoff_cap_seconds"],
+            type=float,
+            default=5.0,
+            show_default=True,
+            panel="Server Settings",
+            help="Ceiling on the feed reconnect backoff ladder (pre-jitter).",
+        ),
+        PanelOption(
+            ["--response-cache/--no-response-cache", "response_cache_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "The epoch-keyed rendered-response cache (the feed pre-warms "
+                "it with the source's rendered variants)."
+            ),
+        ),
+        PanelOption(
+            ["--response-cache-entries", "response_cache_max_entries"],
+            type=int,
+            default=256,
+            show_default=True,
+            panel="Server Settings",
+            help="Entry bound on the response cache.",
+        ),
+        PanelOption(
+            ["--response-cache-mb", "response_cache_max_mb"],
+            type=float,
+            default=64.0,
+            show_default=True,
+            panel="Server Settings",
+            help="Body-byte bound on the response cache (MB).",
+        ),
+        PanelOption(
+            ["--render-concurrency", "server_render_concurrency"],
+            type=int,
+            default=4,
+            show_default=True,
+            panel="Server Settings",
+            help="Bounded render pool width for cache-miss renders.",
+        ),
+        PanelOption(
+            ["--render-queue", "server_render_queue"],
+            type=int,
+            default=16,
+            show_default=True,
+            panel="Server Settings",
+            help="Renders allowed to QUEUE behind the pool before shedding 503s.",
+        ),
+        PanelOption(["-q", "--quiet", "quiet"], is_flag=True, default=False, panel="Logging"),
+        PanelOption(["-v", "--verbose", "verbose"], is_flag=True, default=False, panel="Logging"),
+        PanelOption(
+            ["--log-format", "log_format"],
+            type=click.Choice(["console", "json"]),
+            default="console",
+            show_default=True,
+            panel="Logging",
+            help="Structured log output format.",
+        ),
+    ]
+    return PanelCommand(
+        "replica",
+        callback=callback,
+        params=replica_options,
+        help=(
+            "Run a stateless read replica: subscribe to a serve/aggregator's "
+            "published-epoch feed and serve GET /recommendations (and the "
+            "whole read path) byte-identically — N replicas behind a load "
+            "balancer scale reads horizontally."
         ),
     )
 
@@ -1719,6 +1903,7 @@ def load_commands() -> None:
     if "tdigest" in strategies:  # the serve + history subsystems ride the digest strategy
         app.add_command(_make_serve_command("tdigest", strategies["tdigest"]))
         app.add_command(_make_shard_command("tdigest", strategies["tdigest"]))
+        app.add_command(_make_replica_command())
         app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
     app.add_command(_make_analyze_command())
 

@@ -162,6 +162,18 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_federation_unacked_records", "gauge", "Delta records a shard holds buffered awaiting the aggregator's epoch ack (re-sent on reconnect)."),
     ("krr_tpu_federation_sent_bytes_total", "counter", "Delta-record bytes a shard has streamed to its aggregator (re-sends included)."),
     ("krr_tpu_federation_reconnects_total", "counter", "Aggregator connections (re-)established by a shard."),
+    ("krr_tpu_federation_uplink_retries_total", "counter", "Failed federation connect attempts retried through the capped jittered backoff ladder (shard uplinks and the region tier's global uplink alike)."),
+    # Key-range partitioned aggregation (`krr_tpu.federation.ring`).
+    ("krr_tpu_federation_ring_nodes", "gauge", "Aggregator nodes on the shard's consistent-hash ring (--federation-ring)."),
+    ("krr_tpu_federation_ring_keys", "gauge", "Object keys of this shard's store owned by each ring node — the shard-side view of the key-range partition, by node."),
+    # Read replicas (`krr_tpu.federation.replica` + the aggregator's
+    # epoch-feed broadcast).
+    ("krr_tpu_replica_subscribers", "gauge", "Read replicas currently subscribed to this aggregator's epoch feed."),
+    ("krr_tpu_replica_feed_bytes_total", "counter", "Epoch-feed payload bytes: sent to subscribed replicas (on the aggregator) or received from the source (on a replica)."),
+    ("krr_tpu_replica_epoch", "gauge", "Newest epoch this replica installed from its feed (its X-KRR-Epoch matches the source's at this value)."),
+    ("krr_tpu_replica_epochs_applied_total", "counter", "Epoch-feed frames installed by this replica (stale replays drop idempotently and don't count)."),
+    ("krr_tpu_replica_feed_lag_seconds", "gauge", "Age of the replica's newest installed epoch against its own clock at install time (wall-vs-wall: clock skew shows up honestly)."),
+    ("krr_tpu_replica_reconnects_total", "counter", "Feed connections (re-)established by a replica."),
     # SLO engine (`krr_tpu.obs.health`).
     ("krr_tpu_slo_burn_rate", "gauge", "Error-budget burn rate by objective and window (fast|slow): windowed bad ratio divided by the objective's budget; 1.0 consumes exactly the budget over the window."),
     ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),

@@ -53,6 +53,7 @@ Routes:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import time
 import urllib.parse
@@ -494,6 +495,8 @@ class HttpApp:
         }
         if self.state.federation is not None:
             payload["federation"] = self.state.federation.status(float(self.clock()))
+        if self.state.replica is not None:
+            payload["replica"] = self.state.replica.status(float(self.clock()))
         return 200, "application/json", _json_body(payload)
 
     def _trend_text(self) -> str:
@@ -520,12 +523,26 @@ class HttpApp:
         )
         return "\n".join(lines) + "\n"
 
+    def _snapshot_stale(self, snapshot) -> bool:
+        replica = self.state.replica
+        if replica is not None:
+            # A replica's snapshot legitimately freezes while its source is
+            # idle (the feed broadcasts only CHANGED epochs), so age of the
+            # data says nothing — staleness means the FEED has been down
+            # past the budget.
+            down_since = replica.disconnected_at
+            return (
+                down_since is not None
+                and float(self.clock()) - down_since > self.stale_after_seconds
+            )
+        return float(self.clock()) - snapshot.window_end > self.stale_after_seconds
+
     async def _healthz(self) -> tuple[int, str, bytes]:
         snapshot = await self.state.snapshot()
         firing = self.state.slo.firing() if self.state.slo is not None else []
         if snapshot is None:
             status = "starting"
-        elif float(self.clock()) - snapshot.window_end > self.stale_after_seconds:
+        elif self._snapshot_stale(snapshot):
             status = "stale"
         elif firing or self.state.persist_failing:
             # SLO burn — or a failing state persist (ENOSPC/EIO: serve
@@ -590,6 +607,11 @@ class HttpApp:
             # Federation mode: per-shard connected/epoch/lag — the failure
             # domain IS the shard, so liveness must name the silent one.
             body["federation"] = self.state.federation.status(float(self.clock()))
+        if self.state.replica is not None:
+            # Replica mode: the feed subscription IS the data plane —
+            # liveness must show where epochs come from and how far behind
+            # the subscription runs.
+            body["replica"] = self.state.replica.status(float(self.clock()))
         extra = (
             {"X-KRR-Epoch": str(snapshot.epoch)} if snapshot is not None else {}
         )
@@ -1252,6 +1274,50 @@ class KrrServer:
             )
             self.aggregator.seed(store.extra_meta.get("federation"))
             self.state.federation = self.aggregator
+        # Tiered aggregation (`--federation-uplink`): this REGION
+        # aggregator streams its own merged store's deltas to a higher-tier
+        # (global) aggregator over the same shard protocol — an aggregator
+        # IS a shard one tier up. The store runs with delta capture on
+        # (the same queue the durable persist drains; the scheduler's
+        # cursor keeps them from double-consuming it).
+        self.uplink = None
+        if getattr(config, "federation_uplink", None):
+            if self.aggregator is None:
+                raise ValueError(
+                    "--federation-uplink requires --federation-listen: the "
+                    "region tier is an aggregator whose merged store uplinks"
+                )
+            from krr_tpu.federation.shard import Uplink, parse_endpoint as _parse_ep
+
+            up_host, up_port = _parse_ep(
+                config.federation_uplink, "--federation-uplink"
+            )
+            store.track_deltas = True
+            store.capture_full_keys = True
+            spec = settings.cpu_spec()
+            self.uplink = Uplink(
+                stream_id=config.federation_shard_id
+                or f"region-{_os.urandom(4).hex()}",
+                host=up_host,
+                port=up_port,
+                generation=_os.urandom(8).hex(),
+                hello_spec={
+                    "gamma": spec.gamma,
+                    "min_value": spec.min_value,
+                    "num_buckets": spec.num_buckets,
+                },
+                # Late-bound: the scheduler (constructed below) owns the
+                # uplink epoch; snapshot_fn only fires during pump.
+                snapshot_fn=lambda: self.scheduler._uplink_snapshot(),
+                clusters_fn=lambda: sorted(
+                    {obj.cluster or "" for obj in self.aggregator.fleet_objects()}
+                ),
+                inventory_fn=lambda: (self.aggregator.fleet_objects() or None),
+                metrics=self.session.metrics,
+                logger=self.logger,
+                buffer_cap=config.federation_queue_records,
+                backoff_cap=float(config.federation_backoff_cap_seconds),
+            )
         # Push ingest plane (`krr_tpu.ingest`): --metrics-mode push runs a
         # remote-write listener whose buffered streams feed delta ticks
         # directly — steady-state ticks issue zero range queries, and the
@@ -1288,6 +1354,7 @@ class KrrServer:
             durable=self.durable,
             aggregator=self.aggregator,
             ingest=self.ingest,
+            uplink=self.uplink,
         )
         self.app = HttpApp(
             self.state,
@@ -1355,6 +1422,15 @@ class KrrServer:
             self.app.abort_connections()
             await self._server.wait_closed()
             self._server = None
+        if self.uplink is not None:
+            # Best-effort drain: give the global tier a moment to ack the
+            # tail so a rolling restart doesn't force a full re-sync.
+            if self.scheduler.uplink_epoch > self.uplink.acked:
+                with contextlib.suppress(Exception):
+                    await self.uplink.wait_acked(
+                        self.scheduler.uplink_epoch, timeout=5.0
+                    )
+            await self.uplink.close()
         if self.aggregator is not None:
             await self.aggregator.close()
         if self.state.journal is not None:

@@ -86,6 +86,7 @@ class ScanScheduler:
         durable=None,
         aggregator=None,
         ingest=None,
+        uplink=None,
     ) -> None:
         self.session = session
         self.state = state
@@ -101,6 +102,24 @@ class ScanScheduler:
         #: shard delta records into the fleet store (the WAL recovery path)
         #: and publish the merged view through the unchanged pipeline.
         self.aggregator = aggregator
+        #: Tiered aggregation (`--federation-uplink`): a standalone shard
+        #: Uplink (`krr_tpu.federation.shard.Uplink`) this REGION
+        #: aggregator streams its own store's captured ops through, to a
+        #: higher-tier (global) aggregator — the shard protocol verbatim,
+        #: so the tiers compose without a second wire format. The region's
+        #: store runs with delta capture on; each aggregate tick encodes
+        #: the newly captured ops as one record at ``uplink_epoch + 1``.
+        self.uplink = uplink
+        self.uplink_epoch = 0
+        #: How many of the store's queued pending ops are already encoded
+        #: into uplink records (the uplink consumes the SAME capture the
+        #: durable persist drains; a failed persist keeps ops queued, and
+        #: this cursor keeps the uplink from re-encoding them).
+        self._uplink_consumed = 0
+        #: First uplink record flags ``reset`` — the global tier may hold
+        #: a previous incarnation's rows for this region.
+        self._uplink_needs_reset = True
+        self._uplink_inventory_keys: "Optional[tuple]" = None
         #: The durable persistence engine (`krr_tpu.core.durastore`) when
         #: the serve composition opened one for state_path — per-tick delta
         #: WAL appends, threshold compaction, and the publish epoch the
@@ -369,6 +388,87 @@ class ScanScheduler:
                     f"Digest state persistence to {self.state_path} recovered"
                 )
             self.state.persist_failing = False
+
+    # ---------------------------------------------------- tiered aggregation
+    async def _uplink_tick(self, objects, window_end: float) -> None:
+        """Encode this tick's newly captured store ops as one uplink record
+        (epoch ``uplink_epoch + 1``) and buffer it for the global tier —
+        the shard's ``_encode_tick`` with the region aggregator's merged
+        store as the source. The pending-op cursor (``_uplink_consumed``)
+        lets the uplink and the durable persist share one capture queue:
+        under a persist failure the ops stay queued (and
+        ``compact_pending`` re-encodes them in place, count preserved), so
+        the cursor stays valid until the fault-free persist drains them."""
+        from krr_tpu.core.durastore import encode_ops
+        from krr_tpu.federation.protocol import MSG_DELTA, encode_message
+        from krr_tpu.core.streaming import object_key as _object_key
+
+        store = self.state.store
+        ops = store.pending_ops()
+        new = ops[self._uplink_consumed :]
+        extra = {"window_end": window_end, "kind": "region"}
+        if self._uplink_needs_reset:
+            extra["reset"] = True
+            self._uplink_needs_reset = False
+        epoch = self.uplink_epoch + 1
+        payload = await asyncio.to_thread(
+            encode_ops,
+            new,
+            epoch=epoch,
+            extra=extra,
+            num_buckets=store.spec.num_buckets,
+        )
+        await self.uplink.offer(epoch, encode_message(MSG_DELTA, payload))
+        self.uplink_epoch = epoch
+        self._uplink_consumed = len(ops)
+        if not self.state_path:
+            # Memory-only region: nothing else drains the capture.
+            store.clear_pending(len(ops))
+            self._uplink_consumed = 0
+        fingerprint = tuple(_object_key(obj) for obj in objects)
+        if fingerprint != self._uplink_inventory_keys:
+            self._uplink_inventory_keys = fingerprint
+            self.uplink.mark_inventory_dirty()
+
+    def _uplink_snapshot(self) -> "Optional[tuple[int, bytes]]":
+        """The region's whole merged store as ONE reset record at the
+        current uplink epoch — the re-sync path when the global tier never
+        met this incarnation (or regressed behind the pruned buffer).
+        Same contract as ``FederatedShard._snapshot_record``. Runs in a
+        worker thread (Uplink calls it via ``asyncio.to_thread``)."""
+        from krr_tpu.core.durastore import encode_ops
+        from krr_tpu.federation.protocol import MSG_DELTA, encode_message
+
+        store = self.state.store
+        keys = list(store.keys)
+        ops = (
+            [
+                (
+                    "fold",
+                    keys,
+                    store.cpu_counts,
+                    store.cpu_total,
+                    store.cpu_peak,
+                    store.mem_total,
+                    store.mem_peak,
+                )
+            ]
+            if keys
+            else []
+        )
+        if not ops and self.uplink_epoch <= 0:
+            return None
+        payload = encode_ops(
+            ops,
+            epoch=self.uplink_epoch,
+            extra={
+                "reset": True,
+                "window_end": self.state.last_end,
+                "kind": "snapshot",
+            },
+            num_buckets=store.spec.num_buckets,
+        )
+        return self.uplink_epoch, encode_message(MSG_DELTA, payload)
 
     # ------------------------------------------------- degraded-tick helpers
     def _step(self) -> float:
@@ -645,6 +745,10 @@ class ScanScheduler:
         self.state.last_end = end
         t2 = time.perf_counter()
 
+        if self.uplink is not None:
+            # Capture BEFORE the persist: save_delta drains the same
+            # pending-op queue this encodes from.
+            await self._uplink_tick(objects, end)
         persist_seconds = 0.0
         persist_bytes = 0
         if self.state_path:
@@ -653,12 +757,20 @@ class ScanScheduler:
             persist_seconds = time.perf_counter() - t2
             wal_after = self.durable.wal_size if self.durable is not None else 0
             persist_bytes = max(0, wal_after - wal_before)
+            if not self.state.persist_failing:
+                self._uplink_consumed = 0  # the persist drained the capture
         if not self.state.persist_failing:
             # The applied ops are durable (or serve is memory-only, where
             # apply IS the commit point): release the shards' buffers. A
             # failing persist withholds acks — shards keep their records
             # and the next fault-free tick's persist carries the backlog.
             await agg.flush_acks()
+        # Push this tick's published epoch to subscribed read replicas
+        # (no-op when the epoch didn't move or nothing is published yet —
+        # the frame still refreshes so late subscribers catch up warm).
+        await agg.broadcast_epoch()
+        if self.uplink is not None:
+            await self.uplink.pump()
 
         metrics.inc("krr_tpu_scans_total", kind="aggregate")
         metrics.set("krr_tpu_last_scan_timestamp_seconds", end)

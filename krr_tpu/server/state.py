@@ -308,6 +308,11 @@ class ServerState:
         #: serve runs with ``--federation-listen``: /healthz and /statusz
         #: render its per-shard connected/epoch/lag state. None otherwise.
         self.federation = None
+        #: The epoch-feed client (`krr_tpu.federation.replica`) when this
+        #: process is a ``krr-tpu replica``: /healthz and /statusz render
+        #: its subscription posture (source, feed epoch, lag). None
+        #: otherwise.
+        self.replica = None
         #: Push-ingest posture (`krr_tpu.ingest`, ``--metrics-mode push``):
         #: the active mode, the listener's bound port, and the scheduler's
         #: per-tick plane stats (series, buffered samples, freshness,
@@ -365,6 +370,33 @@ class ServerState:
                 if self.response_cache is not None:
                     self.response_cache.invalidate(self.publish_epoch)
             self._snapshot = snapshot
+
+    async def install_snapshot(
+        self, snapshot: Snapshot, *, variants: "Optional[dict[str, bytes]]" = None
+    ) -> bool:
+        """Install a snapshot whose epoch/changed_at were decided ELSEWHERE
+        — the replica feed path. Unlike :meth:`publish` (which allocates
+        the next local epoch), the caller's values install verbatim so the
+        replica's validators are byte-identical to its source's; stale
+        feeds (epoch at or below the installed one) are dropped, making
+        reconnect replays idempotent. ``variants`` pre-warms the response
+        cache with the source's rendered encodings under the unfiltered/
+        unpaged json key — the replica never re-renders what the feed
+        already carries. Returns whether the snapshot installed."""
+        async with self.rwlock.write():
+            previous = self._snapshot
+            if previous is not None and snapshot.epoch <= previous.epoch:
+                return False
+            self.publish_epoch = max(self.publish_epoch, int(snapshot.epoch))
+            self._snapshot = snapshot
+            if self.response_cache is not None:
+                self.response_cache.invalidate(snapshot.epoch)
+                base_key = ("json", (), (), (), None, 0)
+                for encoding, body in (variants or {}).items():
+                    self.response_cache.put(
+                        snapshot.epoch, (*base_key, encoding), body
+                    )
+            return True
 
     async def snapshot(self) -> Optional[Snapshot]:
         async with self.rwlock.read():

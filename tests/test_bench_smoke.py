@@ -144,6 +144,18 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("federation_records", 0) >= 12, secondary
     assert secondary.get("federation_wire_bytes", 0) > 0, secondary
     assert "federation_fold_seconds" in secondary, secondary
+    # The HA/replica leg ran end-to-end: the 2-node ring survived the
+    # mid-soak primary kill with zero lost epochs, the injected duplicate
+    # was counted (and never double-applied — bit-exactness gates that),
+    # and the read replica served byte-identical responses at >= 90% of
+    # its source aggregator's RPS (gate failures are rc 1; assert the
+    # fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("ha_bitexact") == 1.0, secondary
+    assert secondary.get("ha_failover_zero_lost_epochs") == 1.0, secondary
+    assert secondary.get("ha_duplicates", 0) >= 1, secondary
+    assert secondary.get("ha_primary_rps", 0) > 0, secondary
+    assert secondary.get("ha_replica_rps", 0) > 0, secondary
+    assert secondary.get("ha_replica_rps_ratio", 0) >= 0.9, secondary
     # The read-path loadtest leg ran end-to-end: keep-alive readers hit the
     # epoch-keyed response cache at steady state (≥ 99%), conditional
     # revalidations did zero render work, pushdown stayed bit-exact, the

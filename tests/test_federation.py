@@ -13,6 +13,7 @@ frame is discarded, nothing half-applies, the re-send heals it.
 
 import asyncio
 import contextlib
+import gzip
 import json
 import time
 
@@ -1071,6 +1072,702 @@ class TestFederationObservability:
             finally:
                 for shard in shards:
                     await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+
+# -------------------------------------------------------------- hash ring
+class TestHashRing:
+    """Pure ring arithmetic: the key → aggregator assignment must be
+    deterministic, order-independent, reasonably balanced, and — the
+    property that justifies consistent hashing at all — BOUNDED under
+    churn: a join or leave moves only the changed node's keys."""
+
+    @staticmethod
+    def _node(name: str) -> "RingNode":
+        from krr_tpu.federation.ring import RingNode
+
+        return RingNode(name=name, endpoints=(("127.0.0.1", 1),))
+
+    @staticmethod
+    def _keys(n: int = 800) -> "list[str]":
+        return [
+            f"c{i % 4}/ns-{i % 7}/app-{i}/main/Deployment" for i in range(n)
+        ]
+
+    def test_owner_deterministic_and_spread_balanced(self):
+        from krr_tpu.federation.ring import HashRing
+
+        keys = self._keys()
+        ring = HashRing([self._node(n) for n in "abcd"])
+        reordered = HashRing([self._node(n) for n in "dcba"])
+        owners = {key: ring.owner(key) for key in keys}
+        # Node-list order and construction instance are irrelevant: the
+        # assignment is a pure function of (names, key).
+        assert all(reordered.owner(key) == owner for key, owner in owners.items())
+        spread = ring.spread(keys)
+        assert set(spread) == set("abcd")
+        assert sum(spread.values()) == len(keys)
+        mean = len(keys) / 4
+        assert all(0 < count < 2 * mean for count in spread.values()), spread
+
+    def test_join_and_leave_move_only_the_changed_nodes_keys(self):
+        from krr_tpu.federation.ring import HashRing
+
+        keys = self._keys()
+        base = HashRing([self._node(n) for n in ("a", "b", "c")])
+        before = {key: base.owner(key) for key in keys}
+
+        joined = HashRing([self._node(n) for n in ("a", "b", "c", "d")])
+        moved_in = 0
+        for key in keys:
+            after = joined.owner(key)
+            if after != before[key]:
+                # Every moved key moved TO the joiner — a key that hopped
+                # between surviving nodes would force a spurious re-sync.
+                assert after == "d", key
+                moved_in += 1
+        # ≈ 1/4 of the keyspace, and never none or all.
+        assert 0 < moved_in < len(keys) // 2
+
+        left = HashRing([self._node(n) for n in ("a", "b")])
+        for key in keys:
+            after = left.owner(key)
+            if after != before[key]:
+                # Only the departed node's keys re-home.
+                assert before[key] == "c", key
+
+    def test_parse_ring_specs_and_errors(self):
+        from krr_tpu.federation.ring import parse_ring
+
+        nodes = parse_ring("a=127.0.0.1:9001, b=10.0.0.2:9002|10.0.0.3:9003")
+        assert [node.name for node in nodes] == ["a", "b"]
+        assert nodes[0].endpoints == (("127.0.0.1", 9001),)
+        # Standbys ride the same node: primary first, standby after.
+        assert nodes[1].endpoints == (("10.0.0.2", 9002), ("10.0.0.3", 9003))
+        for bad in (
+            "a=1.2.3.4:1,a=1.2.3.4:2",  # duplicate name
+            "just-a-host:9001",  # no name=
+            "a=",  # no endpoints
+            "",  # no nodes
+            "a=nocolon",  # not host:port
+        ):
+            with pytest.raises(ValueError):
+                parse_ring(bad)
+
+    def test_partition_ops_union_bitexact_vs_unsplit(self):
+        """The tentpole's correctness kernel, isolated: splitting a tick's
+        captured ops by ring owner, shipping each partition through the
+        WAL encode/decode, and applying each onto its own store yields a
+        UNION bit-identical (per key) to applying the unsplit ops to one
+        store — across dense folds, CSR folds (compact_pending), grows,
+        and drops."""
+        from krr_tpu.core.durastore import apply_ops, decode_ops
+        from krr_tpu.federation.ring import HashRing, partition_ops
+
+        config = base_config()
+        spec = config.create_strategy().settings.cpu_spec()
+        rng = np.random.default_rng(23)
+        keys = [f"cx/ns{i % 3}/app-{i}/main/Deployment" for i in range(12)]
+
+        def fold(store, subset):
+            counts = rng.integers(0, 4, size=(len(subset), spec.num_buckets)).astype(
+                np.float32
+            )
+            store.merge_window(
+                subset,
+                counts,
+                counts.sum(axis=1),
+                rng.uniform(0.1, 2.0, len(subset)).astype(np.float32),
+                rng.uniform(1.0, 8.0, len(subset)).astype(np.float32),
+                rng.uniform(64.0, 512.0, len(subset)).astype(np.float32),
+            )
+
+        source = DigestStore(spec=spec)
+        source.track_deltas = True
+        source.capture_full_keys = True
+        fold(source, keys[:8])
+        source.compact_pending()  # dense fold → fold_csr in place
+        fold(source, keys)  # a second (dense) fold over 4 new rows too
+        extra = [f"cx/ns9/extra-{i}/main/Deployment" for i in range(2)]
+        source.rows_for(extra)  # captured grow: empty rows, NaN scans
+        source.compact({*keys[:10], *extra})  # drops 2 → captured drop ops
+        ops = source.pending_ops()
+        kinds = {op[0] for op in ops}
+        assert {"fold_csr", "fold", "grow", "drop"} <= kinds
+
+        ring = HashRing(
+            [self._node("x"), self._node("y"), self._node("z")]
+        )
+        parts = partition_ops(ops, ring.owner)
+        assert len(parts) > 1, "seeded keys should span several owners"
+
+        whole = DigestStore(spec=spec)
+        _, parsed = decode_ops(encode_ops(ops, epoch=1, extra={}, num_buckets=spec.num_buckets))
+        apply_ops(whole, parsed)
+
+        merged_rows = {}
+        for name, node_ops in parts.items():
+            node_store = DigestStore(spec=spec)
+            _, parsed = decode_ops(
+                encode_ops(node_ops, epoch=1, extra={}, num_buckets=spec.num_buckets)
+            )
+            apply_ops(node_store, parsed)
+            for i, key in enumerate(node_store.keys):
+                # Partitions are disjoint: each key lands on exactly one node.
+                assert key not in merged_rows, key
+                assert ring.owner(key) == name, key
+                merged_rows[key] = node_store
+
+        assert sorted(merged_rows) == sorted(whole.keys)
+        whole_index = {key: i for i, key in enumerate(whole.keys)}
+        for key, node_store in merged_rows.items():
+            i = node_store.keys.index(key)
+            j = whole_index[key]
+            for attr in ("cpu_counts", "cpu_total", "cpu_peak", "mem_total", "mem_peak"):
+                assert np.array_equal(
+                    getattr(node_store, attr)[i], getattr(whole, attr)[j]
+                ), (key, attr)
+
+
+# ------------------------------------------- ring-partitioned aggregation
+def make_ring_shard(
+    fleet: MultiClusterFleet, cluster: str, ring_spec: str, clock, **overrides
+) -> FederatedShard:
+    config = base_config(
+        clusters=[cluster],
+        federation_ring=ring_spec,
+        **overrides,
+    )
+    session = ScanSession(
+        config,
+        inventory=FleetInventory(fleet, clusters=[cluster]),
+        history_factory=history_factory(fleet),
+        logger=config.create_logger(),
+    )
+    return FederatedShard(config, session=session, clock=clock, shard_id=cluster)
+
+
+async def ring_round(servers, shards, now: float) -> None:
+    """One federation round across a PARTITIONED aggregation plane: every
+    shard ticks, every aggregator enqueues every stream's record for this
+    epoch, every aggregator applies + publishes, every endpoint acks."""
+    for shard in shards:
+        await shard.tick(now)
+
+    def all_enqueued():
+        for shard in shards:
+            for uplink in shard._uplinks:
+                agg = servers[uplink.port].aggregator
+                status = agg._shards.get(uplink.stream_id)
+                if status is None or status.enqueued < shard.epoch:
+                    return False
+        return True
+
+    await wait_for(all_enqueued, message="every aggregator to enqueue every stream")
+    for server in servers.values():
+        await server.scheduler.run_once()
+    for shard in shards:
+        assert await shard.wait_acked(shard.epoch, timeout=5.0), (
+            f"shard {shard.shard_id} stuck at acked {shard.acked} < {shard.epoch}"
+        )
+
+
+def _scans_by_key(state) -> "dict[str, dict]":
+    """Parse the published response BYTES and index the per-workload scan
+    objects by key — the response half of the bit-exact matrix."""
+    snapshot = state.peek()
+    assert snapshot is not None
+    body = json.loads(snapshot.body_json.decode())
+    return {
+        "{cluster}/{namespace}/{name}/{container}/{kind}".format(**scan["object"]): scan
+        for scan in body["scans"]
+    }
+
+
+class TestRingFederation:
+    """The tentpole acceptance matrix: an N-aggregator ring's MERGED view —
+    store arrays AND response bytes, per key — is bit-exact vs the
+    single-process control, for N in {2, 3}, and each aggregator holds
+    exactly its owned key range."""
+
+    def test_partitioned_plane_merged_view_bitexact(self):
+        from krr_tpu.federation.ring import HashRing, parse_ring
+
+        async def run_matrix(n_nodes: int):
+            fleet = MultiClusterFleet(clusters=2, seed=101 + n_nodes)
+            control = await run_control(fleet, ticks=3)
+            now = [START]
+            servers = {}
+            shards = []
+            try:
+                names = [f"a{i}" for i in range(n_nodes)]
+                by_port = {}
+                for name in names:
+                    server = aggregator_server(fleet, lambda: now[0])
+                    await server.start(run_scheduler=False)
+                    servers[name] = server
+                    by_port[server.aggregator.port] = server
+                ring_spec = ",".join(
+                    f"{name}=127.0.0.1:{server.aggregator.port}"
+                    for name, server in servers.items()
+                )
+                shards = [
+                    make_ring_shard(fleet, c, ring_spec, lambda: now[0])
+                    for c in fleet.clusters
+                ]
+                for t in range(3):
+                    now[0] = START + t * TICK
+                    await ring_round(by_port, shards, now[0])
+
+                ring = HashRing(parse_ring(ring_spec))
+                control_store = control.state.store
+                control_index = {k: i for i, k in enumerate(control_store.keys)}
+                merged_keys = []
+                for name, server in servers.items():
+                    store = server.state.store
+                    for i, key in enumerate(store.keys):
+                        # Placement: exactly the owned partition, nothing else.
+                        assert ring.owner(key) == name, (key, name)
+                        merged_keys.append(key)
+                        j = control_index[key]
+                        for attr in (
+                            "cpu_counts", "cpu_total", "cpu_peak",
+                            "mem_total", "mem_peak",
+                        ):
+                            assert np.array_equal(
+                                getattr(store, attr)[i],
+                                getattr(control_store, attr)[j],
+                            ), (key, attr)
+                # The union IS the fleet: no key lost, none duplicated.
+                assert sorted(merged_keys) == sorted(control_store.keys)
+
+                # Response bytes, per key: each aggregator's published scan
+                # objects equal the control's for every key it owns.
+                control_scans = _scans_by_key(control.state)
+                served = {}
+                for server in servers.values():
+                    for key, scan in _scans_by_key(server.state).items():
+                        assert key not in served, key
+                        served[key] = scan
+                assert served == control_scans
+
+                # Satellite: the shard names its aggregators and per-stream
+                # lag in its status (the /healthz body).
+                status = shards[0].status()
+                assert status["ring"] == {"nodes": sorted(names)}
+                assert len(status["aggregators"]) == n_nodes
+                for entry in status["aggregators"]:
+                    assert entry["node"] in names
+                    assert entry["connected"] is True
+                    assert entry["acked_epoch"] == shards[0].epoch
+                    assert entry["epoch_lag"] == 0
+                    host, port = entry["endpoint"].rsplit(":", 1)
+                    assert int(port) in by_port
+            finally:
+                for shard in shards:
+                    await shard.close()
+                for server in servers.values():
+                    await server.shutdown()
+                await control.shutdown()
+
+        async def main():
+            for n_nodes in (2, 3):
+                await run_matrix(n_nodes)
+
+        asyncio.run(main())
+
+
+class TestAggregatorFailover:
+    """HA pairs: a ring node with a standby endpoint receives the same
+    records at the same epochs (a replicated WAL on the wire), so killing
+    the primary loses ZERO epochs — and a re-sent record after a torn ack
+    is counted as a duplicate, never double-applied."""
+
+    def test_standby_takes_over_with_zero_lost_epochs(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=83)
+            ticks = 5
+            control = await run_control(fleet, ticks=ticks)
+            now = [START]
+            primary = aggregator_server(fleet, lambda: now[0])
+            standby = aggregator_server(fleet, lambda: now[0])
+            await primary.start(run_scheduler=False)
+            await standby.start(run_scheduler=False)
+            primary_port = primary.aggregator.port
+            standby_port = standby.aggregator.port
+            ring_spec = (
+                f"a=127.0.0.1:{primary_port}|127.0.0.1:{standby_port}"
+            )
+            shard = make_ring_shard(fleet, "c0", ring_spec, lambda: now[0])
+            by_port = {primary_port: primary, standby_port: standby}
+            stream = "c0/a"
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await ring_round(by_port, [shard], now[0])
+                # Both endpoints hold the full key range, bit-exact with
+                # each other (the replicated WAL applied twice).
+                equal, detail = stores_bitexact_by_key(
+                    primary.state.store, standby.state.store
+                )
+                assert equal, detail
+
+                # Tear the standby's connection AFTER its record for epoch 3
+                # is enqueued but BEFORE it acks (the ack only flows once an
+                # aggregate tick applies): the reconnect re-sends epoch 3,
+                # which must count as a duplicate and apply exactly once.
+                standby_uplink = shard._node_uplinks["a"][1]
+                assert standby_uplink.port == standby_port
+                now[0] = START + 2 * TICK
+                await shard.tick(now[0])
+                agg_s = standby.aggregator
+                await wait_for(
+                    lambda: agg_s._shards[stream].enqueued == 3,
+                    message="standby to enqueue epoch 3",
+                )
+                standby_uplink._disconnect()
+                await shard._pump()  # reconnect → welcome acked=2 → re-send 3
+                await wait_for(
+                    lambda: agg_s._shards[stream].duplicates >= 1,
+                    message="re-sent epoch 3 to count as a duplicate",
+                )
+                await wait_for(
+                    lambda: primary.aggregator._shards[stream].enqueued == 3,
+                    message="primary to enqueue epoch 3",
+                )
+                await primary.scheduler.run_once()
+                await standby.scheduler.run_once()
+                assert await shard.wait_acked(3, timeout=5.0)
+                assert agg_s._shards[stream].duplicates == 1
+                assert agg_s._shards[stream].applied == 3
+                assert standby.state.metrics.value(
+                    "krr_tpu_federation_duplicate_records_total", shard=stream
+                ) == 1.0
+
+                # Kill the primary mid-fleet. The standby already holds
+                # everything; the stream continues against it alone.
+                await primary.shutdown()
+                for t in (3, 4):
+                    now[0] = START + t * TICK
+                    await shard.tick(now[0])
+                    await wait_for(
+                        lambda: agg_s._shards[stream].enqueued >= shard.epoch,
+                        message="standby to enqueue post-failover epochs",
+                    )
+                    await standby.scheduler.run_once()
+                    await wait_for(
+                        lambda: standby_uplink.acked >= shard.epoch,
+                        message="standby to ack post-failover epochs",
+                    )
+                # Zero lost epochs: every epoch the shard ever encoded is
+                # applied at the surviving endpoint, and the store is
+                # bit-exact vs the never-partitioned control.
+                assert shard.epoch == ticks
+                assert standby_uplink.acked == ticks
+                assert agg_s._shards[stream].applied == ticks
+                equal, detail = stores_bitexact_by_key(
+                    standby.state.store, control.state.store
+                )
+                assert equal, detail
+
+                # The shard's status tells the failover story per endpoint:
+                # the dead primary shows its lag, the standby shows none.
+                entries = {
+                    entry["endpoint"]: entry
+                    for entry in shard.status()["aggregators"]
+                }
+                dead = entries[f"127.0.0.1:{primary_port}"]
+                alive = entries[f"127.0.0.1:{standby_port}"]
+                assert not dead["connected"] and dead["epoch_lag"] == 2
+                assert alive["connected"] and alive["epoch_lag"] == 0
+            finally:
+                await shard.close()
+                await standby.shutdown()
+                await primary.shutdown()
+                await control.shutdown()
+
+        asyncio.run(main())
+
+
+# ----------------------------------------------------------- read replicas
+async def _raw_get(port: int, path: str, headers: "dict[str, str]" = None):
+    """Exact-bytes HTTP GET (no client-side decompression): the replica
+    contract is BYTE identity, including the gzip variant."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    request = f"GET {path} HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+    for name, value in (headers or {}).items():
+        request += f"{name}: {value}\r\n"
+    writer.write((request + "\r\n").encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split()[1])
+    hdrs = {}
+    for line in lines[1:]:
+        name, _, value = line.decode("latin-1").partition(":")
+        hdrs[name.strip().lower()] = value.strip()
+    return status, hdrs, body
+
+
+class TestReadReplica:
+    """``krr-tpu replica``: a stateless subscriber serves the PR 13 read
+    path byte-identically to its source — same body bytes, same ETag and
+    epoch validators, same pre-compressed variant — from the epoch feed
+    alone (catch-up frame on subscribe, broadcast on every publish)."""
+
+    def test_replica_serves_byte_identical_responses(self):
+        from krr_tpu.federation.replica import ReplicaServer
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=91)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+            replica = None
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await federated_round(server, [shard], now[0])
+
+                config = base_config(
+                    federation_aggregator=f"127.0.0.1:{server.aggregator.port}",
+                    federation_shard_id="replica-0",
+                )
+                replica = ReplicaServer(config, clock=lambda: now[0])
+                await replica.start()
+                # The catch-up frame installs the CURRENT epoch without
+                # waiting for the next publish.
+                await wait_for(
+                    lambda: replica.state.publish_epoch == server.state.publish_epoch,
+                    message="replica to install the catch-up epoch",
+                )
+
+                async def compare(path, headers=None):
+                    src = await _raw_get(server.port, path, headers)
+                    rep = await _raw_get(replica.port, path, headers)
+                    assert rep[0] == src[0], (path, rep[0], src[0])
+                    assert rep[2] == src[2], path  # body bytes
+                    for name in (
+                        "etag", "x-krr-epoch", "last-modified",
+                        "content-type", "content-encoding",
+                    ):
+                        assert rep[1].get(name) == src[1].get(name), (path, name)
+                    return src
+
+                status, headers, body = await compare("/recommendations")
+                assert status == 200 and headers["x-krr-epoch"] == "2"
+                etag = headers["etag"]
+                # The pre-compressed variant rode the feed: identical gzip
+                # BYTES, not merely equal decompressed content.
+                status, gz_headers, gz_body = await compare(
+                    "/recommendations", {"Accept-Encoding": "gzip"}
+                )
+                assert gz_headers.get("content-encoding") == "gzip"
+                assert gzip.decompress(gz_body) == body
+                # Validators transfer: a client revalidating against the
+                # replica with the SOURCE's ETag gets its 304.
+                status, hdrs, not_modified = await _raw_get(
+                    replica.port, "/recommendations", {"If-None-Match": etag}
+                )
+                assert status == 304 and not_modified == b""
+                assert hdrs["etag"] == etag
+
+                # Next publish broadcasts: the replica follows without
+                # re-subscribing, and stays byte-identical.
+                now[0] = START + 2 * TICK
+                await federated_round(server, [shard], now[0])
+                await wait_for(
+                    lambda: replica.state.publish_epoch == 3,
+                    message="replica to follow the broadcast epoch",
+                )
+                status, headers, _body = await compare("/recommendations")
+                assert headers["x-krr-epoch"] == "3"
+                status, hdrs, body = await _raw_get(replica.port, "/healthz")
+                payload = json.loads(body)
+                assert payload["replica"]["feed_epoch"] == 3
+                assert payload["replica"]["connected"] is True
+                assert payload["replica"]["epochs_applied"] == 2
+                assert payload["epoch"] == 3
+                assert replica.client.status(now[0])["source"] == (
+                    f"127.0.0.1:{server.aggregator.port}"
+                )
+                # The aggregator counts its subscriber.
+                assert server.state.metrics.value(
+                    "krr_tpu_replica_subscribers"
+                ) == 1.0
+            finally:
+                if replica is not None:
+                    await replica.shutdown()
+                await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_replica_survives_source_outage_and_resubscribes(self):
+        from krr_tpu.federation.replica import ReplicaServer
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=93)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+            agg_port = server.aggregator.port
+            replica = None
+            try:
+                now[0] = START
+                await federated_round(server, [shard], now[0])
+                config = base_config(
+                    federation_aggregator=f"127.0.0.1:{agg_port}",
+                    # Tight cap: the retry loop must find the restarted
+                    # source within the test's patience.
+                    federation_backoff_cap_seconds=0.2,
+                )
+                replica = ReplicaServer(config, clock=lambda: now[0])
+                await replica.start()
+                await wait_for(
+                    lambda: replica.state.publish_epoch == 1,
+                    message="replica to install the catch-up epoch",
+                )
+
+                # An idle-but-healthy source broadcasts nothing (epochs only
+                # move on changed bytes), so the replica's snapshot freezing
+                # far past the cadence budget must NOT read as stale while
+                # the feed is up.
+                now[0] = START + 4 * TICK
+                status, _headers, body = await _raw_get(replica.port, "/healthz")
+                assert status == 200, body
+                assert json.loads(body)["status"] == "ok", body
+
+                # Source dies: the replica keeps serving its last epoch.
+                await shard.close()
+                await server.shutdown()
+                status, headers, body = await _raw_get(replica.port, "/recommendations")
+                assert status == 200 and headers["x-krr-epoch"] == "1"
+                await wait_for(
+                    lambda: not replica.client.connected,
+                    message="replica to notice the source died",
+                )
+                # Freshly down: inside the 3-cadence budget, still healthy...
+                status, _headers, body = await _raw_get(replica.port, "/healthz")
+                assert status == 200, body
+                # ...but a feed down past the budget IS stale.
+                now[0] = START + 8 * TICK
+                status, _headers, body = await _raw_get(replica.port, "/healthz")
+                assert status == 503, body
+                assert json.loads(body)["status"] == "stale", body
+
+                # Source returns on the SAME port with more history: the
+                # subscription heals and the replica converges.
+                restarted_config = base_config(
+                    federation_listen=f"127.0.0.1:{agg_port}"
+                )
+                server = KrrServer(
+                    restarted_config,
+                    session=ScanSession(
+                        restarted_config,
+                        inventory=FleetInventory(fleet, clusters=[]),
+                        history_factory=history_factory(fleet),
+                        logger=restarted_config.create_logger(),
+                    ),
+                    clock=lambda: now[0],
+                )
+                await server.start(run_scheduler=False)
+                shard = make_shard(fleet, "c0", agg_port, lambda: now[0])
+                for t in (9, 10):
+                    now[0] = START + t * TICK
+                    await federated_round(server, [shard], now[0])
+                await wait_for(
+                    lambda: replica.state.publish_epoch
+                    == server.state.publish_epoch,
+                    message="replica to re-subscribe and converge",
+                    timeout=15.0,
+                )
+                src = await _raw_get(server.port, "/recommendations")
+                rep = await _raw_get(replica.port, "/recommendations")
+                assert rep[2] == src[2] and rep[1]["etag"] == src[1]["etag"]
+                assert replica.client.reconnects >= 2
+                # Resubscribed: the stale verdict clears.
+                status, _headers, body = await _raw_get(replica.port, "/healthz")
+                assert status == 200 and json.loads(body)["status"] == "ok", body
+            finally:
+                if replica is not None:
+                    await replica.shutdown()
+                await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------- uplink backoff
+class TestUplinkBackoff:
+    def test_capped_jitter_ladder_and_reset(self, monkeypatch):
+        """The uplink reconnect rides the Prometheus retry ladder's
+        semantics: 0.25·2^(n−1) capped PRE-jitter at --backoff-cap-seconds,
+        ±50% jitter (pinned to 1.0 here), re-armed by a successful connect
+        or an explicit repoint."""
+        import krr_tpu.federation.shard as shard_mod
+        from krr_tpu.federation.shard import Uplink
+        from krr_tpu.obs.metrics import MetricsRegistry
+
+        monkeypatch.setattr(shard_mod.random, "uniform", lambda a, b: 1.0)
+
+        async def main():
+            config = base_config()
+            spec = config.create_strategy().settings.cpu_spec()
+            probe = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+            dead_port = probe.sockets[0].getsockname()[1]
+            probe.close()
+            await probe.wait_closed()
+            metrics = MetricsRegistry()
+            uplink = Uplink(
+                stream_id="t",
+                host="127.0.0.1",
+                port=dead_port,
+                generation="g",
+                hello_spec={
+                    "gamma": spec.gamma,
+                    "min_value": spec.min_value,
+                    "num_buckets": spec.num_buckets,
+                },
+                snapshot_fn=lambda: None,
+                metrics=metrics,
+                logger=config.create_logger(),
+                buffer_cap=4,
+                backoff_cap=2.0,
+            )
+            waits = []
+            for _ in range(6):
+                uplink._next_attempt = 0.0  # force the next dial now
+                await uplink.pump()
+                assert not uplink.connected
+                waits.append(uplink._next_attempt - time.monotonic())
+            expected = [0.25, 0.5, 1.0, 2.0, 2.0, 2.0]
+            for got, want in zip(waits, expected):
+                assert want - 0.15 <= got <= want + 0.01, (waits, expected)
+            assert metrics.value("krr_tpu_federation_uplink_retries_total") == 6.0
+            # Inside the window the pump doesn't even dial.
+            attempts = uplink._attempts
+            await uplink.pump()
+            assert uplink._attempts == attempts
+
+            # Success re-arms the ladder from zero.
+            fleet = MultiClusterFleet(clusters=1, seed=7)
+            server = aggregator_server(fleet, lambda: START)
+            await server.start(run_scheduler=False)
+            try:
+                uplink.host, uplink.port = "127.0.0.1", server.aggregator.port
+                uplink.reset_backoff()
+                assert uplink._next_attempt == 0.0
+                await uplink.pump()
+                assert uplink.connected and uplink._attempts == 0
+            finally:
+                await uplink.close()
                 await server.shutdown()
 
         asyncio.run(main())
