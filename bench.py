@@ -72,6 +72,12 @@ under ``secondary.obs_device_*``), BENCH_SKIP_CHAOS, BENCH_CHAOS_TICKS
 archetype fleet through real serve ticks under a scripted fault timeline,
 gated on no crash, recovery bit-exactness vs a never-faulted control, and
 a bounded hard-down tick wall, carried under ``secondary.chaos_*``),
+BENCH_SKIP_EVAL, BENCH_EVAL_SAMPLES (default 240), BENCH_EVAL_WORKLOADS
+(default 2), BENCH_EVAL_TICKS (default 8 — the quality-evaluation leg:
+registered strategies + labeled static probes replayed through the real
+hysteresis gate over a chaos-archetype fleet, gated on byte-identical
+repeated scoreboards and the labeled-archetype ranking contract, replay
+throughput carried under ``secondary.eval_*``),
 BENCH_SKIP_FETCHPLAN, BENCH_FETCHPLAN_WORKLOADS (default 3 — the adaptive
 fetch-engine leg: a real-loader fetch over HTTP where the planner coalesces
 AND shards, gated on plan-counter engagement, bit-exactness vs the
@@ -165,6 +171,11 @@ SMOKE_DEFAULTS = {
     # serve ticks, at toy scale but with every gate EXECUTED.
     "BENCH_CHAOS_TICKS": "8",
     "BENCH_CHAOS_WORKLOADS": "2",
+    # Eval leg: strategy + probe replays over a labeled archetype fleet
+    # (determinism + ranking gates EXECUTED at toy scale).
+    "BENCH_EVAL_SAMPLES": "96",
+    "BENCH_EVAL_WORKLOADS": "1",
+    "BENCH_EVAL_TICKS": "6",
     # Discovery leg: watch-reconcile vs per-round relist at equal fleet
     # width with injected churn (bit-exactness + reconcile-beats-relist
     # gates EXECUTED at toy scale).
@@ -454,6 +465,110 @@ def chaos_leg(secondary: dict, check) -> None:
         "chaos_down_tick_wall_bounded",
         down_wall < 10.0,
         f"hard-down tick took {down_wall:.2f}s (clean tick {clean_wall:.2f}s)",
+    )
+
+
+def eval_leg(secondary: dict, check) -> None:
+    """Quality-evaluation gates (`krr_tpu.eval`): replay registered
+    strategies plus labeled static probes over a chaos-archetype fleet,
+    with the archetypes' DECLARED incident windows as ground truth. Two
+    parity-style gates:
+
+    * eval_deterministic — the same replay rendered twice is BYTE-identical
+      (jitted reductions over fixed shapes, no clock reads anywhere in the
+      scoreboard path);
+    * eval_ranks_labeled_archetypes — the undersized probe scores >0
+      would-have-been OOM incidents on the oom-loop archetype, the
+      oversized probe scores none with MORE over-provisioned GB-hours, and
+      the board ranks the incident-free probe first (safety before cost).
+
+    Replay wall + throughput are trended under ``secondary.eval_*``.
+    """
+    import json
+
+    from krr_tpu.eval import (
+        StaticReplayStrategy,
+        build_scoreboard,
+        render_scoreboard,
+        replay,
+        score_replay,
+    )
+    from krr_tpu.strategies.base import BaseStrategy
+    from tests.fakes.chaos import ArchetypeSpec, build_fleet, fleet_replay_input
+
+    samples = int(os.environ.get("BENCH_EVAL_SAMPLES", 240))
+    workloads = int(os.environ.get("BENCH_EVAL_WORKLOADS", 2))
+    ticks = int(os.environ.get("BENCH_EVAL_TICKS", 8))
+    fleet = build_fleet(
+        tuple(
+            ArchetypeSpec(kind, workloads=workloads, pods=1)
+            for kind in ("oom-loop", "diurnal", "bursty-batch")
+        ),
+        samples=samples,
+        seed=31,
+    )
+    inputs = fleet_replay_input(fleet)
+    probes = (
+        # Under every oom-loop incident peak (~7.4e8+ bytes) but over the
+        # diurnal baseline; vs comfortably over everything.
+        ("static-under", lambda: StaticReplayStrategy(0.01, 3e8)),
+        ("static-over", lambda: StaticReplayStrategy(10.0, 5e9)),
+    )
+
+    def board_json() -> "tuple[str, float]":
+        rows = []
+        start = time.perf_counter()
+        for name in ("simple", "tdigest"):
+            strategy_type = BaseStrategy.find(name)
+            strategy = strategy_type(strategy_type.get_settings_type()())
+            rows.append(score_replay(inputs, replay(inputs, strategy, name=name, ticks=ticks)))
+        wall = time.perf_counter() - start
+        for name, make in probes:
+            rows.append(score_replay(inputs, replay(inputs, make(), name=name, ticks=ticks)))
+        board = build_scoreboard(
+            rows,
+            samples=len(inputs.timestamps),
+            window_seconds=float(inputs.timestamps[-1] - inputs.timestamps[0]),
+        )
+        return render_scoreboard(board, "json"), wall
+
+    first, wall = board_json()
+    second, _ = board_json()
+    payload = json.loads(first)
+    order = [s["strategy"] for s in payload["scores"]]
+    by_name = {s["strategy"]: s for s in payload["scores"]}
+    under, over = by_name["static-under"], by_name["static-over"]
+    replayed_rows = 2 * len(inputs.keys) * ticks  # registry strategies only
+    rows_per_sec = replayed_rows / wall if wall > 0 else 0.0
+    secondary["eval_workloads"] = float(len(inputs.keys))
+    secondary["eval_samples"] = float(len(inputs.timestamps))
+    secondary["eval_replay_seconds"] = round(wall, 4)
+    secondary["eval_replay_rows_per_sec"] = round(rows_per_sec, 2)
+    print(
+        f"bench: eval replayed 2 strategies + {len(probes)} probes over "
+        f"{len(inputs.keys)} workloads x {len(inputs.timestamps)} samples "
+        f"in {ticks} ticks: {wall:.3f}s ({rows_per_sec:.0f} rows/s), "
+        f"board order {order}",
+        file=sys.stderr,
+    )
+    check(
+        "eval_deterministic",
+        first == second,
+        "repeated replay rendered a different scoreboard (byte-identity broken)",
+    )
+    ranks = (
+        under["oom_incidents"] > 0
+        and over["oom_incidents"] == 0
+        and over["throttle_incidents"] == 0
+        and over["overprovisioned_gb_hours"] > under["overprovisioned_gb_hours"]
+        and order.index("static-over") < order.index("static-under")
+    )
+    check(
+        "eval_ranks_labeled_archetypes",
+        ranks,
+        f"under={under['oom_incidents']} oom / {under['overprovisioned_gb_hours']} GBh, "
+        f"over={over['oom_incidents']} oom / {over['overprovisioned_gb_hours']} GBh, "
+        f"order {order}",
     )
 
 
@@ -2821,6 +2936,13 @@ def main() -> None:
         # bit-exactness, and the breaker-bounded hard-down tick wall — the
         # standing regression gate for the fault-isolation machinery.
         chaos_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_EVAL"):
+        # Quality-evaluation gates: byte-identical repeated replays and the
+        # labeled-archetype ranking contract (undersized probe finds the
+        # declared OOM windows, oversized probe buys zero incidents with
+        # more slack) — the standing gate for the eval scoreboard.
+        eval_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_DISCOVERY"):
         # Discovery gates: the watch-mode reconcile must stay bit-identical

@@ -463,6 +463,16 @@ class Config(pd.BaseModel):
     #: every tick either way.
     hysteresis_enabled: bool = True
 
+    # Quality evaluation (`krr_tpu.eval`)
+    #: Replay ticks `krr-tpu eval` walks the recorded grid in: each tick the
+    #: strategy sees the history so far and its raw recommendation routes
+    #: through the real hysteresis gate before scoring.
+    eval_replay_ticks: int = pd.Field(16, ge=1)
+    #: Serve the journal-derived fleet savings block on GET /statusz (and
+    #: the krr_tpu_eval_* gauges it refreshes); False skips the computation
+    #: entirely on scrape.
+    savings_enabled: bool = True
+
     # TPU backend settings
     #: Fleet-axis host chunking: the raw path's packed [rows × T] copy is
     #: built (and run) at most this many rows at a time

@@ -816,6 +816,16 @@ def _server_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--savings/--no-savings", "savings_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "--no-savings drops the journal-derived fleet savings block "
+                "from GET /statusz (and stops refreshing the krr_tpu_eval_* "
+                "window gauges on scrape)."
+            ),
+        ),
+        PanelOption(
             ["--federation-listen", "federation_listen"],
             default=None,
             panel="Server Settings",
@@ -1596,6 +1606,175 @@ def _make_diff_command(strategy_name: str, strategy_type: Any) -> click.Command:
     )
 
 
+def _make_eval_command() -> click.Command:
+    """``krr-tpu eval``: the what-if replay scoreboard.
+
+    Replays registered strategies tick-by-tick over recorded usage — a serve
+    journal (read-only, the diff open path) or an ``.npz`` usage grid — each
+    raw recommendation routed through a REAL hysteresis gate, then scores
+    would-have-been OOM/throttle incidents, over-provisioned core-/GB-hours,
+    and gate flaps (`krr_tpu.eval`), rendering the ranked board through the
+    formatter registry.
+    """
+
+    def callback(**kwargs: Any) -> None:
+        import pydantic
+
+        from krr_tpu.core.config import Config
+
+        journal_path = kwargs.pop("journal_path")
+        usage_path = kwargs.pop("usage_path")
+        state_path = kwargs.pop("state_path")
+        strategy_names = list(kwargs.pop("strategies") or [])
+        clusters = list(kwargs.pop("clusters") or [])
+        namespaces = list(kwargs.pop("namespaces") or [])
+        try:
+            config = Config(
+                clusters="*" if "*" in clusters else (clusters or None),
+                namespaces="*" if ("*" in namespaces or not namespaces) else namespaces,
+                **kwargs,
+            )
+        except pydantic.ValidationError as e:
+            details = "; ".join(
+                f"--{'.'.join(str(p) for p in err['loc']) or 'config'}: {err['msg']}" for err in e.errors()
+            )
+            raise click.UsageError(f"Invalid settings — {details}") from e
+
+        from krr_tpu.eval import (
+            ReplayInput,
+            build_scoreboard,
+            render_scoreboard,
+            replay,
+            score_replay,
+        )
+        from krr_tpu.strategies.base import BaseStrategy
+
+        logger = config.create_logger()
+        if usage_path is not None and journal_path is not None:
+            raise click.UsageError("--usage and --journal are two sources for ONE grid; pass one")
+        if usage_path is not None:
+            inputs = ReplayInput.load_npz(usage_path)
+        else:
+            if journal_path is None:
+                if state_path:
+                    journal_path = f"{state_path}.journal"
+                else:
+                    raise click.UsageError(
+                        "pass --journal (or --state_path to derive <state_path>.journal), "
+                        "or --usage for an .npz grid"
+                    )
+            try:
+                # readonly: like diff, an eval must never create, repair, or
+                # truncate a journal — including one a running server owns.
+                inputs = ReplayInput.from_journal(
+                    journal_path,
+                    retention_seconds=config.history_retention_seconds,
+                    logger=logger,
+                )
+            except ValueError as e:
+                raise click.UsageError(str(e)) from e
+        inputs = inputs.scoped(
+            namespaces=None if config.namespaces == "*" else tuple(config.namespaces),
+            clusters=tuple(config.clusters) if isinstance(config.clusters, list) else None,
+        )
+        if not inputs.keys:
+            raise click.UsageError("no workloads left to replay after -n/-c scoping")
+
+        available = BaseStrategy.get_all()
+        names = strategy_names or sorted(available)
+        unknown = [n for n in names if n not in available]
+        if unknown:
+            raise click.UsageError(
+                f"unknown strategy {', '.join(unknown)} (available: {', '.join(sorted(available))})"
+            )
+        rows = []
+        for name in names:
+            strategy_type = available[name]
+            strategy = strategy_type(strategy_type.get_settings_type()())
+            replayed = replay(
+                inputs,
+                strategy,
+                name=name,
+                ticks=config.eval_replay_ticks,
+                dead_band_pct=config.hysteresis_dead_band_pct,
+                confirm_ticks=config.hysteresis_confirm_ticks,
+                hysteresis=config.hysteresis_enabled,
+            )
+            rows.append(score_replay(inputs, replayed))
+            logger.info(
+                f"eval: replayed {name} over {len(inputs.keys)} workload(s) x "
+                f"{len(inputs.timestamps)} samples in {len(replayed.tick_indices)} tick(s)"
+            )
+        window = (
+            float(inputs.timestamps[-1] - inputs.timestamps[0]) if len(inputs.timestamps) else 0.0
+        )
+        board = build_scoreboard(rows, samples=len(inputs.timestamps), window_seconds=window)
+        logger.print_result(render_scoreboard(board, config.format))
+
+    from krr_tpu.core.config import Config
+
+    eval_options = [
+        PanelOption(
+            ["--journal", "journal_path"],
+            default=None,
+            help="Path to the serve journal to replay (default: <state_path>.journal when --state_path is set).",
+        ),
+        PanelOption(
+            ["--usage", "usage_path"],
+            default=None,
+            help="Path to an .npz usage grid (keys/timestamps/cpu/mem arrays) to replay instead of a journal.",
+        ),
+        PanelOption(
+            ["--state_path"],
+            default=None,
+            help="Digest state path whose <state_path>.journal sibling holds the recorded history.",
+        ),
+        PanelOption(
+            ["--strategy", "strategies"],
+            multiple=True,
+            help="Strategy to replay (repeatable; default: every registered strategy, with default settings).",
+        ),
+        PanelOption(
+            ["--replay-ticks", "eval_replay_ticks"],
+            type=int,
+            default=Config.model_fields["eval_replay_ticks"].default,
+            show_default=True,
+            help="Replay ticks to walk the recorded grid in (each re-runs the strategy on the history so far).",
+        ),
+        PanelOption(
+            ["--dead-band-pct", "hysteresis_dead_band_pct"],
+            type=float,
+            default=Config.model_fields["hysteresis_dead_band_pct"].default,
+            show_default=True,
+            help="Hysteresis dead band the replayed recommendations gate through.",
+        ),
+        PanelOption(
+            ["--confirm-ticks", "hysteresis_confirm_ticks"],
+            type=int,
+            default=Config.model_fields["hysteresis_confirm_ticks"].default,
+            show_default=True,
+            help="Consecutive out-of-band replay ticks before the gate republishes.",
+        ),
+        PanelOption(
+            ["--hysteresis/--no-hysteresis", "hysteresis_enabled"],
+            default=True,
+            help="--no-hysteresis replays every raw recommendation verbatim (gate pass-through).",
+        ),
+    ]
+    return PanelCommand(
+        "eval",
+        callback=callback,
+        params=eval_options + _common_options(),
+        help=(
+            "Score registered strategies against recorded usage: replay a "
+            "serve journal (read-only) or an .npz grid tick-by-tick through "
+            "the real hysteresis gate and rank the would-have-been "
+            "OOM/throttle incidents, over-provisioned core/GB-hours, and "
+            "flap counts per strategy."
+        ),
+    )
+
+
 def _finish_observability(config: Any, session: Any) -> None:
     """The ``--trace`` / ``--metrics-dump`` / ``--statusz`` exit hooks of a
     one-shot scan: dump the session tracer's ring as Chrome trace JSON, the
@@ -1906,6 +2085,7 @@ def load_commands() -> None:
         app.add_command(_make_replica_command())
         app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
     app.add_command(_make_analyze_command())
+    app.add_command(_make_eval_command())
 
 
 def run() -> None:

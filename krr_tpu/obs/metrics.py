@@ -179,6 +179,16 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),
     ("krr_tpu_slo_alert_firing", "gauge", "1 while the objective's fast AND slow burn rates exceed their thresholds, else 0."),
     ("krr_tpu_slo_alert_transitions_total", "counter", "SLO alert state transitions by objective and direction (firing|resolved)."),
+    # Quality evaluation (`krr_tpu.eval`): the journal-derived fleet
+    # savings posture refreshed on /statusz scrape, plus the scheduler's
+    # instantaneous gate-vs-raw over-provision snapshot each publish tick.
+    ("krr_tpu_eval_oom_incidents", "gauge", "Would-have-been OOM incidents over the journal window: rising edges where recorded raw memory demand exceeded the published recommendation."),
+    ("krr_tpu_eval_throttle_incidents", "gauge", "Would-have-been CPU throttle incidents over the journal window: rising edges where recorded raw CPU demand exceeded the published recommendation."),
+    ("krr_tpu_eval_overprovision_core_hours", "gauge", "Core-hours of published-above-demand CPU slack integrated over the journal window (the reclaimable CPU savings)."),
+    ("krr_tpu_eval_overprovision_gb_hours", "gauge", "GB-hours of published-above-demand memory slack integrated over the journal window (the reclaimable memory savings)."),
+    ("krr_tpu_eval_overprovision_cores", "gauge", "Instantaneous gate-held CPU above raw demand summed over the fleet at the last publish tick."),
+    ("krr_tpu_eval_overprovision_gb", "gauge", "Instantaneous gate-held memory above raw demand (GB) summed over the fleet at the last publish tick."),
+    ("krr_tpu_eval_replay_seconds", "gauge", "Wall seconds the last /statusz savings computation spent replaying the journal."),
     # Process self-metrics (refreshed on scrape/dump).
     ("krr_tpu_process_resident_bytes", "gauge", "Resident set size of this process."),
     ("krr_tpu_process_open_fds", "gauge", "Open file descriptors of this process."),

@@ -278,6 +278,7 @@ class HttpApp:
         tracer: NullTracer = NULL_TRACER,
         render_concurrency: int = 4,
         render_queue: int = 16,
+        savings_enabled: bool = True,
     ) -> None:
         self.state = state
         self.logger = logger
@@ -301,6 +302,11 @@ class HttpApp:
         #: of records) and is IDENTICAL between scheduler ticks — a poller
         #: must not burn a core-second per scrape recomputing it.
         self._trend_memo: "Optional[tuple[tuple, dict]]" = None
+        #: Whether /statusz serves the journal-derived fleet savings block
+        #: (and refreshes the krr_tpu_eval_* gauges). Memoized like the
+        #: trend report — the journal replay is identical between ticks.
+        self.savings_enabled = bool(savings_enabled)
+        self._savings_memo: "Optional[tuple[tuple, Optional[dict]]]" = None
         #: Open client connections, for shutdown: ``Server.close()`` stops
         #: the listener but never touches established keep-alive
         #: connections, and on Python ≥ 3.12.1 ``wait_closed()`` waits for
@@ -469,6 +475,9 @@ class HttpApp:
             text = engine.render_text()
             if self.state.sentinel is not None:
                 text += self._trend_text()
+            savings = await asyncio.to_thread(self._savings_block)
+            if savings is not None:
+                text += self._savings_text(savings)
             return 200, "text/plain; charset=utf-8", text.encode()
         if fmt != "json":
             return 400, "application/json", _json_body(
@@ -493,11 +502,63 @@ class HttpApp:
             "discovery": dict(self.state.discovery),
             "ingest": dict(self.state.ingest),
         }
+        # The fleet "savings" summary: what the journal says the published
+        # recommendations would have cost/saved over the retention window
+        # (`krr_tpu.eval.score.journal_savings`) — serve-only, like trend.
+        savings = await asyncio.to_thread(self._savings_block)
+        if savings is not None:
+            payload["savings"] = savings
         if self.state.federation is not None:
             payload["federation"] = self.state.federation.status(float(self.clock()))
         if self.state.replica is not None:
             payload["replica"] = self.state.replica.status(float(self.clock()))
         return 200, "application/json", _json_body(payload)
+
+    def _savings_block(self) -> "Optional[dict]":
+        """The journal-derived fleet savings summary, memoized on (record
+        count, newest tick) — a scrape never re-replays an unchanged
+        journal — with the ``krr_tpu_eval_*`` gauges refreshed whenever the
+        replay actually runs."""
+        journal = self.state.journal
+        if not self.savings_enabled or journal is None:
+            return None
+        key = (journal.record_count, journal.newest_ts)
+        if self._savings_memo is not None and self._savings_memo[0] == key:
+            return self._savings_memo[1]
+        from krr_tpu.eval.score import journal_savings
+
+        started = time.monotonic()
+        block = journal_savings(journal)
+        if block is not None:
+            metrics = self.state.metrics
+            metrics.set("krr_tpu_eval_oom_incidents", block["oom_incidents"])
+            metrics.set("krr_tpu_eval_throttle_incidents", block["throttle_incidents"])
+            metrics.set(
+                "krr_tpu_eval_overprovision_core_hours", block["overprovisioned_core_hours"]
+            )
+            metrics.set(
+                "krr_tpu_eval_overprovision_gb_hours", block["overprovisioned_gb_hours"]
+            )
+            metrics.set(
+                "krr_tpu_eval_replay_seconds", round(time.monotonic() - started, 6)
+            )
+        self._savings_memo = (key, block)
+        return block
+
+    def _savings_text(self, block: "dict") -> str:
+        """The human savings lines appended to ``/statusz?format=text``."""
+        hours = block["window_seconds"] / 3600.0
+        return (
+            "\n"
+            "savings (journal replay):\n"
+            f"  {block['workloads']} workload(s) over {block['ticks']} tick(s) ({hours:.1f}h)\n"
+            f"  would-have-been incidents: {block['oom_incidents']} OOM, "
+            f"{block['throttle_incidents']} throttle\n"
+            f"  reclaimable slack: {block['overprovisioned_core_hours']:.3f} core-h, "
+            f"{block['overprovisioned_gb_hours']:.3f} GB-h\n"
+            f"  {block['published_records']} published / {block['suppressed_records']} "
+            f"suppressed journal records\n"
+        )
 
     def _trend_text(self) -> str:
         """The human trend lines appended to ``/statusz?format=text``."""
@@ -1369,6 +1430,7 @@ class KrrServer:
             tracer=self.session.tracer,
             render_concurrency=config.server_render_concurrency,
             render_queue=config.server_render_queue,
+            savings_enabled=config.savings_enabled,
         )
         self._server: Optional[asyncio.AbstractServer] = None
 

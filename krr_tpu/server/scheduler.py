@@ -547,6 +547,23 @@ class ScanScheduler:
                 )
             keys = [object_key(obj) for obj in objects]
             decision = self.gate.observe(keys, cpu_raw, mem_raw)
+            # The instantaneous over-provision snapshot (`krr_tpu.eval`):
+            # what the gate-HELD values publish above this tick's raw
+            # demand, fleet-summed. The /statusz savings block integrates
+            # the same slack over the journal window; this pair is the
+            # per-tick spot reading. Raw memory is journal-unit MB → GB.
+            held_cpu = np.asarray(decision.cpu, np.float64)
+            held_mem = np.asarray(decision.mem, np.float64)
+            cpu_slack = np.where(
+                np.isfinite(held_cpu) & np.isfinite(cpu_raw),
+                np.maximum(held_cpu - cpu_raw, 0.0), 0.0,
+            )
+            mem_slack = np.where(
+                np.isfinite(held_mem) & np.isfinite(mem_raw),
+                np.maximum(held_mem - mem_raw, 0.0), 0.0,
+            )
+            metrics.set("krr_tpu_eval_overprovision_cores", round(float(cpu_slack.sum()), 6))
+            metrics.set("krr_tpu_eval_overprovision_gb", round(float(mem_slack.sum()) / 1000.0, 6))
             # The shared publish epoch: this tick's journal batch is marked
             # with the epoch its store persist WILL commit as, so a crash
             # between the two is detectable (and reconciled by truncation)

@@ -37,7 +37,7 @@ import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -51,19 +51,50 @@ STEP = 60.0  # the fake series grid (timeframe_duration = 1 minute)
 
 
 # ------------------------------------------------------------ archetype series
-def _diurnal(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+#: Declared incident labels: each generator returns, alongside its series, the
+#: sample-index windows ``[start, end)`` where its OWN parameters put demand
+#: at archetype peak — the spans an undersized recommendation would incident
+#: on. Labels are emitted at generation time from the generator's internal
+#: knobs (sawtooth ramp, burst starts, sine phase), NOT re-derived from the
+#: noisy output data, so the eval oracle asserts against declared ground
+#: truth (`krr_tpu.eval`) instead of against its own detector.
+Windows = "tuple[tuple[int, int], ...]"
+
+
+def _mask_windows(mask: np.ndarray) -> "tuple[tuple[int, int], ...]":
+    """Contiguous True runs of ``mask`` as ``(start, end)`` windows."""
+    edges = np.flatnonzero(np.diff(np.r_[0, mask.astype(np.int8), 0]))
+    return tuple((int(edges[j]), int(edges[j + 1])) for j in range(0, len(edges), 2))
+
+
+def _merge_windows(windows: "list[tuple[int, int]]") -> "tuple[tuple[int, int], ...]":
+    """Sorted union of possibly-overlapping windows (per-pod labels of one
+    workload fold into workload-level spans)."""
+    merged: "list[list[int]]" = []
+    for start, end in sorted(windows):
+        if merged and start <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], end)
+        else:
+            merged.append([start, end])
+    return tuple((s, e) for s, e in merged)
+
+
+def _diurnal(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray, Windows]":
     """Sinusoidal day/night load: the pattern cycles twice inside the series
     so percentiles genuinely move as the scan window grows."""
     t = np.arange(n)
     phase = rng.uniform(0, 2 * np.pi)
     base = rng.uniform(0.2, 0.5)
-    cpu = base * (1.0 + 0.6 * np.sin(2 * np.pi * t / (n / 2) + phase))
+    wave = np.sin(2 * np.pi * t / (n / 2) + phase)
+    cpu = base * (1.0 + 0.6 * wave)
     cpu = np.clip(cpu + rng.normal(0, 0.01, n), 1e-3, None)
-    mem = 2e8 * (1.0 + 0.3 * np.sin(2 * np.pi * t / (n / 2) + phase)) + rng.uniform(0, 1e7, n)
-    return cpu, mem
+    mem = 2e8 * (1.0 + 0.3 * wave) + rng.uniform(0, 1e7, n)
+    # Peak label: the top of the drawn sine (phase is a parameter of this
+    # pod's series, so the windows are declared, not detected).
+    return cpu, mem, _mask_windows(wave >= 0.8)
 
 
-def _bursty_batch(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+def _bursty_batch(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray, Windows]":
     """Idle baseline with periodic tall bursts (cron-style batch): sizing to
     the burst vs the baseline is exactly what percentile strategies disagree
     about."""
@@ -71,45 +102,50 @@ def _bursty_batch(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray
     mem = np.full(n, 8e7) + rng.uniform(0, 5e6, n)
     period = max(8, n // 6)
     width = max(2, period // 8)
+    windows: "list[tuple[int, int]]" = []
     for start in range(rng.integers(0, period), n, period):
         height = rng.uniform(1.5, 3.0)
         cpu[start : start + width] += height
         mem[start : start + width] += 6e8
-    return np.clip(cpu, 1e-3, None), mem
+        windows.append((start, min(start + width, n)))
+    return np.clip(cpu, 1e-3, None), mem, tuple(windows)
 
 
-def _oom_loop(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+def _oom_loop(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray, Windows]":
     """Memory sawtooth climbing to a ceiling and resetting (an OOM-killed
     container in a restart loop); CPU stays low."""
     cpu = np.clip(np.full(n, 0.05) + rng.normal(0, 0.01, n), 1e-3, None)
     ramp = max(6, n // 8)
     t = np.arange(n)
-    mem = 1e8 + (9e8 - 1e8) * ((t % ramp) / ramp)
+    fill = (t % ramp) / ramp
+    mem = 1e8 + (9e8 - 1e8) * fill
     mem = mem + rng.uniform(0, 5e6, n)
-    return cpu, mem
+    # Spike label: the top fifth of each sawtooth cycle (where the restart
+    # loop's kills land) — one window per cycle, declared from the ramp.
+    return cpu, mem, _mask_windows(fill >= 0.8)
 
 
-def _high_churn(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+def _high_churn(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray, Windows]":
     """Moderate lognormal noise — the archetype's character is DISCOVERY
     churn (pods and deployments replaced mid-soak via ``on_tick``), not the
     series shape."""
     cpu = rng.lognormal(mean=-2.0, sigma=0.4, size=n)
     mem = rng.uniform(1e8, 2.5e8, n)
-    return cpu, mem
+    return cpu, mem, ()
 
 
-def _mixed_qos(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray]":
+def _mixed_qos(rng: np.random.Generator, n: int, i: int) -> "tuple[np.ndarray, np.ndarray, Windows]":
     """Alternating QoS classes: even workloads run flat and hot
     (guaranteed), odd ones idle with rare spikes (burstable)."""
     if i % 2 == 0:
         cpu = np.clip(np.full(n, 0.5) + rng.normal(0, 0.01, n), 1e-3, None)
         mem = np.full(n, 4e8) + rng.uniform(0, 1e7, n)
-    else:
-        cpu = np.clip(np.full(n, 0.04) + rng.normal(0, 0.008, n), 1e-3, None)
-        spikes = rng.random(n) < 0.03
-        cpu = cpu + np.where(spikes, rng.uniform(0.5, 1.0, n), 0.0)
-        mem = np.full(n, 9e7) + rng.uniform(0, 8e6, n)
-    return cpu, mem
+        return cpu, mem, ()
+    cpu = np.clip(np.full(n, 0.04) + rng.normal(0, 0.008, n), 1e-3, None)
+    spikes = rng.random(n) < 0.03
+    cpu = cpu + np.where(spikes, rng.uniform(0.5, 1.0, n), 0.0)
+    mem = np.full(n, 9e7) + rng.uniform(0, 8e6, n)
+    return cpu, mem, _mask_windows(spikes)
 
 
 ARCHETYPES: "dict[str, Callable]" = {
@@ -145,6 +181,24 @@ class ChaosFleet:
     backend: FakeBackend
     #: namespace → workload names, for targeting faults and assertions.
     namespaces: "dict[str, list[str]]"
+    #: (namespace, workload, pod) → the generator's DECLARED incident
+    #: windows for that pod's series (sample-index ``[start, end)`` spans).
+    labels: "dict[tuple[str, str, str], tuple[tuple[int, int], ...]]" = field(
+        default_factory=dict
+    )
+
+    def incident_windows(self, kind: Optional[str] = None) -> "dict[str, tuple[tuple[int, int], ...]]":
+        """The fleet's labeled ground truth, per workload: declared incident
+        windows merged across the workload's pods, keyed
+        ``namespace/workload``. ``kind`` filters to one archetype. This is
+        the oracle surface the eval tests assert against — labels the
+        generators emitted, never spans re-derived from the series."""
+        grouped: "dict[str, list[tuple[int, int]]]" = {}
+        for (namespace, name, _pod), windows in self.labels.items():
+            if kind is not None and not name.startswith(f"{kind}-"):
+                continue
+            grouped.setdefault(f"{namespace}/{name}", []).extend(windows)
+        return {key: _merge_windows(spans) for key, spans in sorted(grouped.items())}
 
 
 def build_fleet(
@@ -160,6 +214,7 @@ def build_fleet(
     metrics.enforce_range = True  # window slicing: the delta-fetch contract
     rng = np.random.default_rng(seed)
     namespaces: "dict[str, list[str]]" = {}
+    labels: "dict[tuple[str, str, str], tuple[tuple[int, int], ...]]" = {}
     for spec in specs:
         generate = ARCHETYPES[spec.kind]
         namespace = spec.namespace or spec.kind
@@ -169,15 +224,51 @@ def build_fleet(
                 "Deployment", name, namespace, pod_count=spec.pods
             )
             for pod in pods:
-                cpu, mem = generate(rng, samples, w)
+                cpu, mem, windows = generate(rng, samples, w)
                 metrics.set_series(namespace, "main", pod, cpu=cpu, memory=mem)
+                labels[(namespace, name, pod)] = windows
             namespaces.setdefault(namespace, []).append(name)
     return ChaosFleet(
         cluster=cluster,
         metrics=metrics,
         backend=FakeBackend(cluster, metrics),
         namespaces=namespaces,
+        labels=labels,
     )
+
+
+def fleet_replay_input(fleet: ChaosFleet):
+    """A chaos fleet as eval replay input (`krr_tpu.eval.ReplayInput`): one
+    row per workload on the fake series grid, usage = the elementwise MAX
+    across the workload's pods (per-container sizing must cover the
+    hungriest pod). Keys use the fleet's object-key grammar so ``-n``
+    scoping and the labels' ``namespace/workload`` keys line up."""
+    from krr_tpu.eval import ReplayInput
+
+    per_workload: "dict[str, tuple[np.ndarray, np.ndarray]]" = {}
+    for (namespace, container, _pod), (cpu, mem) in sorted(fleet.metrics.series.items()):
+        name = _workload_for_pod(fleet, namespace, _pod)
+        key = f"/{namespace}/{name}/{container}/Deployment"
+        held = per_workload.get(key)
+        if held is None:
+            per_workload[key] = (np.asarray(cpu, float), np.asarray(mem, float))
+        else:
+            per_workload[key] = (np.maximum(held[0], cpu), np.maximum(held[1], mem))
+    samples = len(next(iter(per_workload.values()))[0])
+    timestamps = ORIGIN + STEP * np.arange(samples)
+    return ReplayInput.from_series(per_workload, timestamps)
+
+
+def _workload_for_pod(fleet: ChaosFleet, namespace: str, pod: str) -> str:
+    for (ns, name, p) in fleet.labels:
+        if ns == namespace and p == pod:
+            return name
+    # Pods added outside build_fleet (churn scenarios): fall back to the
+    # conventional "<workload>-<pod suffix>" prefix match.
+    for name in fleet.namespaces.get(namespace, ()):
+        if pod.startswith(f"{name}-"):
+            return name
+    return pod
 
 
 def write_kubeconfig(path, url: str) -> str:
@@ -567,6 +658,7 @@ __all__ = [
     "SoakReport",
     "TickSample",
     "build_fleet",
+    "fleet_replay_input",
     "run_kill_soak",
     "run_soak",
     "stores_bitexact",

@@ -95,6 +95,15 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("chaos_breaker_opens", 0) >= 1, secondary
     assert secondary.get("chaos_recovered_bitexact") == 1.0, secondary
     assert 0 < secondary.get("chaos_down_tick_seconds", 0) < 10.0, secondary
+    # The quality-evaluation leg ran end-to-end: registered strategies +
+    # labeled static probes replayed through the real hysteresis gate over
+    # the archetype fleet, the repeated scoreboard was byte-identical, and
+    # the labeled ranking contract held (gate failures are rc 1; assert
+    # the fields so a leg-skipping refactor can't pass silently).
+    assert secondary.get("eval_workloads", 0) >= 3, secondary
+    assert secondary.get("eval_samples", 0) > 0, secondary
+    assert secondary.get("eval_replay_seconds", 0) > 0, secondary
+    assert secondary.get("eval_replay_rows_per_sec", 0) > 0, secondary
     # The discovery leg ran end-to-end: the watch-mode reconcile stayed
     # bit-identical to a fresh relist through injected churn AND beat the
     # relist wall at equal fleet width (gate failures are rc 1; assert the

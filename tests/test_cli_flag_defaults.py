@@ -1,0 +1,104 @@
+"""Boolean-flag default audit across every subcommand.
+
+click 8.3 resolves a dual-name flag (``--x/--no-x``) from its *declared*
+default, and a bare ``--x`` flag from ``False`` — so a boolean whose Config
+default is True but whose CLI declaration forgets the ``/--no-x`` secondary
+name silently INVERTS when the user passes no flags (the PR 10 trap: the
+hysteresis gate shipped off-by-default for one commit because of exactly
+this). This audit invokes every subcommand's real click parser with no
+flags and asserts each boolean parameter lands on its declared default, and
+that every declared default agrees with the Config / strategy-settings
+field it feeds — any new boolean option added without wiring both sides
+fails here, not in production.
+"""
+
+from __future__ import annotations
+
+import click
+import pytest
+
+from krr_tpu import main as cli_main
+from krr_tpu.core.config import Config
+from krr_tpu.strategies.base import BaseStrategy
+
+cli_main.load_commands()
+
+
+def _bool_field_defaults() -> "dict[str, bool]":
+    """Boolean defaults from Config plus every registered strategy's
+    settings model — the authoritative side the CLI declarations must
+    agree with. A name declared with conflicting defaults across models
+    is dropped (no single truth to pin)."""
+    defaults: "dict[str, bool]" = {}
+    conflicted: "set[str]" = set()
+    models = [Config] + [s.get_settings_type() for s in BaseStrategy.get_all().values()]
+    for model in models:
+        for name, field in model.model_fields.items():
+            if not isinstance(field.default, bool):
+                continue
+            if name in defaults and defaults[name] != field.default:
+                conflicted.add(name)
+            defaults[name] = field.default
+    for name in conflicted:
+        defaults.pop(name, None)
+    return defaults
+
+
+FIELD_DEFAULTS = _bool_field_defaults()
+
+
+def _boolean_options(cmd: click.Command) -> "list[click.Option]":
+    return [
+        p
+        for p in cmd.params
+        if isinstance(p, click.Option)
+        and (p.is_flag or getattr(p, "is_bool_flag", False) or p.type is click.BOOL)
+    ]
+
+
+@pytest.mark.parametrize("name", sorted(cli_main.app.commands))
+def test_no_flag_invocation_lands_on_declared_defaults(name: str) -> None:
+    # The real parser, no flags: what the callback would actually receive.
+    cmd = cli_main.app.commands[name]
+    ctx = cmd.make_context(name, [], resilient_parsing=True)
+    for opt in _boolean_options(cmd):
+        value = ctx.params.get(opt.name)
+        assert value is not None, (
+            f"{name} --{opt.name}: parsed to None with no flags — the "
+            f"declaration lost its default"
+        )
+        assert value == opt.default, (
+            f"{name} --{opt.name}: no-flag invocation parsed to {value!r} "
+            f"but the option declares default {opt.default!r} (the click "
+            f"inverted-flag trap)"
+        )
+
+
+@pytest.mark.parametrize("name", sorted(cli_main.app.commands))
+def test_declared_defaults_match_config_fields(name: str) -> None:
+    # Every boolean option that feeds a Config / strategy-settings field by
+    # name must declare the SAME default that field carries.
+    cmd = cli_main.app.commands[name]
+    for opt in _boolean_options(cmd):
+        if opt.name not in FIELD_DEFAULTS:
+            continue  # command-local flag (e.g. diff --live), not a field
+        assert opt.default == FIELD_DEFAULTS[opt.name], (
+            f"{name} --{opt.name}: CLI declares default {opt.default!r} but "
+            f"the settings field defaults to {FIELD_DEFAULTS[opt.name]!r} — "
+            f"a no-flag run would invert the documented behavior"
+        )
+
+
+def test_true_default_booleans_have_an_off_switch() -> None:
+    # A True-default boolean reachable only as a bare `--x` FLAG can never
+    # be turned OFF from the CLI; it must be declared `--x/--no-x`.
+    # (Value-taking BOOL options — `--x false` — are exempt.)
+    for name, cmd in sorted(cli_main.app.commands.items()):
+        for opt in _boolean_options(cmd):
+            if not (opt.is_flag or getattr(opt, "is_bool_flag", False)):
+                continue
+            if opt.default is True:
+                assert opt.secondary_opts, (
+                    f"{name} --{opt.name} defaults to True but has no "
+                    f"--no-* secondary name"
+                )

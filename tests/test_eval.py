@@ -1,0 +1,284 @@
+"""The quality scoreboard + what-if replay engine (`krr_tpu.eval`).
+
+The acceptance contract of the eval subsystem, asserted against the chaos
+archetypes' DECLARED incident labels (not re-derived ones):
+
+* the labeled oracle — scoring an oom-loop fleet against a recommendation
+  pinned between the incident peaks and the baseline reproduces exactly the
+  windows the archetype generator declared;
+* the ranking contract — an undersized probe strategy scores >0 OOM
+  incidents on the oom-loop archetype, an oversized one scores 0 with more
+  over-provisioned GB-hours, and the board ranks the safe one first;
+* determinism — replaying the same inputs twice renders a byte-identical
+  scoreboard (json), including through a real registered strategy;
+* read-only journal evals — `krr-tpu eval` against a journal a live server
+  owns takes no lock, never mutates the file, and leaves the writer
+  appendable (the diff open path, satellite of PR 3).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from krr_tpu.eval import (
+    ReplayInput,
+    StaticReplayStrategy,
+    build_scoreboard,
+    render_scoreboard,
+    replay,
+    score_grids,
+    score_replay,
+)
+from krr_tpu.history.journal import RecommendationJournal
+
+from .fakes.chaos import ORIGIN, STEP, ArchetypeSpec, build_fleet, fleet_replay_input
+
+# Undersized / oversized static probes for the oom-loop archetype: its
+# declared incident peaks sit at ~7.4e8–8.5e8 bytes, its baseline under
+# ~7e8 — so 3e8 is under every peak and 5e9 is over everything.
+UNDER = dict(cpu_cores=0.01, mem_bytes=3e8)
+OVER = dict(cpu_cores=10.0, mem_bytes=5e9)
+
+
+def oom_fleet(workloads: int = 2, samples: int = 120, seed: int = 0):
+    fleet = build_fleet(
+        [ArchetypeSpec("oom-loop", workloads=workloads, pods=1)],
+        samples=samples,
+        seed=seed,
+    )
+    return fleet, fleet_replay_input(fleet)
+
+
+class TestLabeledOracle:
+    def test_score_reproduces_declared_incident_windows(self):
+        # One recommendation pinned between the oom-loop baseline (≤ ~6.9e8
+        # at the pre-window ramp sample) and the declared incident peaks:
+        # every declared window produces exactly one rising edge, nothing
+        # else does — the score IS the label count.
+        fleet, inputs = oom_fleet(workloads=1)
+        windows = fleet.incident_windows("oom-loop")
+        assert len(windows) == 1
+        (declared,) = windows.values()
+        assert declared, "oom-loop must declare incident windows"
+        scores = score_grids(
+            inputs.cpu,
+            inputs.mem,
+            rec_cpu=np.full((1, 1), 10.0),
+            rec_mem=np.full((1, 1), 7.45e8),
+            tick_indices=np.array([0]),
+            step_seconds=inputs.step_seconds,
+        )
+        assert scores["oom_incidents"] == len(declared)
+        assert scores["throttle_incidents"] == 0
+        assert scores["samples_scored"] == inputs.cpu.shape[1]
+
+    def test_declared_windows_bound_the_hot_samples(self):
+        # The labels are authoritative: every sample above the probe line
+        # falls inside a declared window.
+        fleet, inputs = oom_fleet(workloads=1)
+        (declared,) = fleet.incident_windows("oom-loop").values()
+        hot = np.flatnonzero(inputs.mem[0] > 7.45e8)
+        for i in hot:
+            assert any(start <= i < end for start, end in declared), (
+                f"sample {i} exceeds the probe but no declared window covers it"
+            )
+
+    def test_sustained_breach_is_one_incident(self):
+        usage = np.zeros((1, 10))
+        usage[0, 3:7] = 5.0  # one 4-sample plateau above the recommendation
+        scores = score_grids(
+            usage,
+            usage,
+            rec_cpu=np.full((1, 1), 1.0),
+            rec_mem=np.full((1, 1), 1.0),
+            tick_indices=np.array([0]),
+            step_seconds=60.0,
+        )
+        assert scores["throttle_incidents"] == 1
+        assert scores["oom_incidents"] == 1
+
+
+class TestRankingContract:
+    def test_undersized_scores_incidents_oversized_scores_slack(self):
+        _fleet, inputs = oom_fleet()
+        rows = [
+            score_replay(inputs, replay(inputs, StaticReplayStrategy(**UNDER), name="under")),
+            score_replay(inputs, replay(inputs, StaticReplayStrategy(**OVER), name="over")),
+        ]
+        under, over = rows
+        assert under["oom_incidents"] > 0
+        assert under["throttle_incidents"] > 0
+        assert over["oom_incidents"] == 0
+        assert over["throttle_incidents"] == 0
+        assert over["overprovisioned_gb_hours"] > under["overprovisioned_gb_hours"]
+        assert over["overprovisioned_core_hours"] > under["overprovisioned_core_hours"]
+
+        board = build_scoreboard(
+            rows,
+            samples=len(inputs.timestamps),
+            window_seconds=float(inputs.timestamps[-1] - inputs.timestamps[0]),
+        )
+        # Safety ranks above cost: the incident-free probe leads the board.
+        assert [s.strategy for s in board.scores] == ["over", "under"]
+        assert board.scores[0].severity.name == "GOOD"
+        assert board.scores[1].severity.name == "CRITICAL"
+
+    def test_registered_strategy_replays_through_the_gate(self):
+        from krr_tpu.strategies.base import BaseStrategy
+
+        _fleet, inputs = oom_fleet(workloads=1)
+        simple = BaseStrategy.find("simple")
+        strategy = simple(simple.get_settings_type()())
+        replayed = replay(inputs, strategy, name="simple", ticks=6)
+        row = score_replay(inputs, replayed)
+        assert row["ticks"] == len(replayed.tick_indices)
+        assert np.all(np.isfinite(replayed.rec_mem[:, -1]))
+        # A percentile strategy over a spiky series must sit above baseline.
+        assert float(replayed.rec_mem[0, -1]) > 1e8
+
+
+class TestDeterminism:
+    def test_replay_twice_renders_byte_identical_scoreboard(self):
+        from krr_tpu.strategies.base import BaseStrategy
+
+        _fleet, inputs = oom_fleet()
+        simple = BaseStrategy.find("simple")
+
+        def board_json() -> str:
+            rows = []
+            for name, strategy in (
+                ("under", StaticReplayStrategy(**UNDER)),
+                ("over", StaticReplayStrategy(**OVER)),
+                ("simple", simple(simple.get_settings_type()())),
+            ):
+                rows.append(
+                    score_replay(inputs, replay(inputs, strategy, name=name, ticks=8))
+                )
+            board = build_scoreboard(
+                rows,
+                samples=len(inputs.timestamps),
+                window_seconds=float(inputs.timestamps[-1] - inputs.timestamps[0]),
+            )
+            return render_scoreboard(board, "json")
+
+        first, second = board_json(), board_json()
+        assert first == second  # byte-identical, not merely approx-equal
+
+    def test_npz_round_trip_preserves_the_grid(self, tmp_path):
+        _fleet, inputs = oom_fleet(workloads=1)
+        path = str(tmp_path / "usage.npz")
+        inputs.save_npz(path)
+        loaded = ReplayInput.load_npz(path)
+        assert loaded.keys == inputs.keys
+        np.testing.assert_array_equal(loaded.cpu, inputs.cpu)
+        np.testing.assert_array_equal(loaded.mem, inputs.mem)
+        np.testing.assert_array_equal(loaded.timestamps, inputs.timestamps)
+
+
+class TestReadonlyJournalEval:
+    def _populated_journal(self, tmp_path) -> "tuple[str, RecommendationJournal]":
+        path = str(tmp_path / "server.journal")
+        journal = RecommendationJournal(path)
+        keys = ["/default/web/app/Deployment", "/default/db/pg/StatefulSet"]
+        for i in range(5):
+            journal.append_tick(
+                ORIGIN + STEP * i,
+                keys,
+                np.array([0.5 + 0.01 * i, 1.0]),
+                np.array([100.0 + 5.0 * i, 800.0]),
+                np.array([i == 0, i == 0]),
+            )
+        return path, journal
+
+    def test_eval_does_not_perturb_a_live_writers_journal(self, tmp_path):
+        # The writer stays OPEN (a running server owns this journal) while
+        # the eval side builds its ReplayInput: no lock file appears, the
+        # bytes on disk don't change, and the writer can keep appending.
+        path, writer = self._populated_journal(tmp_path)
+        with open(path, "rb") as fh:
+            before = fh.read()
+
+        inputs = ReplayInput.from_journal(path)
+        assert len(inputs.keys) == 2
+        assert len(inputs.timestamps) == 5
+        assert not os.path.exists(path + ".lock"), "readonly open must not lock"
+        with open(path, "rb") as fh:
+            assert fh.read() == before, "readonly open must not rewrite the journal"
+
+        writer.append_tick(
+            ORIGIN + STEP * 5,
+            ["/default/web/app/Deployment"],
+            np.array([0.6]),
+            np.array([130.0]),
+            np.array([True]),
+        )
+        assert len(ReplayInput.from_journal(path).timestamps) == 6
+
+    def test_journal_grid_is_raw_mb_scaled_to_bytes(self, tmp_path):
+        path, _writer = self._populated_journal(tmp_path)
+        inputs = ReplayInput.from_journal(path)
+        db = inputs.keys.index("/default/db/pg/StatefulSet")
+        np.testing.assert_allclose(inputs.mem[db], 800.0 * 1e6)
+
+    def test_missing_journal_raises_value_error(self, tmp_path):
+        with pytest.raises(ValueError, match="no journal"):
+            ReplayInput.from_journal(str(tmp_path / "absent.journal"))
+
+
+class TestEvalCli:
+    def test_eval_subcommand_scores_a_live_journal(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu import main as cli_main
+
+        cli_main.load_commands()
+        path = str(tmp_path / "server.journal")
+        journal = RecommendationJournal(path)
+        keys = ["/default/web/app/Deployment"]
+        for i in range(6):
+            journal.append_tick(
+                ORIGIN + STEP * i,
+                keys,
+                np.array([0.5]),
+                np.array([100.0 + 50.0 * (i % 2)]),
+                np.array([True]),
+            )
+        # The writer stays open across the whole CLI run.
+        result = CliRunner().invoke(
+            cli_main.app,
+            ["eval", "--journal", path, "--strategy", "simple", "--replay-ticks", "3", "-f", "json", "-q"],
+        )
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)
+        assert [s["strategy"] for s in payload["scores"]] == ["simple"]
+        assert payload["workloads"] == 1
+        assert not os.path.exists(path + ".lock")
+        journal.append_tick(  # writer survived the eval
+            ORIGIN + STEP * 6, keys, np.array([0.5]), np.array([100.0]), np.array([True])
+        )
+
+    def test_eval_scoping_filters_namespaces(self, tmp_path):
+        from click.testing import CliRunner
+
+        from krr_tpu import main as cli_main
+
+        cli_main.load_commands()
+        fleet, inputs = oom_fleet(workloads=2)
+        npz = str(tmp_path / "usage.npz")
+        inputs.save_npz(npz)
+        ns = inputs.keys[0].split("/")[1]
+        result = CliRunner().invoke(
+            cli_main.app,
+            ["eval", "--usage", npz, "--strategy", "simple", "-n", ns, "-f", "json", "-q"],
+        )
+        assert result.exit_code == 0, result.output
+        assert json.loads(result.output)["workloads"] == 2  # same namespace
+
+        result = CliRunner().invoke(
+            cli_main.app,
+            ["eval", "--usage", npz, "-n", "no-such-namespace", "-f", "json", "-q"],
+        )
+        assert result.exit_code != 0
+        assert "no workloads" in result.output
