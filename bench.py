@@ -96,7 +96,14 @@ consistent-hash ring with a primary|standby aggregator pair, a mid-soak
 primary kill plus duplicate injection, and a read replica subscribed to
 the epoch feed, gated on merged-view bit-exactness vs the single-process
 control, zero lost epochs with exactly-once apply, and replica RPS within
-10% of its source, carried under ``secondary.ha_*``). The
+10% of its source, carried under ``secondary.ha_*``), BENCH_SKIP_FLEETOBS,
+BENCH_FLEETOBS_TICKS (default 4), BENCH_FLEETOBS_WORKLOADS (default 2 —
+the fleet-observability leg: 2 shards + aggregator + read replica each
+recording their own trace ring, gated on the cross-process stitched trace
+joining scan/apply/install spans, monotone end-to-end freshness lineage
+with every stage histogram engaged, and lineage stamping within 2% of the
+no-lineage control's tick wall at bit-exact stores, carried under
+``secondary.fleet_*``). The
 e2e leg runs `bench_e2e.py` in a subprocess with BENCH_E2E_CONTAINERS
 defaulted to 10000 (fleet scale) unless already set.
 
@@ -204,6 +211,11 @@ SMOKE_DEFAULTS = {
     "BENCH_HA_WORKLOADS": "2",
     "BENCH_HA_CLIENTS": "2",
     "BENCH_HA_REQUESTS": "16",
+    # Fleet-observability leg: 2 shards + aggregator + replica with every
+    # trace ring recording, stitched-trace / lineage-monotonicity /
+    # <2%-overhead gates all EXECUTED against the no-lineage control.
+    "BENCH_FLEETOBS_TICKS": "3",
+    "BENCH_FLEETOBS_WORKLOADS": "2",
     # Read-path leg: concurrent keep-alive readers against a live serve
     # (cache hit rate, 304 zero-render, pushdown bit-exactness, LRU bound,
     # cached-vs-uncached RPS), toy-sized but every gate EXECUTED.
@@ -1901,6 +1913,281 @@ def ha_leg(secondary: dict, check) -> None:
     )
 
 
+def fleet_obs_leg(secondary: dict, check) -> None:
+    """Fleet-observability gates (`krr_tpu.obs.trace` stitching +
+    `krr_tpu.federation` freshness lineage): two in-process scanner shards
+    stream into an aggregator serve whose epoch feed drives a read replica
+    — every process recording its own trace ring — then the identical soak
+    repeats with ``--no-lineage`` as the overhead control. Three gates:
+
+    * ``fleet_trace_stitched`` — ``stitch_chrome`` over the four processes'
+      trace exports joins the shard ``scan``, aggregator ``apply_record``,
+      and replica ``install`` spans into one causally-connected stitched
+      component, with every remote parent reference resolving;
+    * ``fleet_freshness_monotonic`` — every published epoch's lineage chain
+      (newest sample → fold → apply → publish → install) is monotone
+      non-decreasing, install receipts included, and all four
+      ``krr_tpu_e2e_freshness_seconds{stage}`` histograms actually fired;
+    * ``fleet_lineage_overhead`` — the lineage-stamped soak's tick wall is
+      within 2% of the no-lineage control's (plus a 50 ms toy-scale noise
+      floor), and both runs' merged stores are bit-identical per key
+      (lineage is metadata-only by construction).
+
+    Trended under ``secondary.fleet_*``: soak walls, the overhead delta,
+    stitched component/lane counts, and lineage epoch depth.
+    """
+    import asyncio
+    import time as _time
+
+    from krr_tpu.core.runner import ScanSession
+    from krr_tpu.core.config import Config
+    from krr_tpu.federation.replica import ReplicaServer
+    from krr_tpu.federation.shard import FederatedShard
+    from krr_tpu.obs.trace import stitch_chrome
+    from krr_tpu.server.app import KrrServer
+    from tests.fakes.federation import (
+        FleetInventory,
+        MultiClusterFleet,
+        ORIGIN,
+        history_factory,
+        stores_bitexact_by_key,
+    )
+
+    ticks = max(2, int(os.environ.get("BENCH_FLEETOBS_TICKS", 4)))
+    workloads = max(1, int(os.environ.get("BENCH_FLEETOBS_WORKLOADS", 2)))
+    tick_seconds = 300.0
+    start = ORIGIN + 3600.0
+    fleet = MultiClusterFleet(
+        clusters=2,
+        namespaces_per_cluster=2,
+        workloads_per_namespace=workloads,
+        seed=61,
+    )
+
+    def config(**overrides) -> Config:
+        defaults = dict(
+            strategy="tdigest",
+            quiet=True,
+            server_port=0,
+            scan_interval_seconds=tick_seconds,
+            hysteresis_enabled=False,
+            other_args={"history_duration": 1, "timeframe_duration": 1},
+        )
+        defaults.update(overrides)
+        return Config(**defaults)
+
+    async def soak(lineage: bool) -> dict:
+        now = [start]
+        server = KrrServer(
+            config(
+                federation_listen="127.0.0.1:0",
+                federation_lineage_enabled=lineage,
+            ),
+            session=ScanSession(
+                config(),
+                inventory=FleetInventory(fleet, clusters=[]),
+                history_factory=history_factory(fleet),
+            ),
+            clock=lambda: now[0],
+        )
+        await server.start(run_scheduler=False)
+        shards = [
+            FederatedShard(
+                config(
+                    clusters=[c],
+                    federation_aggregator=f"127.0.0.1:{server.aggregator.port}",
+                    federation_lineage_enabled=lineage,
+                ),
+                session=ScanSession(
+                    config(clusters=[c]),
+                    inventory=FleetInventory(fleet, clusters=[c]),
+                    history_factory=history_factory(fleet),
+                ),
+                clock=lambda: now[0],
+                shard_id=c,
+            )
+            for c in fleet.clusters
+        ]
+        replica = ReplicaServer(
+            config(
+                federation_aggregator=f"127.0.0.1:{server.aggregator.port}",
+                federation_shard_id="bench-replica",
+                federation_backoff_cap_seconds=0.2,
+            ),
+            clock=lambda: now[0],
+        )
+        await replica.start()
+
+        async def wait(predicate, message, timeout=30.0):
+            deadline = _time.monotonic() + timeout
+            while not predicate():
+                assert (
+                    _time.monotonic() < deadline
+                ), f"fleet_obs: timed out waiting for {message}"
+                await asyncio.sleep(0.01)
+
+        wall = 0.0
+        try:
+            agg = server.aggregator
+            await wait(lambda: replica.client.connected, "replica subscribe")
+            for t in range(ticks):
+                now[0] = start + t * tick_seconds
+                for shard in shards:
+                    begin = _time.perf_counter()
+                    assert await shard.tick(now[0])
+                    wall += _time.perf_counter() - begin
+                await wait(
+                    lambda: all(
+                        s.shard_id in agg._shards
+                        and agg._shards[s.shard_id].enqueued >= s.epoch
+                        for s in shards
+                    ),
+                    f"tick {t} records to enqueue",
+                )
+                begin = _time.perf_counter()
+                assert await server.scheduler.run_once()
+                wall += _time.perf_counter() - begin
+                for shard in shards:
+                    assert await shard.wait_acked(shard.epoch, timeout=10.0)
+                await wait(
+                    lambda: replica.client.feed_epoch >= agg._feed_epoch,
+                    f"tick {t} replica install",
+                )
+            if lineage:
+                # The replica's install receipt travels back over the feed
+                # socket — the lineage chain's last hop must land before the
+                # rings are read.
+                await wait(
+                    lambda: agg.newest_installed_lineage() is not None,
+                    "a replica install ack",
+                )
+            payloads = [s.tracer.export_chrome() for s in shards] + [
+                server.session.tracer.export_chrome(),
+                replica.tracer.export_chrome(),
+            ]
+            metrics = server.state.metrics
+            return {
+                "wall": wall,
+                "store": server.state.store,
+                "payloads": payloads,
+                "lineage": agg.epoch_lineage(n=64),
+                "installed": agg.newest_installed_lineage(),
+                "stage_counts": {
+                    stage: metrics.value(
+                        "krr_tpu_e2e_freshness_seconds_count", stage=stage
+                    )
+                    for stage in ("fold", "apply", "publish", "install")
+                },
+            }
+        finally:
+            for shard in shards:
+                await shard.close()
+            await replica.shutdown()
+            await server.shutdown()
+
+    control = asyncio.run(soak(lineage=False))
+    report = asyncio.run(soak(lineage=True))
+
+    # Stitched-trace gate: one component must carry all three cross-process
+    # hops, and every re-parented remote span must resolve inside the merge.
+    stitched = stitch_chrome(report["payloads"])
+    spans = [e for e in stitched["traceEvents"] if e.get("ph") == "X"]
+    ids_by_pid: dict = {}
+    names_by_pid: dict = {}
+    for event in spans:
+        ids_by_pid.setdefault(event["pid"], set()).add(event["args"].get("span_id"))
+        names_by_pid.setdefault(event["pid"], set()).add(event["name"])
+    joined = [
+        pid
+        for pid, names in names_by_pid.items()
+        if {"scan", "apply_record", "install"} <= names
+    ]
+    remote_spans = [e for e in spans if e["args"].get("remote")]
+    remote_resolved = all(
+        e["args"].get("parent_id") in ids_by_pid.get(e["pid"], ())
+        for e in remote_spans
+    )
+    remote_installs = [e for e in remote_spans if e["name"] == "install"]
+    lanes = max(
+        (len({e["tid"] for e in spans if e["pid"] == pid}) for pid in joined),
+        default=0,
+    )
+    stitched_ok = bool(joined) and bool(remote_installs) and remote_resolved
+
+    # Lineage-monotonicity gate over every retained epoch record.
+    def monotone() -> "tuple[bool, str]":
+        if not report["lineage"]:
+            return False, "no lineage records"
+        for record in report["lineage"]:
+            chain = [
+                float(record["newest_sample_ts"]),
+                float(record["fold_ts"]),
+                float(record["apply_ts"]),
+                float(record["publish_ts"]),
+            ]
+            if chain != sorted(chain):
+                return False, f"epoch {record['epoch']} chain out of order: {chain}"
+            for replica_id, install_ts in (record.get("installs") or {}).items():
+                if float(install_ts) < float(record["publish_ts"]):
+                    return False, (
+                        f"epoch {record['epoch']} installed at {replica_id} "
+                        "before its publish"
+                    )
+        if report["installed"] is None:
+            return False, "no epoch carries a replica install receipt"
+        return True, f"{len(report['lineage'])} epochs monotone"
+
+    monotonic_ok, monotonic_detail = monotone()
+    stages_ok = all(
+        (report["stage_counts"].get(stage) or 0.0) >= 1.0
+        for stage in ("fold", "apply", "publish", "install")
+    )
+
+    # Overhead gate: lineage stamping is metadata-only — same bytes in the
+    # merged store, and a tick wall within 2% (50 ms floor at toy scale).
+    equal, detail = stores_bitexact_by_key(report["store"], control["store"])
+    overhead = report["wall"] - control["wall"]
+    budget = max(0.02 * control["wall"], 0.05)
+
+    secondary["fleet_obs_ticks"] = float(ticks)
+    secondary["fleet_trace_stitched"] = 1.0 if stitched_ok else 0.0
+    secondary["fleet_stitched_components"] = float(len(joined))
+    secondary["fleet_stitched_lanes"] = float(lanes)
+    secondary["fleet_freshness_monotonic"] = (
+        1.0 if monotonic_ok and stages_ok else 0.0
+    )
+    secondary["fleet_lineage_epochs"] = float(len(report["lineage"]))
+    secondary["fleet_lineage_wall_seconds"] = round(report["wall"], 4)
+    secondary["fleet_control_wall_seconds"] = round(control["wall"], 4)
+    secondary["fleet_lineage_overhead_seconds"] = round(overhead, 4)
+    secondary["fleet_lineage_bitexact"] = 1.0 if equal else 0.0
+    print(
+        f"bench: fleet obs 2 shards + replica x {ticks} ticks -> "
+        f"{len(joined)} stitched component(s) ({lanes} lanes), "
+        f"{len(report['lineage'])} lineage epochs, lineage wall "
+        f"{report['wall']:.3f}s vs control {control['wall']:.3f}s "
+        f"({overhead:+.3f}s)",
+        file=sys.stderr,
+    )
+    check(
+        "fleet_trace_stitched",
+        stitched_ok,
+        f"joined={len(joined)}, remote_installs={len(remote_installs)}, "
+        f"remote_resolved={remote_resolved}",
+    )
+    check(
+        "fleet_freshness_monotonic",
+        monotonic_ok and stages_ok,
+        f"{monotonic_detail}; stage counts={report['stage_counts']}",
+    )
+    check(
+        "fleet_lineage_overhead",
+        equal and overhead <= budget,
+        f"bitexact={equal} ({detail}), overhead={overhead:.3f}s "
+        f"over budget={budget:.3f}s",
+    )
+
+
 def readpath_leg(secondary: dict, check) -> None:
     """High-QPS read-path loadtest (`krr_tpu.server.state.ResponseCache` +
     the app's conditional-GET / pushdown / bounded-render machinery):
@@ -2984,6 +3271,14 @@ def main() -> None:
         # plus a read replica serving byte-identical responses at >= 90%
         # of its source aggregator's RPS.
         ha_leg(secondary, check)
+
+    if not os.environ.get("BENCH_SKIP_FLEETOBS"):
+        # Fleet-observability gates: the cross-process trace rings stitch
+        # into one causally-joined component (scan → apply_record →
+        # install), the per-epoch freshness lineage stays monotone with
+        # every stage histogram engaged, and lineage stamping costs <2%
+        # of the no-lineage control's tick wall while staying bit-exact.
+        fleet_obs_leg(secondary, check)
 
     if not os.environ.get("BENCH_SKIP_READPATH"):
         # Read-path gates: concurrent keep-alive readers against a live

@@ -425,6 +425,13 @@ class Config(pd.BaseModel):
     #: region aggregators uplink to a global one over the same shard
     #: protocol, so the tiers compose without a second wire format.
     federation_uplink: Optional[str] = None
+    #: End-to-end freshness lineage: when on, every shard tick stamps its
+    #: delta records with a lineage block (newest-sample → fold → apply →
+    #: publish → install timestamps accumulate hop by hop) and the
+    #: aggregator fires ``krr_tpu_e2e_freshness_seconds{stage}`` per epoch.
+    #: Metadata-only — stores and served bytes are bit-identical either
+    #: way. Off = the no-lineage control (bench overhead gate).
+    federation_lineage_enabled: bool = True
 
     #: One-shot recovery flag for ``--fetch-downsample`` over a persisted
     #: window cursor that predates the flag (unaligned grid): drop the

@@ -40,11 +40,12 @@ import asyncio
 import contextlib
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Optional
 
 from krr_tpu.core.durastore import apply_ops, decode_ops
 from krr_tpu.core.streaming import object_key
+from krr_tpu.obs.trace import NULL_TRACER, link_remote_parent
 from krr_tpu.federation.protocol import (
     FED_MAGIC,
     FRAME_OVERHEAD,
@@ -154,6 +155,32 @@ class Aggregator:
         self._replicas: "set[asyncio.StreamWriter]" = set()
         self._feed_frame: Optional[bytes] = None
         self._feed_epoch = 0
+        #: Node identity + tracer, installed by the owning KrrServer (the
+        #: aggregator shares the serve session's tracer so its
+        #: ``apply_record`` spans land in the same ring as the tick's
+        #: aggregate scan span).
+        self.node = "aggregator"
+        self.tracer = NULL_TRACER
+        #: Freshness lineage stamping (mirrors the shard-side knob; the
+        #: owning server sets it from ``federation_lineage_enabled``).
+        self.lineage_enabled = True
+        #: Newest applied lineage fragment per shard (the stage-1/2
+        #: timestamps a delta record's ``extra["lineage"]`` carried) —
+        #: what `note_epoch` rolls into the published epoch's record.
+        self._shard_lineage: "dict[str, dict]" = {}
+        #: epoch → {"lineage": record, "trace": propagation ctx} for the
+        #: last EPOCH_LINEAGE_KEEP published epochs: the /statusz lineage
+        #: block, the feed frame's observability stamp, and the slot a
+        #: replica's install ack completes.
+        self._epochs: "OrderedDict[int, dict]" = OrderedDict()
+        #: Epoch-feed subscriber census keyed by replica id — survives the
+        #: connection (a reconnecting replica updates its row), so /fleet
+        #: can show a DEAD replica's last posture too.
+        self._replica_census: "dict[str, dict]" = {}
+
+    #: Bounded per-epoch lineage memory (epochs advance once per changed
+    #: publish, so 64 covers hours of history at production cadence).
+    EPOCH_LINEAGE_KEEP = 64
 
     def seed(self, meta: Optional[dict]) -> None:
         """Restore per-shard watermarks persisted in the store's
@@ -424,6 +451,9 @@ class Aggregator:
                 )
         await writer.drain()
         self._replicas.add(writer)
+        census = self._replica_census.setdefault(replica_id, {"acked_epoch": 0})
+        census["connected"] = True
+        census["subscribed_at"] = float(self.clock())
         if self.metrics is not None:
             self.metrics.set("krr_tpu_replica_subscribers", len(self._replicas))
         self._info(
@@ -435,8 +465,15 @@ class Aggregator:
                 message = await read_message(reader)
                 if message is None:
                     break  # clean unsubscribe
+                kind, body = message
+                if kind == MSG_ACK:
+                    # Install receipt: the replica finished swapping this
+                    # epoch in — the census gains its acked watermark and
+                    # the epoch's lineage record gains its install stage.
+                    self._on_replica_ack(replica_id, decode_control(body))
         finally:
             self._replicas.discard(writer)
+            census["connected"] = False
             if self.metrics is not None:
                 self.metrics.set("krr_tpu_replica_subscribers", len(self._replicas))
 
@@ -447,6 +484,18 @@ class Aggregator:
         cache warmed from the feed serves bytes identical to the primary's."""
         from krr_tpu.server.app import encode_body
 
+        # Observability stamp: the publishing tick's trace context (the
+        # replica's install joins it as a remote child) and the epoch's
+        # lineage so far. Meta-only — the body/variant bytes a replica
+        # serves are identical with or without it.
+        extra = {}
+        entry = self._epochs.get(int(snapshot.epoch)) or {}
+        if entry.get("trace"):
+            extra["trace"] = dict(entry["trace"])
+        if entry.get("lineage"):
+            extra["lineage"] = {
+                k: v for k, v in entry["lineage"].items() if k != "installs"
+            }
         payload = encode_epoch_feed(
             epoch=snapshot.epoch,
             changed_at=snapshot.changed_at,
@@ -455,6 +504,7 @@ class Aggregator:
             keys=list(snapshot.keys),
             body=snapshot.body_json,
             variants={"gzip": encode_body(snapshot.body_json, "gzip")},
+            extra=extra or None,
         )
         return encode_message(MSG_EPOCH, payload)
 
@@ -579,18 +629,30 @@ class Aggregator:
             while status.queue:
                 epoch, meta, parsed, nbytes = status.queue.popleft()
                 extra = meta.get("extra") or {}
-                if extra.get("reset"):
-                    # The shard restarted (or first contact after an
-                    # aggregator wipe): its accumulated rows re-arrive in
-                    # full, so the old ones must go first or the fold
-                    # would double-count the overlap.
-                    dropped = self._drop_shard_rows(store, status, parsed)
-                    if dropped:
-                        self._info(
-                            f"federation: shard {status.shard_id} reset — dropped "
-                            f"{dropped} superseded row(s) before the snapshot"
-                        )
-                apply_ops(store, parsed)
+                # One span per replayed record, remote-linked to the shard
+                # tick that encoded it: `apply_queued` runs this in a
+                # worker thread, where the contextvar carries the tick's
+                # ``apply`` span across to_thread — so apply_record nests
+                # locally under apply AND joins the shard's scan remotely.
+                with self.tracer.span(
+                    "apply_record",
+                    shard=status.shard_id,
+                    epoch=epoch,
+                    ops=len(parsed),
+                ) as span:
+                    link_remote_parent(span, extra.get("trace"))
+                    if extra.get("reset"):
+                        # The shard restarted (or first contact after an
+                        # aggregator wipe): its accumulated rows re-arrive
+                        # in full, so the old ones must go first or the
+                        # fold would double-count the overlap.
+                        dropped = self._drop_shard_rows(store, status, parsed)
+                        if dropped:
+                            self._info(
+                                f"federation: shard {status.shard_id} reset — dropped "
+                                f"{dropped} superseded row(s) before the snapshot"
+                            )
+                    apply_ops(store, parsed)
                 # Ownership bookkeeping: the reset drop scope for a FUTURE
                 # reset is exactly the keys this shard has claimed.
                 for op in parsed:
@@ -603,6 +665,9 @@ class Aggregator:
                 window_end = extra.get("window_end")
                 if window_end is not None:
                     status.last_window_end = float(window_end)
+                lineage = extra.get("lineage")
+                if self.lineage_enabled and isinstance(lineage, dict):
+                    self._shard_lineage[status.shard_id] = dict(lineage)
                 applied += 1
                 applied_bytes += nbytes
         return applied, applied_bytes
@@ -724,6 +789,228 @@ class Aggregator:
                 status.connected = False
                 status.writer = None
 
+    # ------------------------------------------------------ freshness lineage
+    def note_epoch(
+        self,
+        epoch: int,
+        *,
+        apply_ts: float,
+        publish_ts: float,
+        trace_ctx: Optional[dict] = None,
+    ) -> Optional[dict]:
+        """Stamp one published epoch with its lineage record and trace
+        context — called by the aggregate tick after the publish, before
+        the broadcast (so the feed frame carries the stamp).
+
+        The record chains every hop's OWN clock: ``newest_sample_ts`` (the
+        newest shard window end folded in) → ``fold_ts`` (when the slowest
+        contributing shard folded it) → ``apply_ts`` → ``publish_ts``,
+        with ``install_ts`` arriving later via replica acks. Suppressed
+        publishes re-use the epoch number, so an already-stamped epoch is
+        left alone (the FIRST publish of an epoch is its lineage). Fires
+        the ``krr_tpu_e2e_freshness_seconds{stage}`` histograms: each
+        stage's value is the recommendation's AGE at that stage — how far
+        the pipeline had drifted from the newest sample by the time the
+        stage finished."""
+        if epoch <= 0:
+            return None
+        entry = self._epochs.get(int(epoch))
+        if entry is None:
+            entry = {}
+            self._epochs[int(epoch)] = entry
+            while len(self._epochs) > self.EPOCH_LINEAGE_KEEP:
+                self._epochs.popitem(last=False)
+        if trace_ctx:
+            entry["trace"] = dict(trace_ctx)
+        if not self.lineage_enabled or not self._shard_lineage:
+            return entry.get("lineage")
+        lineage = entry.get("lineage")
+        if lineage is None:
+            shards = {sid: dict(frag) for sid, frag in self._shard_lineage.items()}
+            lineage = {
+                "epoch": int(epoch),
+                "newest_sample_ts": max(
+                    float(f.get("newest_sample_ts") or 0.0) for f in shards.values()
+                ),
+                "fold_ts": max(
+                    float(f.get("fold_ts") or 0.0) for f in shards.values()
+                ),
+                "apply_ts": float(apply_ts),
+                "publish_ts": float(publish_ts),
+                "shards": shards,
+            }
+            entry["lineage"] = lineage
+            if self.metrics is not None:
+                newest = lineage["newest_sample_ts"]
+                for stage in ("fold", "apply", "publish"):
+                    self.metrics.observe(
+                        "krr_tpu_e2e_freshness_seconds",
+                        max(0.0, lineage[f"{stage}_ts"] - newest),
+                        stage=stage,
+                    )
+        return lineage
+
+    def _on_replica_ack(self, replica_id: str, ack: dict) -> None:
+        """A replica's install receipt: ``{epoch, install_ts}`` — the
+        lineage chain's LAST hop, reported by the only process that knows
+        when the swap actually happened (stamped with the REPLICA'S
+        clock). Completes the epoch's lineage record and the census row
+        /fleet lag derives from. Unknown epochs (rolled out of the ring,
+        or lineage disabled) just update the census."""
+        epoch = int(ack.get("epoch", 0))
+        install_ts = ack.get("install_ts")
+        census = self._replica_census.setdefault(replica_id, {"acked_epoch": 0})
+        census["acked_epoch"] = max(int(census.get("acked_epoch", 0)), epoch)
+        if install_ts is not None:
+            census["install_ts"] = float(install_ts)
+        lineage = (self._epochs.get(epoch) or {}).get("lineage")
+        if lineage is None or install_ts is None:
+            return
+        installs = lineage.setdefault("installs", {})
+        if replica_id in installs:
+            return  # duplicate ack (reconnect re-install) — first wins
+        installs[replica_id] = float(install_ts)
+        lineage["install_ts"] = max(
+            float(lineage.get("install_ts") or 0.0), float(install_ts)
+        )
+        if self.metrics is not None:
+            self.metrics.observe(
+                "krr_tpu_e2e_freshness_seconds",
+                max(0.0, float(install_ts) - float(lineage["newest_sample_ts"])),
+                stage="install",
+            )
+
+    def epoch_lineage(self, n: int = 1) -> "list[dict]":
+        """The newest ``n`` epochs' lineage records, oldest first (the
+        /statusz block and the timeline's per-tick lineage)."""
+        records = [
+            entry["lineage"]
+            for entry in self._epochs.values()
+            if entry.get("lineage") is not None
+        ]
+        return [dict(record) for record in records[-max(1, int(n)):]]
+
+    def newest_installed_lineage(self) -> Optional[dict]:
+        """The newest epoch whose lineage has at least one replica
+        install — the install hop the sentinel bands (acks land after the
+        tick that published, so this intentionally trails the current
+        epoch)."""
+        for entry in reversed(self._epochs.values()):
+            lineage = entry.get("lineage")
+            if lineage is not None and lineage.get("install_ts") is not None:
+                return dict(lineage)
+        return None
+
+    # --------------------------------------------------------- fleet topology
+    def fleet_census(self, now: Optional[float] = None) -> dict:
+        """The ``GET /fleet`` topology census: every node this aggregator
+        has met through a HELLO/subscribe handshake (plus itself), with
+        per-node health, acked-vs-current epoch lag, and freshness. Built
+        entirely from state the handshakes already maintain — no new wire
+        traffic."""
+        if now is None:
+            now = float(self.clock())
+        nodes: "list[dict]" = []
+        newest = None
+        for entry in reversed(self._epochs.values()):
+            if entry.get("lineage") is not None:
+                newest = entry["lineage"]
+                break
+        nodes.append(
+            {
+                "node": self.node,
+                "role": "aggregator",
+                "connected": True,
+                "epoch": self._feed_epoch,
+                "acked_epoch": self._feed_epoch,
+                "epoch_lag": 0,
+                "freshness_seconds": (
+                    round(
+                        max(
+                            0.0, newest["publish_ts"] - newest["newest_sample_ts"]
+                        ),
+                        3,
+                    )
+                    if newest is not None
+                    else None
+                ),
+                "health": "ok",
+            }
+        )
+        with self._registry_lock:
+            statuses = [self._shards[sid] for sid in sorted(self._shards)]
+        for s in statuses:
+            stale = (
+                s.last_window_end is not None
+                and now - s.last_window_end > self.staleness
+            )
+            nodes.append(
+                {
+                    "node": s.shard_id,
+                    "role": "shard",
+                    "connected": s.connected,
+                    "epoch": s.enqueued,
+                    "acked_epoch": s.acked,
+                    "epoch_lag": max(0, s.enqueued - s.acked),
+                    "freshness_seconds": (
+                        round(max(0.0, now - s.last_window_end), 3)
+                        if s.last_window_end is not None
+                        else None
+                    ),
+                    "health": (
+                        "stale"
+                        if stale
+                        else ("ok" if s.connected else "disconnected")
+                    ),
+                }
+            )
+        for replica_id in sorted(self._replica_census):
+            census = self._replica_census[replica_id]
+            acked = int(census.get("acked_epoch", 0))
+            connected = bool(census.get("connected"))
+            install_ts = census.get("install_ts")
+            nodes.append(
+                {
+                    "node": replica_id,
+                    "role": "replica",
+                    "connected": connected,
+                    "epoch": self._feed_epoch,
+                    "acked_epoch": acked,
+                    "epoch_lag": max(0, self._feed_epoch - acked),
+                    "freshness_seconds": (
+                        round(max(0.0, now - float(install_ts)), 3)
+                        if install_ts is not None
+                        else None
+                    ),
+                    "health": "ok" if connected else "disconnected",
+                }
+            )
+        return {
+            "nodes": nodes,
+            "feed_epoch": self._feed_epoch,
+            "staleness_seconds": self.staleness,
+        }
+
+    def fleet_gauges(self, now: float) -> None:
+        """Refresh the fleet metrics from the census — once per aggregate
+        tick. The check/unhealthy counter pair is CUMULATIVE (one check
+        per node per tick), so the fleet_health SLO rollup burns its error
+        budget at exactly the unhealthy-node-ticks rate."""
+        if self.metrics is None:
+            return
+        census = self.fleet_census(now)
+        roles: "dict[str, int]" = {}
+        for entry in census["nodes"]:
+            roles[entry["role"]] = roles.get(entry["role"], 0) + 1
+            self.metrics.set(
+                "krr_tpu_fleet_epoch_lag", entry["epoch_lag"], node=entry["node"]
+            )
+            self.metrics.inc("krr_tpu_fleet_node_checks_total")
+            if entry["health"] != "ok":
+                self.metrics.inc("krr_tpu_fleet_node_unhealthy_total")
+        for role, count in roles.items():
+            self.metrics.set("krr_tpu_fleet_nodes", count, role=role)
+
     # ---------------------------------------------------------- observability
     def _update_gauges(self) -> None:
         if self.metrics is None:
@@ -802,5 +1089,6 @@ class Aggregator:
             "staleness_seconds": self.staleness,
             "replicas": len(self._replicas),
             "feed_epoch": self._feed_epoch,
+            "lineage": (self.epoch_lineage(1) or [None])[-1],
         }
 

@@ -205,29 +205,32 @@ def encode_epoch_feed(
     keys: "list[str]",
     body: bytes,
     variants: "Optional[dict[str, bytes]]" = None,
+    extra: "Optional[dict]" = None,
 ) -> bytes:
     """Serialize one published epoch for the replica feed (MSG_EPOCH body):
     the rendered JSON body, any pre-compressed variants (the replica warms
     its response cache with them — same bytes the aggregator would serve),
     and the exact publish metadata (``epoch``/``changed_at`` drive the
-    ETag, so replicas emit byte-identical validators). Packed with
-    ``np.savez`` like a delta record so the payload byte-arrays ride
-    uncopied."""
+    ETag, so replicas emit byte-identical validators). ``extra`` carries
+    observability metadata (trace propagation context, freshness lineage)
+    merged into the meta JSON — decoders pass unknown keys through, so old
+    and new peers interoperate. Packed with ``np.savez`` like a delta
+    record so the payload byte-arrays ride uncopied."""
     import io
 
     import numpy as np
 
-    meta = json.dumps(
-        {
-            "epoch": int(epoch),
-            "changed_at": float(changed_at),
-            "window_end": float(window_end),
-            "published_at": float(published_at),
-            "keys": list(keys),
-            "variants": sorted(variants) if variants else [],
-        },
-        sort_keys=True,
-    ).encode("utf-8")
+    fields = {
+        "epoch": int(epoch),
+        "changed_at": float(changed_at),
+        "window_end": float(window_end),
+        "published_at": float(published_at),
+        "keys": list(keys),
+        "variants": sorted(variants) if variants else [],
+    }
+    if extra:
+        fields.update({k: v for k, v in extra.items() if k not in fields})
+    meta = json.dumps(fields, sort_keys=True).encode("utf-8")
     arrays = {
         "meta": np.frombuffer(meta, dtype=np.uint8),
         "body": np.frombuffer(body, dtype=np.uint8),

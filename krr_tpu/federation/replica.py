@@ -43,6 +43,7 @@ from typing import Optional
 from krr_tpu.core.config import Config
 from krr_tpu.federation.protocol import (
     FED_MAGIC,
+    MSG_ACK,
     MSG_EPOCH,
     MSG_HELLO,
     MSG_WELCOME,
@@ -53,6 +54,7 @@ from krr_tpu.federation.protocol import (
     encode_control,
     read_message,
 )
+from krr_tpu.obs.trace import NULL_TRACER, link_remote_parent
 from krr_tpu.server.state import ServerState, Snapshot
 from krr_tpu.utils.logging import KrrLogger
 
@@ -82,6 +84,7 @@ class ReplicaClient:
         logger: KrrLogger,
         backoff_cap: float = 5.0,
         clock=time.time,
+        tracer=NULL_TRACER,
     ) -> None:
         self.state = state
         self.host = host
@@ -91,6 +94,10 @@ class ReplicaClient:
         self.logger = logger
         self.backoff_cap = float(backoff_cap)
         self.clock = clock
+        #: Each install records a root ``install`` span remote-linked to
+        #: the publishing tick's trace (the frame's ``trace`` meta) — the
+        #: last lane of the stitched fleet trace.
+        self.tracer = tracer
         self.connected = False
         #: Newest INSTALLED epoch (dropped stale replays don't count).
         self.feed_epoch = 0
@@ -176,16 +183,27 @@ class ReplicaClient:
                     raise ProtocolError("source closed the epoch feed")
                 kind, body = message
                 if kind == MSG_EPOCH:
-                    await self._install(body)
+                    await self._install(body, writer)
         finally:
             self.connected = False
             if self.disconnected_at is None:
                 self.disconnected_at = float(self.clock())
             writer.close()
 
-    async def _install(self, payload: bytes) -> None:
+    async def _install(
+        self, payload: bytes, writer: Optional[asyncio.StreamWriter] = None
+    ) -> None:
         """One epoch frame → one installed snapshot (or an idempotent drop
-        when the feed replays an epoch we already hold)."""
+        when the feed replays an epoch we already hold).
+
+        An actual install closes the observability loop twice over: the
+        root ``install`` span joins the publishing tick's trace as a
+        remote child (the frame's ``trace`` meta), the frame's ``lineage``
+        stages fire the ``krr_tpu_e2e_freshness_seconds{stage}``
+        histograms on THIS registry (every stage, so one replica /metrics
+        scrape shows the whole chain), and an ``MSG_ACK {epoch,
+        install_ts}`` rides back up the feed connection — the install
+        timestamp only this process's clock can stamp."""
 
         def build() -> "tuple[dict, Snapshot, dict]":
             from krr_tpu.models.result import Result
@@ -208,21 +226,64 @@ class ReplicaClient:
             )
             return meta, snapshot, variants
 
-        meta, snapshot, variants = await asyncio.to_thread(build)
-        self.metrics.inc("krr_tpu_replica_feed_bytes_total", len(payload))
-        installed = await self.state.install_snapshot(snapshot, variants=variants)
-        if installed:
-            self.feed_epoch = snapshot.epoch
-            self.epochs_applied += 1
-            self.last_published_at = snapshot.published_at
-            self.metrics.set("krr_tpu_replica_epoch", self.feed_epoch)
-            self.metrics.inc("krr_tpu_replica_epochs_applied_total")
-        else:
-            self.epochs_dropped += 1
+        with self.tracer.span(
+            "install", kind="replica", replica=self.replica_id
+        ) as span:
+            meta, snapshot, variants = await asyncio.to_thread(build)
+            link_remote_parent(span, meta.get("trace"))
+            span.set(epoch=snapshot.epoch)
+            self.metrics.inc("krr_tpu_replica_feed_bytes_total", len(payload))
+            installed = await self.state.install_snapshot(snapshot, variants=variants)
+            install_ts = float(self.clock())
+            if installed:
+                self.feed_epoch = snapshot.epoch
+                self.epochs_applied += 1
+                self.last_published_at = snapshot.published_at
+                self.metrics.set("krr_tpu_replica_epoch", self.feed_epoch)
+                self.metrics.inc("krr_tpu_replica_epochs_applied_total")
+                self._observe_lineage(meta.get("lineage"), install_ts)
+                if writer is not None:
+                    with contextlib.suppress(OSError, ConnectionError):
+                        writer.write(
+                            encode_control(
+                                MSG_ACK, epoch=snapshot.epoch, install_ts=install_ts
+                            )
+                        )
+                        await writer.drain()
+            else:
+                self.epochs_dropped += 1
+                span.set(kind="dropped")
+        if not installed:
+            self.tracer.discard(span.trace_id)
         lag = max(0.0, float(self.clock()) - (self.last_published_at or 0.0))
         if self.last_published_at is not None:
             self.metrics.set("krr_tpu_replica_feed_lag_seconds", lag)
         self.installed.set()
+
+    def _observe_lineage(self, lineage, install_ts: float) -> None:
+        """Fire every freshness stage from the frame's lineage record plus
+        our own install — each value the recommendation's age (stage ts −
+        newest sample ts) when that stage finished. No lineage on the
+        frame (source predates it, or stamping is off) fires nothing."""
+        if not isinstance(lineage, dict):
+            return
+        newest = lineage.get("newest_sample_ts")
+        if newest is None:
+            return
+        newest = float(newest)
+        for stage in ("fold", "apply", "publish"):
+            ts = lineage.get(f"{stage}_ts")
+            if ts is not None:
+                self.metrics.observe(
+                    "krr_tpu_e2e_freshness_seconds",
+                    max(0.0, float(ts) - newest),
+                    stage=stage,
+                )
+        self.metrics.observe(
+            "krr_tpu_e2e_freshness_seconds",
+            max(0.0, install_ts - newest),
+            stage="install",
+        )
 
     def status(self, now: float) -> dict:
         """The /healthz + /statusz ``replica`` block: where the feed comes
@@ -303,6 +364,15 @@ class ReplicaServer:
         replica_id = getattr(config, "federation_shard_id", None) or (
             f"replica-{os.urandom(4).hex()}"
         )
+        self.replica_id = replica_id
+        # Replicas always record install spans (the ring is bounded): the
+        # node-stamped /debug/trace export is the replica's lane in the
+        # stitched fleet trace.
+        from krr_tpu.obs.trace import Tracer
+
+        self.tracer = Tracer(
+            ring_scans=getattr(config, "trace_ring_scans", 16), node=replica_id
+        )
         self.client = ReplicaClient(
             self.state,
             host=host,
@@ -314,6 +384,7 @@ class ReplicaServer:
                 getattr(config, "federation_backoff_cap_seconds", 5.0) or 5.0
             ),
             clock=clock,
+            tracer=self.tracer,
         )
         self.state.replica = self.client
         self.app = HttpApp(
@@ -326,6 +397,7 @@ class ReplicaServer:
             drift_dead_band_pct=config.hysteresis_dead_band_pct,
             drift_confirm_ticks=config.hysteresis_confirm_ticks,
             hysteresis_enabled=config.hysteresis_enabled,
+            tracer=self.tracer,
             render_concurrency=config.server_render_concurrency,
             render_queue=config.server_render_queue,
         )
@@ -371,8 +443,28 @@ async def run_replica(config: Config, *, logger: Optional[KrrLogger] = None) -> 
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # non-unix event loops
             pass
+    # kill -USR2 <pid> dumps the install trace ring + a metrics snapshot
+    # without stopping the replica — serve/shard parity (`krr_tpu.obs.dump`).
+    from krr_tpu.obs.dump import install_signal_dump
+
+    install_signal_dump(
+        replica.tracer,
+        replica.metrics,
+        trace_target=config.trace_path,
+        metrics_target=config.metrics_dump_path,
+        logger=replica.logger,
+        loop=loop,
+    )
     try:
         await stop.wait()
     finally:
         replica.logger.info("Replica shutting down")
         await replica.shutdown()
+        if config.trace_path:
+            from krr_tpu.obs.trace import write_chrome_trace
+
+            write_chrome_trace(replica.tracer, config.trace_path)
+        if config.profile_path:
+            from krr_tpu.obs.profile import write_profile_report
+
+            write_profile_report(replica.tracer, config.profile_path)

@@ -83,6 +83,7 @@ from krr_tpu.federation.protocol import (
     read_message,
 )
 from krr_tpu.federation.ring import HashRing, RingNode, parse_ring, partition_ops
+from krr_tpu.obs.trace import Tracer, propagation_context
 from krr_tpu.utils.logging import KrrLogger
 
 
@@ -455,6 +456,20 @@ class FederatedShard:
         self.scan_interval = float(config.scan_interval_seconds)
         self.discovery_interval = float(config.discovery_interval_seconds)
         self.metrics = self.session.metrics
+        # Shards always record spans (the ring is bounded): the tick's scan
+        # span is the ROOT the aggregator's apply and the replica's install
+        # join as remote children, so without it no cross-process trace
+        # stitches. The node identity stamps every exported event.
+        if self.session.tracer.enabled:
+            self.session.tracer.node = self.shard_id
+        else:
+            self.session.tracer = Tracer(
+                ring_scans=getattr(config, "trace_ring_scans", 16), node=self.shard_id
+            )
+        self.tracer = self.session.tracer
+        #: Freshness lineage stamping (metadata-only; the bench's overhead
+        #: control turns it off).
+        self.lineage_enabled = bool(getattr(config, "federation_lineage_enabled", True))
 
         self.epoch = 0
         self.last_end: Optional[float] = None
@@ -475,6 +490,15 @@ class FederatedShard:
         #: whose aggregators may hold a previous incarnation's rows flags
         #: record 1 ``reset`` so they drop those rows before applying.
         self._needs_reset = True
+        #: The newest tick's observability metadata, re-stamped onto
+        #: snapshot records: a resync/collapse REPLACES buffered tick
+        #: records (on a real first contact the handshake routinely lands
+        #: after tick 1 encoded, so the generation mismatch re-syncs and
+        #: the snapshot is the only record the aggregator ever sees), and
+        #: without these the fleet would lose its lineage chain and the
+        #: apply span's remote link to the scan that folded the state.
+        self._last_scan_ctx: "Optional[dict]" = None
+        self._last_lineage: "Optional[dict]" = None
         self._ack_event = asyncio.Event()
         hello_spec = {
             "gamma": self.spec.gamma,
@@ -651,9 +675,24 @@ class FederatedShard:
         encode the captured deltas as one record per aggregator, buffer +
         send them. Returns False when no new window was due (the pump
         still runs, so a downed connection keeps retrying between due
-        windows)."""
+        windows).
+
+        The whole tick runs under a root ``scan`` span whose propagation
+        context rides the tick's delta records — the aggregator's
+        ``apply_record`` span and (transitively) the replica's ``install``
+        span join it as remote children, so one stitched trace covers the
+        epoch's full shard→aggregator→replica journey."""
         if now is None:
             now = float(self.clock())
+        with self.tracer.span("scan", kind="shard", shard=self.shard_id) as scan_span:
+            did_scan = await self._tick_traced(scan_span, now)
+            if not did_scan:
+                scan_span.set(kind="skipped")
+        if not did_scan:
+            self.tracer.discard(scan_span.trace_id)
+        return did_scan
+
+    async def _tick_traced(self, scan_span, now: float) -> bool:
         settings = self.session.strategy.settings
         step = self._step_seconds()
         self.session.begin_scan()
@@ -728,8 +767,28 @@ class FederatedShard:
             self.store.fold_fleet(fleet, MEMORY_SCALE)
         self.last_end = end
 
-        await self._encode_tick(
-            extra={"window_end": end, "window_start": start, "kind": kind}
+        extra = {"window_end": end, "window_start": start, "kind": kind}
+        ctx = propagation_context(scan_span, node=self.shard_id)
+        if ctx is not None:
+            extra["trace"] = ctx
+        self._last_scan_ctx = ctx
+        if self.lineage_enabled:
+            # Lineage stage 1: the tick's newest sample is the window end;
+            # the fold finished "now" by THIS process's clock. Metadata
+            # only — the record's ops and the stores they build are
+            # bit-identical with lineage off.
+            extra["lineage"] = {
+                "shard": self.shard_id,
+                "newest_sample_ts": float(end),
+                "fold_ts": float(now),
+            }
+            self._last_lineage = extra["lineage"]
+        await self._encode_tick(extra=extra)
+        scan_span.set(
+            window_start=round(start, 3),
+            window_end=round(end, 3),
+            objects=len(objects),
+            epoch=self.epoch,
         )
         self.metrics.inc("krr_tpu_scans_total", kind="shard")
         self.metrics.set("krr_tpu_scan_window_seconds", end - start)
@@ -819,10 +878,19 @@ class FederatedShard:
         ops = [("fold", keys, *arrays)] if keys else []
         if not ops and self.epoch <= 0:
             return None
+        extra: dict = {"reset": True, "window_end": self.last_end, "kind": "snapshot"}
+        # The snapshot IS the last tick's folded state, so it carries that
+        # tick's trace context and lineage fragment: the aggregator's
+        # apply span still joins the scan that produced the data, and the
+        # freshness chain reports the fold's real age, not the resync's.
+        if self._last_scan_ctx is not None:
+            extra["trace"] = dict(self._last_scan_ctx)
+        if self.lineage_enabled and self._last_lineage is not None:
+            extra["lineage"] = dict(self._last_lineage)
         payload = encode_ops(
             ops,
             epoch=self.epoch,
-            extra={"reset": True, "window_end": self.last_end, "kind": "snapshot"},
+            extra=extra,
             num_buckets=self.spec.num_buckets,
         )
         return self.epoch, encode_message(MSG_DELTA, payload)
@@ -903,16 +971,21 @@ class FederatedShard:
 
 class ShardStatusServer:
     """A minimal HTTP surface for a shard process: ``GET /healthz`` (the
-    shard's scan + uplink posture as JSON) and ``GET /metrics`` (the shared
+    shard's scan + uplink posture as JSON), ``GET /metrics`` (the shared
     registry's exposition — the shard-side ``krr_tpu_federation_*`` family
     would otherwise be write-only: `krr_tpu_federation_unacked_records` is
     the signal that a shard is silently buffering through an aggregator
-    outage, and it manifests on the SHARD)."""
+    outage, and it manifests on the SHARD), and ``GET /debug/trace``
+    (the tick ring as Chrome trace JSON, node-stamped — what ``analyze
+    --stitch`` fetches to join this shard's lane into the fleet trace)."""
 
     def __init__(self, shard: FederatedShard) -> None:
         self.shard = shard
         self._server: Optional[asyncio.AbstractServer] = None
         self._connections: "set[asyncio.StreamWriter]" = set()
+        from krr_tpu.obs.metrics import record_build_info
+
+        record_build_info(self.shard.metrics)
 
     async def serve(self, host: str, port: int) -> None:
         self._server = await asyncio.start_server(self._handle, host, port)
@@ -933,7 +1006,8 @@ class ShardStatusServer:
             while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                 pass  # drain headers; GET carries no body
             parts = request_line.decode("latin-1", "replace").split()
-            path = parts[1].split("?", 1)[0] if len(parts) >= 2 else ""
+            target = parts[1] if len(parts) >= 2 else ""
+            path, _, query = target.partition("?")
             if path == "/metrics":
                 from krr_tpu.obs.metrics import refresh_process_metrics
 
@@ -943,9 +1017,21 @@ class ShardStatusServer:
             elif path == "/healthz":
                 status, content_type = 200, "application/json"
                 body = (json.dumps(self.shard.status()) + "\n").encode()
+            elif path == "/debug/trace":
+                n = None
+                for part in query.split("&"):
+                    key, _, value = part.partition("=")
+                    if key == "n" and value.isdigit() and int(value) > 0:
+                        n = int(value)
+                payload = await asyncio.to_thread(self.shard.tracer.export_chrome, n)
+                status, content_type = 200, "application/json"
+                body = (json.dumps(payload) + "\n").encode()
             else:
                 status, content_type = 404, "application/json"
-                body = b'{"error": "no route (shard serves /healthz and /metrics)"}\n'
+                body = (
+                    b'{"error": "no route (shard serves /healthz, /metrics'
+                    b' and /debug/trace)"}\n'
+                )
             reason = {200: "OK", 404: "Not Found"}[status]
             writer.write(
                 (
@@ -994,6 +1080,19 @@ async def run_shard(config: Config, *, logger: Optional[KrrLogger] = None) -> No
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # non-unix event loops
             pass
+    # kill -USR2 <pid> dumps the tick trace ring + a metrics snapshot to
+    # timestamped files without stopping the shard — the same escape hatch
+    # serve has (`krr_tpu.obs.dump`).
+    from krr_tpu.obs.dump import install_signal_dump
+
+    install_signal_dump(
+        shard.tracer,
+        shard.metrics,
+        trace_target=config.trace_path,
+        metrics_target=config.metrics_dump_path,
+        logger=shard.logger,
+        loop=loop,
+    )
     try:
         while not stop.is_set():
             await shard.run_once()
@@ -1008,3 +1107,11 @@ async def run_shard(config: Config, *, logger: Optional[KrrLogger] = None) -> No
                 await shard.wait_acked(shard.epoch, timeout=5.0)
         await status_server.close()
         await shard.close()
+        if config.trace_path:
+            from krr_tpu.obs.trace import write_chrome_trace
+
+            write_chrome_trace(shard.tracer, config.trace_path)
+        if config.profile_path:
+            from krr_tpu.obs.profile import write_profile_report
+
+            write_profile_report(shard.tracer, config.profile_path)

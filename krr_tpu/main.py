@@ -874,6 +874,18 @@ def _server_options() -> list[click.Option]:
             ),
         ),
         PanelOption(
+            ["--lineage/--no-lineage", "federation_lineage_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "End-to-end freshness lineage: stamp every epoch with the "
+                "newest-sample → fold → apply → publish → install timestamp "
+                "chain (krr_tpu_e2e_freshness_seconds, /statusz lineage "
+                "block, per-hop sentinel bands). Metadata-only — stores and "
+                "served bytes are bit-identical either way."
+            ),
+        ),
+        PanelOption(
             ["--realign-window-grid", "realign_window_grid"],
             is_flag=True,
             default=False,
@@ -1292,6 +1304,17 @@ def _make_shard_command(strategy_name: str, strategy_type: Any) -> click.Command
                 "(0 = auto: four discovery intervals)."
             ),
         ),
+        PanelOption(
+            ["--lineage/--no-lineage", "federation_lineage_enabled"],
+            default=True,
+            panel="Server Settings",
+            help=(
+                "Stamp this shard's delta records with the freshness lineage "
+                "fragment (newest-sample + fold timestamps) the aggregator "
+                "folds into the per-epoch krr_tpu_e2e_freshness_seconds "
+                "chain. Metadata-only."
+            ),
+        ),
     ]
     # Shards take the scan commands' common options minus the one-shot-only
     # flags (no formatter — output is the delta stream; no --statusz dump).
@@ -1425,6 +1448,35 @@ def _make_replica_command() -> click.Command:
             show_default=True,
             panel="Server Settings",
             help="Renders allowed to QUEUE behind the pool before shedding 503s.",
+        ),
+        PanelOption(
+            ["--trace", "trace_path"],
+            default=None,
+            panel="Observability",
+            help=(
+                "Write the replica's install spans (feed frame → decode → "
+                "install, remote-linked to the publishing aggregator) as "
+                "Chrome trace-event JSON to this file at exit. SIGUSR2 dumps "
+                "the same ring mid-run."
+            ),
+        ),
+        PanelOption(
+            ["--profile", "profile_path"],
+            default=None,
+            panel="Observability",
+            help=(
+                "Write the install-path critical-path attribution report as "
+                "JSON to this file at exit; `krr-tpu analyze` renders it."
+            ),
+        ),
+        PanelOption(
+            ["--metrics-dump", "metrics_dump_path"],
+            default=None,
+            panel="Observability",
+            help=(
+                "Write a Prometheus text-exposition snapshot of the replica's "
+                "metrics to this file at exit — the offline twin of /metrics."
+            ),
         ),
         PanelOption(["-q", "--quiet", "quiet"], is_flag=True, default=False, panel="Logging"),
         PanelOption(["-v", "--verbose", "verbose"], is_flag=True, default=False, panel="Logging"),
@@ -1884,39 +1936,81 @@ def _make_analyze_command() -> click.Command:
         )
         _render_out(rendered, output)
 
+    def _load_trace_file(path: str) -> dict:
+        import json
+
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except OSError as e:
+            raise click.UsageError(f"cannot read trace file {path}: {e}") from e
+        except json.JSONDecodeError as e:
+            raise click.UsageError(f"{path} is not Chrome trace JSON: {e}") from e
+
+    def _fetch_trace_url(base: str, n: int) -> dict:
+        import json
+        import urllib.error
+        import urllib.request
+
+        target = base.rstrip("/") + "/debug/trace" + (f"?n={n}" if n > 0 else "")
+        try:
+            with urllib.request.urlopen(target, timeout=30) as response:
+                return json.load(response)
+        except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
+            raise click.UsageError(f"cannot fetch {target}: {e}") from e
+
     def callback(
-        trace: Any, url: Any, n: int, fmt: str, output: Any, trend: bool, timeline: Any
+        trace: Any,
+        url: Any,
+        n: int,
+        fmt: str,
+        output: Any,
+        trend: bool,
+        timeline: Any,
+        stitch: bool,
     ) -> None:
         import json
 
         from krr_tpu.obs.profile import profile_chrome_payload, render_text
 
+        traces = list(trace or ())
+        urls = list(url or ())
         if trend or timeline is not None:
-            if trace is not None:
-                raise click.UsageError("--trend reads a --timeline file (or --url), not --trace")
-            return _trend(timeline, url, n, fmt, output)
-        if (trace is None) == (url is None):
-            raise click.UsageError("pass exactly one of --trace FILE or --url URL")
-        if trace is not None:
-            try:
-                with open(trace) as f:
-                    payload = json.load(f)
-            except OSError as e:
-                raise click.UsageError(f"cannot read trace file {trace}: {e}") from e
-            except json.JSONDecodeError as e:
-                raise click.UsageError(f"{trace} is not Chrome trace JSON: {e}") from e
-        else:
-            import urllib.error
-            import urllib.request
+            if traces or stitch:
+                raise click.UsageError(
+                    "--trend reads a --timeline file (or --url), not --trace/--stitch"
+                )
+            if len(urls) > 1:
+                raise click.UsageError("--trend takes a single --url")
+            return _trend(timeline, urls[0] if urls else None, n, fmt, output)
+        if stitch:
+            # Fleet mode: merge every source's trace ring into ONE Chrome
+            # trace — remote links join shard scan → aggregator apply →
+            # replica install, each process keeping its own lanes.
+            from krr_tpu.obs.trace import stitch_chrome
 
-            target = url.rstrip("/") + "/debug/trace" + (f"?n={n}" if n > 0 else "")
-            try:
-                with urllib.request.urlopen(target, timeout=30) as response:
-                    payload = json.load(response)
-            except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
-                raise click.UsageError(f"cannot fetch {target}: {e}") from e
+            if not traces and not urls:
+                raise click.UsageError(
+                    "--stitch needs at least one --trace FILE or --url URL"
+                )
+            payloads = [_load_trace_file(p) for p in traces]
+            payloads += [_fetch_trace_url(u, n) for u in urls]
+            stitched = stitch_chrome(payloads)
+            if not stitched.get("traceEvents"):
+                click.echo("no completed spans in any source — nothing to stitch")
+                return
+            _render_out(json.dumps(stitched, indent=2) + "\n", output)
+            return
+        if len(traces) + len(urls) != 1:
+            raise click.UsageError(
+                "pass exactly one of --trace FILE or --url URL "
+                "(repeat sources only with --stitch)"
+            )
+        payload = (
+            _load_trace_file(traces[0]) if traces else _fetch_trace_url(urls[0], n)
+        )
         report = profile_chrome_payload(payload, n=n)
-        if url is not None and not report["scans"]:
+        if urls and not report["scans"]:
             # A live server whose trace ring is empty is a FRESH server, not
             # a broken one: say so plainly and exit clean instead of dumping
             # an empty report and a confusing error.
@@ -1938,15 +2032,32 @@ def _make_analyze_command() -> click.Command:
         params=[
             PanelOption(
                 ["--trace", "trace"],
-                default=None,
-                help="Chrome trace-event JSON file recorded by --trace (scan or serve).",
+                multiple=True,
+                default=(),
+                help=(
+                    "Chrome trace-event JSON file recorded by --trace (scan or "
+                    "serve). Repeat with --stitch to merge several processes."
+                ),
             ),
             PanelOption(
                 ["--url", "url"],
-                default=None,
+                multiple=True,
+                default=(),
                 help=(
-                    "Base URL of a live krr-tpu serve instance; reads its "
-                    "/debug/trace ring (or /debug/timeline with --trend)."
+                    "Base URL of a live krr-tpu process; reads its /debug/trace "
+                    "ring (or /debug/timeline with --trend). Repeat with "
+                    "--stitch to merge several processes."
+                ),
+            ),
+            PanelOption(
+                ["--stitch", "stitch"],
+                is_flag=True,
+                default=False,
+                help=(
+                    "Merge the trace rings from every --trace/--url source into "
+                    "ONE Chrome trace: remote links join shard scan → aggregator "
+                    "apply → replica install across processes, with one lane "
+                    "block per source."
                 ),
             ),
             PanelOption(
@@ -1993,6 +2104,64 @@ def _make_analyze_command() -> click.Command:
             "free; and print the critical path. Reads a --trace file or a live "
             "server's /debug/trace ring. With --trend: replay the scan timeline "
             "through the regression sentinel instead."
+        ),
+    )
+
+
+def _make_fleet_status_command() -> click.Command:
+    """``krr-tpu fleet-status``: the aggregator's fleet topology census —
+    every node it has heard from (shard HELLOs, replica subscribes) with
+    health, acked-vs-current epoch lag, end-to-end freshness, and the
+    fleet_health SLO burn — fetched from a live aggregator's ``GET /fleet``."""
+
+    def callback(url: Any, fmt: str, output: Any) -> None:
+        import json
+        import urllib.error
+        import urllib.request
+
+        target = url.rstrip("/") + f"/fleet?format={fmt}"
+        try:
+            with urllib.request.urlopen(target, timeout=30) as response:
+                body = response.read().decode()
+        except (OSError, urllib.error.URLError) as e:
+            raise click.UsageError(f"cannot fetch {target}: {e}") from e
+        if fmt == "json":
+            try:
+                body = json.dumps(json.loads(body), indent=2) + "\n"
+            except json.JSONDecodeError as e:
+                raise click.UsageError(f"{target} returned non-JSON: {e}") from e
+        if output:
+            with open(output, "w") as f:
+                f.write(body)
+        else:
+            click.echo(body, nl=False)
+
+    return PanelCommand(
+        "fleet-status",
+        callback=callback,
+        params=[
+            PanelOption(
+                ["--url", "url"],
+                required=True,
+                help="Base URL of the aggregator (the serve with --federation-listen).",
+            ),
+            PanelOption(
+                ["--format", "-f", "fmt"],
+                type=click.Choice(["text", "json"]),
+                default="text",
+                show_default=True,
+                help="Census rendering: the human table or the JSON /fleet serves.",
+            ),
+            PanelOption(
+                ["--output", "-o", "output"],
+                default=None,
+                help="Write the census to this file instead of stdout.",
+            ),
+        ],
+        help=(
+            "Show the fleet topology census from a live aggregator's GET "
+            "/fleet: per-node health, acked-vs-current epoch lag, end-to-end "
+            "freshness, and the fleet_health SLO burn."
         ),
     )
 
@@ -2085,6 +2254,7 @@ def load_commands() -> None:
         app.add_command(_make_replica_command())
         app.add_command(_make_diff_command("tdigest", strategies["tdigest"]))
     app.add_command(_make_analyze_command())
+    app.add_command(_make_fleet_status_command())
     app.add_command(_make_eval_command())
 
 

@@ -174,6 +174,14 @@ SERVER_METRICS: tuple[tuple, ...] = (
     ("krr_tpu_replica_epochs_applied_total", "counter", "Epoch-feed frames installed by this replica (stale replays drop idempotently and don't count)."),
     ("krr_tpu_replica_feed_lag_seconds", "gauge", "Age of the replica's newest installed epoch against its own clock at install time (wall-vs-wall: clock skew shows up honestly)."),
     ("krr_tpu_replica_reconnects_total", "counter", "Feed connections (re-)established by a replica."),
+    # Fleet observability: end-to-end freshness lineage + topology census
+    # (the /fleet surface). Freshness buckets run far wider than request
+    # latencies — an epoch's age spans scan cadences, not milliseconds.
+    ("krr_tpu_e2e_freshness_seconds", "histogram", "Recommendation age (stage timestamp minus the epoch's newest sample timestamp) when each lineage stage finished, by stage (fold|apply|publish|install) — the end-to-end freshness chain of every published epoch.", (0.1, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 1800.0, 3600.0, 7200.0, 21600.0, 86400.0)),
+    ("krr_tpu_fleet_nodes", "gauge", "Nodes in the aggregator's fleet census, by role (aggregator|shard|replica) — everything a HELLO or feed subscription ever introduced."),
+    ("krr_tpu_fleet_epoch_lag", "gauge", "Acked-vs-current epoch lag per fleet node: how many epochs the node trails what it should hold (0 = fully caught up), by node."),
+    ("krr_tpu_fleet_node_checks_total", "counter", "Fleet census health checks: one per known node per aggregate tick — the denominator of the fleet_health SLO rollup."),
+    ("krr_tpu_fleet_node_unhealthy_total", "counter", "Fleet census health checks that found the node disconnected or stale — the fleet_health SLO rollup's error-budget burn."),
     # SLO engine (`krr_tpu.obs.health`).
     ("krr_tpu_slo_burn_rate", "gauge", "Error-budget burn rate by objective and window (fast|slow): windowed bad ratio divided by the objective's budget; 1.0 consumes exactly the budget over the window."),
     ("krr_tpu_slo_error_budget_remaining", "gauge", "Fraction of the objective's error budget left over the slow window (negative = overspent)."),

@@ -68,6 +68,12 @@ _NAME_CATEGORY = {
     # (`krr_tpu.federation.aggregator`): it IS the tick's fold leg — the
     # same WAL apply path a recovery replays — so it shares the bucket.
     "apply": "fold",
+    # Per-record replay spans under `apply` (remote-linked to the shard
+    # tick that encoded the record) — same WAL-apply work, same bucket.
+    "apply_record": "fold",
+    # A replica's epoch-feed install (decode + snapshot swap): the closest
+    # local analogue is the publish leg it mirrors from the source side.
+    "install": "publish",
     "compute": "compute",
     "pack": "compute",
     "digest": "compute",

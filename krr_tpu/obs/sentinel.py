@@ -64,11 +64,30 @@ from krr_tpu.obs.profile import CATEGORIES
 #: (milliseconds — a value band like wire_mb, not a scan-seconds band), so
 #: a cache-hit-rate collapse or render-pool saturation pages as a trend
 #: verdict instead of a mystery latency complaint from clients.
-MONITORED = tuple(c for c in CATEGORIES if c != "idle") + ("wall", "wire_mb", "read_p99_ms")
+#: The four freshness-lineage hops (federation mode): each series is the
+#: LATENCY OF ONE HOP of the epoch's end-to-end lineage chain (newest
+#: sample → shard fold → aggregator apply → publish → replica install),
+#: so a freshness regression pages with the guilty hop named instead of a
+#: generic "replica lag regressed". Value bands (seconds of pipeline AGE,
+#: not seconds of scan wall): a 300s delivery stall must not out-rank a
+#: genuine compute regression in the dominant pool.
+_FRESHNESS_HOPS = (
+    "freshness_fold",
+    "freshness_apply",
+    "freshness_publish",
+    "freshness_install",
+)
+
+MONITORED = (
+    tuple(c for c in CATEGORIES if c != "idle")
+    + ("wall", "wire_mb", "read_p99_ms")
+    + _FRESHNESS_HOPS
+)
 
 #: Value-band series (not scan-seconds): excluded from the seconds-ranked
 #: dominant pool, and rendered/reported in their own units.
 _VALUE_BANDS = {"wire_mb": "MB", "read_p99_ms": "ms"}
+_VALUE_BANDS.update({hop: "s" for hop in _FRESHNESS_HOPS})
 
 #: Transport phases whose bands refine a fetch_transport attribution.
 _PHASE_DETAIL = ("connect", "request_write", "ttfb", "body_read", "queue_wait")
@@ -94,6 +113,26 @@ SUSPECT_LAYERS = {
         "read-path p99 up → response-cache hit rate collapsed (epoch churn? "
         "filter-cardinality evictions?) or the render pool saturated — "
         "check the record's readpath hits/misses/shed split"
+    ),
+    "freshness_fold": (
+        "sample→fold hop up → the SHARD side: its scan cadence slipped or "
+        "its fetch/fold leg slowed — check the shard's scan duration and "
+        "consecutive-failure counters"
+    ),
+    "freshness_apply": (
+        "fold→apply hop up → shard→aggregator DELIVERY: unacked backlog, "
+        "reconnect churn, or aggregator backpressure — check "
+        "krr_tpu_federation_unacked_records and the aggregate tick cadence"
+    ),
+    "freshness_publish": (
+        "apply→publish hop up → the AGGREGATOR's compute/render/persist "
+        "stage between replay and snapshot swap — check the tick's "
+        "compute/persist seconds"
+    ),
+    "freshness_install": (
+        "publish→install hop up → the REPLICA leg: feed broadcast, frame "
+        "decode, or the install swap slowed (replica lag regressed) — "
+        "check krr_tpu_replica_feed_lag_seconds and /fleet epoch lag"
     ),
 }
 
@@ -189,6 +228,31 @@ class RegressionSentinel:
         readpath = record.get("readpath") or {}
         if readpath.get("requests") and readpath.get("p99_ms") is not None:
             values["read_p99_ms"] = float(readpath["p99_ms"])
+        # Freshness lineage hops — no-sample-when-absent like wire_mb: a
+        # non-federation record (or lineage off) contributes nothing, and
+        # the install hop only samples on ticks with a replica-acked epoch
+        # (acks trail the publishing tick by design).
+        lineage = record.get("lineage") or {}
+        newest = lineage.get("newest_sample_ts")
+        fold_ts = lineage.get("fold_ts")
+        apply_ts = lineage.get("apply_ts")
+        publish_ts = lineage.get("publish_ts")
+        if newest is not None and fold_ts is not None:
+            values["freshness_fold"] = max(0.0, float(fold_ts) - float(newest))
+            if apply_ts is not None:
+                values["freshness_apply"] = max(0.0, float(apply_ts) - float(fold_ts))
+                if publish_ts is not None:
+                    values["freshness_publish"] = max(
+                        0.0, float(publish_ts) - float(apply_ts)
+                    )
+        install = lineage.get("install") or {}
+        if (
+            install.get("install_ts") is not None
+            and install.get("publish_ts") is not None
+        ):
+            values["freshness_install"] = max(
+                0.0, float(install["install_ts"]) - float(install["publish_ts"])
+            )
         for phase, seconds in (record.get("phases") or {}).items():
             if phase in _PHASE_DETAIL:
                 values[f"phase_{phase}"] = float(seconds)

@@ -445,6 +445,12 @@ def build_scan_record(
         # applied records and delta wire bytes — the trendable federation
         # cost beside the apply seconds already in `categories["fold"]`.
         record["federation"] = dict(stats["federation"])
+    if "lineage" in stats:
+        # The epoch's end-to-end freshness lineage (newest sample → fold →
+        # apply → publish, plus the newest replica-acked install) — what
+        # the sentinel bands per hop so a freshness regression pages with
+        # the guilty stage named.
+        record["lineage"] = dict(stats["lineage"])
     if "readpath" in stats:
         # Read-path serving deltas for the tick window (requests / 304s /
         # cache hits / misses / sheds / bytes / p99) — the sentinel bands

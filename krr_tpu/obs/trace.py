@@ -17,6 +17,18 @@ ring (``ring_scans`` traces, oldest evicted) that ``GET /debug/trace`` and
 ``--trace FILE`` export as Chrome trace-event JSON — loadable in
 ``chrome://tracing`` and Perfetto.
 
+Cross-process stitching: a tracer may carry a ``node`` identity (shard id,
+aggregator, replica id) stamped onto every exported event, and any ROOT
+span may carry ``remote_trace_id``/``remote_parent``/``remote_node``
+attributes naming the span in ANOTHER process that caused it (the shard
+tick that produced the delta record an aggregator applies; the aggregator
+tick whose epoch a replica installs). :func:`propagation_context` builds
+the wire form of that link, :func:`link_remote_parent` applies it, and
+:func:`stitch_chrome` merges several processes' Chrome exports into ONE
+trace: remote links union traces into connected components (one stitched
+process each), every source process keeps its own non-overlapping lane
+block, and timestamps rebase onto the shared ``wall_start`` wall clock.
+
 Cost discipline: the default for every scan path is :data:`NULL_TRACER`,
 whose ``span()`` returns one shared no-op context manager — no allocation,
 no contextvar touch, no lock — so tracing is near-free when disabled. A
@@ -171,11 +183,19 @@ class Tracer(NullTracer):
 
     enabled = True
 
-    def __init__(self, ring_scans: int = 16, max_spans_per_trace: int = 4096):
+    def __init__(
+        self,
+        ring_scans: int = 16,
+        max_spans_per_trace: int = 4096,
+        node: Optional[str] = None,
+    ):
         #: perf_counter↔wall anchors taken together, so exported timestamps
         #: can be mapped to wall time.
         self.epoch_perf = time.perf_counter()
         self.epoch_wall = time.time()
+        #: Process identity stamped onto exported events (shard id,
+        #: "aggregator", replica id) — what `stitch_chrome` names lanes by.
+        self.node = node
         self._ring: "deque[list[Span]]" = deque(maxlen=max(1, ring_scans))
         self._open: dict[str, list[Span]] = {}
         self._dropped: dict[str, int] = {}
@@ -285,12 +305,15 @@ class Tracer(NullTracer):
         for pid, spans in enumerate(self.traces(n), start=1):
             if not spans:
                 continue
+            process_name = (
+                f"{self.node}:{spans[0].trace_id}" if self.node else f"{spans[0].trace_id}"
+            )
             events.append(
                 {
                     "ph": "M",
                     "pid": pid,
                     "name": "process_name",
-                    "args": {"name": f"{spans[0].trace_id}"},
+                    "args": {"name": process_name},
                 }
             )
             # Lane layout: spans sorted by (start, -end) take the first lane
@@ -313,6 +336,15 @@ class Tracer(NullTracer):
                     tid = len(lanes) - 1
                 assigned[span.span_id] = tid
             for span in spans:
+                args = {
+                    "trace_id": span.trace_id,
+                    "span_id": f"{span.span_id:x}",
+                    "parent_id": f"{span.parent_id:x}" if span.parent_id else None,
+                    "wall_start": round(self.wall_of(span), 6),
+                }
+                if self.node:
+                    args["node"] = self.node
+                args.update(span.attributes)
                 events.append(
                     {
                         "name": span.name,
@@ -322,13 +354,7 @@ class Tracer(NullTracer):
                         "dur": round(span.duration * 1e6, 3),
                         "pid": pid,
                         "tid": assigned[span.span_id],
-                        "args": {
-                            "trace_id": span.trace_id,
-                            "span_id": f"{span.span_id:x}",
-                            "parent_id": f"{span.parent_id:x}" if span.parent_id else None,
-                            "wall_start": round(self.wall_of(span), 6),
-                            **span.attributes,
-                        },
+                        "args": args,
                     }
                 )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -376,3 +402,170 @@ def traces_from_chrome(payload: dict) -> "list[list[Span]]":
         span.end = span.start + float(event.get("dur", 0.0)) / 1e6
         by_trace.setdefault((event.get("pid", 0), str(trace_id)), []).append(span)
     return [spans for _key, spans in sorted(by_trace.items(), key=lambda kv: kv[0][0])]
+
+
+# --------------------------------------------------- cross-process stitching
+def propagation_context(span, node: Optional[str] = None) -> "Optional[dict]":
+    """The wire form of a trace link: ``{trace_id, span_id[, node]}`` for a
+    live span, carried in a KRRFED1 record's ``extra`` metadata (DELTA) or
+    the epoch feed's meta JSON (EPOCH) so the receiving process can join its
+    work to this span as a remote child. None for a null span (tracing
+    disabled) — the link simply doesn't ride the wire."""
+    if getattr(span, "trace_id", None) is None:
+        return None
+    ctx = {"trace_id": span.trace_id, "span_id": f"{span.span_id:x}"}
+    if node:
+        ctx["node"] = node
+    return ctx
+
+
+def link_remote_parent(span, ctx: "Optional[dict]") -> None:
+    """Stamp a received propagation context onto a span as remote-parent
+    attributes. The span's LOCAL parentage is untouched (``parent_id`` stays
+    within its own process trace, preserving the root-close ring invariant);
+    the ``remote_*`` attributes are what `stitch_chrome` re-parents by."""
+    if not ctx or not isinstance(ctx, dict) or not ctx.get("trace_id"):
+        return
+    span.set(
+        remote_trace_id=str(ctx["trace_id"]),
+        remote_parent=str(ctx.get("span_id") or ""),
+        remote_node=str(ctx.get("node") or ""),
+    )
+
+
+def stitch_chrome(payloads: "list[dict]") -> dict:
+    """Merge Chrome trace exports from MULTIPLE processes (shards,
+    aggregator, replicas — each payload one ``/debug/trace`` body or
+    ``--trace`` file) into ONE stitched trace:
+
+    * remote links (``remote_trace_id`` on a span joining another process's
+      trace) union traces into connected components — each component
+      becomes one stitched Chrome process, so a shard tick, the aggregator
+      apply it fed, and the replica installs it produced render as one
+      causally-joined trace;
+    * every source process keeps its own block of ``tid`` lanes (offset so
+      lanes from different processes NEVER overlap), labeled with the
+      exporter's ``node`` identity;
+    * timestamps rebase onto the shared wall clock (each event's
+      ``wall_start``) relative to the component's earliest span, so
+      cross-process ordering is honest even though each tracer had its own
+      perf_counter epoch;
+    * a root span carrying ``remote_parent`` is re-parented under the named
+      remote span (``args.parent_id`` gains the stitched id, ``args.remote``
+      marks the hop), so viewers and `traces_from_chrome` see the join.
+    """
+    parent: dict[str, str] = {}
+
+    def find(x: str) -> str:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: str, b: str) -> None:
+        parent[find(a)] = find(b)
+
+    #: (source index, source pid) → one exported process's events + identity.
+    groups: dict[tuple, dict] = {}
+    owner_of_trace: dict[str, tuple] = {}
+    for source, payload in enumerate(payloads):
+        for event in (payload or {}).get("traceEvents", ()):
+            if event.get("ph") != "X":
+                continue
+            args = event.get("args") or {}
+            key = (source, event.get("pid", 0))
+            group = groups.setdefault(
+                key, {"events": [], "trace_ids": set(), "node": None, "min_wall": None}
+            )
+            group["events"].append(event)
+            trace_id = str(args.get("trace_id") or f"src{source}-pid{event.get('pid', 0)}")
+            group["trace_ids"].add(trace_id)
+            owner_of_trace.setdefault(trace_id, key)
+            if group["node"] is None and args.get("node"):
+                group["node"] = str(args["node"])
+            find(trace_id)
+            remote = args.get("remote_trace_id")
+            if remote:
+                union(trace_id, str(remote))
+            wall = args.get("wall_start")
+            if wall is not None:
+                wall = float(wall)
+                if group["min_wall"] is None or wall < group["min_wall"]:
+                    group["min_wall"] = wall
+
+    components: dict[str, list[tuple]] = {}
+    for key, group in groups.items():
+        root = find(next(iter(sorted(group["trace_ids"]))))
+        components.setdefault(root, []).append(key)
+
+    def group_start(key: tuple) -> tuple:
+        group = groups[key]
+        wall = group["min_wall"] if group["min_wall"] is not None else float("inf")
+        return (wall, key)
+
+    events: list[dict] = []
+    stitched_pid = 0
+    for _root, keys in sorted(
+        components.items(), key=lambda kv: min(group_start(k) for k in kv[1])
+    ):
+        stitched_pid += 1
+        keys = sorted(keys, key=group_start)
+        walls = [groups[k]["min_wall"] for k in keys if groups[k]["min_wall"] is not None]
+        base_wall = min(walls) if walls else None
+        nodes = sorted({groups[k]["node"] for k in keys if groups[k]["node"]})
+        label = "+".join(nodes) if nodes else _root
+        events.append(
+            {
+                "ph": "M",
+                "pid": stitched_pid,
+                "name": "process_name",
+                "args": {"name": f"fleet:{label}"},
+            }
+        )
+        tid_base = 0
+        for key in keys:
+            group = groups[key]
+            source, _pid = key
+            lane_label = group["node"] or next(iter(sorted(group["trace_ids"])))
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": stitched_pid,
+                    "tid": tid_base,
+                    "name": "thread_name",
+                    "args": {"name": lane_label},
+                }
+            )
+            max_tid = 0
+            for event in group["events"]:
+                args = dict(event.get("args") or {})
+                tid = int(event.get("tid", 0) or 0)
+                max_tid = max(max_tid, tid)
+                if args.get("span_id"):
+                    args["span_id"] = f"{source}:{args['span_id']}"
+                remote = args.get("remote_trace_id")
+                remote_parent = args.get("remote_parent")
+                if args.get("parent_id"):
+                    args["parent_id"] = f"{source}:{args['parent_id']}"
+                elif remote and remote_parent and str(remote) in owner_of_trace:
+                    remote_source = owner_of_trace[str(remote)][0]
+                    args["parent_id"] = f"{remote_source}:{remote_parent}"
+                    args["remote"] = True
+                wall = args.get("wall_start")
+                ts = event.get("ts", 0.0)
+                if wall is not None and base_wall is not None:
+                    ts = round((float(wall) - base_wall) * 1e6, 3)
+                events.append(
+                    {
+                        "name": event.get("name"),
+                        "cat": "scan",
+                        "ph": "X",
+                        "ts": ts,
+                        "dur": event.get("dur", 0.0),
+                        "pid": stitched_pid,
+                        "tid": tid_base + tid,
+                        "args": args,
+                    }
+                )
+            tid_base += max_tid + 1
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

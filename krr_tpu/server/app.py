@@ -363,6 +363,8 @@ class HttpApp:
             return await self._debug_profile(query)
         if path == "/debug/timeline":
             return await self._debug_timeline(query)
+        if path == "/fleet":
+            return await self._fleet(query)
         return 404, "application/json", _json_body({"error": f"no route for {path}"})
 
     @staticmethod
@@ -460,6 +462,62 @@ class HttpApp:
 
         content_type = "text/plain; charset=utf-8" if fmt == "text" else "application/json"
         return 200, content_type, await asyncio.to_thread(render)
+
+    async def _fleet(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
+        """The fleet topology census: every node the aggregator has heard
+        from (shard HELLOs, replica subscribes) with health, acked-vs-current
+        epoch lag, and end-to-end freshness, plus the ``fleet_health`` SLO
+        burn riding along. 404 on non-aggregator processes — the census
+        lives where the feed terminates."""
+        federation = self.state.federation
+        if federation is None or not hasattr(federation, "fleet_census"):
+            return 404, "application/json", _json_body(
+                {"error": "no fleet census on this server (not an aggregator)"}
+            )
+        fmt = (query.get("format") or ["json"])[-1]
+        if fmt not in ("json", "text"):
+            return 400, "application/json", _json_body(
+                {"error": f"unknown format {fmt!r}; one of ['json', 'text']"}
+            )
+        census = federation.fleet_census(float(self.clock()))
+        engine = self.state.slo
+        if engine is not None:
+            for objective in engine.status().get("objectives", []):
+                if objective.get("name") == "fleet_health":
+                    census["slo"] = objective
+                    break
+        if fmt == "text":
+            return 200, "text/plain; charset=utf-8", self._fleet_text(census).encode()
+        return 200, "application/json", _json_body(census)
+
+    @staticmethod
+    def _fleet_text(census: dict) -> str:
+        """The human rendering of the fleet census (``/fleet?format=text``)."""
+        lines = [
+            f"krr-tpu fleet (feed epoch {census.get('feed_epoch', 0)}, "
+            f"staleness {census.get('staleness_seconds', 0.0):g}s)"
+        ]
+        slo = census.get("slo")
+        if slo is not None:
+            burn = slo.get("burn_rate", {})
+            flag = "FIRING" if slo.get("firing") else "ok"
+            lines.append(
+                f"fleet_health SLO [{flag}]: burn fast={burn.get('fast', 0.0):g} "
+                f"slow={burn.get('slow', 0.0):g}, budget remaining "
+                f"{slo.get('error_budget_remaining', 0.0):g}"
+            )
+        lines.append("")
+        header = f"{'NODE':<24} {'ROLE':<11} {'HEALTH':<13} {'EPOCH':>7} {'LAG':>5} {'FRESH':>9}"
+        lines.append(header)
+        for node in census.get("nodes", []):
+            fresh = node.get("freshness_seconds")
+            fresh_text = "n/a" if fresh is None else f"{fresh:.1f}s"
+            lines.append(
+                f"{str(node.get('node', '?')):<24} {str(node.get('role', '?')):<11} "
+                f"{str(node.get('health', '?')):<13} {node.get('epoch', 0):>7} "
+                f"{node.get('epoch_lag', 0):>5} {fresh_text:>9}"
+            )
+        return "\n".join(lines) + "\n"
 
     async def _statusz(self, query: dict[str, list[str]]) -> tuple[int, str, bytes]:
         """The SLO engine's posture. READ-ONLY: burn rates recompute at the
@@ -1058,7 +1116,7 @@ class HttpApp:
         route_label = (
             split.path
             if split.path
-            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/debug/trace", "/debug/profile", "/debug/timeline")
+            in ("/healthz", "/metrics", "/statusz", "/recommendations", "/history", "/drift", "/fleet", "/debug/trace", "/debug/profile", "/debug/timeline")
             else "other"
         )
         self.state.metrics.inc("krr_tpu_http_requests_total", route=route_label, code=str(status))
@@ -1163,8 +1221,15 @@ class KrrServer:
         # pick up the recording tracer. An injected session that already
         # carries a recording tracer (tests pinning their own ring) is
         # respected.
+        # Node identity stamps every exported span so stitched fleet traces
+        # (`krr-tpu analyze --stitch`) can label this process's lane.
+        node_id = getattr(config, "federation_shard_id", None) or (
+            "aggregator" if getattr(config, "federation_listen", None) else "serve"
+        )
         if not self.session.tracer.enabled:
-            self.session.tracer = Tracer(ring_scans=config.trace_ring_scans)
+            self.session.tracer = Tracer(ring_scans=config.trace_ring_scans, node=node_id)
+        elif getattr(self.session.tracer, "node", None) is None:
+            self.session.tracer.node = node_id
         if state_path:
             from krr_tpu.core.durastore import DurableStore
 
@@ -1334,7 +1399,36 @@ class KrrServer:
                 clock=clock,
             )
             self.aggregator.seed(store.extra_meta.get("federation"))
+            # The aggregator's apply/ack spans land in the SERVE trace ring
+            # (one ring per process), stamped with this node's identity so
+            # stitched fleet traces keep the lanes apart.
+            self.aggregator.tracer = self.session.tracer
+            self.aggregator.node = node_id
+            self.aggregator.lineage_enabled = bool(
+                getattr(config, "federation_lineage_enabled", True)
+            )
             self.state.federation = self.aggregator
+            # Fleet-level SLO rollup: every census tick samples each node
+            # once (checks_total), unhealthy nodes burn the budget — the
+            # fleet twin of scan_regressions.
+            if self.state.slo is not None:
+                from krr_tpu.obs.health import Objective
+
+                fleet_metrics = self.session.metrics
+                self.state.slo.add_objective(
+                    Objective(
+                        name="fleet_health",
+                        description=(
+                            "Fleet nodes must stay connected and fresh: "
+                            "stale or disconnected census entries burn this budget."
+                        ),
+                        budget=0.10,
+                        sample=lambda: (
+                            float(fleet_metrics.total("krr_tpu_fleet_node_unhealthy_total")),
+                            float(fleet_metrics.total("krr_tpu_fleet_node_checks_total")),
+                        ),
+                    )
+                )
         # Tiered aggregation (`--federation-uplink`): this REGION
         # aggregator streams its own merged store's deltas to a higher-tier
         # (global) aggregator over the same shard protocol — an aggregator

@@ -736,6 +736,9 @@ class ScanScheduler:
         agg.dirty = False
         with tracer.span("apply", records=pending):
             applied, applied_bytes = await agg.apply_queued()
+        # Lineage stage 3, stamped with THIS process's clock (each hop's
+        # own clock keeps the chain monotone under pinned test clocks).
+        apply_ts = float(self.clock())
         t1 = time.perf_counter()
 
         objects = agg.fleet_objects()
@@ -782,6 +785,21 @@ class ScanScheduler:
             # failing persist withholds acks — shards keep their records
             # and the next fault-free tick's persist carries the backlog.
             await agg.flush_acks()
+        # Stamp the published epoch's lineage + trace context BEFORE the
+        # broadcast, so the feed frame carries both and the replicas'
+        # install spans/acks can join this tick. `note_epoch` is the
+        # lineage commit point: it fires the fold/apply/publish freshness
+        # histograms exactly once per epoch.
+        from krr_tpu.obs.trace import propagation_context
+
+        snapshot = self.state.peek()
+        publish_ts = float(self.clock())
+        lineage = agg.note_epoch(
+            snapshot.epoch if snapshot is not None else 0,
+            apply_ts=apply_ts,
+            publish_ts=publish_ts,
+            trace_ctx=propagation_context(scan_span, node=agg.node),
+        )
         # Push this tick's published epoch to subscribed read replicas
         # (no-op when the epoch didn't move or nothing is published yet —
         # the frame still refreshes so late subscribers catch up warm).
@@ -798,7 +816,23 @@ class ScanScheduler:
         metrics.set("krr_tpu_digest_store_rows", len(self.state.store.keys))
         metrics.set("krr_tpu_digest_store_bytes", self.state.store.nbytes)
         agg.tick_gauges(now)
+        agg.fleet_gauges(now)
         federation_stats = agg.tick_stats(now, applied)
+        # The timeline's lineage block: this epoch's hops, plus the newest
+        # REPLICA-ACKED epoch's install hop (acks land after the tick that
+        # published, so the install stage intentionally trails — the
+        # sentinel bands it against its own epoch's publish_ts).
+        timeline_lineage = dict(lineage) if lineage is not None else None
+        if timeline_lineage is not None:
+            timeline_lineage.pop("installs", None)
+            installed_record = agg.newest_installed_lineage()
+            if installed_record is not None:
+                timeline_lineage["install"] = {
+                    "epoch": installed_record.get("epoch"),
+                    "install_ts": installed_record.get("install_ts"),
+                    "publish_ts": installed_record.get("publish_ts"),
+                    "replicas": len(installed_record.get("installs") or {}),
+                }
         scan_span.set(
             kind="aggregate",
             window_end=end,
@@ -829,6 +863,8 @@ class ScanScheduler:
             ),
             "federation": federation_stats,
         }
+        if timeline_lineage is not None:
+            self.last_tick_stats["lineage"] = timeline_lineage
         self.logger.info(
             f"aggregate tick {scan_span.trace_id or ''} applied {applied} shard "
             f"record(s) ({applied_bytes} B) from "

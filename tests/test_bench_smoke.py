@@ -165,6 +165,22 @@ def test_bench_smoke_end_to_end():
     assert secondary.get("ha_primary_rps", 0) > 0, secondary
     assert secondary.get("ha_replica_rps", 0) > 0, secondary
     assert secondary.get("ha_replica_rps_ratio", 0) >= 0.9, secondary
+    # The fleet-observability leg ran end-to-end: the four processes' trace
+    # rings stitched into a causally-joined component (scan → apply_record
+    # → install), the per-epoch freshness lineage stayed monotone with all
+    # four stage histograms engaged, and lineage stamping cleared the <2%
+    # tick-wall overhead gate bit-exact vs the no-lineage control (gate
+    # failures are rc 1; assert the fields so a leg-skipping refactor
+    # can't pass silently).
+    assert secondary.get("fleet_trace_stitched") == 1.0, secondary
+    assert secondary.get("fleet_freshness_monotonic") == 1.0, secondary
+    assert secondary.get("fleet_lineage_bitexact") == 1.0, secondary
+    assert secondary.get("fleet_stitched_components", 0) >= 1, secondary
+    assert secondary.get("fleet_stitched_lanes", 0) >= 4, secondary
+    assert secondary.get("fleet_lineage_epochs", 0) >= 1, secondary
+    assert secondary.get("fleet_lineage_wall_seconds", 0) > 0, secondary
+    assert secondary.get("fleet_control_wall_seconds", 0) > 0, secondary
+    assert "fleet_lineage_overhead_seconds" in secondary, secondary
     # The read-path loadtest leg ran end-to-end: keep-alive readers hit the
     # epoch-keyed response cache at steady state (≥ 99%), conditional
     # revalidations did zero render work, pushdown stayed bit-exact, the

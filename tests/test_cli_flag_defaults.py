@@ -102,3 +102,40 @@ def test_true_default_booleans_have_an_off_switch() -> None:
                     f"{name} --{opt.name} defaults to True but has no "
                     f"--no-* secondary name"
                 )
+
+
+#: PR 19's fleet-observability surface: the debug-dump flags every
+#: long-running subcommand must carry, the lineage gate on both federation
+#: roles, and the stitch/census additions — a command dropping one of these
+#: regresses the fleet debugging story silently, so pin presence here.
+OBSERVABILITY_FLAGS = {
+    "replica": {"trace_path", "profile_path", "metrics_dump_path"},
+    "shard": {
+        "trace_path",
+        "profile_path",
+        "metrics_dump_path",
+        "federation_lineage_enabled",
+    },
+    "serve": {"trace_path", "profile_path", "federation_lineage_enabled"},
+    "analyze": {"stitch", "trace", "url"},
+    "fleet-status": {"url", "fmt", "output"},
+}
+
+
+@pytest.mark.parametrize("name", sorted(OBSERVABILITY_FLAGS))
+def test_observability_flags_present(name: str) -> None:
+    cmd = cli_main.app.commands[name]
+    have = {p.name for p in cmd.params}
+    missing = OBSERVABILITY_FLAGS[name] - have
+    assert not missing, f"{name} lost observability flags: {sorted(missing)}"
+
+
+def test_analyze_sources_repeat_for_stitch() -> None:
+    # `analyze --stitch` merges SEVERAL processes' rings: both source
+    # options must stay repeatable (multiple=True) with empty-tuple
+    # defaults, or multi-URL stitching silently degrades to last-one-wins.
+    cmd = cli_main.app.commands["analyze"]
+    by_name = {p.name: p for p in cmd.params}
+    for source in ("trace", "url"):
+        assert by_name[source].multiple, f"analyze --{source} lost multiple=True"
+        assert by_name[source].default == ()

@@ -1771,3 +1771,595 @@ class TestUplinkBackoff:
                 await server.shutdown()
 
         asyncio.run(main())
+
+
+# ------------------------------------------------------- fleet observability
+def _lineage_chain(lineage: dict) -> "list[float]":
+    """The stage timestamps of one epoch's lineage record, pipeline order."""
+    return [
+        float(lineage["newest_sample_ts"]),
+        float(lineage["fold_ts"]),
+        float(lineage["apply_ts"]),
+        float(lineage["publish_ts"]),
+    ]
+
+
+async def _start_replica(agg_port: int, clock, **overrides):
+    from krr_tpu.federation.replica import ReplicaServer
+
+    config = base_config(
+        federation_aggregator=f"127.0.0.1:{agg_port}",
+        federation_shard_id=overrides.pop("replica_id", "replica-0"),
+        federation_backoff_cap_seconds=0.2,
+        **overrides,
+    )
+    replica = ReplicaServer(config, clock=clock)
+    await replica.start()
+    return replica
+
+
+class TestFleetObservability:
+    """PR 19's tentpole: cross-process trace stitching (shard scan →
+    aggregator apply → replica install join ONE trace), end-to-end freshness
+    lineage (per-stage histograms + monotone per-epoch records), and the
+    /fleet topology census. Everything is metadata-only: the stores and
+    served bytes stay bit-exact vs a lineage-off control."""
+
+    def test_trace_join_and_stitch_e2e(self):
+        from krr_tpu.obs.trace import stitch_chrome, traces_from_chrome
+
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=71)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+            replica = None
+            try:
+                now[0] = START
+                await federated_round(server, [shard], now[0])
+                replica = await _start_replica(
+                    server.aggregator.port, lambda: now[0]
+                )
+                await wait_for(
+                    lambda: replica.state.publish_epoch == server.state.publish_epoch,
+                    message="replica to install the catch-up epoch",
+                )
+                now[0] = START + TICK
+                await federated_round(server, [shard], now[0])
+                await wait_for(
+                    lambda: replica.state.publish_epoch == 2,
+                    message="replica to follow the broadcast",
+                )
+                agg = server.aggregator
+                await wait_for(
+                    lambda: (agg._epochs.get(2) or {}).get("lineage", {}).get(
+                        "install_ts"
+                    )
+                    is not None,
+                    message="replica install ack to stamp the lineage",
+                )
+
+                # The DELTA record carried the shard tick's trace context:
+                # the aggregator's apply_record span joined it remotely.
+                shard_scans = {
+                    spans[0].trace_id for spans in shard.tracer.traces() if spans
+                }
+                assert shard_scans, "shard recorded no scan traces"
+                agg_spans = [
+                    s
+                    for spans in server.session.tracer.traces()
+                    for s in spans
+                    if s.name == "apply_record"
+                ]
+                assert agg_spans, "aggregator recorded no apply_record spans"
+                joined = {
+                    s.attributes.get("remote_trace_id") for s in agg_spans
+                }
+                assert joined & shard_scans, (joined, shard_scans)
+                # apply_record nests LOCALLY under the tick's apply span.
+                assert all(s.parent_id is not None for s in agg_spans)
+
+                # The EPOCH feed frame carried the aggregate tick's context:
+                # the replica's install span joined it remotely.
+                agg_ticks = {
+                    spans[0].trace_id
+                    for spans in server.session.tracer.traces()
+                    if spans
+                }
+                installs = [
+                    s
+                    for spans in replica.tracer.traces()
+                    for s in spans
+                    if s.name == "install"
+                ]
+                assert installs, "replica recorded no install spans"
+                assert {
+                    s.attributes.get("remote_trace_id") for s in installs
+                } & agg_ticks
+                # Node identity stamps every process's export.
+                assert shard.tracer.node == "c0"
+                assert server.session.tracer.node == "aggregator"
+                assert replica.tracer.node == "replica-0"
+
+                # Stitch the three rings: the joined chain lands in ONE
+                # stitched Chrome process, lanes never overlap.
+                payloads = [
+                    shard.tracer.export_chrome(),
+                    server.session.tracer.export_chrome(),
+                    replica.tracer.export_chrome(),
+                ]
+                stitched = stitch_chrome(payloads)
+                events = [
+                    e for e in stitched["traceEvents"] if e.get("ph") == "X"
+                ]
+                assert events
+                by_name = {}
+                for event in events:
+                    by_name.setdefault(event["name"], []).append(event)
+                assert {"scan", "apply_record", "install"} <= set(by_name)
+                # One causal component: a shard scan, the aggregator tick it
+                # fed, and the replica install share a stitched pid.
+                install_pids = {e["pid"] for e in by_name["install"]}
+                apply_pids = {e["pid"] for e in by_name["apply_record"]}
+                scan_pids = {e["pid"] for e in by_name["scan"]}
+                assert install_pids & apply_pids & scan_pids
+                # The install root was re-parented under the remote publish
+                # tick (args.remote marks the cross-process hop)...
+                remote_installs = [
+                    e for e in by_name["install"] if e["args"].get("remote")
+                ]
+                assert remote_installs
+                span_ids = {e["args"].get("span_id") for e in events}
+                for event in remote_installs:
+                    assert event["args"]["parent_id"] in span_ids
+                # ...and every stitched parent reference resolves (nesting
+                # is well-formed: traces_from_chrome round-trips it).
+                for event in events:
+                    parent = event["args"].get("parent_id")
+                    if parent is not None:
+                        assert parent in span_ids, event["name"]
+                # Lanes: each source's events keep a disjoint tid block
+                # within a stitched process.
+                for pid in install_pids & apply_pids & scan_pids:
+                    lanes = {}
+                    for event in events:
+                        if event["pid"] != pid:
+                            continue
+                        source = event["args"]["span_id"].split(":", 1)[0]
+                        lanes.setdefault(source, set()).add(event["tid"])
+                    for a in lanes:
+                        for b in lanes:
+                            if a != b:
+                                assert not (lanes[a] & lanes[b]), (a, b, lanes)
+                assert len(lanes) == 3, lanes
+                # The stitched payload parses back into span trees.
+                assert traces_from_chrome(stitched)
+            finally:
+                if replica is not None:
+                    await replica.shutdown()
+                await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_snapshot_record_carries_lineage_and_trace(self):
+        # A resync/collapse snapshot REPLACES buffered tick records — on a
+        # real first contact the uplink handshake routinely lands after
+        # tick 1 already encoded, so the generation mismatch re-syncs and
+        # the snapshot is the ONLY record the aggregator ever sees. It must
+        # re-stamp the last tick's lineage fragment and trace context, or
+        # the fleet silently loses both observability surfaces.
+        async def main():
+            from krr_tpu.core.durastore import decode_ops
+            from krr_tpu.federation.protocol import FRAME_OVERHEAD
+
+            fleet = MultiClusterFleet(clusters=1, seed=91)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            port = server.aggregator.port
+            shard = make_shard(fleet, "c0", port, lambda: now[0])
+            off = make_shard(
+                fleet, "c0", port, lambda: now[0], federation_lineage_enabled=False
+            )
+            try:
+                await shard.tick(now[0])
+                epoch, framed = shard._snapshot_record()
+                assert epoch == shard.epoch == 1
+                meta, _ops = decode_ops(framed[FRAME_OVERHEAD:])
+                extra = meta["extra"]
+                assert extra["reset"] is True and extra["kind"] == "snapshot"
+                lineage = extra["lineage"]
+                assert lineage["shard"] == "c0"
+                assert lineage["newest_sample_ts"] <= lineage["fold_ts"]
+                assert extra["trace"]["node"] == "c0"
+                assert extra["trace"]["trace_id"]
+
+                # Lineage off: the snapshot stays unstamped (no lineage key),
+                # like every other record that shard emits.
+                await off.tick(now[0])
+                _epoch2, framed2 = off._snapshot_record()
+                meta2, _ = decode_ops(framed2[FRAME_OVERHEAD:])
+                assert "lineage" not in meta2["extra"]
+
+                # Before any tick there is nothing to say — and nothing to
+                # stamp (no fabricated lineage at epoch 0).
+                fresh = make_shard(fleet, "c0", port, lambda: now[0])
+                try:
+                    assert fresh._snapshot_record() is None
+                finally:
+                    await fresh.close()
+            finally:
+                await off.close()
+                await shard.close()
+                await server.shutdown()
+
+        asyncio.run(main())
+
+    def test_lineage_monotonic_survives_restart_and_takeover(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=73)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            agg_port = server.aggregator.port
+            shard = make_shard(fleet, "c0", agg_port, lambda: now[0])
+            replica = None
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await federated_round(server, [shard], now[0])
+                replica = await _start_replica(agg_port, lambda: now[0])
+                await wait_for(
+                    lambda: replica.state.publish_epoch == 2,
+                    message="replica catch-up",
+                )
+                agg = server.aggregator
+                await wait_for(
+                    lambda: (agg._epochs.get(2) or {})
+                    .get("lineage", {})
+                    .get("install_ts")
+                    is not None,
+                    message="install ack on epoch 2",
+                )
+                for lineage in agg.epoch_lineage(2):
+                    chain = _lineage_chain(lineage)
+                    assert chain == sorted(chain), lineage
+                installed = agg.newest_installed_lineage()
+                # The install hop is stamped by the REPLICA's clock and must
+                # not precede its epoch's publish.
+                assert installed["install_ts"] >= installed["publish_ts"]
+
+                # Aggregator restart on the same port: watermarks recover,
+                # lineage memory starts fresh, and the chain stays monotone
+                # for every post-restart epoch.
+                await server.shutdown()
+                restarted_config = base_config(
+                    federation_listen=f"127.0.0.1:{agg_port}"
+                )
+                server = KrrServer(
+                    restarted_config,
+                    session=ScanSession(
+                        restarted_config,
+                        inventory=FleetInventory(fleet, clusters=[]),
+                        history_factory=history_factory(fleet),
+                        logger=restarted_config.create_logger(),
+                    ),
+                    clock=lambda: now[0],
+                )
+                await server.start(run_scheduler=False)
+                shard2 = make_shard(fleet, "c0", agg_port, lambda: now[0])
+                try:
+                    for t in (2, 3):
+                        now[0] = START + t * TICK
+                        await federated_round(server, [shard2], now[0])
+                    agg = server.aggregator
+                    # The restarted aggregator's epochs restart at 1 (fresh
+                    # in-memory store), so the replica DROPS its catch-up
+                    # frames as stale replays — the heal asserted here is
+                    # the re-subscription itself (install acks resume once
+                    # the epoch counter passes the replica's watermark).
+                    await wait_for(
+                        lambda: replica.client.reconnects >= 2
+                        and replica.client.connected,
+                        message="replica to re-subscribe after restart",
+                        timeout=15.0,
+                    )
+                    records = agg.epoch_lineage(4)
+                    assert records, "no lineage after restart"
+                    for lineage in records:
+                        chain = _lineage_chain(lineage)
+                        assert chain == sorted(chain), lineage
+                finally:
+                    await shard2.close()
+            finally:
+                if replica is not None:
+                    await replica.shutdown()
+                await shard.close()
+                await server.shutdown()
+
+            # Standby takeover: an HA ring pair receives the same records;
+            # after the primary dies the SURVIVOR's lineage records stay
+            # monotone — the property holds across the failover boundary.
+            now = [START]
+            primary = aggregator_server(fleet, lambda: now[0])
+            standby = aggregator_server(fleet, lambda: now[0])
+            await primary.start(run_scheduler=False)
+            await standby.start(run_scheduler=False)
+            ring_spec = (
+                f"a=127.0.0.1:{primary.aggregator.port}"
+                f"|127.0.0.1:{standby.aggregator.port}"
+            )
+            ring_shard = make_ring_shard(fleet, "c0", ring_spec, lambda: now[0])
+            by_port = {
+                primary.aggregator.port: primary,
+                standby.aggregator.port: standby,
+            }
+            try:
+                for t in range(2):
+                    now[0] = START + t * TICK
+                    await ring_round(by_port, [ring_shard], now[0])
+                await primary.shutdown()
+                agg_s = standby.aggregator
+                stream = "c0/a"
+                for t in (2, 3):
+                    now[0] = START + t * TICK
+                    await ring_shard.tick(now[0])
+                    await wait_for(
+                        lambda: agg_s._shards[stream].enqueued >= ring_shard.epoch,
+                        message="standby to enqueue post-failover epochs",
+                    )
+                    await standby.scheduler.run_once()
+                records = agg_s.epoch_lineage(4)
+                assert records, "standby recorded no lineage"
+                for lineage in records:
+                    chain = _lineage_chain(lineage)
+                    assert chain == sorted(chain), lineage
+            finally:
+                await ring_shard.close()
+                await standby.shutdown()
+                with contextlib.suppress(Exception):
+                    await primary.shutdown()
+
+        asyncio.run(main())
+
+    def test_freshness_histograms_and_fleet_route(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=79)
+            now = [START]
+            server = aggregator_server(fleet, lambda: now[0])
+            await server.start(run_scheduler=False)
+            shard = make_shard(fleet, "c0", server.aggregator.port, lambda: now[0])
+            replica = None
+            try:
+                now[0] = START
+                await federated_round(server, [shard], now[0])
+                replica = await _start_replica(
+                    server.aggregator.port, lambda: now[0]
+                )
+                await wait_for(
+                    lambda: replica.state.publish_epoch == 1,
+                    message="replica catch-up",
+                )
+                now[0] = START + TICK
+                await federated_round(server, [shard], now[0])
+                agg = server.aggregator
+                await wait_for(
+                    lambda: (agg._epochs.get(2) or {})
+                    .get("lineage", {})
+                    .get("install_ts")
+                    is not None,
+                    message="install ack on epoch 2",
+                )
+                # Every stage's histogram populated on the aggregator...
+                metrics = server.state.metrics
+                for stage in ("fold", "apply", "publish", "install"):
+                    count = metrics.value(
+                        "krr_tpu_e2e_freshness_seconds_count", stage=stage
+                    )
+                    assert count and count >= 1.0, stage
+                # ...and the whole chain on ONE replica scrape (the frame
+                # carries the upstream stages; install is its own clock).
+                for stage in ("fold", "apply", "publish", "install"):
+                    count = replica.metrics.value(
+                        "krr_tpu_e2e_freshness_seconds_count", stage=stage
+                    )
+                    assert count and count >= 1.0, f"replica {stage}"
+                # Satellite: the replica /metrics exposition carries build
+                # info + process self-metrics like serve does.
+                status, _headers, body = await _raw_get(replica.port, "/metrics")
+                text = body.decode()
+                assert status == 200
+                assert "krr_tpu_build_info{" in text
+                assert "krr_tpu_process_resident_bytes" in text
+                assert 'krr_tpu_e2e_freshness_seconds_count{stage="install"}' in text
+
+                # The /statusz federation block carries the newest epoch's
+                # lineage record.
+                status, _headers, body = await _raw_get(server.port, "/statusz")
+                lineage = json.loads(body)["federation"]["lineage"]
+                assert lineage["epoch"] == 2
+                chain = _lineage_chain(lineage)
+                assert chain == sorted(chain)
+                # The timeline record carries the same block per tick.
+                status, _headers, body = await _raw_get(
+                    server.port, "/debug/timeline?n=1"
+                )
+                record = json.loads(body)["records"][-1]
+                assert record["lineage"]["epoch"] == 2
+
+                # GET /fleet: the census lists aggregator + shard + replica
+                # with lag and health; the fleet SLO burn rides along.
+                now[0] = START + TICK + 1.0
+                status, _headers, body = await _raw_get(server.port, "/fleet")
+                assert status == 200
+                census = json.loads(body)
+                assert census["feed_epoch"] == 2
+                nodes = {entry["node"]: entry for entry in census["nodes"]}
+                assert nodes["aggregator"]["role"] == "aggregator"
+                assert nodes["c0"]["role"] == "shard"
+                assert nodes["replica-0"]["role"] == "replica"
+                for entry in nodes.values():
+                    assert entry["health"] == "ok", entry
+                    assert entry["epoch_lag"] == 0, entry
+                assert nodes["aggregator"]["freshness_seconds"] is not None
+                assert census["slo"]["name"] == "fleet_health"
+                # Text rendering + the gauges the census refreshes.
+                status, headers, body = await _raw_get(
+                    server.port, "/fleet?format=text"
+                )
+                assert status == 200 and "text/plain" in headers["content-type"]
+                text = body.decode()
+                assert "NODE" in text and "replica-0" in text and "c0" in text
+                assert metrics.value("krr_tpu_fleet_nodes", role="shard") == 1.0
+                # The lag gauge snapshots at TICK time — the replica's
+                # install ack lands after the tick that published, so its
+                # tick-time lag is honest at >= 0 (the live census above
+                # already showed 0).
+                assert (
+                    metrics.value("krr_tpu_fleet_epoch_lag", node="replica-0")
+                    is not None
+                )
+                assert metrics.total("krr_tpu_fleet_node_checks_total") >= 3.0
+                # The fleet SLO objective samples the census counters.
+                engine_status = server.state.slo.status(now[0])
+                names = [o["name"] for o in engine_status["objectives"]]
+                assert "fleet_health" in names
+
+                # A dead replica pages as disconnected with its lag named.
+                await replica.shutdown()
+                replica = None
+                await wait_for(
+                    lambda: not any(
+                        c.get("connected")
+                        for c in agg._replica_census.values()
+                    ),
+                    message="census to notice the replica died",
+                )
+                now[0] = START + 2 * TICK
+                await federated_round(server, [shard], now[0])
+                status, _headers, body = await _raw_get(server.port, "/fleet")
+                nodes = {
+                    entry["node"]: entry for entry in json.loads(body)["nodes"]
+                }
+                assert nodes["replica-0"]["health"] == "disconnected"
+                assert nodes["replica-0"]["epoch_lag"] >= 1
+                assert metrics.total("krr_tpu_fleet_node_unhealthy_total") >= 1.0
+
+            finally:
+                if replica is not None:
+                    await replica.shutdown()
+                await shard.close()
+                await server.shutdown()
+
+        async def fleet_404():
+            fleet = MultiClusterFleet(clusters=1, seed=79)
+            now = [START]
+            control = control_server(fleet, lambda: now[0])
+            await control.start(run_scheduler=False)
+            try:
+                status, _headers, body = await _raw_get(control.port, "/fleet")
+                assert status == 404, body
+            finally:
+                await control.shutdown()
+
+        asyncio.run(main())
+        asyncio.run(fleet_404())
+
+    def test_lineage_off_is_bitexact_and_unstamped(self):
+        async def main():
+            fleet = MultiClusterFleet(clusters=1, seed=83)
+            stores = {}
+            bodies = {}
+            for lineage_on in (True, False):
+                now = [START]
+                server = aggregator_server(
+                    fleet, lambda: now[0], federation_lineage_enabled=lineage_on
+                )
+                await server.start(run_scheduler=False)
+                shard = make_shard(
+                    fleet,
+                    "c0",
+                    server.aggregator.port,
+                    lambda: now[0],
+                    federation_lineage_enabled=lineage_on,
+                )
+                try:
+                    for t in range(2):
+                        now[0] = START + t * TICK
+                        await federated_round(server, [shard], now[0])
+                    stores[lineage_on] = server.state.store
+                    bodies[lineage_on] = server.state.peek().body_json
+                    if lineage_on:
+                        assert server.aggregator.epoch_lineage(1)
+                    else:
+                        assert not server.aggregator.epoch_lineage(1)
+                        assert server.state.metrics.value(
+                            "krr_tpu_e2e_freshness_seconds_count", stage="fold"
+                        ) is None
+                finally:
+                    await shard.close()
+                    await server.shutdown()
+            equal, detail = stores_bitexact_by_key(stores[True], stores[False])
+            assert equal, detail
+            assert bodies[True] == bodies[False]
+
+        asyncio.run(main())
+
+    def test_sentinel_names_guilty_freshness_hop(self):
+        from krr_tpu.obs.sentinel import RegressionSentinel
+
+        def record(i: int, install_delta: float = 2.0) -> dict:
+            base = 1_000_000.0 + i * 300.0
+            return {
+                "v": 1,
+                "ts": base,
+                "scan_id": f"scan-{i}",
+                "kind": "aggregate",
+                "wall": 1.0,
+                "categories": {
+                    "fetch_transport": 0.0,
+                    "fetch_decode": 0.0,
+                    "fetch_backoff": 0.0,
+                    "fetch_other": 0.0,
+                    "fold": 0.4,
+                    "compute": 0.4,
+                    "discover": 0.0,
+                    "publish": 0.2,
+                    "other": 0.0,
+                    "idle": 0.0,
+                },
+                "rows": 8,
+                "failed_rows": 0,
+                "stale_workloads": 0,
+                "lineage": {
+                    "epoch": i + 1,
+                    "newest_sample_ts": base - 300.0,
+                    "fold_ts": base - 295.0,
+                    "apply_ts": base - 290.0,
+                    "publish_ts": base - 288.0,
+                    "install": {
+                        "epoch": i,
+                        "publish_ts": base - 588.0,
+                        "install_ts": base - 588.0 + install_delta,
+                        "replicas": 1,
+                    },
+                },
+            }
+
+        sentinel = RegressionSentinel(warmup_scans=4)
+        rng = np.random.default_rng(5)
+        for i in range(12):
+            verdict = sentinel.observe(
+                record(i, install_delta=2.0 * float(1.0 + rng.normal(0, 0.04))),
+                fire=False,
+            )
+            assert verdict["status"] in ("warming", "nominal"), verdict
+        # The replica install hop stalls: the verdict pages with the
+        # REPLICA leg named, not a generic "freshness regressed".
+        verdict = sentinel.observe(record(12, install_delta=240.0), fire=False)
+        assert verdict["status"] == "regressed"
+        assert verdict["dominant"] == "freshness_install"
+        assert verdict["excess_unit"] == "s"
+        assert "REPLICA" in verdict["suspect"]
